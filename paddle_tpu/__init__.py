@@ -1,0 +1,86 @@
+"""paddle_tpu: a TPU-native deep-learning framework with PaddlePaddle's
+capability surface, built from scratch on JAX/XLA/Pallas.
+
+See SURVEY.md for the capability map against the reference
+(/root/reference, liuyunly/Paddle) and the layer-by-layer design stance.
+"""
+from __future__ import annotations
+
+import jax as _jax
+
+# int64/float64 parity with the reference's default dtypes. Creation ops and
+# nn initializers still default to float32; the TPU compute path uses
+# bf16/f32 explicitly.
+_jax.config.update("jax_enable_x64", True)
+# f32 matmul precision ~ the reference's cuBLAS TF32 default on Ampere;
+# bf16 tensors are unaffected. Override with JAX_DEFAULT_MATMUL_PRECISION.
+import os as _os
+
+if "JAX_DEFAULT_MATMUL_PRECISION" not in _os.environ:
+    _jax.config.update("jax_default_matmul_precision", "tensorfloat32")
+
+__version__ = "0.1.0"
+
+from .core import (  # noqa: F401,E402
+    CPUPlace,
+    Parameter,
+    Place,
+    Tensor,
+    TPUPlace,
+    device_count,
+    enable_grad,
+    get_device,
+    grad,
+    is_grad_enabled,
+    no_grad,
+    set_device,
+    set_grad_enabled,
+    to_tensor,
+)
+from .core.dtype import (  # noqa: F401,E402
+    bfloat16,
+    bool_,
+    complex64,
+    complex128,
+    float16,
+    float32,
+    float64,
+    get_default_dtype,
+    int8,
+    int16,
+    int32,
+    int64,
+    set_default_dtype,
+    uint8,
+)
+from .framework.random import seed  # noqa: F401,E402
+from .ops import *  # noqa: F401,F403,E402
+from .ops import __all__ as _ops_all
+from . import autograd  # noqa: F401,E402
+
+# subpackages filled in progressively (static, jit, amp, distributed, ...)
+from . import nn  # noqa: F401,E402
+from . import optimizer  # noqa: F401,E402
+from . import io  # noqa: F401,E402
+from . import metric  # noqa: F401,E402
+from . import vision  # noqa: F401,E402
+from . import hapi  # noqa: F401,E402
+from .hapi import Model  # noqa: F401,E402
+from .framework.io import load, save  # noqa: F401,E402
+
+bool = bool_  # paddle.bool
+
+__all__ = [
+    "Tensor",
+    "Parameter",
+    "to_tensor",
+    "no_grad",
+    "enable_grad",
+    "grad",
+    "seed",
+    "set_device",
+    "get_device",
+    "device_count",
+    "set_default_dtype",
+    "get_default_dtype",
+] + list(_ops_all)

@@ -1,0 +1,110 @@
+"""paddle.autograd parity surface: backward, grad, PyLayer, hooks.
+
+PyLayer (custom autograd op — the reference implements it over the eager
+GradNode machinery, /root/reference/python/paddle/autograd/py_layer.py)
+records a tape node whose vjp calls the user's ``backward``. The functional
+equivalent for jitted code is ``jax.custom_vjp`` — see
+``paddle_tpu.incubate.primapi``.
+"""
+from __future__ import annotations
+
+from .core.autograd import (  # noqa: F401
+    GradNode,
+    backward,
+    enable_grad,
+    grad,
+    is_grad_enabled,
+    no_grad,
+    set_grad_enabled,
+)
+from .core.dtype import is_floating
+from .core.tensor import Tensor
+
+__all__ = [
+    "backward",
+    "grad",
+    "no_grad",
+    "enable_grad",
+    "is_grad_enabled",
+    "set_grad_enabled",
+    "PyLayer",
+    "PyLayerContext",
+]
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.__dict__["_attrs"] = {}
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    def saved_tensor(self):
+        return self._saved
+
+
+class PyLayer:
+    """Subclass with ``forward(ctx, *args)`` / ``backward(ctx, *grads)``."""
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from .core.autograd import _recording
+
+        ctx = PyLayerContext()
+        tensor_args = [a for a in args if isinstance(a, Tensor)]
+        diff_inputs = [
+            t for t in tensor_args if not t.stop_gradient and is_floating(t.dtype)
+        ]
+        record = _recording() and bool(diff_inputs)
+
+        with no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+
+        if not record:
+            return outputs
+
+        single = not isinstance(outputs, (tuple, list))
+        out_list = [outputs] if single else list(outputs)
+        out_avals = [(tuple(o.shape), o.dtype) for o in out_list]
+        diff_set = {id(t) for t in diff_inputs}
+
+        def vjp_fn(cots):
+            cot_list = [cots] if single else list(cots)
+            cot_tensors = tuple(
+                Tensor._wrap(c, stop_gradient=True) for c in cot_list
+            )
+            with no_grad():
+                grads = cls.backward(ctx, *cot_tensors)
+            if not isinstance(grads, (tuple, list)):
+                grads = (grads,)
+            # user's backward returns one grad per tensor input of forward;
+            # keep only those for diff inputs, in order
+            out = []
+            gi = iter(grads)
+            for t in tensor_args:
+                g = next(gi, None)
+                if id(t) in diff_set:
+                    out.append(None if g is None else (g._value if isinstance(g, Tensor) else g))
+            return tuple(out)
+
+        node = GradNode(cls.__name__, vjp_fn, diff_inputs, out_avals)
+        wrapped = [
+            Tensor._wrap(o._value, stop_gradient=False, node=node, output_index=i)
+            if is_floating(o.dtype)
+            else o
+            for i, o in enumerate(out_list)
+        ]
+        return wrapped[0] if single else tuple(wrapped)
+
+
+def saved_tensors_hooks(*a, **k):  # placeholder parity shim
+    raise NotImplementedError("saved_tensors_hooks is not supported yet")

@@ -1,0 +1,12 @@
+from . import autograd, device, dispatch, dtype, tensor  # noqa: F401
+from .autograd import enable_grad, grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401
+from .device import (  # noqa: F401
+    CPUPlace,
+    Place,
+    TPUPlace,
+    device_count,
+    get_device,
+    get_place,
+    set_device,
+)
+from .tensor import Parameter, Tensor, to_tensor  # noqa: F401

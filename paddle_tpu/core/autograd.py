@@ -1,0 +1,301 @@
+"""Eager autograd engine.
+
+Replaces the reference's dygraph autograd stack — per-tensor ``AutogradMeta``
+pointing at a ``GradNodeBase`` DAG with a reverse in-degree sweep
+(/root/reference/paddle/fluid/eager/grad_node_info.h:168,
+ /root/reference/paddle/fluid/eager/backward.cc:104,421) — with a tape of
+``jax.vjp`` closures: every eager op that touches a differentiable input
+records one ``GradNode`` holding the op's vjp function. ``backward()`` walks
+the node graph in reverse topological order, accumulating cotangents
+(the reference's ``GradTensorHolder`` role) and depositing leaf grads
+(the reference's ``GradNodeAccumulation`` role).
+
+The hot training path does NOT use this tape: ``paddle_tpu`` modules are pure
+functions over their state_dict pytrees, so jitted train steps use
+``jax.grad`` directly (see nn/functional_call). The tape exists for API parity
+(``loss.backward()``, ``paddle.grad``, hooks, ``PyLayer``) and debugging.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import numpy as np
+
+__all__ = [
+    "GradNode",
+    "no_grad",
+    "enable_grad",
+    "is_grad_enabled",
+    "set_grad_enabled",
+    "backward",
+    "grad",
+]
+
+_state = threading.local()
+
+
+def is_grad_enabled() -> bool:
+    return getattr(_state, "grad_enabled", True)
+
+
+def set_grad_enabled(mode: bool):
+    _state.grad_enabled = bool(mode)
+
+
+class no_grad(contextlib.ContextDecorator):
+    """Context manager / decorator disabling tape recording (paddle.no_grad)."""
+
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        set_grad_enabled(False)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+
+class enable_grad(contextlib.ContextDecorator):
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        set_grad_enabled(True)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+
+# --------------------------------------------------------------------------
+# pure mode: inside functional tracing (jit/grad over module state) the tape
+# must stay off so tracers never leak into persistent GradNodes.
+# --------------------------------------------------------------------------
+
+
+def in_pure_mode() -> bool:
+    return getattr(_state, "pure_depth", 0) > 0
+
+
+@contextlib.contextmanager
+def pure_mode():
+    _state.pure_depth = getattr(_state, "pure_depth", 0) + 1
+    try:
+        yield
+    finally:
+        _state.pure_depth -= 1
+
+
+def _recording() -> bool:
+    return is_grad_enabled() and not in_pure_mode()
+
+
+class GradNode:
+    """One recorded op: vjp closure + references to its differentiable inputs.
+
+    ``inputs[i]`` is the Tensor supplying the i-th vjp argument (the
+    reference's Edge + TensorWrapper in one), ``out_avals`` the
+    (shape, dtype) of each forward output so missing cotangents can be
+    zero-filled (multi-output ops where only some outputs are used).
+    """
+
+    __slots__ = ("name", "vjp_fn", "inputs", "out_avals", "n_outputs")
+
+    def __init__(self, name, vjp_fn, inputs, out_avals):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs
+        self.out_avals = out_avals
+        self.n_outputs = len(out_avals)
+
+    def __repr__(self):
+        return f"GradNode({self.name}, n_in={len(self.inputs)}, n_out={self.n_outputs})"
+
+
+def _zero_cotangent(aval):
+    shape, dtype = aval
+    if np.issubdtype(np.dtype(dtype), np.inexact):
+        import jax.numpy as jnp
+
+        return jnp.zeros(shape, dtype)
+    # integer/bool outputs take float0 cotangents in jax's vjp convention
+    return np.zeros(shape, jax.dtypes.float0)
+
+
+def _toposort(seed_nodes):
+    """Iterative DFS post-order over the node graph (reverse = backward order)."""
+    order, visited = [], set()
+    stack = [(n, False) for n in seed_nodes]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        stack.append((node, True))
+        for t in node.inputs:
+            producer = t._grad_node
+            if producer is not None and id(producer) not in visited:
+                stack.append((producer, False))
+    return order  # post-order: process reversed(order)... actually reversed below
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """Run reverse accumulation from ``tensors`` and fill leaf ``.grad``.
+
+    Mirrors ``egr::Backward`` (/root/reference/paddle/fluid/eager/backward.cc:421).
+    """
+    from .tensor import Tensor
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+
+    _run_backward(tensors, grad_tensors, retain_graph, wanted=None)
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph=None,
+    create_graph=False,
+    allow_unused=False,
+    no_grad_vars=None,
+):
+    """``paddle.grad``: return grads of ``outputs`` w.r.t. ``inputs`` without
+    touching ``.grad`` (the reference's ``GeneralGrad`` path)."""
+    from .tensor import Tensor
+
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+    if retain_graph is None:
+        retain_graph = create_graph
+
+    wanted = {id(t): None for t in inputs}
+    _run_backward(
+        outputs, grad_outputs, retain_graph, wanted=wanted, write_leaf_grads=False
+    )
+    results = []
+    for t in inputs:
+        cot = wanted[id(t)]
+        if cot is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "an input tensor received no gradient; pass allow_unused=True "
+                    "to return None for unused inputs"
+                )
+            results.append(None)
+        else:
+            results.append(Tensor._wrap(cot, stop_gradient=True))
+    return results
+
+
+def _run_backward(tensors, grad_tensors, retain_graph, wanted=None, write_leaf_grads=True):
+    import jax.numpy as jnp
+
+    from .tensor import Tensor
+
+    # cotangents pending per node: id(node) -> [cot or None per output]
+    pending: dict[int, list] = {}
+    node_by_id: dict[int, GradNode] = {}
+    seeds = []
+
+    def _seed(t, g):
+        if t._grad_node is None:
+            # leaf with no graph: grad is just the seed
+            _deposit(t, g)
+            return
+        # an output that is also a requested input gets the seed directly
+        # (d y / d y = seed), in addition to propagating into the graph
+        if wanted is not None and id(t) in wanted:
+            prev = wanted[id(t)]
+            wanted[id(t)] = g if prev is None else prev + g
+        node = t._grad_node
+        node_by_id[id(node)] = node
+        slot = pending.setdefault(id(node), [None] * node.n_outputs)
+        idx = t._output_index
+        slot[idx] = g if slot[idx] is None else slot[idx] + g
+        seeds.append(node)
+
+    def _apply_hooks(t, cot):
+        for hook in t._grad_hooks:
+            new = hook(Tensor._wrap(cot, stop_gradient=True))
+            if new is not None:
+                cot = new._value if isinstance(new, Tensor) else new
+        return cot
+
+    def _deposit(t, cot):
+        cot = _apply_hooks(t, cot)
+        if wanted is not None and id(t) in wanted:
+            prev = wanted[id(t)]
+            wanted[id(t)] = cot if prev is None else prev + cot
+        if write_leaf_grads and not t.stop_gradient and (
+            t._grad_node is None or t._retain_grad
+        ):
+            if t._grad is None:
+                t._grad = cot
+            else:
+                t._grad = t._grad + cot
+
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient and t._grad_node is None:
+            continue
+        if g is None:
+            # paddle parity: non-scalar backward seeds with ones
+            # (/root/reference/python/paddle/fluid/dygraph/tensor_patch_methods.py:230)
+            gval = jnp.ones(t.shape, t._value.dtype)
+        else:
+            gval = g._value if isinstance(g, Tensor) else jnp.asarray(g)
+        _seed(t, gval)
+
+    if not seeds:
+        return
+
+    order = _toposort(seeds)
+    # post-order DFS: dependencies (producers) appear before consumers, so
+    # process in reverse (consumers first) for reverse-mode accumulation.
+    for node in reversed(order):
+        cots = pending.pop(id(node), None)
+        if cots is None:
+            continue
+        full = tuple(
+            c if c is not None else _zero_cotangent(node.out_avals[i])
+            for i, c in enumerate(cots)
+        )
+        if node.n_outputs == 1:
+            in_cots = node.vjp_fn(full[0])
+        else:
+            in_cots = node.vjp_fn(full)
+        for t, cot in zip(node.inputs, in_cots):
+            if cot is None:
+                continue
+            producer = t._grad_node
+            if producer is not None:
+                cot = _apply_hooks(t, cot)
+                slot = pending.setdefault(id(producer), [None] * producer.n_outputs)
+                idx = t._output_index
+                slot[idx] = cot if slot[idx] is None else slot[idx] + cot
+                if t._retain_grad or (wanted is not None and id(t) in wanted):
+                    if wanted is not None and id(t) in wanted:
+                        prev = wanted[id(t)]
+                        wanted[id(t)] = cot if prev is None else prev + cot
+                    if write_leaf_grads and t._retain_grad and not t.stop_gradient:
+                        t._grad = cot if t._grad is None else t._grad + cot
+            else:
+                _deposit(t, cot)
+        if not retain_graph:
+            node.vjp_fn = None
+            node.inputs = ()

@@ -1,0 +1,96 @@
+"""Eager op dispatch.
+
+The reference routes every eager op through generated per-op plumbing:
+Python-C shim → dygraph forward (records a hand-generated GradNode class) →
+PHI kernel dispatch on (backend, layout, dtype)
+(/root/reference/paddle/fluid/eager/auto_code_generator/generator/eager_gen.py:1160,
+ /root/reference/paddle/phi/api/lib/kernel_dispatch.h:179).
+
+On TPU all of that collapses into one generic ``apply``: the op body is a
+jax-traceable function; XLA is the single backend so there is no kernel-key
+selection; the GradNode is the op's ``jax.vjp`` closure recorded by the
+autograd tape (core/autograd.py); InferMeta (shape/dtype inference) is jax
+abstract evaluation, which happens for free inside tracing.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax import tree_util
+
+from .autograd import GradNode, _recording
+from .dtype import is_floating
+from .tensor import Tensor
+
+__all__ = ["apply"]
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def apply(fn, *args, op_name="op", **kwargs):
+    """Run ``fn`` eagerly with Tensor args unwrapped to arrays, recording a
+    GradNode when any float input requires grad.
+
+    ``fn`` receives raw jax arrays wherever Tensors were passed (anywhere in
+    ``args``/``kwargs``, nested in lists/tuples/dicts) and must return a jax
+    array or a tuple of jax arrays.
+    """
+    leaves, treedef = tree_util.tree_flatten((args, kwargs), is_leaf=_is_tensor)
+    tensor_pos = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
+
+    record = _recording() and any(
+        not leaves[i].stop_gradient and is_floating(leaves[i]._value.dtype)
+        for i in tensor_pos
+    )
+
+    if not record:
+        vals = [l._value if isinstance(l, Tensor) else l for l in leaves]
+        a, k = tree_util.tree_unflatten(treedef, vals)
+        out = fn(*a, **k)
+        return _wrap_outputs(out, node=None)
+
+    diff_pos = [
+        i
+        for i in tensor_pos
+        if not leaves[i].stop_gradient and is_floating(leaves[i]._value.dtype)
+    ]
+    diff_set = set(diff_pos)
+    diff_tensors = [leaves[i] for i in diff_pos]
+
+    def pure(*diff_vals):
+        it = iter(diff_vals)
+        vals = [
+            next(it)
+            if i in diff_set
+            else (l._value if isinstance(l, Tensor) else l)
+            for i, l in enumerate(leaves)
+        ]
+        a, k = tree_util.tree_unflatten(treedef, vals)
+        return fn(*a, **k)
+
+    out, vjp_fn = jax.vjp(pure, *(t._value for t in diff_tensors))
+    out_list = list(out) if isinstance(out, (tuple, list)) else [out]
+    node = GradNode(
+        op_name,
+        vjp_fn,
+        diff_tensors,
+        [(o.shape, np.dtype(o.dtype)) for o in out_list],
+    )
+    return _wrap_outputs(out, node=node)
+
+
+def _wrap_outputs(out, node):
+    if isinstance(out, (tuple, list)):
+        wrapped = tuple(
+            _wrap_one(o, node, i) for i, o in enumerate(out)
+        )
+        return wrapped
+    return _wrap_one(out, node, 0)
+
+
+def _wrap_one(o, node, idx):
+    if node is not None and is_floating(o.dtype):
+        return Tensor._wrap(o, stop_gradient=False, node=node, output_index=idx)
+    return Tensor._wrap(o, stop_gradient=True, output_index=idx)

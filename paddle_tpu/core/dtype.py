@@ -1,0 +1,96 @@
+"""Dtype system.
+
+Mirrors the role of the reference's ``phi::DataType`` axis of the kernel key
+(/root/reference/paddle/phi/common/data_type.h) but maps directly onto numpy /
+jax dtypes: on TPU there is no separate dtype enum to dispatch on — XLA carries
+the element type. We keep paddle-style string names ("float32", "bfloat16", …)
+as the canonical user-facing spelling.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype table: paddle name -> numpy/jax dtype
+_NAME_TO_DTYPE = {
+    "bool": jnp.bool_,
+    "uint8": jnp.uint8,
+    "int8": jnp.int8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "complex64": jnp.complex64,
+    "complex128": jnp.complex128,
+}
+
+_DTYPE_TO_NAME = {np.dtype(v): k for k, v in _NAME_TO_DTYPE.items()}
+
+# paddle-style module-level dtype constants (paddle.float32 etc.)
+bool_ = np.dtype("bool")
+uint8 = np.dtype("uint8")
+int8 = np.dtype("int8")
+int16 = np.dtype("int16")
+int32 = np.dtype("int32")
+int64 = np.dtype("int64")
+float16 = np.dtype("float16")
+bfloat16 = np.dtype(jnp.bfloat16)
+float32 = np.dtype("float32")
+float64 = np.dtype("float64")
+complex64 = np.dtype("complex64")
+complex128 = np.dtype("complex128")
+
+_default_float_dtype = "float32"
+
+
+def set_default_dtype(d) -> None:
+    """Set default float dtype used for python-float / float-list creation."""
+    global _default_float_dtype
+    name = dtype_name(d)
+    if name not in ("float16", "bfloat16", "float32", "float64"):
+        raise ValueError(f"default dtype must be a float dtype, got {name}")
+    _default_float_dtype = name
+
+
+def get_default_dtype() -> str:
+    return _default_float_dtype
+
+
+def convert_dtype(d):
+    """Normalize any dtype spelling (str, np.dtype, jnp type, Tensor.dtype) to np.dtype."""
+    if d is None:
+        return None
+    if isinstance(d, str):
+        if d not in _NAME_TO_DTYPE:
+            raise ValueError(f"unknown dtype {d!r}")
+        return np.dtype(_NAME_TO_DTYPE[d])
+    return np.dtype(d)
+
+
+def dtype_name(d) -> str:
+    """Canonical paddle-style name of a dtype."""
+    nd = convert_dtype(d)
+    try:
+        return _DTYPE_TO_NAME[nd]
+    except KeyError:
+        return nd.name
+
+
+def is_floating(d) -> bool:
+    nd = convert_dtype(d)
+    return nd is not None and (
+        np.issubdtype(nd, np.floating) or nd == np.dtype(jnp.bfloat16)
+    )
+
+
+def is_integer(d) -> bool:
+    nd = convert_dtype(d)
+    return nd is not None and np.issubdtype(nd, np.integer)
+
+
+def is_complex(d) -> bool:
+    nd = convert_dtype(d)
+    return nd is not None and np.issubdtype(nd, np.complexfloating)

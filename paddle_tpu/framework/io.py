@@ -1,0 +1,67 @@
+"""paddle.save / paddle.load
+(reference: /root/reference/python/paddle/framework/io.py:646,888 — pickled
+nested state_dicts of numpy-converted tensors). Same wire idea: nested
+containers with Tensors converted to numpy, pickled. Orbax handles the
+sharded/async checkpoint path (paddle_tpu.distributed.checkpoint)."""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["save", "load"]
+
+_MAGIC = b"PDTPU1\n"
+
+
+def _to_numpy_tree(obj):
+    if isinstance(obj, Tensor):
+        return _TensorLeaf(np.asarray(obj._value), stop_gradient=obj.stop_gradient)
+    if isinstance(obj, dict):
+        return {k: _to_numpy_tree(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_numpy_tree(v) for v in obj)
+    return obj
+
+
+def _from_numpy_tree(obj, return_numpy=False):
+    if isinstance(obj, _TensorLeaf):
+        if return_numpy:
+            return obj.array
+        t = Tensor(obj.array)
+        t.stop_gradient = obj.stop_gradient
+        return t
+    if isinstance(obj, dict):
+        return {k: _from_numpy_tree(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_numpy_tree(v, return_numpy) for v in obj)
+    return obj
+
+
+class _TensorLeaf:
+    __slots__ = ("array", "stop_gradient")
+
+    def __init__(self, array, stop_gradient=True):
+        self.array = array
+        self.stop_gradient = stop_gradient
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(_MAGIC)
+        pickle.dump(_to_numpy_tree(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        head = f.read(len(_MAGIC))
+        if head != _MAGIC:
+            f.seek(0)
+        obj = pickle.load(f)
+    return _from_numpy_tree(obj, return_numpy=return_numpy)

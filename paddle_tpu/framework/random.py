@@ -1,0 +1,59 @@
+"""Global RNG state.
+
+The reference keeps one ``phi::Generator`` per device with seed control
+(/root/reference/paddle/phi/core/generator.h) plus a distributed RNG-state
+tracker for TP determinism. Here the state is a JAX PRNG key:
+
+- eager ops split the global key statefully (paddle.seed reproducibility);
+- under jit/functional tracing an ``rng_scope`` supplies a (possibly traced)
+  base key; call sites derive keys with ``fold_in(base, counter)`` where the
+  counter advances at trace time — one deterministic stream per call site,
+  varying per step through the traced base key (the idiomatic-JAX replacement
+  for a mutable generator inside a compiled program).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+_state = threading.local()
+_global = {"seed": 0, "key": jax.random.PRNGKey(0)}
+
+
+def seed(s: int):
+    """paddle.seed: reset the global generator."""
+    _global["seed"] = int(s)
+    _global["key"] = jax.random.PRNGKey(int(s))
+    return _global["key"]
+
+
+def get_cuda_rng_state():  # parity shim
+    return [_global["key"]]
+
+
+@contextlib.contextmanager
+def rng_scope(key):
+    """Install a base key for functional tracing (jit/grad)."""
+    prev = getattr(_state, "scope", None)
+    _state.scope = {"key": key, "counter": 0}
+    try:
+        yield
+    finally:
+        _state.scope = prev
+
+
+def next_key():
+    """Get a fresh PRNG key: stateful split in eager, fold_in under a scope."""
+    scope = getattr(_state, "scope", None)
+    if scope is not None:
+        k = jax.random.fold_in(scope["key"], scope["counter"])
+        scope["counter"] += 1
+        return k
+    _global["key"], sub = jax.random.split(_global["key"])
+    return sub
+
+
+def default_seed() -> int:
+    return _global["seed"]

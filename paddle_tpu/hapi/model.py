@@ -1,0 +1,369 @@
+"""paddle.Model: the high-level train/eval/predict loop
+(reference: /root/reference/python/paddle/hapi/model.py — fit:1741,
+DynamicGraphAdapter.train_batch:817).
+
+TPU-first: instead of the reference's per-op dygraph adapter, the train step
+is ONE jitted pure function over (params, buffers, opt_state) with buffer
+donation — the whole model+loss+optimizer fuses into a single XLA program per
+batch shape. Callbacks/metrics run on host around it, matching hapi semantics.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.autograd import no_grad, pure_mode
+from ..core.tensor import Tensor
+from ..framework import io as fio
+from ..framework import random as frandom
+from ..nn.layer import functional_state
+from . import callbacks as cbks
+
+__all__ = ["Model"]
+
+
+def _to_np(x):
+    if isinstance(x, Tensor):
+        return np.asarray(x._value)
+    return np.asarray(x)
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _pure_loss(loss_fn, outputs, labels):
+    """Run a loss Layer/callable on raw arrays inside a traced context."""
+    wrapped_out = [Tensor._wrap(o) for o in outputs]
+    wrapped_lbl = [Tensor._wrap(l) for l in labels]
+    with pure_mode(), no_grad():
+        loss = loss_fn(*wrapped_out, *wrapped_lbl)
+    if isinstance(loss, (list, tuple)):
+        total = loss[0]._value
+        for l in loss[1:]:
+            total = total + l._value
+        return total
+    return loss._value
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self.stop_training = False
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._train_step_fn = None
+        self._eval_step_fn = None
+        self._predict_step_fn = None
+        self._amp_dtype = None
+        self._opt_state = None
+
+    # ------------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _as_list(metrics)
+        if isinstance(amp_configs, str):
+            if amp_configs in ("O1", "O2"):
+                self._amp_dtype = jnp.bfloat16
+        elif isinstance(amp_configs, dict) and amp_configs.get("level") in ("O1", "O2"):
+            self._amp_dtype = jnp.bfloat16
+        self._train_step_fn = None
+        self._eval_step_fn = None
+        self._predict_step_fn = None
+        self._opt_state = None  # drop any previous optimizer's accumulators
+
+    # -- jitted steps ---------------------------------------------------
+    def _build_train_step(self):
+        net, loss_fn, opt = self.network, self._loss, self._optimizer
+        amp_dtype = self._amp_dtype
+
+        def step(params, buffers, opt_state, lr, rng, inputs, labels):
+            from ..nn.layer import functional_call
+
+            def loss_of(p):
+                cast_in = [
+                    i.astype(amp_dtype) if amp_dtype is not None and
+                    jnp.issubdtype(i.dtype, jnp.floating) else i
+                    for i in inputs
+                ]
+                outs, new_buf = functional_call(
+                    net, p, buffers, *cast_in, rng=rng, training=True)
+                outs = outs if isinstance(outs, (list, tuple)) else [outs]
+                outs = [o.astype(jnp.float32) if amp_dtype is not None and
+                        jnp.issubdtype(o.dtype, jnp.floating) else o for o in outs]
+                loss = _pure_loss(loss_fn, outs, labels)
+                return loss, (outs, new_buf)
+
+            (loss, (outs, new_buf)), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(params)
+            new_params, new_opt = opt.apply_gradients(params, grads, opt_state, lr)
+            return loss, list(outs), new_buf, new_params, new_opt
+
+        return jax.jit(step, donate_argnums=(0, 2))
+
+    def _build_eval_step(self):
+        net, loss_fn = self.network, self._loss
+
+        def step(params, buffers, inputs, labels):
+            from ..nn.layer import functional_call
+
+            outs, _ = functional_call(net, params, buffers, *inputs, training=False)
+            outs = outs if isinstance(outs, (list, tuple)) else [outs]
+            loss = _pure_loss(loss_fn, outs, labels) if loss_fn is not None else jnp.zeros(())
+            return loss, list(outs)
+
+        return jax.jit(step)
+
+    def _build_predict_step(self):
+        net = self.network
+
+        def step(params, buffers, inputs):
+            from ..nn.layer import functional_call
+
+            outs, _ = functional_call(net, params, buffers, *inputs, training=False)
+            return list(outs) if isinstance(outs, (list, tuple)) else [outs]
+
+        return jax.jit(step)
+
+    # -- state sync -----------------------------------------------------
+    def _get_state(self):
+        params, buffers = functional_state(self.network)
+        return params, buffers
+
+    def _set_state(self, params, buffers):
+        named_p = dict(self.network.named_parameters())
+        for k, v in params.items():
+            named_p[k]._value = v
+        named_b = dict(self.network.named_buffers())
+        for k, v in buffers.items():
+            named_b[k]._value = v
+
+    def _opt_state_tree(self, params):
+        if self._opt_state is None:
+            self._opt_state = self._optimizer.init_state_tree(params)
+        return self._opt_state
+
+    # -- public batch APIs ----------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        if self._train_step_fn is None:
+            self._train_step_fn = self._build_train_step()
+        inputs = [_to_np(i) for i in _as_list(inputs)]
+        labels = [_to_np(l) for l in _as_list(labels)]
+        params, buffers = self._get_state()
+        opt_state = self._opt_state_tree(params)
+        lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
+        rng = jax.random.fold_in(
+            jax.random.PRNGKey(frandom.default_seed()),
+            self._optimizer._step_count,
+        )
+        loss, outs, new_buf, new_params, new_opt = self._train_step_fn(
+            params, buffers, opt_state, lr, rng, inputs, labels)
+        self._set_state(new_params, new_buf)
+        self._opt_state = new_opt
+        self._optimizer._step_count += 1
+        metrics_out = self._update_metrics(outs, labels)
+        return [float(np.asarray(loss))], metrics_out
+
+    def eval_batch(self, inputs, labels=None):
+        if self._eval_step_fn is None:
+            self._eval_step_fn = self._build_eval_step()
+        inputs = [_to_np(i) for i in _as_list(inputs)]
+        labels = [_to_np(l) for l in _as_list(labels)]
+        params, buffers = self._get_state()
+        loss, outs = self._eval_step_fn(params, buffers, inputs, labels)
+        metrics_out = self._update_metrics(outs, labels)
+        return [float(np.asarray(loss))], metrics_out
+
+    def predict_batch(self, inputs):
+        if self._predict_step_fn is None:
+            self._predict_step_fn = self._build_predict_step()
+        inputs = [_to_np(i) for i in _as_list(inputs)]
+        params, buffers = self._get_state()
+        outs = self._predict_step_fn(params, buffers, inputs)
+        return [np.asarray(o) for o in outs]
+
+    def _update_metrics(self, outs, labels):
+        results = []
+        for m in self._metrics:
+            pre = m.compute(Tensor(np.asarray(outs[0])), Tensor(np.asarray(labels[0])) if labels else None)
+            if isinstance(pre, (list, tuple)):
+                r = m.update(*[_to_np(p) for p in pre])
+            else:
+                r = m.update(_to_np(pre))
+            results.append(r)
+        return results
+
+    # -- loops ----------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        from ..io import DataLoader, Dataset
+
+        if isinstance(train_data, Dataset):
+            train_loader = DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
+                                      drop_last=drop_last, num_workers=num_workers)
+        else:
+            train_loader = train_data
+        if eval_data is not None and isinstance(eval_data, Dataset):
+            eval_loader = DataLoader(eval_data, batch_size=batch_size, num_workers=num_workers)
+        else:
+            eval_loader = eval_data
+
+        cb_list = cbks.CallbackList([cbks.History()] + _as_list(callbacks))
+        if verbose:
+            cb_list.append(cbks.ProgBarLogger(log_freq, verbose=verbose))
+        if save_dir:
+            cb_list.append(cbks.ModelCheckpoint(save_freq, save_dir))
+        if self._optimizer is not None and self._optimizer._lr_scheduler is not None:
+            cb_list.append(cbks.LRScheduler())
+        cb_list.set_model(self)
+        try:
+            steps = len(train_loader)
+        except TypeError:
+            steps = None
+        cb_list.set_params({"epochs": epochs, "steps": steps, "verbose": verbose})
+
+        self.stop_training = False
+        cb_list.on_train_begin()
+        iters_done = 0
+        for epoch in range(epochs):
+            cb_list.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                cb_list.on_train_batch_begin(step)
+                inputs, labels = self._split_batch(batch)
+                loss, metrics = self.train_batch(inputs, labels)
+                logs = self._make_logs(loss, metrics)
+                cb_list.on_train_batch_end(step, logs)
+                iters_done += 1
+                if num_iters is not None and iters_done >= num_iters:
+                    self.stop_training = True
+                    break
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self._run_eval(eval_loader, cb_list)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+            cb_list.on_epoch_end(epoch, logs)
+            if self.stop_training:
+                break
+        cb_list.on_train_end(logs)
+        history = next(c for c in cb_list.callbacks if isinstance(c, cbks.History))
+        return history
+
+    def _run_eval(self, eval_loader, cb_list=None):
+        for m in self._metrics:
+            m.reset()
+        if cb_list is not None:
+            cb_list.on_eval_begin()
+        losses = []
+        logs = {}
+        for step, batch in enumerate(eval_loader):
+            inputs, labels = self._split_batch(batch)
+            loss, metrics = self.eval_batch(inputs, labels)
+            losses.append(loss[0])
+            logs = self._make_logs([np.mean(losses)], metrics)
+        if cb_list is not None:
+            cb_list.on_eval_end(logs)
+        return logs
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        from ..io import DataLoader, Dataset
+
+        if isinstance(eval_data, Dataset):
+            eval_loader = DataLoader(eval_data, batch_size=batch_size, num_workers=num_workers)
+        else:
+            eval_loader = eval_data
+        logs = self._run_eval(eval_loader)
+        if verbose:
+            print("Eval:", logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        from ..io import DataLoader, Dataset
+
+        if isinstance(test_data, Dataset):
+            loader = DataLoader(test_data, batch_size=batch_size, num_workers=num_workers)
+        else:
+            loader = test_data
+        outputs = []
+        for batch in loader:
+            inputs, _ = self._split_batch(batch, has_labels=False)
+            outs = self.predict_batch(inputs)
+            outputs.append(outs)
+        n_out = len(outputs[0])
+        grouped = [[b[i] for b in outputs] for i in range(n_out)]
+        if stack_outputs:
+            grouped = [np.concatenate(g, axis=0) for g in grouped]
+        return grouped
+
+    def _forward_arity(self):
+        import inspect
+
+        try:
+            sig = inspect.signature(self.network.forward)
+            n = 0
+            for p in sig.parameters.values():
+                if p.kind in (p.VAR_POSITIONAL, p.VAR_KEYWORD):
+                    return None
+                if p.default is p.empty:
+                    n += 1
+            return n
+        except (TypeError, ValueError):
+            return None
+
+    def _split_batch(self, batch, has_labels=True):
+        if isinstance(batch, (list, tuple)):
+            batch = list(batch)
+            if has_labels and len(batch) >= 2:
+                return batch[:-1], batch[-1:]
+            if not has_labels and len(batch) >= 2:
+                # predict on a (inputs..., label) dataset: keep only as many
+                # leading items as the network's forward takes
+                n = self._forward_arity()
+                if n is not None and n < len(batch):
+                    return batch[:n], []
+            return batch, []
+        return [batch], []
+
+    def _make_logs(self, loss, metrics):
+        logs = {"loss": loss}
+        for m, r in zip(self._metrics, metrics):
+            names = m.name()
+            if isinstance(names, list):
+                logs.update(dict(zip(names, np.atleast_1d(r))))
+            else:
+                logs[names] = r
+        return logs
+
+    # -- persistence ----------------------------------------------------
+    def save(self, path, training=True):
+        fio.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            fio.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        state = fio.load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        import os
+
+        if not reset_optimizer and self._optimizer is not None and os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(fio.load(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        total = sum(p.size for p in self.network.parameters())
+        trainable = sum(p.size for p in self.network.parameters() if p.trainable)
+        print(f"Total params: {total}")
+        print(f"Trainable params: {trainable}")
+        return {"total_params": total, "trainable_params": trainable}

@@ -1,0 +1,30 @@
+"""paddle.nn parity surface (reference: /root/reference/python/paddle/nn/)."""
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .layer import (  # noqa: F401
+    Layer,
+    LayerList,
+    ParameterList,
+    Sequential,
+    functional_call,
+    functional_state,
+)
+from .layers.activation import *  # noqa: F401,F403
+from .layers.common import *  # noqa: F401,F403
+from .layers.conv import *  # noqa: F401,F403
+from .layers.loss import *  # noqa: F401,F403
+from .layers.norm import *  # noqa: F401,F403
+from .layers.pooling import *  # noqa: F401,F403
+
+from .layers import activation as _act
+from .layers import common as _common
+from .layers import conv as _conv
+from .layers import loss as _loss
+from .layers import norm as _norm
+from .layers import pooling as _pooling
+
+__all__ = (
+    ["Layer", "LayerList", "Sequential", "ParameterList", "functional", "initializer"]
+    + _act.__all__ + _common.__all__ + _conv.__all__
+    + _loss.__all__ + _norm.__all__ + _pooling.__all__
+)

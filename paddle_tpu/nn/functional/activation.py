@@ -1,0 +1,167 @@
+"""Activation functionals (paddle.nn.functional.activation parity,
+/root/reference/python/paddle/nn/functional/activation.py). Bodies are
+jax.nn / jnp compositions — XLA fuses them into surrounding matmuls on TPU."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply
+from ...ops.registry import defop
+
+__all__ = [
+    "relu", "relu6", "relu_", "elu", "selu", "celu", "gelu", "sigmoid",
+    "log_sigmoid", "tanh", "softmax", "log_softmax", "leaky_relu", "prelu",
+    "rrelu", "silu", "swish", "mish", "hardswish", "hardsigmoid", "hardtanh",
+    "hardshrink", "softshrink", "tanhshrink", "thresholded_relu", "softplus",
+    "softsign", "maxout", "glu", "gumbel_softmax", "one_hot",
+]
+
+relu = defop("relu")(lambda x: jax.nn.relu(x))
+relu6 = defop("relu6")(lambda x: jnp.clip(x, 0, 6))
+sigmoid = defop("sigmoid")(lambda x: jax.nn.sigmoid(x))
+log_sigmoid = defop("log_sigmoid")(lambda x: jax.nn.log_sigmoid(x))
+tanh = defop("tanh_act")(lambda x: jnp.tanh(x))
+silu = defop("silu")(lambda x: jax.nn.silu(x))
+softsign = defop("softsign")(lambda x: jax.nn.soft_sign(x))
+mish = defop("mish")(lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+tanhshrink = defop("tanhshrink")(lambda x: x - jnp.tanh(x))
+
+
+def relu_(x):
+    out = relu(x)
+    x._value = out._value
+    return x
+
+
+@defop("elu")
+def elu(x, alpha=1.0):
+    return jax.nn.elu(x, alpha)
+
+
+@defop("selu")
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+@defop("celu")
+def celu(x, alpha=1.0):
+    return jax.nn.celu(x, alpha)
+
+
+@defop("gelu")
+def gelu(x, approximate=False):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+@defop("softmax")
+def softmax(x, axis=-1, dtype=None):
+    if dtype is not None:
+        from ...core.dtype import convert_dtype
+
+        x = x.astype(convert_dtype(dtype))
+    return jax.nn.softmax(x, axis=int(axis))
+
+
+@defop("log_softmax")
+def log_softmax(x, axis=-1, dtype=None):
+    if dtype is not None:
+        from ...core.dtype import convert_dtype
+
+        x = x.astype(convert_dtype(dtype))
+    return jax.nn.log_softmax(x, axis=int(axis))
+
+
+@defop("leaky_relu")
+def leaky_relu(x, negative_slope=0.01):
+    return jax.nn.leaky_relu(x, negative_slope)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def body(v, w):
+        if w.size == 1:
+            return jnp.where(v >= 0, v, w.reshape(()) * v)
+        shape = [1] * v.ndim
+        ch_axis = 1 if data_format[1] == "C" else v.ndim - 1
+        shape[ch_axis] = w.size
+        return jnp.where(v >= 0, v, w.reshape(shape) * v)
+
+    return apply(body, x, weight, op_name="prelu")
+
+
+@defop("rrelu")
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True):
+    # eval-mode deterministic slope (training sampling handled by layer)
+    slope = (lower + upper) / 2.0
+    return jnp.where(x >= 0, x, slope * x)
+
+
+@defop("swish")
+def swish(x):
+    return jax.nn.silu(x)
+
+
+@defop("hardswish")
+def hardswish(x):
+    return x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
+
+
+@defop("hardsigmoid")
+def hardsigmoid(x, slope=1.0 / 6.0, offset=0.5):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+@defop("hardtanh")
+def hardtanh(x, min=-1.0, max=1.0):
+    return jnp.clip(x, min, max)
+
+
+@defop("hardshrink")
+def hardshrink(x, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+@defop("softshrink")
+def softshrink(x, threshold=0.5):
+    return jnp.where(x > threshold, x - threshold, jnp.where(x < -threshold, x + threshold, 0.0))
+
+
+@defop("thresholded_relu")
+def thresholded_relu(x, threshold=1.0, value=0.0):
+    return jnp.where(x > threshold, x, value)
+
+
+@defop("softplus")
+def softplus(x, beta=1.0, threshold=20.0):
+    return jnp.where(x * beta > threshold, x, jax.nn.softplus(x * beta) / beta)
+
+
+@defop("maxout")
+def maxout(x, groups, axis=1):
+    ax = int(axis)
+
+    def reshape_max(v):
+        shp = list(v.shape)
+        c = shp[ax]
+        shp[ax : ax + 1] = [c // groups, groups]
+        return jnp.max(v.reshape(shp), axis=ax + 1)
+
+    return reshape_max(x)
+
+
+@defop("glu")
+def glu(x, axis=-1):
+    a, b = jnp.split(x, 2, axis=int(axis))
+    return a * jax.nn.sigmoid(b)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...ops.random import gumbel_softmax as _gs
+
+    return _gs(x, temperature=temperature, hard=hard, axis=axis)
+
+
+@defop("one_hot")
+def one_hot(x, num_classes):
+    n = int(num_classes)
+    return jax.nn.one_hot(x.astype(jnp.int32), n, dtype=jnp.float32)

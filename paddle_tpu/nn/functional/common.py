@@ -1,0 +1,243 @@
+"""Common functionals: linear, dropout, embedding, pad, normalize, interpolate
+(parity: /root/reference/python/paddle/nn/functional/common.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply
+from ...core.tensor import Tensor
+from ...framework.random import next_key
+from ...ops.manipulation import pad  # noqa: F401  (re-exported)
+
+__all__ = [
+    "linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout", "embedding",
+    "pad", "normalize", "cosine_similarity", "interpolate", "upsample",
+    "pixel_shuffle", "pixel_unshuffle", "channel_shuffle", "unfold", "fold",
+    "bilinear", "label_smooth",
+]
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b with paddle's [in, out] weight layout — one MXU matmul."""
+    if bias is None:
+        return apply(lambda v, w: jnp.matmul(v, w), x, weight, op_name="linear")
+    return apply(lambda v, w, b: jnp.matmul(v, w) + b, x, weight, bias, op_name="linear")
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return apply(lambda v: v * (1.0 - p), x, op_name="dropout_infer")
+        return x
+    key = next_key()
+
+    def body(v):
+        shape = list(v.shape)
+        if axis is not None:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            shape = [s if i in [a % v.ndim for a in axes] else 1 for i, s in enumerate(v.shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, v / (1.0 - p), 0.0).astype(v.dtype)
+        return jnp.where(keep, v, 0.0).astype(v.dtype)
+
+    return apply(body, x, op_name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ch_axis = 1 if data_format == "NCHW" else 3
+    return dropout(x, p=p, axis=[0, ch_axis], training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ch_axis = 1 if data_format == "NCDHW" else 4
+    return dropout(x, p=p, axis=[0, ch_axis], training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    key = next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def body(v):
+        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        a = (1.0 / ((1.0 - p) * (1.0 + p * alpha_p**2)) ** 0.5)
+        b = -a * alpha_p * p
+        return (a * jnp.where(keep, v, alpha_p) + b).astype(v.dtype)
+
+    return apply(body, x, op_name="alpha_dropout")
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    def body(ids, w):
+        out = jnp.take(w, ids.astype(jnp.int32), axis=0)
+        if padding_idx is not None:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+
+    return apply(body, x, weight, op_name="embedding")
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def body(v):
+        nrm = jnp.power(jnp.sum(jnp.power(jnp.abs(v), p), axis=axis, keepdims=True), 1.0 / p)
+        return v / jnp.maximum(nrm, epsilon)
+
+    return apply(body, x, op_name="normalize")
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def body(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        den = jnp.sqrt(jnp.sum(a * a, axis=axis) * jnp.sum(b * b, axis=axis))
+        return num / jnp.maximum(den, eps)
+
+    return apply(body, x1, x2, op_name="cosine_similarity")
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    def body(v):
+        if data_format in ("NCHW", "NCDHW", "NCL", "NCW"):
+            spatial = v.shape[2:]
+            if size is not None:
+                out_size = tuple(int(s) for s in (size if isinstance(size, (list, tuple)) else [size]))
+            else:
+                sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * len(spatial)
+                out_size = tuple(int(round(s * f)) for s, f in zip(spatial, sf))
+            new_shape = v.shape[:2] + out_size
+            method = {"nearest": "nearest", "bilinear": "linear", "trilinear": "linear",
+                      "bicubic": "cubic", "linear": "linear", "area": "linear"}[mode]
+            return jax.image.resize(v, new_shape, method=method)
+        raise NotImplementedError(f"interpolate data_format {data_format}")
+
+    return apply(body, x, op_name="interpolate")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = int(upscale_factor)
+
+    def body(v):
+        n, c, h, w = v.shape
+        v = v.reshape(n, c // (r * r), r, r, h, w)
+        v = v.transpose(0, 1, 4, 2, 5, 3)
+        return v.reshape(n, c // (r * r), h * r, w * r)
+
+    return apply(body, x, op_name="pixel_shuffle")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = int(downscale_factor)
+
+    def body(v):
+        n, c, h, w = v.shape
+        v = v.reshape(n, c, h // r, r, w // r, r)
+        v = v.transpose(0, 1, 3, 5, 2, 4)
+        return v.reshape(n, c * r * r, h // r, w // r)
+
+    return apply(body, x, op_name="pixel_unshuffle")
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    g = int(groups)
+
+    def body(v):
+        n, c, h, w = v.shape
+        v = v.reshape(n, g, c // g, h, w)
+        v = v.transpose(0, 2, 1, 3, 4)
+        return v.reshape(n, c, h, w)
+
+    return apply(body, x, op_name="channel_shuffle")
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+
+    def body(v):
+        n, c = v.shape[:2]
+        v = jnp.pad(v, [(0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])])
+        h, w = v.shape[2:]
+        oh = (h - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (w - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        patches = []
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                di, dj = i * dl[0], j * dl[1]
+                patches.append(
+                    v[:, :, di : di + oh * st[0] : st[0], dj : dj + ow * st[1] : st[1]]
+                )
+        out = jnp.stack(patches, axis=2)  # N, C, K*K, OH, OW
+        return out.reshape(n, c * ks[0] * ks[1], oh * ow)
+
+    return apply(body, x, op_name="unfold")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    os_ = output_sizes if isinstance(output_sizes, (list, tuple)) else [output_sizes] * 2
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+
+    def body(v):
+        n = v.shape[0]
+        c = v.shape[1] // (ks[0] * ks[1])
+        h, w = os_[0] + 2 * pd[0], os_[1] + 2 * pd[1]
+        oh = (h - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (w - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        v5 = v.reshape(n, c, ks[0] * ks[1], oh, ow)
+        out = jnp.zeros((n, c, h, w), v.dtype)
+        idx = 0
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                di, dj = i * dl[0], j * dl[1]
+                out = out.at[:, :, di : di + oh * st[0] : st[0], dj : dj + ow * st[1] : st[1]].add(
+                    v5[:, :, idx]
+                )
+                idx += 1
+        return out[:, :, pd[0] : h - pd[0] or None, pd[1] : w - pd[1] or None]
+
+    return apply(body, x, op_name="fold")
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def body(a, b, w, bb=None):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bb is not None:
+            out = out + bb
+        return out
+
+    if bias is None:
+        return apply(body, x1, x2, weight, op_name="bilinear")
+    return apply(lambda a, b, w, bb: body(a, b, w, bb), x1, x2, weight, bias, op_name="bilinear")
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def body(lbl, prior=None):
+        k = lbl.shape[-1]
+        if prior is None:
+            return (1.0 - epsilon) * lbl + epsilon / k
+        return (1.0 - epsilon) * lbl + epsilon * prior
+
+    if prior_dist is None:
+        return apply(body, label, op_name="label_smooth")
+    return apply(body, label, prior_dist, op_name="label_smooth")
+
+
+def class_center_sample(*a, **k):
+    raise NotImplementedError
+
+
+def _tensor(x):
+    return x if isinstance(x, Tensor) else Tensor(x)

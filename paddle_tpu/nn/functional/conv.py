@@ -1,0 +1,137 @@
+"""Convolution functionals over ``lax.conv_general_dilated``
+(parity: /root/reference/python/paddle/nn/functional/conv.py; the reference
+dispatches to cuDNN — on TPU XLA lowers convs straight onto the MXU)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.dispatch import apply
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose", "conv2d_transpose", "conv3d_transpose"]
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _padding(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    return [tuple(p) for p in padding]
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n, data_format):
+    channels_last = data_format in ("NHWC", "NLC", "NDHWC", "NWC")
+    spatial = "DHW"[3 - n :]
+    if channels_last:
+        lhs_spec = "N" + spatial + "C"
+    else:
+        lhs_spec = "NC" + spatial
+    rhs_spec = "OI" + spatial
+    out_spec = lhs_spec
+    dn = lax.conv_dimension_numbers((1,) * (n + 2), (1,) * (n + 2), (lhs_spec, rhs_spec, out_spec))
+
+    st = _tuple(stride, n)
+    dl = _tuple(dilation, n)
+    pad_cfg = _padding(padding, n)
+
+    def body(v, w, b=None):
+        out = lax.conv_general_dilated(
+            v, w, window_strides=st, padding=pad_cfg,
+            rhs_dilation=dl, dimension_numbers=dn, feature_group_count=groups,
+        )
+        if b is not None:
+            shape = [1] * out.ndim
+            shape[1 if not channels_last else -1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    if bias is None:
+        return apply(body, x, weight, op_name=f"conv{n}d")
+    return apply(body, x, weight, bias, op_name=f"conv{n}d")
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1, data_format)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, n, data_format):
+    channels_last = data_format in ("NHWC", "NLC", "NDHWC", "NWC")
+    spatial = "DHW"[3 - n :]
+    lhs_spec = ("N" + spatial + "C") if channels_last else ("NC" + spatial)
+    # paddle transpose-conv weight layout: [in_c, out_c/groups, *k]
+    rhs_spec = "IO" + spatial
+    dn = lax.conv_dimension_numbers((1,) * (n + 2), (1,) * (n + 2), (lhs_spec, rhs_spec, lhs_spec))
+
+    st = _tuple(stride, n)
+    dl = _tuple(dilation, n)
+    op = _tuple(output_padding, n) if output_padding else (0,) * n
+
+    def body(v, w, b=None):
+        k_spatial = w.shape[2:]
+        if isinstance(padding, str):
+            cfg = padding.upper()
+        else:
+            pads = _padding(padding, n)
+            cfg = [
+                (dl[i] * (k_spatial[i] - 1) - pads[i][0],
+                 dl[i] * (k_spatial[i] - 1) - pads[i][1] + op[i])
+                for i in range(n)
+            ]
+        if groups > 1:
+            # grouped transpose conv: split and concat along channel axis
+            ch_axis = -1 if channels_last else 1
+            v_groups = jnp.split(v, groups, axis=ch_axis)
+            w_groups = jnp.split(w, groups, axis=0)
+            outs = [
+                lax.conv_general_dilated(
+                    vg, wg, window_strides=(1,) * n, padding=cfg,
+                    lhs_dilation=st, rhs_dilation=dl, dimension_numbers=dn,
+                )
+                for vg, wg in zip(v_groups, w_groups)
+            ]
+            out = jnp.concatenate(outs, axis=ch_axis)
+        else:
+            out = lax.conv_general_dilated(
+                v, w, window_strides=(1,) * n, padding=cfg,
+                lhs_dilation=st, rhs_dilation=dl, dimension_numbers=dn,
+            )
+        if b is not None:
+            shape = [1] * out.ndim
+            shape[1 if not channels_last else -1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    if bias is None:
+        return apply(body, x, weight, op_name=f"conv{n}d_transpose")
+    return apply(body, x, weight, bias, op_name=f"conv{n}d_transpose")
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, 1, data_format)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, 2, data_format)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, 3, data_format)

@@ -1,0 +1,298 @@
+"""Loss functionals (parity: /root/reference/python/paddle/nn/functional/loss.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply
+from ...core.tensor import Tensor
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "mse_loss", "l1_loss",
+    "nll_loss", "binary_cross_entropy", "binary_cross_entropy_with_logits",
+    "kl_div", "smooth_l1_loss", "margin_ranking_loss", "hinge_embedding_loss",
+    "cosine_embedding_loss", "triplet_margin_loss", "log_loss", "square_error_cost",
+    "sigmoid_focal_loss", "dice_loss", "ctc_loss",
+]
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0, name=None):
+    def body(logits, lbl, w=None):
+        ax = int(axis) % logits.ndim
+        logp = jax.nn.log_softmax(logits, axis=ax) if use_softmax else jnp.log(
+            jnp.maximum(logits, 1e-30)
+        )
+        n_classes = logits.shape[ax]
+        if soft_label or (lbl.ndim == logits.ndim and lbl.shape == logits.shape):
+            soft = lbl
+            if label_smoothing:
+                soft = soft * (1 - label_smoothing) + label_smoothing / n_classes
+            loss = -jnp.sum(soft * logp, axis=ax)
+        else:
+            lbl_i = lbl.astype(jnp.int32)
+            if lbl_i.ndim == logits.ndim:
+                lbl_i = jnp.squeeze(lbl_i, axis=ax)
+            valid = lbl_i != ignore_index
+            safe_lbl = jnp.where(valid, lbl_i, 0)
+            picked = jnp.take_along_axis(
+                logp, jnp.expand_dims(safe_lbl, ax), axis=ax
+            ).squeeze(ax)
+            if label_smoothing:
+                smooth_term = jnp.mean(logp, axis=ax)
+                picked = (1 - label_smoothing) * picked + label_smoothing * smooth_term
+            loss = jnp.where(valid, -picked, 0.0)
+            if w is not None:
+                wsel = jnp.where(valid, jnp.take(w, safe_lbl), 0.0)
+                loss = loss * wsel
+                if reduction == "mean":
+                    return jnp.sum(loss) / jnp.maximum(jnp.sum(wsel), 1e-12)
+            if reduction == "mean":
+                denom = jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+                return jnp.sum(loss) / denom
+        return _reduce(loss, reduction)
+
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply(body, *args, op_name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label, ignore_index=ignore_index,
+                         reduction="none", axis=axis)
+    from .activation import softmax as softmax_fn
+
+    loss = loss.unsqueeze(int(axis)) if loss.ndim < logits.ndim else loss
+    if return_softmax:
+        return loss, softmax_fn(logits, axis=axis)
+    return loss
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply(lambda x, y: _reduce(jnp.square(x - y), reduction), input, label, op_name="mse_loss")
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply(lambda x, y: _reduce(jnp.abs(x - y), reduction), input, label, op_name="l1_loss")
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    def body(logp, lbl, w=None):
+        lbl_i = lbl.astype(jnp.int32)
+        valid = lbl_i != ignore_index
+        safe = jnp.where(valid, lbl_i, 0)
+        picked = jnp.take_along_axis(logp, safe[..., None] if logp.ndim == lbl_i.ndim + 1 else safe, axis=1)
+        picked = picked.squeeze(1) if picked.ndim > lbl_i.ndim else picked
+        loss = jnp.where(valid, -picked, 0.0)
+        if w is not None:
+            wsel = jnp.where(valid, jnp.take(w, safe), 0.0)
+            loss = loss * wsel
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(wsel), 1e-12)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+        return _reduce(loss, reduction)
+
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply(body, *args, op_name="nll_loss")
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def body(p, y, w=None):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply(body, *args, op_name="binary_cross_entropy")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean", pos_weight=None, name=None):
+    def body(z, y, w=None, pw=None):
+        neg_abs = -jnp.abs(z)
+        loss = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(neg_abs))
+        if pw is not None:
+            log_sig = jax.nn.log_sigmoid(z)
+            log_sig_neg = jax.nn.log_sigmoid(-z)
+            loss = -(pw * y * log_sig + (1 - y) * log_sig_neg)
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+
+    args = [logit, label]
+    if weight is not None:
+        args.append(weight)
+    if pos_weight is not None:
+        if weight is None:
+            return apply(lambda z, y, pw: body(z, y, None, pw), logit, label, pos_weight, op_name="bce_logits")
+        args.append(pos_weight)
+    return apply(body, *args, op_name="bce_logits")
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def body(logp, y):
+        if log_target:
+            loss = jnp.exp(y) * (y - logp)
+        else:
+            loss = y * (jnp.log(jnp.maximum(y, 1e-30)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+
+    return apply(body, input, label, op_name="kl_div")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def body(x, y):
+        diff = jnp.abs(x - y)
+        loss = jnp.where(diff < delta, 0.5 * diff * diff / delta, diff - 0.5 * delta)
+        return _reduce(loss, reduction)
+
+    return apply(body, input, label, op_name="smooth_l1_loss")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    def body(x1, x2, y):
+        loss = jnp.maximum(0.0, -y * (x1 - x2) + margin)
+        return _reduce(loss, reduction)
+
+    return apply(body, input, other, label, op_name="margin_ranking_loss")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def body(x, y):
+        loss = jnp.where(y == 1, x, jnp.maximum(0.0, margin - x))
+        return _reduce(loss, reduction)
+
+    return apply(body, input, label, op_name="hinge_embedding_loss")
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    def body(x1, x2, y):
+        cos = jnp.sum(x1 * x2, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(x1, axis=-1) * jnp.linalg.norm(x2, axis=-1), 1e-12
+        )
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+
+    return apply(body, input1, input2, label, op_name="cosine_embedding_loss")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def body(a, pos, neg):
+        def dist(u, v):
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(u - v) + epsilon, p), axis=-1), 1.0 / p)
+
+        d_ap = dist(a, pos)
+        d_an = dist(a, neg)
+        if swap:
+            d_pn = dist(pos, neg)
+            d_an = jnp.minimum(d_an, d_pn)
+        loss = jnp.maximum(0.0, d_ap - d_an + margin)
+        return _reduce(loss, reduction)
+
+    return apply(body, input, positive, negative, op_name="triplet_margin_loss")
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def body(p, y):
+        return -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon)
+
+    return apply(body, input, label, op_name="log_loss")
+
+
+def square_error_cost(input, label):
+    return apply(lambda x, y: jnp.square(x - y), input, label, op_name="square_error_cost")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0, reduction="sum", name=None):
+    def body(z, y, nrm=None):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * jnp.power(1 - p_t, gamma) * ce
+        if nrm is not None:
+            loss = loss / nrm
+        return _reduce(loss, reduction)
+
+    args = [logit, label] + ([normalizer] if normalizer is not None else [])
+    return apply(body, *args, op_name="sigmoid_focal_loss")
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    def body(p, y):
+        y1 = jax.nn.one_hot(y.squeeze(-1).astype(jnp.int32), p.shape[-1], dtype=p.dtype)
+        reduce_dims = tuple(range(1, p.ndim))
+        inter = 2 * jnp.sum(p * y1, axis=reduce_dims)
+        union = jnp.sum(p, axis=reduce_dims) + jnp.sum(y1, axis=reduce_dims)
+        return jnp.mean(1 - (inter + epsilon) / (union + epsilon))
+
+    return apply(body, input, label, op_name="dice_loss")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction="mean", norm_by_times=False):
+    """CTC loss, dynamic-programming over lax.scan (warpctc parity,
+    /root/reference/paddle/phi/kernels/gpu/warpctc_kernel.cu). Pallas-fused
+    variant lands in paddle_tpu.kernels.
+
+    log_probs: [T, B, C] (paddle layout), labels: [B, L] padded with blank.
+    """
+    def body(lp, lbl, in_len, lbl_len):
+        T, B, C = lp.shape
+        L = lbl.shape[1]
+        S = 2 * L + 1
+        lbl = lbl.astype(jnp.int32)
+        # extended label sequence: blank, l1, blank, l2, ..., blank
+        ext = jnp.full((B, S), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lbl)
+        neg_inf = -1e30
+
+        logp_ext = jnp.take_along_axis(
+            lp.transpose(1, 0, 2), ext[:, None, :].repeat(T, axis=1), axis=2
+        )  # [B, T, S]
+
+        alpha0 = jnp.full((B, S), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(logp_ext[:, 0, 0])
+        alpha0 = alpha0.at[:, 1].set(jnp.where(lbl_len > 0, logp_ext[:, 0, 1], neg_inf))
+
+        same = jnp.concatenate(
+            [jnp.ones((B, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1
+        )
+
+        def step(alpha, lp_t):
+            a1 = alpha
+            a2 = jnp.concatenate([jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1)
+            a3 = jnp.concatenate([jnp.full((B, 2), neg_inf), alpha[:, :-2]], axis=1)
+            a3 = jnp.where(same, neg_inf, a3)
+            new = jnp.logaddexp(jnp.logaddexp(a1, a2), a3) + lp_t
+            return new, new
+
+        _, alphas = jax.lax.scan(step, alpha0, jnp.swapaxes(logp_ext, 0, 1)[1:])
+        alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T, B, S]
+
+        t_idx = jnp.clip(in_len.astype(jnp.int32) - 1, 0, T - 1)
+        last = alphas[t_idx, jnp.arange(B)]  # [B, S]
+        s_last = 2 * lbl_len.astype(jnp.int32)
+        ll = jnp.logaddexp(
+            jnp.take_along_axis(last, s_last[:, None], axis=1).squeeze(1),
+            jnp.take_along_axis(
+                last, jnp.clip(s_last - 1, 0, S - 1)[:, None], axis=1
+            ).squeeze(1),
+        )
+        loss = -ll
+        if norm_by_times:
+            loss = loss / jnp.maximum(in_len.astype(loss.dtype), 1.0)
+        return _reduce(loss, reduction)
+
+    return apply(body, log_probs, labels, input_lengths, label_lengths, op_name="ctc_loss")

@@ -1,0 +1,154 @@
+"""Normalization functionals
+(parity: /root/reference/python/paddle/nn/functional/norm.py). These are the
+HBM-bandwidth-bound ops XLA fuses; a Pallas fused layer_norm/rms_norm variant
+registers over the same names in paddle_tpu.kernels."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.dispatch import apply
+
+__all__ = ["batch_norm", "layer_norm", "instance_norm", "group_norm", "local_response_norm", "rms_norm"]
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
+               momentum=0.9, epsilon=1e-5, data_format="NCHW", use_global_stats=None, name=None):
+    """Functional BN. In training mode the *caller layer* updates running
+    stats (mutating its buffers) from the returned batch statistics."""
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    use_batch_stats = training and not use_global_stats
+
+    def body(v, rm, rv, w=None, b=None):
+        axes = tuple(i for i in range(v.ndim) if i != ch_axis)
+        if use_batch_stats:
+            mean = jnp.mean(v, axis=axes)
+            var = jnp.var(v, axis=axes)
+        else:
+            mean, var = rm, rv
+        shape = [1] * v.ndim
+        shape[ch_axis] = v.shape[ch_axis]
+        out = (v - mean.reshape(shape)) / jnp.sqrt(var.reshape(shape) + epsilon)
+        if w is not None:
+            out = out * w.reshape(shape)
+        if b is not None:
+            out = out + b.reshape(shape)
+        return out
+
+    args = [x, running_mean, running_var]
+    if weight is not None:
+        args.append(weight)
+    if bias is not None:
+        args.append(bias)
+    return apply(body, *args, op_name="batch_norm")
+
+
+def batch_norm_stats(x, ch_axis):
+    """Batch mean/var used by the BN layer to update running buffers."""
+    def body(v):
+        axes = tuple(i for i in range(v.ndim) if i != ch_axis)
+        return jnp.mean(v, axis=axes), jnp.var(v, axis=axes)
+
+    return apply(body, x, op_name="batch_norm_stats")
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_axes = len(tuple(normalized_shape))
+
+    def body(v, w=None, b=None):
+        axes = tuple(range(v.ndim - n_axes, v.ndim))
+        mean = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - mean) / jnp.sqrt(var + epsilon)
+        if w is not None:
+            out = out * w
+        if b is not None:
+            out = out + b
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+    if bias is not None:
+        args.append(bias)
+    if weight is None and bias is not None:
+        return apply(lambda v, b: body(v, None, b), x, bias, op_name="layer_norm")
+    return apply(body, *args, op_name="layer_norm")
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, axis=-1, name=None):
+    """RMSNorm (beyond-reference; the Llama-family norm)."""
+
+    def body(v, w=None):
+        ms = jnp.mean(jnp.square(v.astype(jnp.float32)), axis=axis, keepdims=True)
+        out = (v.astype(jnp.float32) / jnp.sqrt(ms + epsilon)).astype(v.dtype)
+        if w is not None:
+            out = out * w
+        return out
+
+    if weight is None:
+        return apply(body, x, op_name="rms_norm")
+    return apply(body, x, weight, op_name="rms_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW", name=None):
+    def body(v, w=None, b=None):
+        axes = tuple(range(2, v.ndim))
+        mean = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - mean) / jnp.sqrt(var + eps)
+        if w is not None:
+            shape = [1, -1] + [1] * (v.ndim - 2)
+            out = out * w.reshape(shape)
+        if b is not None:
+            shape = [1, -1] + [1] * (v.ndim - 2)
+            out = out + b.reshape(shape)
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+    if bias is not None:
+        args.append(bias)
+    return apply(body, *args, op_name="instance_norm")
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None, data_format="NCHW", name=None):
+    def body(v, w=None, b=None):
+        n, c = v.shape[0], v.shape[1]
+        g = int(num_groups)
+        rest = v.shape[2:]
+        vg = v.reshape((n, g, c // g) + rest)
+        axes = tuple(range(2, vg.ndim))
+        mean = jnp.mean(vg, axis=axes, keepdims=True)
+        var = jnp.var(vg, axis=axes, keepdims=True)
+        out = ((vg - mean) / jnp.sqrt(var + epsilon)).reshape(v.shape)
+        shape = [1, c] + [1] * (v.ndim - 2)
+        if w is not None:
+            out = out * w.reshape(shape)
+        if b is not None:
+            out = out + b.reshape(shape)
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+    if bias is not None:
+        args.append(bias)
+    return apply(body, *args, op_name="group_norm")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+    def body(v):
+        sq = jnp.square(v)
+        half = size // 2
+        c = v.shape[1]
+        pads = [(0, 0)] * v.ndim
+        pads[1] = (half, size - half - 1)
+        padded = jnp.pad(sq, pads)
+        acc = sum(padded[:, i : i + c] for i in range(size))
+        return v / jnp.power(k + alpha * acc / size, beta)
+
+    return apply(body, x, op_name="local_response_norm")

@@ -1,0 +1,157 @@
+"""Pooling functionals via ``lax.reduce_window``
+(parity: /root/reference/python/paddle/nn/functional/pooling.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.dispatch import apply
+
+__all__ = [
+    "max_pool1d", "max_pool2d", "max_pool3d", "avg_pool1d", "avg_pool2d",
+    "avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool2d",
+    "adaptive_max_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
+    "adaptive_avg_pool3d",
+]
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _pool(x, kernel, stride, padding, n, reducer, init, ceil_mode=False, channels_last=False, count_include_pad=True):
+    k = _tuple(kernel, n)
+    s = _tuple(stride if stride is not None else kernel, n)
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        p = _tuple(padding, n)
+        pad = [(pp, pp) for pp in p]
+
+    def body(v):
+        if channels_last:
+            dims = (1,) + k + (1,)
+            strides = (1,) + s + (1,)
+            pads = "VALID" if pad == "VALID" else ("SAME" if pad == "SAME" else [(0, 0)] + list(pad) + [(0, 0)])
+        else:
+            dims = (1, 1) + k
+            strides = (1, 1) + s
+            pads = "VALID" if pad == "VALID" else ("SAME" if pad == "SAME" else [(0, 0), (0, 0)] + list(pad))
+        if ceil_mode and not isinstance(pads, str):
+            # grow right-side padding so the last partial window is included
+            spatial = v.shape[2:] if not channels_last else v.shape[1:-1]
+            newpads = list(pads)
+            off = 2 if not channels_last else 1
+            for i in range(n):
+                size = spatial[i] + pads[off + i][0] + pads[off + i][1]
+                rem = (size - k[i]) % s[i]
+                if rem:
+                    newpads[off + i] = (pads[off + i][0], pads[off + i][1] + (s[i] - rem))
+            pads = newpads
+        out = lax.reduce_window(v, init(v.dtype), reducer, dims, strides, pads)
+        if reducer is lax.add:
+            if isinstance(pads, str) or count_include_pad:
+                denom = float(np.prod(k))
+                out = out / denom
+            else:
+                ones = jnp.ones_like(v)
+                counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pads)
+                out = out / counts
+        return out
+
+    return body
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, name=None):
+    body = _pool(x, kernel_size, stride, padding, 1, lax.max, _neg_inf, ceil_mode)
+    return apply(body, x, op_name="max_pool1d")
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCHW", name=None):
+    body = _pool(x, kernel_size, stride, padding, 2, lax.max, _neg_inf, ceil_mode, channels_last=data_format == "NHWC")
+    return apply(body, x, op_name="max_pool2d")
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCDHW", name=None):
+    body = _pool(x, kernel_size, stride, padding, 3, lax.max, _neg_inf, ceil_mode, channels_last=data_format == "NDHWC")
+    return apply(body, x, op_name="max_pool3d")
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, name=None):
+    body = _pool(x, kernel_size, stride, padding, 1, lax.add, _zero, ceil_mode, count_include_pad=not exclusive)
+    return apply(body, x, op_name="avg_pool1d")
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    body = _pool(x, kernel_size, stride, padding, 2, lax.add, _zero, ceil_mode, channels_last=data_format == "NHWC", count_include_pad=not exclusive)
+    return apply(body, x, op_name="avg_pool2d")
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    body = _pool(x, kernel_size, stride, padding, 3, lax.add, _zero, ceil_mode, channels_last=data_format == "NDHWC", count_include_pad=not exclusive)
+    return apply(body, x, op_name="avg_pool3d")
+
+
+def _neg_inf(dtype):
+    return -jnp.inf if jnp.issubdtype(dtype, jnp.floating) else jnp.iinfo(dtype).min
+
+
+def _zero(dtype):
+    return jnp.array(0, dtype).item() if not jnp.issubdtype(dtype, jnp.floating) else 0.0
+
+
+def _adaptive(x, output_size, n, op):
+    def body(v):
+        spatial = v.shape[2:]
+        out_size = _tuple(output_size, n)
+        out_size = tuple(o if o is not None else s for o, s in zip(out_size, spatial))
+        # adaptive pooling = split each spatial dim into out_size bins
+        out = v
+        for d in range(n):
+            s, o = out.shape[2 + d], out_size[d]
+            if s % o == 0:
+                k = s // o
+                shape = out.shape[: 2 + d] + (o, k) + out.shape[2 + d + 1 :]
+                out = out.reshape(shape)
+                out = op(out, axis=2 + d + 1)
+            else:
+                # uneven bins: gather per-bin slices (shapes are static)
+                idx_starts = [int(np.floor(i * s / o)) for i in range(o)]
+                idx_ends = [int(np.ceil((i + 1) * s / o)) for i in range(o)]
+                slices = []
+                for st, en in zip(idx_starts, idx_ends):
+                    sl = [slice(None)] * out.ndim
+                    sl[2 + d] = slice(st, en)
+                    slices.append(op(out[tuple(sl)], axis=2 + d, keepdims=True))
+                out = jnp.concatenate(slices, axis=2 + d)
+        return out
+
+    return apply(body, x, op_name=f"adaptive_pool{n}d")
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(x, output_size, 1, jnp.mean)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive(x, output_size, 2, jnp.mean)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive(x, output_size, 3, jnp.mean)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 1, jnp.max)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 2, jnp.max)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 3, jnp.max)

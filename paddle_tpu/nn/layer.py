@@ -1,0 +1,441 @@
+"""nn.Layer: the module base class.
+
+API parity with the reference's ``paddle.nn.Layer``
+(/root/reference/python/paddle/nn/layer/layers.py): parameter/sublayer/buffer
+registration via ``__setattr__``, ``state_dict``/``set_state_dict``,
+train/eval, hooks, ``apply``, ``to``.
+
+TPU-first twist: a Layer is also a *pure function over its state pytree* —
+``functional_state`` extracts (params, buffers) as raw-array dicts and
+``functional_call`` runs forward with that state swapped in under pure mode
+(no tape, tracers allowed). Every jitted training path (hapi Model.fit,
+distributed fleet, bench) goes through this bridge; the mutable eager surface
+is the same code.
+"""
+from __future__ import annotations
+
+import contextlib
+from collections import OrderedDict
+
+import numpy as np
+
+from ..core.autograd import no_grad, pure_mode
+from ..core.dtype import convert_dtype
+from ..core.tensor import Parameter, Tensor
+from ..framework import random as frandom
+
+__all__ = ["Layer", "functional_state", "functional_call", "LayerList", "Sequential", "ParameterList"]
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        object.__setattr__(self, "_parameters", OrderedDict())
+        object.__setattr__(self, "_sub_layers", OrderedDict())
+        object.__setattr__(self, "_buffers", OrderedDict())
+        self._dtype = convert_dtype(dtype)
+        self.training = True
+        self._forward_pre_hooks = OrderedDict()
+        self._forward_post_hooks = OrderedDict()
+        self._name_scope = name_scope or type(self).__name__.lower()
+
+    # -- registration -----------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ before assigning parameters")
+            params[name] = value
+            self.__dict__.pop(name, None)
+            return
+        layers = self.__dict__.get("_sub_layers")
+        if isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call Layer.__init__ before assigning sublayers")
+            layers[name] = value
+            self.__dict__.pop(name, None)
+            return
+        if params is not None and name in params:
+            if value is None:
+                del params[name]
+            else:
+                params[name] = value
+            return
+        if layers is not None and name in layers:
+            if value is None:
+                del layers[name]
+            else:
+                layers[name] = value
+            return
+        buffers = self.__dict__.get("_buffers")
+        if buffers is not None and name in buffers:
+            if isinstance(value, Tensor):
+                buffers[name] = value
+                return
+            if value is None:
+                del buffers[name]
+                return
+        object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(f"{type(self).__name__!r} has no attribute {name!r}")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def add_parameter(self, name, parameter):
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        if tensor is not None and not isinstance(tensor, Tensor):
+            tensor = Tensor(tensor)
+        if tensor is not None:
+            tensor.persistable = persistable
+        self._buffers[name] = tensor
+        return tensor
+
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False, default_initializer=None):
+        from . import initializer as I
+
+        dtype = convert_dtype(dtype) if dtype is not None else self._dtype
+        if default_initializer is None:
+            default_initializer = I.Constant(0.0) if is_bias else I.XavierUniform()
+        value = default_initializer._init(tuple(int(s) for s in shape), dtype)
+        return Parameter(value, dtype=dtype)
+
+    # -- traversal --------------------------------------------------------
+    def named_parameters(self, prefix="", include_sublayers=True):
+        seen = set()
+        for name, p in self._parameters.items():
+            if p is not None and id(p) not in seen:
+                seen.add(id(p))
+                yield (f"{prefix}.{name}" if prefix else name), p
+        if include_sublayers:
+            for lname, layer in self._sub_layers.items():
+                if layer is None:
+                    continue
+                sub_prefix = f"{prefix}.{lname}" if prefix else lname
+                yield from layer.named_parameters(sub_prefix, True)
+
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        for name, b in self._buffers.items():
+            if b is not None:
+                yield (f"{prefix}.{name}" if prefix else name), b
+        if include_sublayers:
+            for lname, layer in self._sub_layers.items():
+                if layer is None:
+                    continue
+                sub_prefix = f"{prefix}.{lname}" if prefix else lname
+                yield from layer.named_buffers(sub_prefix, True)
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def named_sublayers(self, prefix="", include_self=False):
+        if include_self:
+            yield prefix, self
+        for name, layer in self._sub_layers.items():
+            if layer is None:
+                continue
+            sub_prefix = f"{prefix}.{name}" if prefix else name
+            yield sub_prefix, layer
+            yield from layer.named_sublayers(sub_prefix, False)
+
+    def sublayers(self, include_self=False):
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def children(self):
+        return [l for l in self._sub_layers.values() if l is not None]
+
+    def named_children(self):
+        return [(n, l) for n, l in self._sub_layers.items() if l is not None]
+
+    def apply(self, fn):
+        for layer in self.sublayers(include_self=True):
+            fn(layer)
+        return self
+
+    # -- state dict -------------------------------------------------------
+    def state_dict(self, include_sublayers=True, structured_name_prefix="", use_hook=True):
+        out = OrderedDict()
+        for name, p in self.named_parameters(prefix=structured_name_prefix):
+            out[name] = p
+        for name, b in self.named_buffers(prefix=structured_name_prefix):
+            if b.persistable:
+                out[name] = b
+        return out
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        missing, unexpected = [], list(state_dict.keys())
+        own = dict(self.named_parameters())
+        own.update(dict(self.named_buffers()))
+        for name, tgt in own.items():
+            if name in state_dict:
+                src = state_dict[name]
+                arr = src.numpy() if isinstance(src, Tensor) else np.asarray(src)
+                tgt.set_value(arr.astype(tgt.dtype))
+                unexpected.remove(name)
+            else:
+                missing.append(name)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    # -- modes ------------------------------------------------------------
+    def train(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = True
+        return self
+
+    def eval(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = False
+        return self
+
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            nd = convert_dtype(dtype)
+            for p in self.parameters():
+                p._value = p._value.astype(nd)
+            for b in self.buffers():
+                from ..core.dtype import is_floating
+
+                if is_floating(b.dtype):
+                    b._value = b._value.astype(nd)
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    # -- hooks ------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        handle = _HookHandle(self._forward_pre_hooks)
+        self._forward_pre_hooks[handle.id] = hook
+        return handle
+
+    def register_forward_post_hook(self, hook):
+        handle = _HookHandle(self._forward_post_hooks)
+        self._forward_post_hooks[handle.id] = hook
+        return handle
+
+    # -- call -------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            result = hook(self, inputs)
+            if result is not None:
+                inputs = result if isinstance(result, tuple) else (result,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            result = hook(self, inputs, outputs)
+            if result is not None:
+                outputs = result
+        return outputs
+
+    def __repr__(self):
+        extra = ", ".join(
+            f"{n}={list(p.shape)}" for n, p in self._parameters.items() if p is not None
+        )
+        lines = [f"{type(self).__name__}({extra})"]
+        for name, layer in self._sub_layers.items():
+            if layer is None:
+                continue
+            body = repr(layer).splitlines()
+            lines.append(f"  ({name}): " + body[0])
+            lines.extend("  " + line for line in body[1:])
+        return "\n".join(lines)
+
+    def full_name(self):
+        return self._name_scope
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+
+class _HookHandle:
+    _next_id = [0]
+
+    def __init__(self, store):
+        self.id = _HookHandle._next_id[0]
+        _HookHandle._next_id[0] += 1
+        self._store = store
+
+    def remove(self):
+        self._store.pop(self.id, None)
+
+
+class Sequential(Layer):
+    def __init__(self, *layers):
+        super().__init__()
+        if len(layers) == 1 and isinstance(layers[0], (list, tuple)) and layers and \
+                layers[0] and isinstance(layers[0][0], tuple):
+            for name, layer in layers[0]:
+                self.add_sublayer(name, layer)
+        else:
+            for i, layer in enumerate(layers):
+                if isinstance(layer, tuple):
+                    self.add_sublayer(layer[0], layer[1])
+                else:
+                    self.add_sublayer(str(i), layer)
+
+    def forward(self, x):
+        for layer in self._sub_layers.values():
+            x = layer(x)
+        return x
+
+    def __getitem__(self, idx):
+        return list(self._sub_layers.values())[idx]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+
+class LayerList(Layer):
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers is not None:
+            for i, layer in enumerate(sublayers):
+                self.add_sublayer(str(i), layer)
+
+    def append(self, layer):
+        self.add_sublayer(str(len(self._sub_layers)), layer)
+        return self
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return LayerList(list(self._sub_layers.values())[idx])
+        return self._sub_layers[str(idx % len(self._sub_layers))]
+
+    def __setitem__(self, idx, layer):
+        self._sub_layers[str(idx)] = layer
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+
+class ParameterList(Layer):
+    def __init__(self, parameters=None):
+        super().__init__()
+        if parameters is not None:
+            for i, p in enumerate(parameters):
+                self.add_parameter(str(i), p)
+
+    def append(self, parameter):
+        self.add_parameter(str(len(self._parameters)), parameter)
+        return self
+
+    def __getitem__(self, idx):
+        return self._parameters[str(idx)]
+
+    def __len__(self):
+        return len(self._parameters)
+
+    def __iter__(self):
+        return iter(self._parameters.values())
+
+
+# ---------------------------------------------------------------------------
+# functional bridge: Layer as a pure function of its state pytree
+# ---------------------------------------------------------------------------
+
+
+def functional_state(layer: Layer):
+    """Extract (params, buffers) as flat name->raw-array dicts (a pytree)."""
+    params = {name: p._value for name, p in layer.named_parameters()}
+    buffers = {name: b._value for name, b in layer.named_buffers()}
+    return params, buffers
+
+
+@contextlib.contextmanager
+def _swapped_state(layer: Layer, params, buffers):
+    named_p = dict(layer.named_parameters())
+    named_b = dict(layer.named_buffers())
+    old_p = {n: t._value for n, t in named_p.items()}
+    old_b = {n: t._value for n, t in named_b.items()}
+    try:
+        for n, v in (params or {}).items():
+            named_p[n]._value = v
+        for n, v in (buffers or {}).items():
+            named_b[n]._value = v
+        yield named_b
+    finally:
+        for n, t in named_p.items():
+            t._value = old_p[n]
+        for n, t in named_b.items():
+            t._value = old_b[n]
+
+
+def functional_call(layer: Layer, params, buffers, *args, rng=None, training=None, **kwargs):
+    """Run ``layer`` purely: state swapped in, tape off, raw arrays in/out.
+
+    Returns ``(outputs, new_buffers)`` — buffer mutations (e.g. BatchNorm
+    running stats) are captured functionally so jitted train steps can thread
+    them. ``rng`` seeds the functional RNG scope for dropout etc.
+    """
+    from ..core.tensor import Tensor as T
+
+    prev_training = None
+    if training is not None:
+        prev_training = [l.training for l in layer.sublayers(include_self=True)]
+        for l in layer.sublayers(include_self=True):
+            l.training = training
+
+    def wrap(a):
+        return T._wrap(a) if _is_array(a) else a
+
+    try:
+        with _swapped_state(layer, params, buffers) as named_b, pure_mode(), no_grad():
+            ctx = (
+                frandom.rng_scope(rng)
+                if rng is not None
+                else contextlib.nullcontext()
+            )
+            with ctx:
+                targs = [wrap(a) for a in args]
+                tkwargs = {k: wrap(v) for k, v in kwargs.items()}
+                out = layer(*targs, **tkwargs)
+            new_buffers = {n: t._value for n, t in named_b.items()}
+    finally:
+        if prev_training is not None:
+            for l, tr in zip(layer.sublayers(include_self=True), prev_training):
+                l.training = tr
+
+    return _unwrap_tree(out), new_buffers
+
+
+def _is_array(a):
+    import jax
+
+    return isinstance(a, (jax.Array, np.ndarray))
+
+
+def _unwrap_tree(out):
+    if isinstance(out, Tensor):
+        return out._value
+    if isinstance(out, (list, tuple)):
+        return type(out)(_unwrap_tree(o) for o in out)
+    if isinstance(out, dict):
+        return {k: _unwrap_tree(v) for k, v in out.items()}
+    return out

@@ -1,0 +1,85 @@
+"""Activation layers (parity: /root/reference/python/paddle/nn/layer/activation.py)."""
+from __future__ import annotations
+
+from .. import functional as F
+from ..layer import Layer
+
+__all__ = [
+    "ReLU", "ReLU6", "ELU", "SELU", "CELU", "GELU", "Sigmoid", "LogSigmoid",
+    "Tanh", "Softmax", "LogSoftmax", "LeakyReLU", "PReLU", "RReLU", "Silu",
+    "Swish", "Mish", "Hardswish", "Hardsigmoid", "Hardtanh", "Hardshrink",
+    "Softshrink", "Tanhshrink", "ThresholdedReLU", "Softplus", "Softsign",
+    "Maxout", "GLU",
+]
+
+
+def _simple(name, fn_name, **defaults):
+    def __init__(self, **kwargs):
+        Layer.__init__(self)
+        merged = dict(defaults)
+        kwargs.pop("name", None)
+        merged.update(kwargs)
+        self._kwargs = merged
+
+    def forward(self, x):
+        return getattr(F, fn_name)(x, **self._kwargs)
+
+    return type(name, (Layer,), {"__init__": __init__, "forward": forward})
+
+
+ReLU = _simple("ReLU", "relu")
+ReLU6 = _simple("ReLU6", "relu6")
+Sigmoid = _simple("Sigmoid", "sigmoid")
+LogSigmoid = _simple("LogSigmoid", "log_sigmoid")
+Tanh = _simple("Tanh", "tanh")
+Silu = _simple("Silu", "silu")
+Swish = _simple("Swish", "swish")
+Mish = _simple("Mish", "mish")
+Hardswish = _simple("Hardswish", "hardswish")
+Hardsigmoid = _simple("Hardsigmoid", "hardsigmoid")
+Tanhshrink = _simple("Tanhshrink", "tanhshrink")
+Softsign = _simple("Softsign", "softsign")
+ELU = _simple("ELU", "elu", alpha=1.0)
+SELU = _simple("SELU", "selu")
+CELU = _simple("CELU", "celu", alpha=1.0)
+GELU = _simple("GELU", "gelu", approximate=False)
+Softmax = _simple("Softmax", "softmax", axis=-1)
+LogSoftmax = _simple("LogSoftmax", "log_softmax", axis=-1)
+LeakyReLU = _simple("LeakyReLU", "leaky_relu", negative_slope=0.01)
+Hardtanh = _simple("Hardtanh", "hardtanh", min=-1.0, max=1.0)
+Hardshrink = _simple("Hardshrink", "hardshrink", threshold=0.5)
+Softshrink = _simple("Softshrink", "softshrink", threshold=0.5)
+ThresholdedReLU = _simple("ThresholdedReLU", "thresholded_relu", threshold=1.0)
+Softplus = _simple("Softplus", "softplus", beta=1.0, threshold=20.0)
+GLU = _simple("GLU", "glu", axis=-1)
+
+
+class Maxout(Layer):
+    def __init__(self, groups, axis=1, name=None):
+        super().__init__()
+        self.groups, self.axis = groups, axis
+
+    def forward(self, x):
+        return F.maxout(x, self.groups, self.axis)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        from .. import initializer as I
+
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            [num_parameters], default_initializer=I.Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, self._data_format)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1.0 / 8.0, upper=1.0 / 3.0, name=None):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def forward(self, x):
+        return F.rrelu(x, self.lower, self.upper, training=self.training)

@@ -1,0 +1,123 @@
+"""Op surface: creation/math/manipulation/logic/search/linalg/random/stat.
+
+Also monkey-patches the method surface onto Tensor, mirroring the reference's
+``tensor_patch_methods`` (/root/reference/python/paddle/fluid/dygraph/
+tensor_patch_methods.py) which grafts the op API onto the eager Tensor type.
+"""
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+from . import creation, linalg, logic, manipulation, math, random, search, stat
+from .creation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .registry import OPS, op_coverage, register_variant  # noqa: F401
+from .search import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+
+__all__ = (
+    creation.__all__
+    + math.__all__
+    + manipulation.__all__
+    + logic.__all__
+    + search.__all__
+    + linalg.__all__
+    + random.__all__
+    + stat.__all__
+)
+
+
+def _patch_tensor_methods():
+    import builtins
+
+    m = math
+    # arithmetic dunders
+    Tensor.__add__ = lambda s, o: m.add(s, _c(o))
+    Tensor.__radd__ = lambda s, o: m.add(_c(o), s)
+    Tensor.__sub__ = lambda s, o: m.subtract(s, _c(o))
+    Tensor.__rsub__ = lambda s, o: m.subtract(_c(o), s)
+    Tensor.__mul__ = lambda s, o: m.multiply(s, _c(o))
+    Tensor.__rmul__ = lambda s, o: m.multiply(_c(o), s)
+    Tensor.__truediv__ = lambda s, o: m.divide(s, _c(o))
+    Tensor.__rtruediv__ = lambda s, o: m.divide(_c(o), s)
+    Tensor.__floordiv__ = lambda s, o: m.floor_divide(s, _c(o))
+    Tensor.__mod__ = lambda s, o: m.remainder(s, _c(o))
+    Tensor.__pow__ = lambda s, o: m.pow(s, _c(o))
+    Tensor.__rpow__ = lambda s, o: m.pow(_c(o), s)
+    Tensor.__matmul__ = lambda s, o: linalg.matmul(s, _c(o))
+    Tensor.__neg__ = lambda s: m.neg(s)
+    Tensor.__abs__ = lambda s: m.abs(s)
+    Tensor.__invert__ = lambda s: logic.logical_not(s)
+    # comparisons (elementwise, like paddle)
+    Tensor.__eq__ = lambda s, o: logic.equal(s, _c(o))
+    Tensor.__ne__ = lambda s, o: logic.not_equal(s, _c(o))
+    Tensor.__lt__ = lambda s, o: logic.less_than(s, _c(o))
+    Tensor.__le__ = lambda s, o: logic.less_equal(s, _c(o))
+    Tensor.__gt__ = lambda s, o: logic.greater_than(s, _c(o))
+    Tensor.__ge__ = lambda s, o: logic.greater_equal(s, _c(o))
+    Tensor.__hash__ = lambda s: id(s)
+
+    # named methods: everything single-tensor-first from the op modules
+    method_sources = {
+        "add": m.add, "subtract": m.subtract, "multiply": m.multiply,
+        "divide": m.divide, "floor_divide": m.floor_divide, "remainder": m.remainder,
+        "mod": m.remainder, "pow": m.pow, "maximum": m.maximum, "minimum": m.minimum,
+        "exp": m.exp, "log": m.log, "log2": m.log2, "log10": m.log10, "log1p": m.log1p,
+        "sqrt": m.sqrt, "rsqrt": m.rsqrt, "square": m.square, "abs": m.abs,
+        "sign": m.sign, "sin": m.sin, "cos": m.cos, "tan": m.tan, "tanh": m.tanh,
+        "asin": m.asin, "acos": m.acos, "atan": m.atan, "sinh": m.sinh, "cosh": m.cosh,
+        "floor": m.floor, "ceil": m.ceil, "round": m.round, "trunc": m.trunc,
+        "reciprocal": m.reciprocal, "erf": m.erf, "clip": m.clip, "lerp": m.lerp,
+        "neg": m.neg, "isnan": m.isnan, "isinf": m.isinf, "isfinite": m.isfinite,
+        "sum": m.sum, "mean": m.mean, "max": m.max, "min": m.min, "prod": m.prod,
+        "all": m.all, "any": m.any, "amax": m.amax, "amin": m.amin,
+        "logsumexp": m.logsumexp, "cumsum": m.cumsum, "cumprod": m.cumprod,
+        "trace": m.trace, "kron": m.kron, "inner": m.inner, "outer": m.outer,
+        "scale": m.scale, "nan_to_num": m.nan_to_num,
+        "std": stat.std, "var": stat.var, "numel": stat.numel,
+        "reshape": manipulation.reshape, "transpose": manipulation.transpose,
+        "flatten": manipulation.flatten, "squeeze": manipulation.squeeze,
+        "unsqueeze": manipulation.unsqueeze, "split": manipulation.split,
+        "chunk": manipulation.chunk, "gather": manipulation.gather,
+        "gather_nd": manipulation.gather_nd, "scatter": manipulation.scatter,
+        "index_select": manipulation.index_select, "masked_select": manipulation.masked_select,
+        "tile": manipulation.tile, "expand": manipulation.expand,
+        "expand_as": manipulation.expand_as, "broadcast_to": manipulation.broadcast_to,
+        "flip": manipulation.flip, "roll": manipulation.roll, "unbind": manipulation.unbind,
+        "take_along_axis": manipulation.take_along_axis,
+        "put_along_axis": manipulation.put_along_axis,
+        "repeat_interleave": manipulation.repeat_interleave,
+        "tril": creation.tril, "triu": creation.triu,
+        "matmul": linalg.matmul, "dot": linalg.dot, "bmm": linalg.bmm, "mm": linalg.mm,
+        "mv": linalg.mv, "t": linalg.t, "norm": linalg.norm, "dist": linalg.dist,
+        "cholesky": linalg.cholesky, "inv": linalg.inv, "cross": linalg.cross,
+        "equal": logic.equal, "not_equal": logic.not_equal,
+        "greater_than": logic.greater_than, "greater_equal": logic.greater_equal,
+        "less_than": logic.less_than, "less_equal": logic.less_equal,
+        "logical_and": logic.logical_and, "logical_or": logic.logical_or,
+        "logical_not": logic.logical_not, "logical_xor": logic.logical_xor,
+        "isclose": logic.isclose, "allclose": logic.allclose, "equal_all": logic.equal_all,
+        "argmax": search.argmax, "argmin": search.argmin, "argsort": search.argsort,
+        "sort": search.sort, "topk": search.topk, "where": search.where,
+        "nonzero": search.nonzero, "unique": search.unique, "median": search.median,
+        "kthvalue": search.kthvalue, "mode": search.mode,
+        "uniform_": random.uniform_, "normal_": random.normal_,
+        "exponential_": random.exponential_, "bernoulli": random.bernoulli,
+        "multinomial": random.multinomial,
+    }
+    for name, fn in method_sources.items():
+        if not hasattr(Tensor, name):
+            setattr(Tensor, name, fn)
+
+
+def _c(o):
+    """Coerce python scalars / numpy arrays in binary-op positions."""
+    if isinstance(o, Tensor):
+        return o
+    return o  # scalars pass straight through to jnp broadcasting
+
+
+_patch_tensor_methods()

@@ -1,0 +1,199 @@
+"""Tensor creation ops (paddle.tensor.creation parity,
+/root/reference/python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core.dispatch import apply
+from ..core.tensor import Tensor, to_tensor
+from .registry import register
+
+__all__ = [
+    "to_tensor",
+    "zeros",
+    "ones",
+    "full",
+    "zeros_like",
+    "ones_like",
+    "full_like",
+    "empty",
+    "empty_like",
+    "arange",
+    "linspace",
+    "logspace",
+    "eye",
+    "diag",
+    "diagflat",
+    "meshgrid",
+    "tril",
+    "triu",
+    "assign",
+    "clone",
+    "create_parameter",
+]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+def _dt(dtype, default=None):
+    if dtype is None:
+        dtype = default or dtype_mod.get_default_dtype()
+    return dtype_mod.convert_dtype(dtype)
+
+
+@register("zeros")
+def zeros(shape, dtype=None, name=None):
+    return Tensor._wrap(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+@register("ones")
+def ones(shape, dtype=None, name=None):
+    return Tensor._wrap(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+@register("full")
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        if isinstance(fill_value, bool):
+            dtype = "bool"
+        elif isinstance(fill_value, int):
+            dtype = "int64"
+        else:
+            dtype = dtype_mod.get_default_dtype()
+    return Tensor._wrap(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+@register("zeros_like")
+def zeros_like(x, dtype=None, name=None):
+    return Tensor._wrap(jnp.zeros(x._value.shape, _dt(dtype, str(x.dtype))))
+
+
+@register("ones_like")
+def ones_like(x, dtype=None, name=None):
+    return Tensor._wrap(jnp.ones(x._value.shape, _dt(dtype, str(x.dtype))))
+
+
+@register("full_like")
+def full_like(x, fill_value, dtype=None, name=None):
+    return Tensor._wrap(
+        jnp.full(x._value.shape, fill_value, _dt(dtype, str(x.dtype)))
+    )
+
+
+@register("empty")
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+@register("empty_like")
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+@register("arange")
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    for v in (start, end, step):
+        if isinstance(v, float):
+            dtype = dtype or dtype_mod.get_default_dtype()
+    if end is None:
+        start, end = 0, start
+    if isinstance(start, Tensor):
+        start = start.item()
+    if isinstance(end, Tensor):
+        end = end.item()
+    if isinstance(step, Tensor):
+        step = step.item()
+    nd = _dt(dtype, "int64") if dtype is not None else np.dtype(
+        "int64"
+        if all(isinstance(v, (int, np.integer)) for v in (start, end, step))
+        else dtype_mod.get_default_dtype()
+    )
+    return Tensor._wrap(jnp.arange(start, end, step, dtype=nd))
+
+
+@register("linspace")
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor._wrap(jnp.linspace(float(start), float(stop), int(num), dtype=_dt(dtype)))
+
+
+@register("logspace")
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor._wrap(
+        jnp.logspace(float(start), float(stop), int(num), base=float(base), dtype=_dt(dtype))
+    )
+
+
+@register("eye")
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor._wrap(jnp.eye(int(num_rows), num_columns and int(num_columns), dtype=_dt(dtype)))
+
+
+@register("diag")
+def diag(x, offset=0, padding_value=0, name=None):
+    def _diag(v):
+        if v.ndim == 1 and padding_value != 0:
+            n = v.shape[0] + abs(offset)
+            base = jnp.full((n, n), padding_value, v.dtype)
+            return base + jnp.diag(v - 0, k=offset) - jnp.diag(jnp.full(v.shape, padding_value, v.dtype), k=offset)
+        return jnp.diag(v, k=offset)
+
+    return apply(_diag, x, op_name="diag")
+
+
+@register("diagflat")
+def diagflat(x, offset=0, name=None):
+    return apply(lambda v: jnp.diagflat(v, k=offset), x, op_name="diagflat")
+
+
+@register("meshgrid")
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    return list(apply(lambda *vs: tuple(jnp.meshgrid(*vs, indexing="ij")), *args, op_name="meshgrid"))
+
+
+@register("tril")
+def tril(x, diagonal=0, name=None):
+    return apply(lambda v: jnp.tril(v, k=diagonal), x, op_name="tril")
+
+
+@register("triu")
+def triu(x, diagonal=0, name=None):
+    return apply(lambda v: jnp.triu(v, k=diagonal), x, op_name="triu")
+
+
+@register("assign")
+def assign(x, output=None):
+    src = x if isinstance(x, Tensor) else to_tensor(np.asarray(x))
+    if output is None:
+        return apply(lambda v: v + 0 if jnp.issubdtype(v.dtype, jnp.number) else v, src, op_name="assign")
+    output.set_value(src._value)
+    return output
+
+
+@register("clone")
+def clone(x, name=None):
+    return x.clone()
+
+
+def create_parameter(shape, dtype=None, name=None, attr=None, is_bias=False, default_initializer=None):
+    from ..core.tensor import Parameter
+
+    nd = _dt(dtype)
+    if default_initializer is not None:
+        data = default_initializer(_shape(shape), nd)
+        if isinstance(data, Tensor):
+            data = data._value
+    else:
+        data = jnp.zeros(_shape(shape), nd) if is_bias else jnp.ones(_shape(shape), nd)
+    return Parameter(data, dtype=nd, name=name)

@@ -1,0 +1,292 @@
+"""Linear algebra ops (paddle.tensor.linalg parity,
+/root/reference/python/paddle/tensor/linalg.py — matmul call stack SURVEY §3.1).
+
+``matmul`` is THE MXU op: XLA tiles jnp.matmul/einsum onto the systolic array;
+keep operands large and (b)f16/bf16 where possible.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from .registry import OPS, OpDef
+
+__all__ = [
+    "matmul", "dot", "bmm", "mm", "mv", "t", "norm", "dist", "einsum",
+    "cholesky", "qr", "svd", "inv", "pinv", "solve", "triangular_solve",
+    "matrix_power", "matrix_rank", "det", "slogdet", "eig", "eigh",
+    "eigvals", "eigvalsh", "lu", "cross", "cov", "corrcoef", "lstsq",
+    "multi_dot", "cdist", "householder_product",
+]
+
+
+def _reg(fn):
+    OPS[fn.__name__] = OpDef(name=fn.__name__, fn=fn, category="linalg")
+    return fn
+
+
+@_reg
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def body(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+
+    return apply(body, x, y, op_name="matmul")
+
+
+@_reg
+def dot(x, y, name=None):
+    def body(a, b):
+        return jnp.sum(a * b, axis=-1)
+
+    return apply(body, x, y, op_name="dot")
+
+
+@_reg
+def bmm(x, y, name=None):
+    return apply(lambda a, b: jnp.matmul(a, b), x, y, op_name="bmm")
+
+
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+_reg(mm)
+
+
+@_reg
+def mv(x, vec, name=None):
+    return apply(lambda a, v: jnp.matmul(a, v), x, vec, op_name="mv")
+
+
+@_reg
+def t(x, name=None):
+    return apply(lambda v: v.T if v.ndim >= 2 else v, x, op_name="t")
+
+
+@_reg
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def body(v):
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if p is None or p == "fro":
+            if ax is None:
+                return jnp.sqrt(jnp.sum(jnp.square(v)))
+            return jnp.sqrt(jnp.sum(jnp.square(v), axis=ax, keepdims=keepdim))
+        if p == "nuc":
+            return jnp.sum(jnp.linalg.svd(v, compute_uv=False), axis=-1)
+        if p == np.inf or p == float("inf"):
+            return jnp.max(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if p == -np.inf or p == float("-inf"):
+            return jnp.min(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((v != 0).astype(v.dtype), axis=ax, keepdims=keepdim)
+        return jnp.power(
+            jnp.sum(jnp.power(jnp.abs(v), p), axis=ax, keepdims=keepdim), 1.0 / p
+        )
+
+    return apply(body, x, op_name="norm")
+
+
+@_reg
+def dist(x, y, p=2, name=None):
+    return norm(x - y, p=p)
+
+
+@_reg
+def einsum(equation, *operands):
+    ops = operands[0] if len(operands) == 1 and isinstance(operands[0], (list, tuple)) else operands
+    return apply(lambda *vs: jnp.einsum(equation, *vs), *ops, op_name="einsum")
+
+
+@_reg
+def cholesky(x, upper=False, name=None):
+    def body(v):
+        lfac = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(lfac, -1, -2) if upper else lfac
+
+    return apply(body, x, op_name="cholesky")
+
+
+@_reg
+def qr(x, mode="reduced", name=None):
+    return apply(lambda v: tuple(jnp.linalg.qr(v, mode=mode)), x, op_name="qr")
+
+
+@_reg
+def svd(x, full_matrices=False, name=None):
+    def body(v):
+        u, s, vh = jnp.linalg.svd(v, full_matrices=full_matrices)
+        return u, s, jnp.swapaxes(vh, -1, -2)  # paddle returns V, not V^H
+
+    return apply(body, x, op_name="svd")
+
+
+@_reg
+def inv(x, name=None):
+    return apply(lambda v: jnp.linalg.inv(v), x, op_name="inv")
+
+
+@_reg
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply(lambda v: jnp.linalg.pinv(v, rtol=rcond, hermitian=hermitian), x, op_name="pinv")
+
+
+@_reg
+def solve(x, y, name=None):
+    return apply(lambda a, b: jnp.linalg.solve(a, b), x, y, op_name="solve")
+
+
+@_reg
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    from jax.scipy.linalg import solve_triangular
+
+    def body(a, b):
+        return solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular,
+        )
+
+    return apply(body, x, y, op_name="triangular_solve")
+
+
+@_reg
+def matrix_power(x, n, name=None):
+    return apply(lambda v: jnp.linalg.matrix_power(v, int(n)), x, op_name="matrix_power")
+
+
+@_reg
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply(lambda v: jnp.linalg.matrix_rank(v, rtol=tol), x, op_name="matrix_rank")
+
+
+@_reg
+def det(x, name=None):
+    return apply(lambda v: jnp.linalg.det(v), x, op_name="det")
+
+
+@_reg
+def slogdet(x, name=None):
+    def body(v):
+        sign, logabs = jnp.linalg.slogdet(v)
+        return jnp.stack([sign, logabs], axis=0)
+
+    return apply(body, x, op_name="slogdet")
+
+
+@_reg
+def eig(x, name=None):
+    # CPU-only in jax; eager fallback via numpy for parity
+    from ..core.tensor import Tensor
+
+    w, v = np.linalg.eig(np.asarray(x._value))
+    return Tensor._wrap(jnp.asarray(w)), Tensor._wrap(jnp.asarray(v))
+
+
+@_reg
+def eigh(x, UPLO="L", name=None):
+    return apply(lambda v: tuple(jnp.linalg.eigh(v, symmetrize_input=True)), x, op_name="eigh")
+
+
+@_reg
+def eigvals(x, name=None):
+    from ..core.tensor import Tensor
+
+    w = np.linalg.eigvals(np.asarray(x._value))
+    return Tensor._wrap(jnp.asarray(w))
+
+
+@_reg
+def eigvalsh(x, UPLO="L", name=None):
+    return apply(lambda v: jnp.linalg.eigvalsh(v), x, op_name="eigvalsh")
+
+
+@_reg
+def lu(x, pivot=True, get_infos=False, name=None):
+    from jax.scipy.linalg import lu_factor
+
+    def body(v):
+        lufac, piv = lu_factor(v)
+        return lufac, (piv + 1).astype(jnp.int32)  # paddle pivots are 1-based
+
+    out = apply(body, x, op_name="lu")
+    if get_infos:
+        from .creation import zeros
+
+        return (*out, zeros([1], "int32"))
+    return out
+
+
+@_reg
+def cross(x, y, axis=9, name=None):
+    def body(a, b):
+        ax = axis
+        if ax == 9:  # paddle default: first axis of size 3
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=int(ax))
+
+    return apply(body, x, y, op_name="cross")
+
+
+@_reg
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    def body(v):
+        return jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0)
+
+    return apply(body, x, op_name="cov")
+
+
+@_reg
+def corrcoef(x, rowvar=True, name=None):
+    return apply(lambda v: jnp.corrcoef(v, rowvar=rowvar), x, op_name="corrcoef")
+
+
+@_reg
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def body(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank.astype(jnp.int64), sv
+
+    return apply(body, x, y, op_name="lstsq")
+
+
+@_reg
+def multi_dot(x, name=None):
+    return apply(lambda *vs: jnp.linalg.multi_dot(vs), *x, op_name="multi_dot")
+
+
+@_reg
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary", name=None):
+    def body(a, b):
+        diff = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(jnp.square(diff), axis=-1) + 1e-30)
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(diff), p), axis=-1), 1.0 / p)
+
+    return apply(body, x, y, op_name="cdist")
+
+
+@_reg
+def householder_product(x, tau, name=None):
+    def body(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        eye = jnp.eye(m, dtype=a.dtype)
+        q = jnp.broadcast_to(eye, a.shape[:-2] + (m, m)).copy() if a.ndim > 2 else eye
+        for i in range(n):
+            v = jnp.concatenate(
+                [jnp.zeros(a.shape[:-2] + (i,), a.dtype),
+                 jnp.ones(a.shape[:-2] + (1,), a.dtype),
+                 a[..., i + 1 :, i]],
+                axis=-1,
+            )
+            h = (
+                jnp.broadcast_to(eye, a.shape[:-2] + (m, m))
+                - t[..., i : i + 1, None] * v[..., :, None] * v[..., None, :]
+            )
+            q = jnp.matmul(q, h)
+        return q[..., :, :n]
+
+    return apply(body, x, tau, op_name="householder_product")

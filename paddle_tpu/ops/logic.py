@@ -1,0 +1,55 @@
+"""Comparison / logical ops (paddle.tensor.logic parity,
+/root/reference/python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from .registry import defop
+
+__all__ = [
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "logical_and", "logical_or", "logical_not", "logical_xor",
+    "bitwise_and", "bitwise_or", "bitwise_not", "bitwise_xor",
+    "isclose", "allclose", "equal_all", "is_empty", "is_tensor",
+]
+
+equal = defop("equal")(lambda x, y: jnp.equal(x, y))
+not_equal = defop("not_equal")(lambda x, y: jnp.not_equal(x, y))
+greater_than = defop("greater_than")(lambda x, y: jnp.greater(x, y))
+greater_equal = defop("greater_equal")(lambda x, y: jnp.greater_equal(x, y))
+less_than = defop("less_than")(lambda x, y: jnp.less(x, y))
+less_equal = defop("less_equal")(lambda x, y: jnp.less_equal(x, y))
+logical_and = defop("logical_and")(lambda x, y: jnp.logical_and(x, y))
+logical_or = defop("logical_or")(lambda x, y: jnp.logical_or(x, y))
+logical_not = defop("logical_not")(lambda x: jnp.logical_not(x))
+logical_xor = defop("logical_xor")(lambda x, y: jnp.logical_xor(x, y))
+bitwise_and = defop("bitwise_and")(lambda x, y: jnp.bitwise_and(x, y))
+bitwise_or = defop("bitwise_or")(lambda x, y: jnp.bitwise_or(x, y))
+bitwise_not = defop("bitwise_not")(lambda x: jnp.bitwise_not(x))
+bitwise_xor = defop("bitwise_xor")(lambda x, y: jnp.bitwise_xor(x, y))
+
+
+@defop("isclose")
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@defop("allclose")
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@defop("equal_all")
+def equal_all(x, y):
+    return jnp.array_equal(x, y)
+
+
+@defop("is_empty")
+def is_empty(x):
+    return jnp.asarray(x.size == 0)
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

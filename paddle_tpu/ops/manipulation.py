@@ -1,0 +1,440 @@
+"""Shape / layout manipulation ops
+(paddle.tensor.manipulation parity, /root/reference/python/paddle/tensor/manipulation.py)."""
+from __future__ import annotations
+
+import builtins
+
+import jax.numpy as jnp
+import numpy as np
+
+_pyslice = builtins.slice
+_pymin = builtins.min
+_pyabs = builtins.abs
+
+from ..core.dispatch import apply
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+from .registry import OPS, OpDef
+
+__all__ = [
+    "reshape", "transpose", "flatten", "squeeze", "unsqueeze", "concat", "stack",
+    "split", "chunk", "slice", "gather", "gather_nd", "scatter", "scatter_nd_add",
+    "index_select", "masked_select", "tile", "expand", "expand_as", "broadcast_to",
+    "flip", "rot90", "roll", "unbind", "unstack", "cast", "take_along_axis",
+    "put_along_axis", "repeat_interleave", "moveaxis", "as_real", "as_complex",
+    "view", "view_as", "tensor_split", "dsplit", "hsplit", "vsplit", "crop",
+    "index_put", "index_add", "fill_diagonal", "pad",
+]
+
+
+def _reg(fn, name=None):
+    name = name or fn.__name__
+    OPS[name] = OpDef(name=name, fn=fn, category="manipulation")
+    return fn
+
+
+def _shape_arg(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    out = []
+    for s in shape:
+        out.append(int(s.item()) if isinstance(s, Tensor) else int(s))
+    return tuple(out)
+
+
+@_reg
+def reshape(x, shape, name=None):
+    sh = _shape_arg(shape)
+    return apply(lambda v: jnp.reshape(v, sh), x, op_name="reshape")
+
+
+@_reg
+def transpose(x, perm=None, name=None):
+    p = None if perm is None else tuple(int(i) for i in perm)
+    return apply(lambda v: jnp.transpose(v, p), x, op_name="transpose")
+
+
+@_reg
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def body(v):
+        nd = v.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        new_shape = v.shape[:s] + (-1,) + v.shape[e + 1 :]
+        return jnp.reshape(v, new_shape)
+
+    return apply(body, x, op_name="flatten")
+
+
+@_reg
+def squeeze(x, axis=None, name=None):
+    def body(v):
+        if axis is None:
+            return jnp.squeeze(v)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        axes = tuple(a % v.ndim for a in axes if v.shape[a % v.ndim] == 1)
+        return jnp.squeeze(v, axis=axes) if axes else v
+
+    return apply(body, x, op_name="squeeze")
+
+
+@_reg
+def unsqueeze(x, axis, name=None):
+    def body(v):
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        out = v
+        for a in sorted(int(a) for a in axes):
+            out = jnp.expand_dims(out, a)
+        return out
+
+    return apply(body, x, op_name="unsqueeze")
+
+
+@_reg
+def concat(x, axis=0, name=None):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return apply(lambda *vs: jnp.concatenate(vs, axis=ax), *x, op_name="concat")
+
+
+@_reg
+def stack(x, axis=0, name=None):
+    return apply(lambda *vs: jnp.stack(vs, axis=int(axis)), *x, op_name="stack")
+
+
+@_reg
+def split(x, num_or_sections, axis=0, name=None):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+
+    def body(v):
+        if isinstance(num_or_sections, int):
+            return tuple(jnp.split(v, num_or_sections, axis=ax))
+        sections = [int(s) for s in num_or_sections]
+        total = v.shape[ax]
+        if any(s == -1 for s in sections):
+            known = sum(s for s in sections if s != -1)
+            sections = [s if s != -1 else total - known for s in sections]
+        idx = np.cumsum(sections)[:-1].tolist()
+        return tuple(jnp.split(v, idx, axis=ax))
+
+    return list(apply(body, x, op_name="split"))
+
+
+@_reg
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, int(chunks), axis)
+
+
+@_reg
+def slice(x, axes, starts, ends, name=None):
+    def body(v):
+        idx = [_pyslice(None)] * v.ndim
+        for a, s, e in zip(axes, starts, ends):
+            idx[int(a)] = _pyslice(int(s), int(e))
+        return v[tuple(idx)]
+
+    return apply(body, x, op_name="slice")
+
+
+@_reg
+def gather(x, index, axis=0, name=None):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return apply(lambda v, i: jnp.take(v, i.astype(jnp.int32), axis=ax), x, index, op_name="gather")
+
+
+@_reg
+def gather_nd(x, index, name=None):
+    def body(v, idx):
+        idx = idx.astype(jnp.int32)
+        k = idx.shape[-1]
+        flat_idx = tuple(jnp.moveaxis(idx, -1, 0))
+        return v[flat_idx]
+
+    return apply(body, x, index, op_name="gather_nd")
+
+
+@_reg
+def scatter(x, index, updates, overwrite=True, name=None):
+    def body(v, i, u):
+        i = i.astype(jnp.int32)
+        if overwrite:
+            return v.at[i].set(u)
+        # paddle semantics for overwrite=False: zero the rows then add
+        zeroed = v.at[i].set(jnp.zeros_like(u))
+        return zeroed.at[i].add(u)
+
+    return apply(body, x, index, updates, op_name="scatter")
+
+
+@_reg
+def scatter_nd_add(x, index, updates, name=None):
+    def body(v, idx, u):
+        idx = idx.astype(jnp.int32)
+        flat_idx = tuple(jnp.moveaxis(idx, -1, 0))
+        return v.at[flat_idx].add(u)
+
+    return apply(body, x, index, updates, op_name="scatter_nd_add")
+
+
+@_reg
+def index_select(x, index, axis=0, name=None):
+    return gather(x, index, axis)
+
+
+@_reg
+def masked_select(x, mask, name=None):
+    # dynamic output shape: eager-only (host round-trip), like reference CPU path
+    v = np.asarray(x._value)
+    m = np.asarray(mask._value).astype(bool)
+    return Tensor._wrap(jnp.asarray(v[m]))
+
+
+@_reg
+def tile(x, repeat_times, name=None):
+    reps = _shape_arg(repeat_times)
+    return apply(lambda v: jnp.tile(v, reps), x, op_name="tile")
+
+
+@_reg
+def expand(x, shape, name=None):
+    sh = _shape_arg(shape)
+
+    def body(v):
+        tgt = list(sh)
+        for i, s in enumerate(tgt):
+            if s == -1:
+                tgt[i] = v.shape[i - len(tgt) + v.ndim] if i - len(tgt) + v.ndim >= 0 else 1
+        return jnp.broadcast_to(v, tuple(tgt))
+
+    return apply(body, x, op_name="expand")
+
+
+@_reg
+def expand_as(x, y, name=None):
+    return apply(lambda v, w: jnp.broadcast_to(v, w.shape), x, y, op_name="expand_as")
+
+
+@_reg
+def broadcast_to(x, shape, name=None):
+    sh = _shape_arg(shape)
+    return apply(lambda v: jnp.broadcast_to(v, sh), x, op_name="broadcast_to")
+
+
+@_reg
+def flip(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = tuple(int(a) for a in axes)
+    return apply(lambda v: jnp.flip(v, axis=axes), x, op_name="flip")
+
+
+@_reg
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply(lambda v: jnp.rot90(v, k=k, axes=tuple(axes)), x, op_name="rot90")
+
+
+@_reg
+def roll(x, shifts, axis=None, name=None):
+    def body(v):
+        sh = tuple(shifts) if isinstance(shifts, (list, tuple)) else int(shifts)
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        return jnp.roll(v, sh, axis=ax)
+
+    return apply(body, x, op_name="roll")
+
+
+@_reg
+def unbind(x, axis=0, name=None):
+    n = x.shape[int(axis)]
+    return list(
+        apply(
+            lambda v: tuple(jnp.squeeze(s, axis=int(axis)) for s in jnp.split(v, n, axis=int(axis))),
+            x,
+            op_name="unbind",
+        )
+    )
+
+
+unstack = _reg(unbind, "unstack")
+
+
+@_reg
+def cast(x, dtype):
+    nd = convert_dtype(dtype)
+    return apply(lambda v: v.astype(nd), x, op_name="cast")
+
+
+@_reg
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return apply(
+        lambda v, i: jnp.take_along_axis(v, i.astype(jnp.int32), axis=int(axis)),
+        arr,
+        indices,
+        op_name="take_along_axis",
+    )
+
+
+@_reg
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    def body(v, i, u):
+        i = i.astype(jnp.int32)
+        u = jnp.broadcast_to(u, i.shape) if jnp.ndim(u) else jnp.full(i.shape, u, v.dtype)
+        if reduce == "assign":
+            return jnp.put_along_axis(v, i, u, axis=int(axis), inplace=False)
+        dims = list(range(v.ndim))
+        # build scatter via at[] with explicit meshgrid indices
+        mesh = jnp.meshgrid(*[jnp.arange(s) for s in i.shape], indexing="ij")
+        full_idx = [mesh[d] for d in dims]
+        full_idx[int(axis)] = i
+        if reduce == "add":
+            return v.at[tuple(full_idx)].add(u)
+        if reduce in ("mul", "multiply"):
+            return v.at[tuple(full_idx)].multiply(u)
+        raise ValueError(f"unsupported reduce {reduce}")
+
+    return apply(body, arr, indices, values, op_name="put_along_axis")
+
+
+@_reg
+def repeat_interleave(x, repeats, axis=None, name=None):
+    def body(v, r=None):
+        if axis is None:
+            v = v.reshape(-1)
+            ax = 0
+        else:
+            ax = int(axis)
+        if r is None:
+            return jnp.repeat(v, repeats, axis=ax)
+        total = int(np.asarray(r).sum())
+        return jnp.repeat(v, r, axis=ax, total_repeat_length=total)
+
+    if isinstance(repeats, Tensor):
+        return apply(body, x, repeats, op_name="repeat_interleave")
+    return apply(body, x, op_name="repeat_interleave")
+
+
+@_reg
+def moveaxis(x, source, destination, name=None):
+    return apply(lambda v: jnp.moveaxis(v, source, destination), x, op_name="moveaxis")
+
+
+@_reg
+def as_real(x, name=None):
+    return apply(lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1), x, op_name="as_real")
+
+
+@_reg
+def as_complex(x, name=None):
+    return apply(lambda v: jax_lax_complex(v), x, op_name="as_complex")
+
+
+def jax_lax_complex(v):
+    from jax import lax
+
+    return lax.complex(v[..., 0], v[..., 1])
+
+
+@_reg
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return cast(x, shape_or_dtype)
+
+
+@_reg
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+@_reg
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    def body(v):
+        return tuple(jnp.array_split(v, num_or_indices, axis=int(axis)))
+
+    return list(apply(body, x, op_name="tensor_split"))
+
+
+@_reg
+def dsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+@_reg
+def hsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=1)
+
+
+@_reg
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+@_reg
+def crop(x, shape=None, offsets=None, name=None):
+    sh = _shape_arg(shape)
+    offs = _shape_arg(offsets) if offsets is not None else (0,) * len(sh)
+
+    def body(v):
+        idx = tuple(_pyslice(o, o + s) for o, s in zip(offs, sh))
+        return v[idx]
+
+    return apply(body, x, op_name="crop")
+
+
+@_reg
+def index_put(x, indices, value, accumulate=False, name=None):
+    def body(v, u, *idx):
+        idx = tuple(i.astype(jnp.int32) if jnp.issubdtype(i.dtype, jnp.integer) else i for i in idx)
+        if accumulate:
+            return v.at[idx].add(u)
+        return v.at[idx].set(u)
+
+    return apply(body, x, value, *indices, op_name="index_put")
+
+
+@_reg
+def index_add(x, index, axis, value, name=None):
+    def body(v, i, u):
+        i = i.astype(jnp.int32)
+        vm = jnp.moveaxis(v, int(axis), 0)
+        um = jnp.moveaxis(u, int(axis), 0)
+        out = vm.at[i].add(um)
+        return jnp.moveaxis(out, 0, int(axis))
+
+    return apply(body, x, index, value, op_name="index_add")
+
+
+@_reg
+def fill_diagonal(x, value, offset=0, wrap=False, name=None):
+    def body(v):
+        n = _pymin(v.shape[-2], v.shape[-1])
+        i = jnp.arange(n - _pyabs(offset) if offset else n)
+        r = i + (-offset if offset < 0 else 0)
+        c = i + (offset if offset > 0 else 0)
+        return v.at[..., r, c].set(value)
+
+    return apply(body, x, op_name="fill_diagonal")
+
+
+@_reg
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    """N-d constant/reflect/replicate pad (also used by nn.functional.pad)."""
+    padding = _shape_arg(pad)
+
+    def body(v):
+        if len(padding) == 2 * v.ndim:
+            # paddle "pad for every dim" form: [d0_lo, d0_hi, d1_lo, d1_hi, ...]
+            cfg = [(padding[2 * i], padding[2 * i + 1]) for i in range(v.ndim)]
+        else:
+            # torch-style: last dims first, pairs
+            k = len(padding) // 2
+            cfg = [(0, 0)] * (v.ndim - k)
+            trailing = [
+                (padding[2 * i], padding[2 * i + 1]) for i in range(k)
+            ]
+            # paddle NCHW 4-len pad applies to spatial dims W,H in order (left,right,top,bottom)
+            cfg += list(reversed(trailing))
+        jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(v, cfg, mode="constant", constant_values=value)
+        return jnp.pad(v, cfg, mode=jmode)
+
+    return apply(body, x, op_name="pad")

@@ -1,0 +1,338 @@
+"""Elementwise math, reductions and scan ops
+(paddle.tensor.math parity, /root/reference/python/paddle/tensor/math.py).
+
+Each op body is a jnp function; ``defop`` wires it through the eager dispatch
+(autograd tape) — the reference's generated `*_ad_func` + PHI-kernel pair
+collapses to these few lines because XLA is the only backend.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from .registry import defop
+
+__all__ = [
+    "add", "subtract", "multiply", "divide", "floor_divide", "remainder", "mod",
+    "pow", "float_power", "maximum", "minimum", "fmax", "fmin",
+    "exp", "expm1", "log", "log2", "log10", "log1p", "sqrt", "rsqrt", "square",
+    "abs", "sign", "sin", "cos", "tan", "asin", "acos", "atan", "atan2",
+    "sinh", "cosh", "tanh", "asinh", "acosh", "atanh",
+    "floor", "ceil", "round", "trunc", "frac", "reciprocal", "neg", "negative",
+    "erf", "erfinv", "lgamma", "digamma", "clip", "lerp", "logit",
+    "sum", "mean", "max", "min", "amax", "amin", "prod", "all", "any",
+    "logsumexp", "cumsum", "cumprod", "cummax", "cummin", "nansum", "nanmean",
+    "isnan", "isinf", "isfinite", "nan_to_num",
+    "add_n", "scale", "stanh", "multiplex", "inner", "outer",
+    "heaviside", "rad2deg", "deg2rad", "gcd", "lcm", "diff", "angle",
+    "count_nonzero", "kron", "trace", "log_normal",
+]
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+# ---------------------------------------------------------------------------
+# binary elementwise
+# ---------------------------------------------------------------------------
+
+add = defop("add")(lambda x, y: jnp.add(x, y))
+subtract = defop("subtract")(lambda x, y: jnp.subtract(x, y))
+multiply = defop("multiply")(lambda x, y: jnp.multiply(x, y))
+divide = defop("divide")(lambda x, y: jnp.true_divide(x, y))
+floor_divide = defop("floor_divide")(lambda x, y: jnp.floor_divide(x, y))
+remainder = defop("remainder")(lambda x, y: jnp.remainder(x, y))
+mod = remainder
+pow = defop("pow")(lambda x, y: jnp.power(x, y))
+float_power = defop("float_power")(lambda x, y: jnp.float_power(x, y))
+maximum = defop("maximum")(lambda x, y: jnp.maximum(x, y))
+minimum = defop("minimum")(lambda x, y: jnp.minimum(x, y))
+fmax = defop("fmax")(lambda x, y: jnp.fmax(x, y))
+fmin = defop("fmin")(lambda x, y: jnp.fmin(x, y))
+atan2 = defop("atan2")(lambda x, y: jnp.arctan2(x, y))
+heaviside = defop("heaviside")(lambda x, y: jnp.heaviside(x, y))
+gcd = defop("gcd")(lambda x, y: jnp.gcd(x, y))
+lcm = defop("lcm")(lambda x, y: jnp.lcm(x, y))
+kron = defop("kron")(lambda x, y: jnp.kron(x, y))
+inner = defop("inner")(lambda x, y: jnp.inner(x, y))
+outer = defop("outer")(lambda x, y: jnp.outer(x, y))
+
+# ---------------------------------------------------------------------------
+# unary elementwise
+# ---------------------------------------------------------------------------
+
+exp = defop("exp")(lambda x: jnp.exp(x))
+expm1 = defop("expm1")(lambda x: jnp.expm1(x))
+log = defop("log")(lambda x: jnp.log(x))
+log2 = defop("log2")(lambda x: jnp.log2(x))
+log10 = defop("log10")(lambda x: jnp.log10(x))
+log1p = defop("log1p")(lambda x: jnp.log1p(x))
+sqrt = defop("sqrt")(lambda x: jnp.sqrt(x))
+rsqrt = defop("rsqrt")(lambda x: jnp.reciprocal(jnp.sqrt(x)))
+square = defop("square")(lambda x: jnp.square(x))
+abs = defop("abs")(lambda x: jnp.abs(x))
+sign = defop("sign")(lambda x: jnp.sign(x))
+sin = defop("sin")(lambda x: jnp.sin(x))
+cos = defop("cos")(lambda x: jnp.cos(x))
+tan = defop("tan")(lambda x: jnp.tan(x))
+asin = defop("asin")(lambda x: jnp.arcsin(x))
+acos = defop("acos")(lambda x: jnp.arccos(x))
+atan = defop("atan")(lambda x: jnp.arctan(x))
+sinh = defop("sinh")(lambda x: jnp.sinh(x))
+cosh = defop("cosh")(lambda x: jnp.cosh(x))
+tanh = defop("tanh")(lambda x: jnp.tanh(x))
+asinh = defop("asinh")(lambda x: jnp.arcsinh(x))
+acosh = defop("acosh")(lambda x: jnp.arccosh(x))
+atanh = defop("atanh")(lambda x: jnp.arctanh(x))
+floor = defop("floor")(lambda x: jnp.floor(x))
+ceil = defop("ceil")(lambda x: jnp.ceil(x))
+round = defop("round")(lambda x: jnp.round(x))
+trunc = defop("trunc")(lambda x: jnp.trunc(x))
+frac = defop("frac")(lambda x: x - jnp.trunc(x))
+reciprocal = defop("reciprocal")(lambda x: jnp.reciprocal(x))
+neg = defop("neg")(lambda x: jnp.negative(x))
+negative = neg
+rad2deg = defop("rad2deg")(lambda x: jnp.rad2deg(x))
+deg2rad = defop("deg2rad")(lambda x: jnp.deg2rad(x))
+angle = defop("angle")(lambda x: jnp.angle(x))
+isnan = defop("isnan")(lambda x: jnp.isnan(x))
+isinf = defop("isinf")(lambda x: jnp.isinf(x))
+isfinite = defop("isfinite")(lambda x: jnp.isfinite(x))
+
+
+@defop("erf")
+def erf(x):
+    from jax.scipy.special import erf as _erf
+
+    return _erf(x)
+
+
+@defop("erfinv")
+def erfinv(x):
+    from jax.scipy.special import erfinv as _erfinv
+
+    return _erfinv(x)
+
+
+@defop("lgamma")
+def lgamma(x):
+    from jax.scipy.special import gammaln
+
+    return gammaln(x)
+
+
+@defop("digamma")
+def digamma(x):
+    from jax.scipy.special import digamma as _digamma
+
+    return _digamma(x)
+
+
+@defop("logit")
+def logit(x, eps=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x) - jnp.log1p(-x)
+
+
+@defop("clip")
+def clip(x, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+@defop("lerp")
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+@defop("stanh")
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+@defop("nan_to_num")
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+@defop("scale")
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None):
+    out = x * scale + bias if bias_after_scale else (x + bias) * scale
+    return out
+
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        ax = axis.numpy().tolist()
+        return tuple(ax) if isinstance(ax, list) else int(ax)
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _reduce(name, jfn, int_promote=False):
+    def op(x, axis=None, keepdim=False, dtype=None, name=None):
+        ax = _axis(axis)
+
+        def body(v):
+            out = jfn(v, axis=ax, keepdims=keepdim)
+            if dtype is not None:
+                from ..core.dtype import convert_dtype
+
+                out = out.astype(convert_dtype(dtype))
+            return out
+
+        return apply(body, x, op_name=name)
+
+    op.__name__ = name
+    from .registry import OPS, OpDef
+
+    OPS[name] = OpDef(name=name, fn=op)
+    return op
+
+
+sum = _reduce("sum", jnp.sum)
+mean = _reduce("mean", jnp.mean)
+prod = _reduce("prod", jnp.prod)
+amax = _reduce("amax", jnp.max)
+amin = _reduce("amin", jnp.min)
+nansum = _reduce("nansum", jnp.nansum)
+nanmean = _reduce("nanmean", jnp.nanmean)
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.max(v, axis=_axis(axis), keepdims=keepdim), x, op_name="max")
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.min(v, axis=_axis(axis), keepdims=keepdim), x, op_name="min")
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.all(v, axis=_axis(axis), keepdims=keepdim), x, op_name="all")
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.any(v, axis=_axis(axis), keepdims=keepdim), x, op_name="any")
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return apply(
+        lambda v: jnp.count_nonzero(v, axis=_axis(axis), keepdims=keepdim), x, op_name="count_nonzero"
+    )
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    from jax.scipy.special import logsumexp as _lse
+
+    return apply(lambda v: _lse(v, axis=_axis(axis), keepdims=keepdim), x, op_name="logsumexp")
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda v: jnp.trace(v, offset=offset, axis1=axis1, axis2=axis2), x, op_name="trace")
+
+
+# ---------------------------------------------------------------------------
+# scans
+# ---------------------------------------------------------------------------
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    def body(v):
+        if axis is None:
+            v = v.reshape(-1)
+            return jnp.cumsum(v)
+        return jnp.cumsum(v, axis=int(axis))
+
+    return apply(body, x, op_name="cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    return apply(lambda v: jnp.cumprod(v, axis=int(dim)), x, op_name="cumprod")
+
+
+def _cum_extreme(x, axis, better, op_name):
+    """Running max/min with indices (paddle returns (values, indices))."""
+
+    def body(v):
+        vv = v.reshape(-1) if axis is None else v
+        ax = 0 if axis is None else int(axis) % vv.ndim
+        vm = jnp.moveaxis(vv, ax, 0)
+
+        def step(carry, inp):
+            best, best_idx = carry
+            val, i = inp
+            take = better(val, best)
+            new_best = jnp.where(take, val, best)
+            new_idx = jnp.where(take, i, best_idx)
+            return (new_best, new_idx), (new_best, new_idx)
+
+        n = vm.shape[0]
+        init = (vm[0], jnp.zeros_like(vm[0], jnp.int64))
+        _, (vals, idxs) = jax.lax.scan(
+            step, init, (vm, jnp.arange(n, dtype=jnp.int64))
+        )
+        return jnp.moveaxis(vals, 0, ax), jnp.moveaxis(idxs, 0, ax)
+
+    return apply(body, x, op_name=op_name)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    return _cum_extreme(x, axis, lambda a, b: a >= b, "cummax")
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    return _cum_extreme(x, axis, lambda a, b: a <= b, "cummin")
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    def body(v, prepend=None, append=None):
+        return jnp.diff(v, n=n, axis=axis, prepend=prepend, append=append)
+
+    return apply(body, x, prepend=_v(prepend) if prepend is not None else None,
+                 append=_v(append) if append is not None else None, op_name="diff")
+
+
+# ---------------------------------------------------------------------------
+# misc
+# ---------------------------------------------------------------------------
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    return apply(lambda *vs: functools_reduce(vs), *inputs, op_name="add_n")
+
+
+def functools_reduce(vs):
+    out = vs[0]
+    for v in vs[1:]:
+        out = out + v
+    return out
+
+
+def multiplex(inputs, index, name=None):
+    def body(idx, *vs):
+        stacked = jnp.stack(vs, axis=0)
+        return jnp.take_along_axis(
+            stacked, idx.reshape(1, -1, *([1] * (stacked.ndim - 2))).astype(jnp.int32), axis=0
+        )[0]
+
+    return apply(body, index, *inputs, op_name="multiplex")
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, dtype=None, name=None):
+    from . import random as _random
+
+    return _random.standard_normal_impl(shape, dtype, lambda z: jnp.exp(mean + std * z))

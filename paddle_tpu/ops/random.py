@@ -1,0 +1,172 @@
+"""Random sampling ops (paddle.tensor.random parity,
+/root/reference/python/paddle/tensor/random.py). Keys come from
+framework.random so eager calls follow ``paddle.seed`` and jitted code uses
+the functional rng scope."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core.tensor import Tensor
+from ..framework.random import next_key
+from .registry import OPS, OpDef
+
+__all__ = [
+    "rand", "randn", "standard_normal", "normal", "uniform", "randint",
+    "randint_like", "randperm", "bernoulli", "multinomial", "poisson",
+    "exponential_", "uniform_", "normal_", "rand_like", "randn_like", "gumbel_softmax",
+]
+
+
+def _reg(fn):
+    OPS[fn.__name__] = OpDef(name=fn.__name__, fn=fn, category="random")
+    return fn
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def _dt(dtype):
+    return dtype_mod.convert_dtype(dtype or dtype_mod.get_default_dtype())
+
+
+@_reg
+def rand(shape, dtype=None, name=None):
+    return Tensor._wrap(jax.random.uniform(next_key(), _shape(shape), _dt(dtype)))
+
+
+@_reg
+def randn(shape, dtype=None, name=None):
+    return Tensor._wrap(jax.random.normal(next_key(), _shape(shape), _dt(dtype)))
+
+
+standard_normal = _reg(randn)
+
+
+def standard_normal_impl(shape, dtype, transform):
+    z = jax.random.normal(next_key(), _shape(shape), _dt(dtype))
+    return Tensor._wrap(transform(z))
+
+
+@_reg
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    m = mean._value if isinstance(mean, Tensor) else mean
+    s = std._value if isinstance(std, Tensor) else std
+    if shape is None:
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+    else:
+        shp = _shape(shape)
+    z = jax.random.normal(next_key(), shp, _dt(None))
+    return Tensor._wrap(m + s * z)
+
+
+@_reg
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    return Tensor._wrap(
+        jax.random.uniform(next_key(), _shape(shape), _dt(dtype), minval=float(min), maxval=float(max))
+    )
+
+
+@_reg
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor._wrap(
+        jax.random.randint(next_key(), _shape(shape), int(low), int(high), _dt(dtype))
+    )
+
+
+@_reg
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    return randint(low, high, x.shape, dtype or str(x.dtype))
+
+
+@_reg
+def randperm(n, dtype="int64", name=None):
+    return Tensor._wrap(jax.random.permutation(next_key(), int(n)).astype(_dt(dtype)))
+
+
+@_reg
+def bernoulli(x, name=None):
+    p = x._value
+    return Tensor._wrap(
+        jax.random.bernoulli(next_key(), p, p.shape).astype(p.dtype)
+    )
+
+
+@_reg
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    p = x._value
+    logits = jnp.log(jnp.maximum(p, 1e-30))
+    if replacement:
+        out = jax.random.categorical(next_key(), logits, axis=-1, shape=(
+            (p.shape[0], num_samples) if p.ndim == 2 else (num_samples,)
+        ))
+        if p.ndim == 2:
+            out = out.reshape(p.shape[0], num_samples)
+    else:
+        k = next_key()
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(k, p.shape)
+        scored = logits + g
+        out = jax.lax.top_k(scored, num_samples)[1]
+    return Tensor._wrap(out.astype(jnp.int64))
+
+
+@_reg
+def poisson(x, name=None):
+    return Tensor._wrap(jax.random.poisson(next_key(), x._value).astype(x._value.dtype))
+
+
+@_reg
+def exponential_(x, lam=1.0, name=None):
+    u = jax.random.uniform(next_key(), x._value.shape, x._value.dtype, minval=1e-7, maxval=1.0)
+    x._value = -jnp.log(u) / lam
+    return x
+
+
+@_reg
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    x._value = jax.random.uniform(
+        next_key(), x._value.shape, x._value.dtype, minval=float(min), maxval=float(max)
+    )
+    return x
+
+
+@_reg
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x._value = mean + std * jax.random.normal(next_key(), x._value.shape, x._value.dtype)
+    return x
+
+
+@_reg
+def rand_like(x, dtype=None, name=None):
+    return rand(x.shape, dtype or str(x.dtype))
+
+
+@_reg
+def randn_like(x, dtype=None, name=None):
+    return randn(x.shape, dtype or str(x.dtype))
+
+
+@_reg
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ..core.dispatch import apply
+
+    g = jax.random.gumbel(next_key(), tuple(x.shape), x._value.dtype)
+
+    def body(v):
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:
+            one_hot = (y == jnp.max(y, axis=axis, keepdims=True)).astype(y.dtype)
+            return one_hot + y - jax.lax.stop_gradient(y)  # straight-through
+        return y
+
+    return apply(body, x, op_name="gumbel_softmax")

@@ -1,0 +1,80 @@
+"""Op registry.
+
+TPU-native counterpart of PHI's kernel registry
+(/root/reference/paddle/phi/core/kernel_factory.h:61,
+ /root/reference/paddle/phi/core/kernel_registry.h:406 and the YAML op schema
+ /root/reference/paddle/phi/api/yaml/ops.yaml): one table mapping op name →
+implementation. There is a single backend (XLA) so the KernelKey reduces to
+the name; alternate Pallas implementations register under the same name with
+``variant="pallas"`` and are selected by ``paddle_tpu.kernels`` policy.
+
+The registry also powers op-coverage accounting against the reference's YAML
+op inventory (BASELINE.md op-coverage metric).
+"""
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+
+__all__ = ["OpDef", "OPS", "register", "defop", "op_coverage"]
+
+
+@dataclass
+class OpDef:
+    name: str
+    fn: object
+    impl: object = None
+    variants: dict = field(default_factory=dict)  # e.g. {"pallas": fn}
+    category: str = "core"
+
+
+OPS: dict[str, OpDef] = {}
+
+
+def register(name, category="core", impl=None):
+    """Register an already-built eager op function."""
+
+    def deco(fn):
+        OPS[name] = OpDef(name=name, fn=fn, impl=impl, category=category)
+        return fn
+
+    return deco
+
+
+def defop(name, category="core"):
+    """Build + register an eager op from a jnp-level body.
+
+    The body receives raw jax arrays wherever callers pass Tensors; the
+    wrapper routes through core.dispatch.apply for autograd taping.
+    """
+
+    def deco(jfn):
+        from ..core.dispatch import apply
+
+        @functools.wraps(jfn)
+        def op(*args, **kwargs):
+            kwargs.pop("name", None)  # paddle APIs accept a cosmetic name=
+            return apply(jfn, *args, op_name=name, **kwargs)
+
+        OPS[name] = OpDef(name=name, fn=op, impl=jfn, category=category)
+        return op
+
+    return deco
+
+
+def register_variant(name, variant):
+    """Attach an alternate implementation (e.g. a Pallas kernel) to an op."""
+
+    def deco(fn):
+        if name in OPS:
+            OPS[name].variants[variant] = fn
+        else:
+            OPS[name] = OpDef(name=name, fn=fn, variants={variant: fn})
+        return fn
+
+    return deco
+
+
+def op_coverage():
+    """Count registered ops (for the BASELINE op-coverage metric)."""
+    return len(OPS)

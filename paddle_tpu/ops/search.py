@@ -1,0 +1,244 @@
+"""Search / sort ops (paddle.tensor.search parity,
+/root/reference/python/paddle/tensor/search.py).
+
+Ops with data-dependent output shapes (nonzero, unique without a fixed size)
+run eagerly via a host round-trip — the XLA-friendly variants take a static
+``size``/run under jit with padding.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from .registry import OPS, OpDef
+
+__all__ = [
+    "argmax", "argmin", "argsort", "sort", "topk", "where", "nonzero",
+    "index_sample", "searchsorted", "unique", "unique_consecutive", "mode",
+    "kthvalue", "median", "quantile", "bucketize", "histogram",
+]
+
+
+def _reg(fn):
+    OPS[fn.__name__] = OpDef(name=fn.__name__, fn=fn, category="search")
+    return fn
+
+
+def _axis(axis):
+    return None if axis is None else int(axis)
+
+
+@_reg
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    from ..core.dtype import convert_dtype
+
+    nd = convert_dtype(dtype)
+
+    def body(v):
+        if axis is None:
+            return jnp.argmax(v.reshape(-1)).astype(nd)
+        return jnp.argmax(v, axis=int(axis), keepdims=keepdim).astype(nd)
+
+    return apply(body, x, op_name="argmax")
+
+
+@_reg
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    from ..core.dtype import convert_dtype
+
+    nd = convert_dtype(dtype)
+
+    def body(v):
+        if axis is None:
+            return jnp.argmin(v.reshape(-1)).astype(nd)
+        return jnp.argmin(v, axis=int(axis), keepdims=keepdim).astype(nd)
+
+    return apply(body, x, op_name="argmin")
+
+
+@_reg
+def argsort(x, axis=-1, descending=False, name=None):
+    def body(v):
+        idx = jnp.argsort(v, axis=int(axis), descending=descending)
+        return idx.astype(jnp.int64)
+
+    return apply(body, x, op_name="argsort")
+
+
+@_reg
+def sort(x, axis=-1, descending=False, name=None):
+    def body(v):
+        out = jnp.sort(v, axis=int(axis), descending=descending)
+        return out
+
+    return apply(body, x, op_name="sort")
+
+
+@_reg
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    kk = int(k.item()) if isinstance(k, Tensor) else int(k)
+
+    def body(v):
+        ax = int(axis) % v.ndim
+        vm = jnp.moveaxis(v, ax, -1)
+        if largest:
+            vals, idx = jax_topk(vm, kk)
+        else:
+            vals, idx = jax_topk(-vm, kk)
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype(jnp.int64), -1, ax)
+
+    return apply(body, x, op_name="topk")
+
+
+def jax_topk(v, k):
+    from jax import lax
+
+    return lax.top_k(v, k)
+
+
+@_reg
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return apply(lambda c, a, b: jnp.where(c, a, b), condition, x, y, op_name="where")
+
+
+@_reg
+def nonzero(x, as_tuple=False):
+    arr = np.asarray(x._value)
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor._wrap(jnp.asarray(i[:, None].astype(np.int64))) for i in nz)
+    return Tensor._wrap(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)))
+
+
+@_reg
+def index_sample(x, index):
+    return apply(
+        lambda v, i: jnp.take_along_axis(v, i.astype(jnp.int32), axis=1),
+        x,
+        index,
+        op_name="index_sample",
+    )
+
+
+@_reg
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    def body(s, v):
+        side = "right" if right else "left"
+        if s.ndim == 1:
+            out = jnp.searchsorted(s, v, side=side)
+        else:
+            out = jnp.stack(
+                [jnp.searchsorted(s[i], v[i], side=side) for i in range(s.shape[0])]
+            )
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+    return apply(body, sorted_sequence, values, op_name="searchsorted")
+
+
+@_reg
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+@_reg
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    arr = np.asarray(x._value)
+    res = np.unique(
+        arr, return_index=return_index, return_inverse=return_inverse,
+        return_counts=return_counts, axis=axis,
+    )
+    if not isinstance(res, tuple):
+        return Tensor._wrap(jnp.asarray(res))
+    outs = [Tensor._wrap(jnp.asarray(r)) for r in res]
+    # paddle's output order is (out, index, inverse, counts)
+    return tuple(outs)
+
+
+@_reg
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    arr = np.asarray(x._value)
+    if axis is None:
+        arr = arr.reshape(-1)
+    keep = np.ones(arr.shape[0], bool)
+    keep[1:] = np.any(
+        arr[1:] != arr[:-1], axis=tuple(range(1, arr.ndim))
+    ) if arr.ndim > 1 else arr[1:] != arr[:-1]
+    out = arr[keep]
+    rets = [Tensor._wrap(jnp.asarray(out))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        rets.append(Tensor._wrap(jnp.asarray(inv.astype(np.int64))))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, arr.shape[0]))
+        rets.append(Tensor._wrap(jnp.asarray(counts.astype(np.int64))))
+    return rets[0] if len(rets) == 1 else tuple(rets)
+
+
+@_reg
+def mode(x, axis=-1, keepdim=False, name=None):
+    arr = np.asarray(x._value)
+    ax = int(axis) % arr.ndim
+    moved = np.moveaxis(arr, ax, -1)
+    flat = moved.reshape(-1, moved.shape[-1])
+    vals = np.empty(flat.shape[0], arr.dtype)
+    idxs = np.empty(flat.shape[0], np.int64)
+    for i, row in enumerate(flat):
+        uniq, counts = np.unique(row, return_counts=True)
+        v = uniq[np.argmax(counts)]
+        vals[i] = v
+        idxs[i] = np.where(row == v)[0][-1]
+    shp = moved.shape[:-1]
+    vals, idxs = vals.reshape(shp), idxs.reshape(shp)
+    if keepdim:
+        vals, idxs = np.expand_dims(vals, ax), np.expand_dims(idxs, ax)
+    return Tensor._wrap(jnp.asarray(vals)), Tensor._wrap(jnp.asarray(idxs))
+
+
+@_reg
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def body(v):
+        ax = int(axis) % v.ndim
+        sorted_v = jnp.sort(v, axis=ax)
+        sorted_i = jnp.argsort(v, axis=ax)
+        vals = jnp.take(sorted_v, k - 1, axis=ax)
+        idx = jnp.take(sorted_i, k - 1, axis=ax).astype(jnp.int64)
+        if keepdim:
+            vals, idx = jnp.expand_dims(vals, ax), jnp.expand_dims(idx, ax)
+        return vals, idx
+
+    return apply(body, x, op_name="kthvalue")
+
+
+@_reg
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    def body(v):
+        return jnp.median(v, axis=None if axis is None else int(axis), keepdims=keepdim)
+
+    return apply(body, x, op_name="median")
+
+
+@_reg
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    def body(v):
+        return jnp.quantile(
+            v, jnp.asarray(q), axis=None if axis is None else int(axis),
+            keepdims=keepdim, method=interpolation,
+        )
+
+    return apply(body, x, op_name="quantile")
+
+
+@_reg
+def histogram(x, bins=100, min=0, max=0, name=None):
+    arr = np.asarray(x._value)  # range needs concrete values when min==max==0
+    lo, hi = (float(min), float(max))
+    if lo == 0 and hi == 0:
+        lo, hi = float(arr.min()), float(arr.max())
+    hist, _ = np.histogram(arr, bins=int(bins), range=(lo, hi))
+    return Tensor._wrap(jnp.asarray(hist.astype(np.int64)))

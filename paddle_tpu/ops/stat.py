@@ -1,0 +1,57 @@
+"""Statistics ops (paddle.tensor.stat parity)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from .registry import OPS, OpDef
+
+__all__ = ["std", "var", "numel", "shape", "rank"]
+
+
+def _reg(fn):
+    OPS[fn.__name__] = OpDef(name=fn.__name__, fn=fn, category="stat")
+    return fn
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+@_reg
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(
+        lambda v: jnp.std(v, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim),
+        x,
+        op_name="std",
+    )
+
+
+@_reg
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(
+        lambda v: jnp.var(v, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim),
+        x,
+        op_name="var",
+    )
+
+
+@_reg
+def numel(x, name=None):
+    return Tensor._wrap(jnp.asarray(np.int64(x.size)))
+
+
+@_reg
+def shape(x):
+    return Tensor._wrap(jnp.asarray(np.asarray(x.shape, np.int64)))
+
+
+@_reg
+def rank(x):
+    return Tensor._wrap(jnp.asarray(np.int64(x.ndim)))

@@ -1,0 +1,19 @@
+"""paddle.optimizer parity surface."""
+from . import lr  # noqa: F401
+from .optimizer import Optimizer  # noqa: F401
+from .optimizers import (  # noqa: F401
+    SGD,
+    Adadelta,
+    Adagrad,
+    Adam,
+    Adamax,
+    AdamW,
+    Lamb,
+    Momentum,
+    RMSProp,
+)
+
+__all__ = [
+    "Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adagrad", "RMSProp",
+    "Adadelta", "Adamax", "Lamb", "lr",
+]

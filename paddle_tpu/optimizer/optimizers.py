@@ -1,0 +1,236 @@
+"""Concrete optimizers: SGD/Momentum/Adam/AdamW/Adagrad/RMSProp/Adadelta/
+Adamax/Lamb (parity: /root/reference/python/paddle/optimizer/*.py).
+Update rules are pure jnp — XLA fuses each into a single elementwise kernel,
+playing the role of the reference's fused/multi-tensor optimizer kernels."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+__all__ = ["SGD", "Momentum", "Adam", "AdamW", "Adagrad", "RMSProp", "Adadelta", "Adamax", "Lamb"]
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+
+    def update(self, param, grad, state, lr):
+        return param - lr * grad, state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def init_state(self, param_value):
+        return {"velocity": jnp.zeros_like(param_value)}
+
+    def update(self, param, grad, state, lr):
+        v = self._momentum * state["velocity"] + grad
+        if self._nesterov:
+            new_p = param - lr * (grad + self._momentum * v)
+        else:
+            new_p = param - lr * v
+        return new_p, {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def init_state(self, param_value):
+        return {
+            "moment1": jnp.zeros_like(param_value),
+            "moment2": jnp.zeros_like(param_value),
+            "beta1_pow": jnp.ones((), param_value.dtype),
+            "beta2_pow": jnp.ones((), param_value.dtype),
+        }
+
+    def update(self, param, grad, state, lr):
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        m = b1 * state["moment1"] + (1 - b1) * grad
+        v = b2 * state["moment2"] + (1 - b2) * jnp.square(grad)
+        mhat = m / (1 - b1p)
+        vhat = v / (1 - b2p)
+        new_p = param - lr * mhat / (jnp.sqrt(vhat) + eps)
+        return new_p, {"moment1": m, "moment2": v, "beta1_pow": b1p, "beta2_pow": b2p}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py)."""
+
+    _decoupled_wd = True
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision, name)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def update(self, param, grad, state, lr, decay=True):
+        wd = float(self._weight_decay or 0.0)
+        if wd and decay:
+            param = param * (1.0 - lr * wd)
+        return super().update(param, grad, state, lr)
+
+    @property
+    def _wd_step(self):
+        return float(self._weight_decay or 0.0)
+
+    def step(self):
+        # honor apply_decay_param_fun by masking decay per-parameter
+        if self._apply_decay_param_fun is None:
+            return super().step()
+        fn = self._apply_decay_param_fun
+        from ..core.autograd import no_grad
+        from ..core.tensor import Tensor
+
+        with no_grad():
+            lr = self.get_lr()
+            params = self._parameter_list or []
+            grads_and_params = [
+                (p, p._grad) for p in params if p._grad is not None and p.trainable
+            ]
+            if self._grad_clip is not None:
+                clipped = self._grad_clip(
+                    [(p, Tensor._wrap(g)) for p, g in grads_and_params]
+                )
+                grads_and_params = [(p, g._value) for p, g in clipped]
+            for p, g in grads_and_params:
+                g = g.astype(p._value.dtype)
+                st = self._state_for(p)
+                decay = bool(fn(p.name)) if p.name else True
+                new_p, new_st = self.update(p._value, g, st, lr, decay=decay)
+                p._value = new_p
+                self._accumulators[id(p)] = new_st
+            self._step_count += 1
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def init_state(self, param_value):
+        return {"moment": jnp.full_like(param_value, self._init_acc)}
+
+    def update(self, param, grad, state, lr):
+        acc = state["moment"] + jnp.square(grad)
+        return param - lr * grad / (jnp.sqrt(acc) + self._eps), {"moment": acc}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._eps, self._momentum, self._centered = rho, epsilon, momentum, centered
+
+    def init_state(self, param_value):
+        st = {
+            "mean_square": jnp.zeros_like(param_value),
+            "momentum": jnp.zeros_like(param_value),
+        }
+        if self._centered:
+            st["mean_grad"] = jnp.zeros_like(param_value)
+        return st
+
+    def update(self, param, grad, state, lr):
+        rho = self._rho
+        ms = rho * state["mean_square"] + (1 - rho) * jnp.square(grad)
+        st = {"mean_square": ms}
+        if self._centered:
+            mg = rho * state["mean_grad"] + (1 - rho) * grad
+            denom = jnp.sqrt(ms - jnp.square(mg) + self._eps)
+            st["mean_grad"] = mg
+        else:
+            denom = jnp.sqrt(ms + self._eps)
+        mom = self._momentum * state["momentum"] + lr * grad / denom
+        st["momentum"] = mom
+        return param - mom, st
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._eps = rho, epsilon
+
+    def init_state(self, param_value):
+        return {
+            "avg_squared_grad": jnp.zeros_like(param_value),
+            "avg_squared_update": jnp.zeros_like(param_value),
+        }
+
+    def update(self, param, grad, state, lr):
+        rho, eps = self._rho, self._eps
+        asg = rho * state["avg_squared_grad"] + (1 - rho) * jnp.square(grad)
+        upd = jnp.sqrt(state["avg_squared_update"] + eps) / jnp.sqrt(asg + eps) * grad
+        asu = rho * state["avg_squared_update"] + (1 - rho) * jnp.square(upd)
+        return param - lr * upd, {"avg_squared_grad": asg, "avg_squared_update": asu}
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def init_state(self, param_value):
+        return {
+            "moment": jnp.zeros_like(param_value),
+            "inf_norm": jnp.zeros_like(param_value),
+            "beta1_pow": jnp.ones((), param_value.dtype),
+        }
+
+    def update(self, param, grad, state, lr):
+        b1, b2 = self._beta1, self._beta2
+        b1p = state["beta1_pow"] * b1
+        m = b1 * state["moment"] + (1 - b1) * grad
+        u = jnp.maximum(b2 * state["inf_norm"], jnp.abs(grad))
+        new_p = param - lr / (1 - b1p) * m / (u + self._eps)
+        return new_p, {"moment": m, "inf_norm": u, "beta1_pow": b1p}
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._wd = lamb_weight_decay
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def init_state(self, param_value):
+        return {
+            "moment1": jnp.zeros_like(param_value),
+            "moment2": jnp.zeros_like(param_value),
+            "beta1_pow": jnp.ones((), param_value.dtype),
+            "beta2_pow": jnp.ones((), param_value.dtype),
+        }
+
+    def update(self, param, grad, state, lr):
+        b1, b2 = self._beta1, self._beta2
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        m = b1 * state["moment1"] + (1 - b1) * grad
+        v = b2 * state["moment2"] + (1 - b2) * jnp.square(grad)
+        mhat = m / (1 - b1p)
+        vhat = v / (1 - b2p)
+        r = mhat / (jnp.sqrt(vhat) + self._eps) + self._wd * param
+        w_norm = jnp.linalg.norm(param)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        new_p = param - lr * trust * r
+        return new_p, {"moment1": m, "moment2": v, "beta1_pow": b1p, "beta2_pow": b2p}

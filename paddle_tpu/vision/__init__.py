@@ -1,0 +1,3 @@
+from . import datasets, models, transforms  # noqa: F401
+
+__all__ = ["datasets", "models", "transforms"]

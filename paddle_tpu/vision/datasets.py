@@ -1,0 +1,109 @@
+"""Vision datasets (reference: /root/reference/python/paddle/vision/datasets/).
+
+No-network environment: MNIST reads the standard idx files from ``root`` when
+present, otherwise generates a deterministic synthetic-but-learnable digit set
+(class-template + noise) so the LeNet end-to-end config (BASELINE config #1)
+runs hermetically — the same role the reference's fake-device CI plays.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100"]
+
+
+def _synthetic_images(n, num_classes, hw, seed, channels=1, template_seed=1234):
+    # templates are shared across train/test splits (template_seed); only the
+    # sample noise differs per split, so the task generalizes
+    h, w = hw
+    templates = np.random.RandomState(template_seed).rand(
+        num_classes, channels, h, w).astype(np.float32)
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, num_classes, n).astype(np.int64)
+    noise = rng.rand(n, channels, h, w).astype(np.float32) * 0.8
+    images = templates[labels] + noise
+    images = (images / images.max() * 255).astype(np.uint8)
+    return images, labels
+
+
+class MNIST(Dataset):
+    """MNIST; synthetic fallback when idx files are absent."""
+
+    NUM_CLASSES = 10
+    HW = (28, 28)
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None, root=None):
+        self.mode = mode
+        self.transform = transform
+        images = labels = None
+        root = root or image_path
+        if root and os.path.isdir(root):
+            prefix = "train" if mode == "train" else "t10k"
+            img_f = os.path.join(root, f"{prefix}-images-idx3-ubyte.gz")
+            lbl_f = os.path.join(root, f"{prefix}-labels-idx1-ubyte.gz")
+            if os.path.exists(img_f) and os.path.exists(lbl_f):
+                images = self._read_idx_images(img_f)
+                labels = self._read_idx_labels(lbl_f)
+        if images is None:
+            n = 2048 if mode == "train" else 512
+            images, labels = _synthetic_images(
+                n, self.NUM_CLASSES, self.HW, seed=0 if mode == "train" else 1)
+            images = images[:, 0]  # HW, single channel
+        self.images = images
+        self.labels = labels
+
+    @staticmethod
+    def _read_idx_images(path):
+        with gzip.open(path, "rb") as f:
+            _, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            return np.frombuffer(f.read(), np.uint8).reshape(n, rows, cols)
+
+    @staticmethod
+    def _read_idx_labels(path):
+        with gzip.open(path, "rb") as f:
+            _, n = struct.unpack(">II", f.read(8))
+            return np.frombuffer(f.read(), np.uint8).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32)[None]  # 1,28,28
+        img = img / 127.5 - 1.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(self.labels[idx], np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    NUM_CLASSES = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None, download=True, backend=None):
+        self.transform = transform
+        n = 2048 if mode == "train" else 512
+        self.images, self.labels = _synthetic_images(
+            n, self.NUM_CLASSES, (32, 32), seed=2 if mode == "train" else 3, channels=3)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32) / 127.5 - 1.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(self.labels[idx], np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    NUM_CLASSES = 100

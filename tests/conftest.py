@@ -1,0 +1,13 @@
+"""Test harness: force an 8-device virtual CPU mesh BEFORE jax import.
+
+This is the analogue of the reference's fake `custom_cpu` plugin device used
+to test the runtime without hardware (SURVEY.md §4: test/custom_runtime/) and
+of its single-node multi-proc distributed tests — sharding/collective tests
+run on 8 virtual CPU devices.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"

@@ -1,0 +1,170 @@
+"""Autograd tape tests — the reference's eager backward semantics
+(test model: /root/reference/test/legacy_test check_grad + autograd suite)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_chain():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = paddle.exp(paddle.log(x) * 3.0)  # x^3
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 12.0, rtol=1e-5)
+
+
+def test_multi_use_accumulation():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2.0
+    z = (y + y * y).sum()  # dz/dx = 2 + 8x
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [10.0, 18.0])
+
+
+def test_grad_accumulates_across_backwards():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).sum().backward()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([2.0], stop_gradient=True)
+    (x * y).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    assert y.grad is None
+
+
+def test_detach_cuts_graph():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * 2).detach()
+    z = y * 3
+    assert z.stop_gradient
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    y2 = x * 2
+    assert not y2.stop_gradient
+
+
+def test_backward_nonscalar_seeds_ones_or_takes_grad_tensor():
+    # paddle parity: non-scalar backward seeds with ones
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    (x * 2).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+    x2 = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    (x2 * 2).backward(paddle.to_tensor([1.0, 0.5]))
+    np.testing.assert_allclose(x2.grad.numpy(), [2.0, 1.0])
+
+
+def test_grad_of_output_wrt_itself():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    (gy,) = paddle.grad(y, y)
+    np.testing.assert_allclose(gy.numpy(), [1.0, 1.0])
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [12.0])
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x * x
+    (gx,) = paddle.grad(y, x)
+    np.testing.assert_allclose(gx.numpy(), [12.0])
+    assert x.grad is None  # paddle.grad does not pollute .grad
+
+
+def test_grad_allow_unused():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    z = paddle.to_tensor([1.0], stop_gradient=False)
+    with pytest.raises(RuntimeError):
+        paddle.grad(x * 2, [x, z])
+    gx, gz = paddle.grad(x * 2, [x, z], allow_unused=True)
+    assert gz is None
+    np.testing.assert_allclose(gx.numpy(), [2.0])
+
+
+def test_register_hook():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    seen = []
+
+    def hook(g):
+        seen.append(g.numpy().copy())
+        return g * 2
+
+    h = x.register_hook(hook)
+    (x * 3).sum().backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])  # doubled by hook
+    h.remove()
+    x.clear_grad()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0])
+
+
+def test_retain_grads_intermediate():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    y.retain_grads()
+    (y * 3).sum().backward()
+    np.testing.assert_allclose(y.grad.numpy(), [3.0])
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor(np.array([[3.0, 1.0, 2.0]], np.float32), stop_gradient=False)
+    vals, idx = paddle.topk(x, k=2)
+    vals.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[1.0, 0.0, 1.0]])
+
+
+def test_branching_graph():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    a = x * 2
+    b = x * 3
+    (a * b).sum().backward()  # d/dx 6x^2 = 12x
+    np.testing.assert_allclose(x.grad.numpy(), [12.0])
+
+
+def test_pylayer():
+    class Double(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, grad):
+            (x,) = ctx.saved_tensor()
+            return grad * 2 + x * 0
+
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = Double.apply(x)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+def test_grad_flows_through_getitem_concat():
+    x = paddle.to_tensor(np.ones((2, 2), np.float32), stop_gradient=False)
+    y = paddle.concat([x[0], x[1] * 2], axis=0)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[1, 1], [2, 2]])

@@ -1,0 +1,188 @@
+"""nn.Layer + layers + functional bridge tests
+(parity model: /root/reference/test/legacy_test/test_layers.py)."""
+import jax
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.nn import functional_call, functional_state
+
+
+def test_linear_forward_backward():
+    paddle.seed(0)
+    lin = nn.Linear(4, 3)
+    x = paddle.to_tensor(np.random.rand(2, 4).astype(np.float32), stop_gradient=False)
+    y = lin(x)
+    assert y.shape == [2, 3]
+    ref = x.numpy() @ lin.weight.numpy() + lin.bias.numpy()
+    np.testing.assert_allclose(y.numpy(), ref, rtol=2e-2)
+    y.sum().backward()
+    assert lin.weight.grad is not None and lin.weight.grad.shape == [4, 3]
+    assert lin.bias.grad is not None
+
+
+def test_layer_registration_and_state_dict():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(4, 8)
+            self.fc2 = nn.Linear(8, 2, bias_attr=False)
+
+        def forward(self, x):
+            return self.fc2(F.relu(self.fc1(x)))
+
+    net = Net()
+    names = [n for n, _ in net.named_parameters()]
+    assert names == ["fc1.weight", "fc1.bias", "fc2.weight"]
+    sd = net.state_dict()
+    assert set(sd) == {"fc1.weight", "fc1.bias", "fc2.weight"}
+
+    net2 = Net()
+    net2.set_state_dict({k: v.numpy() for k, v in sd.items()})
+    np.testing.assert_array_equal(net2.fc1.weight.numpy(), net.fc1.weight.numpy())
+
+
+def test_train_eval_and_dropout():
+    d = nn.Dropout(0.5)
+    x = paddle.ones([100, 100])
+    d.train()
+    out = d(x)
+    assert 0.2 < float((out.numpy() == 0).mean()) < 0.8
+    d.eval()
+    np.testing.assert_array_equal(d(x).numpy(), x.numpy())
+
+
+def test_conv2d_matches_reference_math():
+    paddle.seed(1)
+    conv = nn.Conv2D(2, 3, 3, padding=1)
+    x = paddle.to_tensor(np.random.rand(1, 2, 8, 8).astype(np.float32), stop_gradient=False)
+    y = conv(x)
+    assert y.shape == [1, 3, 8, 8]
+    y.sum().backward()
+    assert conv.weight.grad.shape == list(conv.weight.shape)
+    # stride/valid padding shape math
+    conv2 = nn.Conv2D(2, 4, 3, stride=2)
+    assert conv2(x).shape == [1, 4, 3, 3]
+
+
+def test_batchnorm_running_stats():
+    bn = nn.BatchNorm2D(3)
+    x = paddle.to_tensor((np.random.rand(4, 3, 5, 5) * 10).astype(np.float32))
+    bn.train()
+    y = bn(x)
+    # batch-normalized output ~ zero mean unit var per channel
+    out = y.numpy()
+    assert abs(out.mean()) < 1e-4
+    assert abs(out.std() - 1) < 1e-2
+    # running stats moved toward batch stats
+    assert bn._mean.numpy().mean() > 0
+    bn.eval()
+    y2 = bn(x)
+    assert y2.shape == [4, 3, 5, 5]
+
+
+def test_layernorm():
+    ln = nn.LayerNorm(8)
+    x = paddle.to_tensor(np.random.rand(2, 4, 8).astype(np.float32) * 5)
+    y = ln(x).numpy()
+    np.testing.assert_allclose(y.mean(-1), 0, atol=1e-5)
+    np.testing.assert_allclose(y.std(-1), 1, atol=1e-2)
+
+
+def test_pooling():
+    x = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    mp = nn.MaxPool2D(2, 2)
+    np.testing.assert_array_equal(mp(x).numpy(), [[[[5, 7], [13, 15]]]])
+    ap = nn.AvgPool2D(2, 2)
+    np.testing.assert_allclose(ap(x).numpy(), [[[[2.5, 4.5], [10.5, 12.5]]]])
+    aap = nn.AdaptiveAvgPool2D(1)
+    np.testing.assert_allclose(aap(x).numpy(), [[[[7.5]]]])
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4)
+    ids = paddle.to_tensor([[1, 2], [3, 4]])
+    out = emb(ids)
+    assert out.shape == [2, 2, 4]
+    np.testing.assert_allclose(out.numpy()[0, 0], emb.weight.numpy()[1])
+    out.sum().backward()
+    assert emb.weight.grad is not None
+
+
+def test_activations():
+    x = paddle.to_tensor([-1.0, 0.0, 2.0])
+    np.testing.assert_allclose(F.relu(x).numpy(), [0, 0, 2])
+    np.testing.assert_allclose(F.sigmoid(x).numpy(), 1 / (1 + np.exp([1.0, 0, -2.0])), rtol=1e-5)
+    s = F.softmax(x).numpy()
+    np.testing.assert_allclose(s.sum(), 1.0, rtol=1e-6)
+    assert F.gelu(x).shape == [3]
+    np.testing.assert_allclose(F.leaky_relu(x, 0.1).numpy(), [-0.1, 0, 2], rtol=1e-6)
+
+
+def test_cross_entropy_losses():
+    logits = paddle.to_tensor(np.array([[2.0, 1.0, 0.1], [0.5, 2.5, 0.3]], np.float32),
+                              stop_gradient=False)
+    labels = paddle.to_tensor([0, 1])
+    loss = F.cross_entropy(logits, labels)
+    # reference: -log softmax picked
+    lp = np.log(np.exp(logits.numpy()) / np.exp(logits.numpy()).sum(-1, keepdims=True))
+    expected = -(lp[0, 0] + lp[1, 1]) / 2
+    np.testing.assert_allclose(loss.numpy(), expected, rtol=1e-5)
+    loss.backward()
+    assert logits.grad is not None
+
+    mse = F.mse_loss(paddle.ones([2, 2]), paddle.zeros([2, 2]))
+    assert mse.item() == 1.0
+
+
+def test_sequential_layerlist():
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    x = paddle.ones([1, 4])
+    assert net(x).shape == [1, 2]
+    assert len(net) == 3
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    assert len(list(ll.parameters())) == 6
+
+
+def test_hooks():
+    lin = nn.Linear(2, 2)
+    calls = []
+    h = lin.register_forward_post_hook(lambda layer, inp, out: calls.append(1))
+    lin(paddle.ones([1, 2]))
+    assert calls == [1]
+    h.remove()
+    lin(paddle.ones([1, 2]))
+    assert calls == [1]
+
+
+def test_functional_call_pure_and_jit():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    params, buffers = functional_state(net)
+    x = np.random.rand(3, 4).astype(np.float32)
+
+    out_eager = net(paddle.to_tensor(x)).numpy()
+    out_fn, _ = functional_call(net, params, buffers, x)
+    np.testing.assert_allclose(np.asarray(out_fn), out_eager, rtol=1e-5)
+
+    # under jit + grad
+    def loss_fn(p, xv):
+        out, _ = functional_call(net, p, buffers, xv)
+        return out.sum()
+
+    g = jax.jit(jax.grad(loss_fn))(params, x)
+    assert set(g) == set(params)
+    assert g["0.weight"].shape == (4, 8)
+    # params unchanged after tracing (no leak)
+    np.testing.assert_allclose(net(paddle.to_tensor(x)).numpy(), out_eager, rtol=1e-6)
+
+
+def test_functional_call_threads_batchnorm_buffers():
+    bn = nn.BatchNorm2D(2)
+    params, buffers = functional_state(bn)
+    x = np.random.rand(4, 2, 3, 3).astype(np.float32)
+    out, new_buffers = functional_call(bn, params, buffers, x, training=True)
+    assert not np.allclose(np.asarray(new_buffers["_mean"]), np.asarray(buffers["_mean"]))
+    # eager buffers untouched by the functional call
+    np.testing.assert_array_equal(bn._mean.numpy(), np.zeros(2, np.float32))

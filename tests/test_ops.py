@@ -1,0 +1,235 @@
+"""Op tests modeled on the reference OpTest
+(/root/reference/test/legacy_test/eager_op_test.py:377): numpy forward parity
++ analytic-vs-numeric gradient checks."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+RTOL = 2e-2  # tf32-class matmul precision
+ATOL = 1e-5
+
+
+def numeric_grad(fn, x, eps=1e-3):
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        i = it.multi_index
+        xp = x.copy(); xp[i] += eps
+        xm = x.copy(); xm[i] -= eps
+        g[i] = (fn(xp) - fn(xm)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+def check_grad(op, x_np, rtol=5e-2, atol=1e-3):
+    x = paddle.to_tensor(x_np, stop_gradient=False)
+    y = op(x)
+    y.sum().backward()
+    num = numeric_grad(lambda a: float(op(paddle.to_tensor(a)).sum().numpy()), x_np.astype(np.float64))
+    np.testing.assert_allclose(x.grad.numpy(), num, rtol=rtol, atol=atol)
+
+
+class TestCreation:
+    def test_zeros_ones_full(self):
+        assert paddle.zeros([2, 3]).numpy().sum() == 0
+        assert paddle.ones([2, 3], "int32").dtype == np.int32
+        np.testing.assert_array_equal(paddle.full([2], 7).numpy(), [7, 7])
+        t = paddle.ones([3])
+        np.testing.assert_array_equal(paddle.zeros_like(t).numpy(), [0, 0, 0])
+
+    def test_arange_linspace_eye(self):
+        np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+        np.testing.assert_array_equal(paddle.arange(1, 7, 2).numpy(), [1, 3, 5])
+        assert paddle.arange(3).dtype == np.int64
+        assert paddle.arange(0.0, 1.0, 0.25).dtype == np.float32
+        np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5))
+        np.testing.assert_array_equal(paddle.eye(3).numpy(), np.eye(3))
+
+    def test_tril_triu_diag(self):
+        a = np.arange(9, dtype=np.float32).reshape(3, 3)
+        np.testing.assert_array_equal(paddle.tril(paddle.to_tensor(a)).numpy(), np.tril(a))
+        np.testing.assert_array_equal(paddle.triu(paddle.to_tensor(a), 1).numpy(), np.triu(a, 1))
+        np.testing.assert_array_equal(paddle.diag(paddle.to_tensor([1.0, 2.0])).numpy(), np.diag([1.0, 2.0]))
+
+
+class TestMath:
+    def test_elementwise_binary(self):
+        a = np.random.rand(3, 4).astype(np.float32) + 0.5
+        b = np.random.rand(3, 4).astype(np.float32) + 0.5
+        ta, tb = paddle.to_tensor(a), paddle.to_tensor(b)
+        for op, ref in [
+            (paddle.add, np.add), (paddle.subtract, np.subtract),
+            (paddle.multiply, np.multiply), (paddle.divide, np.divide),
+            (paddle.maximum, np.maximum), (paddle.minimum, np.minimum),
+            (paddle.pow, np.power),
+        ]:
+            np.testing.assert_allclose(op(ta, tb).numpy(), ref(a, b), rtol=1e-5)
+
+    def test_unary(self):
+        a = np.random.rand(10).astype(np.float32) * 0.8 + 0.1
+        t = paddle.to_tensor(a)
+        for op, ref in [
+            (paddle.exp, np.exp), (paddle.log, np.log), (paddle.sqrt, np.sqrt),
+            (paddle.abs, np.abs), (paddle.sin, np.sin), (paddle.cos, np.cos),
+            (paddle.tanh, np.tanh), (paddle.floor, np.floor), (paddle.ceil, np.ceil),
+            (paddle.square, np.square), (paddle.log1p, np.log1p),
+        ]:
+            np.testing.assert_allclose(op(t).numpy(), ref(a), rtol=2e-4, atol=1e-5)
+
+    def test_broadcasting(self):
+        a = paddle.ones([3, 1])
+        b = paddle.to_tensor(np.arange(4, dtype=np.float32))
+        assert (a + b).shape == [3, 4]
+
+    def test_reductions(self):
+        a = np.random.rand(2, 3, 4).astype(np.float32)
+        t = paddle.to_tensor(a)
+        np.testing.assert_allclose(paddle.sum(t).numpy(), a.sum(), rtol=1e-5)
+        np.testing.assert_allclose(paddle.mean(t, axis=1).numpy(), a.mean(1), rtol=1e-5)
+        np.testing.assert_allclose(paddle.max(t, axis=[0, 2]).numpy(), a.max((0, 2)))
+        np.testing.assert_allclose(paddle.sum(t, axis=-1, keepdim=True).numpy(), a.sum(-1, keepdims=True), rtol=1e-5)
+        np.testing.assert_allclose(paddle.prod(t, axis=0).numpy(), a.prod(0), rtol=1e-5)
+        np.testing.assert_allclose(paddle.logsumexp(t, axis=1).numpy(),
+                                   np.log(np.exp(a).sum(1)), rtol=1e-4)
+
+    def test_cumsum_clip(self):
+        a = np.arange(6, dtype=np.float32).reshape(2, 3)
+        np.testing.assert_allclose(paddle.cumsum(paddle.to_tensor(a), axis=1).numpy(), a.cumsum(1))
+        np.testing.assert_allclose(
+            paddle.clip(paddle.to_tensor(a), 1.0, 4.0).numpy(), a.clip(1, 4))
+
+    def test_grad_checks(self):
+        x = np.random.rand(3, 3).astype(np.float32) + 0.5
+        check_grad(paddle.exp, x)
+        check_grad(paddle.log, x)
+        check_grad(paddle.sqrt, x)
+        check_grad(paddle.tanh, x)
+        check_grad(lambda t: paddle.sum(t * t), x)
+        check_grad(lambda t: paddle.mean(t, axis=0), x)
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        a = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        t = paddle.to_tensor(a)
+        assert paddle.reshape(t, [4, 6]).shape == [4, 6]
+        assert paddle.reshape(t, [-1, 12]).shape == [2, 12]
+        np.testing.assert_array_equal(
+            paddle.transpose(t, [2, 0, 1]).numpy(), a.transpose(2, 0, 1))
+        assert paddle.flatten(t, 1, 2).shape == [2, 12]
+
+    def test_squeeze_unsqueeze(self):
+        t = paddle.ones([1, 3, 1])
+        assert paddle.squeeze(t).shape == [3]
+        assert paddle.squeeze(t, axis=0).shape == [3, 1]
+        assert paddle.unsqueeze(t, [0, 4]).shape == [1, 1, 3, 1, 1]
+
+    def test_concat_stack_split(self):
+        a = paddle.ones([2, 3])
+        b = paddle.zeros([2, 3])
+        assert paddle.concat([a, b], axis=0).shape == [4, 3]
+        assert paddle.stack([a, b], axis=1).shape == [2, 2, 3]
+        parts = paddle.split(paddle.ones([6, 2]), 3, axis=0)
+        assert len(parts) == 3 and parts[0].shape == [2, 2]
+        parts = paddle.split(paddle.ones([7, 2]), [2, 4, -1], axis=0)
+        assert [p.shape[0] for p in parts] == [2, 4, 1]
+
+    def test_gather_scatter(self):
+        a = np.arange(12, dtype=np.float32).reshape(4, 3)
+        idx = paddle.to_tensor([0, 2])
+        np.testing.assert_array_equal(paddle.gather(paddle.to_tensor(a), idx).numpy(), a[[0, 2]])
+        out = paddle.scatter(paddle.to_tensor(a), idx, paddle.zeros([2, 3]))
+        assert out.numpy()[0].sum() == 0 and out.numpy()[2].sum() == 0
+
+    def test_tile_expand_flip(self):
+        t = paddle.to_tensor([[1.0, 2.0]])
+        assert paddle.tile(t, [2, 3]).shape == [2, 6]
+        assert paddle.expand(t, [4, 2]).shape == [4, 2]
+        np.testing.assert_array_equal(paddle.flip(t, axis=1).numpy(), [[2.0, 1.0]])
+
+    def test_take_along_put_along(self):
+        a = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+        idx = paddle.to_tensor(np.array([[0], [1]]))
+        out = paddle.take_along_axis(paddle.to_tensor(a), idx, axis=1)
+        np.testing.assert_array_equal(out.numpy(), [[1.0], [4.0]])
+
+
+class TestLogicSearch:
+    def test_comparisons(self):
+        a = paddle.to_tensor([1.0, 2.0, 3.0])
+        b = paddle.to_tensor([2.0, 2.0, 2.0])
+        np.testing.assert_array_equal(paddle.equal(a, b).numpy(), [False, True, False])
+        np.testing.assert_array_equal(paddle.greater_than(a, b).numpy(), [False, False, True])
+        assert paddle.allclose(a, a).item()
+        assert not paddle.equal_all(a, b).item()
+
+    def test_argmax_sort_topk(self):
+        a = np.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]], np.float32)
+        t = paddle.to_tensor(a)
+        np.testing.assert_array_equal(paddle.argmax(t, axis=1).numpy(), [0, 1])
+        assert paddle.argmax(t).item() == 4
+        np.testing.assert_array_equal(paddle.sort(t, axis=1).numpy(), np.sort(a, 1))
+        np.testing.assert_array_equal(paddle.argsort(t, axis=1).numpy(), np.argsort(a, 1))
+        vals, idx = paddle.topk(t, 2, axis=1)
+        np.testing.assert_array_equal(vals.numpy(), [[3.0, 2.0], [5.0, 4.0]])
+        np.testing.assert_array_equal(idx.numpy(), [[0, 2], [1, 2]])
+
+    def test_where_nonzero_masked(self):
+        a = paddle.to_tensor([1.0, -2.0, 3.0])
+        out = paddle.where(a > 0, a, paddle.zeros_like(a))
+        np.testing.assert_array_equal(out.numpy(), [1.0, 0.0, 3.0])
+        nz = paddle.nonzero(a > 0)
+        np.testing.assert_array_equal(nz.numpy(), [[0], [2]])
+        np.testing.assert_array_equal(paddle.masked_select(a, a > 0).numpy(), [1.0, 3.0])
+
+    def test_unique(self):
+        out = paddle.unique(paddle.to_tensor([3, 1, 2, 1, 3]))
+        np.testing.assert_array_equal(out.numpy(), [1, 2, 3])
+
+
+class TestLinalg:
+    def test_matmul_shapes(self):
+        a = paddle.ones([2, 3, 4])
+        b = paddle.ones([2, 4, 5])
+        assert paddle.matmul(a, b).shape == [2, 3, 5]
+        assert paddle.matmul(a, b, transpose_x=False, transpose_y=False).shape == [2, 3, 5]
+        x = paddle.ones([3, 2])
+        assert paddle.matmul(x, x, transpose_x=True).shape == [2, 2]
+
+    def test_matmul_values(self):
+        a = np.random.rand(4, 3).astype(np.float32)
+        b = np.random.rand(3, 5).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+            a @ b, rtol=RTOL)
+
+    def test_einsum_norm(self):
+        a = np.random.rand(2, 3).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.einsum("ij->ji", paddle.to_tensor(a)).numpy(), a.T)
+        np.testing.assert_allclose(
+            paddle.norm(paddle.to_tensor(a)).numpy(), np.linalg.norm(a), rtol=1e-5)
+
+    def test_solve_inv(self):
+        a = np.array([[2.0, 0.0], [0.0, 4.0]], np.float32)
+        np.testing.assert_allclose(
+            paddle.inv(paddle.to_tensor(a)).numpy(), np.linalg.inv(a), rtol=1e-5)
+
+
+class TestRandom:
+    def test_seed_reproducible(self):
+        paddle.seed(42)
+        a = paddle.rand([4])
+        paddle.seed(42)
+        b = paddle.rand([4])
+        np.testing.assert_array_equal(a.numpy(), b.numpy())
+
+    def test_shapes_ranges(self):
+        u = paddle.uniform([100], min=-2, max=-1)
+        assert (u.numpy() < -0.999).all() and (u.numpy() >= -2).all()
+        r = paddle.randint(0, 5, [50])
+        assert r.dtype == np.int64
+        assert (r.numpy() >= 0).all() and (r.numpy() < 5).all()
+        p = paddle.randperm(10)
+        np.testing.assert_array_equal(np.sort(p.numpy()), np.arange(10))

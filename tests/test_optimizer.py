@@ -1,0 +1,125 @@
+"""Optimizer + LR scheduler tests
+(parity model: /root/reference/test/legacy_test/test_sgd_op.py etc.)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.optimizer import SGD, Adam, AdamW, Momentum, RMSProp, lr
+
+
+def _quadratic_problem():
+    paddle.seed(0)
+    target = np.array([1.0, -2.0, 3.0], np.float32)
+    w = paddle.Parameter(np.zeros(3, np.float32))
+    return w, target
+
+
+def _train(opt, w, target, steps=200):
+    for _ in range(steps):
+        loss = ((w - paddle.to_tensor(target)) ** 2).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return w.numpy()
+
+
+@pytest.mark.parametrize("opt_cls,kwargs,steps", [
+    (SGD, dict(learning_rate=0.1), 200),
+    (Momentum, dict(learning_rate=0.05, momentum=0.9), 200),
+    (Adam, dict(learning_rate=0.1), 300),
+    (AdamW, dict(learning_rate=0.1, weight_decay=0.0), 300),
+    (RMSProp, dict(learning_rate=0.05), 400),
+])
+def test_converges(opt_cls, kwargs, steps):
+    w, target = _quadratic_problem()
+    opt = opt_cls(parameters=[w], **kwargs)
+    final = _train(opt, w, target, steps)
+    np.testing.assert_allclose(final, target, atol=0.05)
+
+
+def test_sgd_exact_step():
+    w = paddle.Parameter(np.array([1.0], np.float32))
+    opt = SGD(learning_rate=0.5, parameters=[w])
+    (w * 3.0).sum().backward()
+    opt.step()
+    np.testing.assert_allclose(w.numpy(), [1.0 - 0.5 * 3.0])
+
+
+def test_adamw_decoupled_decay():
+    w = paddle.Parameter(np.array([10.0], np.float32))
+    opt = AdamW(learning_rate=0.0, weight_decay=0.1, parameters=[w])
+    (w * 1.0).sum().backward()
+    opt.step()
+    # lr=0 => update comes only from decay factor (1 - lr*wd) = 1.0 => unchanged
+    np.testing.assert_allclose(w.numpy(), [10.0])
+
+
+def test_optimizer_state_dict_roundtrip():
+    w = paddle.Parameter(np.ones(2, np.float32))
+    opt = Adam(learning_rate=0.1, parameters=[w])
+    (w**2).sum().backward()
+    opt.step()
+    sd = opt.state_dict()
+    opt2 = Adam(learning_rate=0.1, parameters=[w])
+    opt2.set_state_dict(sd)
+    st = opt2._accumulators[id(w)]
+    np.testing.assert_allclose(np.asarray(st["moment1"]),
+                               np.asarray(opt._accumulators[id(w)]["moment1"]))
+
+
+def test_minimize():
+    w = paddle.Parameter(np.array([4.0], np.float32))
+    opt = SGD(learning_rate=0.25, parameters=[w])
+    loss = (w * w).sum()
+    opt.minimize(loss)
+    np.testing.assert_allclose(w.numpy(), [4.0 - 0.25 * 8.0])
+    assert w.grad is None  # minimize clears grads
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        s = lr.StepDecay(0.1, step_size=2, gamma=0.5)
+        lrs = []
+        for _ in range(5):
+            lrs.append(round(s.get_lr(), 6))
+            s.step()
+        assert lrs == [0.1, 0.1, 0.05, 0.05, 0.025]
+
+    def test_cosine(self):
+        s = lr.CosineAnnealingDecay(1.0, T_max=10)
+        assert s.get_lr() == pytest.approx(1.0)
+        s.step(10)
+        assert s.get_lr() == pytest.approx(0.0, abs=1e-6)
+
+    def test_linear_warmup(self):
+        s = lr.LinearWarmup(0.1, warmup_steps=10, start_lr=0.0, end_lr=0.1)
+        assert s.get_lr() == pytest.approx(0.0)
+        s.step(5)
+        assert s.get_lr() == pytest.approx(0.05)
+        s.step(15)
+        assert s.get_lr() == pytest.approx(0.1)
+
+    def test_piecewise(self):
+        s = lr.PiecewiseDecay([3, 6], [0.1, 0.01, 0.001])
+        s.step(0)
+        assert s.get_lr() == 0.1
+        s.step(4)
+        assert s.get_lr() == 0.01
+        s.step(7)
+        assert s.get_lr() == 0.001
+
+    def test_scheduler_drives_optimizer(self):
+        w = paddle.Parameter(np.array([1.0], np.float32))
+        sched = lr.StepDecay(0.1, step_size=1, gamma=0.1)
+        opt = SGD(learning_rate=sched, parameters=[w])
+        assert opt.get_lr() == pytest.approx(0.1)
+        sched.step()
+        assert opt.get_lr() == pytest.approx(0.01)
+
+    def test_reduce_on_plateau(self):
+        s = lr.ReduceOnPlateau(0.1, patience=1, factor=0.5)
+        s.step(1.0)
+        s.step(1.0)
+        s.step(1.0)
+        assert s.get_lr() == pytest.approx(0.05)
