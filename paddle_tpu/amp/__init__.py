@@ -1,0 +1,191 @@
+"""paddle.amp parity (reference: /root/reference/python/paddle/amp/
+auto_cast.py:646 O1 white/black-list casting, grad_scaler.py:41,576).
+
+TPU-native stance: bf16 is the native mixed-precision dtype — no loss scaling
+needed (GradScaler becomes an optional no-op that keeps the fp16 API shape).
+O1 = whitelist ops (matmul/conv) compute in bf16; O2 = cast the whole model.
+In eager mode auto_cast drives the dispatch-level cast; under jit the engine
+casts params/inputs once per step (Model.prepare(amp_configs)/strategy.amp).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler", "amp_state",
+           "WHITE_LIST", "BLACK_LIST"]
+
+_state = threading.local()
+
+# reference O1 lists (auto_cast.py): compute-bound ops benefit from bf16;
+# numerically sensitive ops stay f32
+WHITE_LIST = {"matmul", "linear", "conv1d", "conv2d", "conv3d", "bmm", "mm", "einsum"}
+BLACK_LIST = {
+    "exp", "log", "logsumexp", "softmax", "log_softmax", "cross_entropy",
+    "layer_norm", "batch_norm", "rms_norm", "mean", "sum", "norm", "cumsum",
+}
+
+
+def amp_state():
+    return getattr(_state, "amp", None)
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    if not enable:
+        yield
+        return
+    prev = amp_state()
+    white = set(WHITE_LIST) | set(custom_white_list or ())
+    black = set(BLACK_LIST) | set(custom_black_list or ())
+    _state.amp = {
+        "dtype": convert_dtype(dtype),
+        "level": level,
+        "white": white,
+        "black": black,
+    }
+    from ..core import dispatch as _dispatch
+
+    _dispatch._amp_cast = op_cast_plan
+    try:
+        yield
+    finally:
+        _state.amp = prev
+        if prev is None:
+            _dispatch._amp_cast = None
+
+
+amp_guard = auto_cast
+
+
+def op_cast_plan(op_name):
+    """Called by core.dispatch: -> (mode, dtype). mode 'down' casts f32 args
+    to the amp dtype, 'up' casts low-precision args back to f32, None leaves
+    args alone."""
+    st = amp_state()
+    if st is None:
+        return None, None
+    if st["level"] == "O2":
+        if op_name in st["black"]:
+            return "up", jnp.float32
+        return "down", st["dtype"]
+    if op_name in st["white"]:
+        return "down", st["dtype"]
+    if op_name in st["black"]:
+        return "up", jnp.float32
+    return None, None
+
+
+def _is_f(a):
+    return hasattr(a, "dtype") and a.dtype in (jnp.float32, np.float32)
+
+
+def _is_lp(a):
+    return hasattr(a, "dtype") and a.dtype in (jnp.bfloat16, jnp.float16)
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2: cast model params to the amp dtype (reference paddle.amp.decorate)."""
+    nd = convert_dtype(dtype)
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    for m in model_list:
+        for p in m.parameters():
+            if p.dtype == np.float32:
+                p._value = p._value.astype(nd)
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaling (reference grad_scaler.py:41). With bf16 on TPU
+    scaling is mathematically unnecessary; the class keeps fp16-style API
+    parity (scale/unscale_/step/update/minimize) and implements real dynamic
+    scaling when enabled for float16 experiments."""
+
+    def __init__(self, enable=True, init_loss_scaling=32768.0, incr_ratio=2.0,
+                 decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        return loss * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale
+        found_inf = False
+        for p in optimizer._parameter_list or []:
+            if p._grad is not None:
+                g = p._grad * inv
+                finite = bool(np.isfinite(np.asarray(g)).all())
+                found_inf = found_inf or not finite
+                p._grad = g
+        self._found_inf = found_inf
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+        optimizer.clear_grad()
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def state_dict(self):
+        return {"scale": self._scale, "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, state):
+        self._scale = state.get("scale", self._scale)
+        self._good_steps = state.get("good_steps", 0)
+        self._bad_steps = state.get("bad_steps", 0)

@@ -29,6 +29,35 @@ def _is_tensor(x):
     return isinstance(x, Tensor)
 
 
+# set by paddle_tpu.amp when an auto_cast context is active (avoids an
+# import cycle and keeps the non-amp fast path free of any check but `is None`)
+_amp_cast = None
+
+
+def _amp_precast(op_name, args, kwargs):
+    """Cast Tensor args per amp policy via dtype-cast ops (autograd-visible)."""
+    import jax.numpy as jnp
+
+    mode, dt = _amp_cast(op_name)
+    if mode is None:
+        return args, kwargs
+    leaves, treedef = tree_util.tree_flatten((args, kwargs), is_leaf=_is_tensor)
+    changed = False
+    for i, l in enumerate(leaves):
+        if not isinstance(l, Tensor):
+            continue
+        cur = l._value.dtype
+        if mode == "down" and cur == jnp.float32:
+            leaves[i] = l.astype(dt)
+            changed = True
+        elif mode == "up" and cur in (jnp.bfloat16, jnp.float16):
+            leaves[i] = l.astype(dt)
+            changed = True
+    if not changed:
+        return args, kwargs
+    return tree_util.tree_unflatten(treedef, leaves)
+
+
 def apply(fn, *args, op_name="op", **kwargs):
     """Run ``fn`` eagerly with Tensor args unwrapped to arrays, recording a
     GradNode when any float input requires grad.
@@ -37,6 +66,9 @@ def apply(fn, *args, op_name="op", **kwargs):
     ``args``/``kwargs``, nested in lists/tuples/dicts) and must return a jax
     array or a tuple of jax arrays.
     """
+    if _amp_cast is not None and op_name != "cast":
+        args, kwargs = _amp_precast(op_name, args, kwargs)
+
     leaves, treedef = tree_util.tree_flatten((args, kwargs), is_leaf=_is_tensor)
     tensor_pos = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
 

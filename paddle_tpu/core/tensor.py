@@ -36,6 +36,7 @@ class Tensor:
         "name",
         "persistable",
         "trainable",
+        "sharding_spec",  # PartitionSpec annotation used by distributed engine
         "__weakref__",
     )
 
@@ -54,6 +55,7 @@ class Tensor:
         self.name = name
         self.persistable = False
         self.trainable = True
+        self.sharding_spec = None
 
     # -- construction -----------------------------------------------------
     @classmethod
@@ -69,6 +71,7 @@ class Tensor:
         t.name = name
         t.persistable = False
         t.trainable = True
+        t.sharding_spec = None
         return t
 
     # -- metadata ---------------------------------------------------------

@@ -1,0 +1,63 @@
+"""paddle.distributed parity surface, TPU-native (SURVEY §2.3, §5.8)."""
+from . import collective, fleet  # noqa: F401
+from .collective import (  # noqa: F401
+    ReduceOp,
+    all_gather,
+    all_reduce,
+    all_to_all,
+    alltoall,
+    barrier,
+    broadcast,
+    new_group,
+    ppermute,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+    shard_to_group,
+    unshard,
+)
+from .engine import DistributedEngine  # noqa: F401
+from .mesh import (  # noqa: F401
+    HybridCommunicateGroup,
+    P,
+    build_mesh,
+    current_mesh,
+    get_hybrid_communicate_group,
+    set_hybrid_communicate_group,
+)
+from .mp_layers import (  # noqa: F401
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    mark_sharding,
+)
+from .parallel import (  # noqa: F401
+    DataParallel,
+    ParallelEnv,
+    get_rank,
+    get_world_size,
+    init_parallel_env,
+)
+from .pipeline import (  # noqa: F401
+    LayerDesc,
+    PipelineLayer,
+    SharedLayerDesc,
+    spmd_pipeline,
+    stack_stage_params,
+)
+from .recompute import recompute, recompute_sequential  # noqa: F401
+from .strategy import DistributedStrategy  # noqa: F401
+
+__all__ = [
+    "init_parallel_env", "get_rank", "get_world_size", "ParallelEnv", "DataParallel",
+    "ReduceOp", "all_reduce", "all_gather", "reduce_scatter", "broadcast",
+    "all_to_all", "alltoall", "reduce", "scatter", "barrier", "send", "recv",
+    "ppermute", "new_group", "shard_to_group", "unshard",
+    "DistributedStrategy", "HybridCommunicateGroup", "build_mesh", "P",
+    "DistributedEngine", "fleet", "collective",
+    "VocabParallelEmbedding", "ColumnParallelLinear", "RowParallelLinear",
+    "ParallelCrossEntropy", "mark_sharding",
+]

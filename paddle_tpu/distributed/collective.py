@@ -1,0 +1,283 @@
+"""Eager collective API over mesh axes.
+
+Parity surface: paddle.distributed.{all_reduce, all_gather, reduce_scatter,
+broadcast, all_to_all, send/recv(ppermute), scatter, reduce, barrier}
+(/root/reference/python/paddle/distributed/communication/*.py) backed by
+ProcessGroup+NCCL in the reference. TPU-native: each collective is a
+``shard_map`` over the current Mesh axis, compiled by XLA onto ICI — there is
+no transport code here (SURVEY §5.8). The eager API exists for debugging and
+for the collective test-suite shape; production paths let GSPMD infer
+collectives from shardings instead.
+
+Data model: a "distributed tensor" is a jax array sharded over the group axis
+(each mesh-axis slice plays the role of one reference rank). Helpers
+``shard_to_group``/``unshard`` move between host batches and group-sharded
+arrays for tests.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from .mesh import HybridCommunicateGroup, get_hybrid_communicate_group
+
+__all__ = [
+    "ReduceOp", "all_reduce", "all_gather", "reduce_scatter", "broadcast",
+    "all_to_all", "alltoall", "reduce", "scatter", "barrier", "send", "recv",
+    "ppermute", "shard_to_group", "unshard", "new_group", "get_group",
+]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A mesh-axis handle (the reference's ProcessGroup analogue)."""
+
+    def __init__(self, axis: str, hcg: HybridCommunicateGroup):
+        self.axis = axis
+        self.hcg = hcg
+
+    @property
+    def nranks(self):
+        return dict(zip(self.hcg.mesh.axis_names, self.hcg.mesh.devices.shape))[self.axis]
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def __repr__(self):
+        return f"Group(axis={self.axis}, nranks={self.nranks})"
+
+
+_custom_groups: dict[int, Group] = {}
+
+
+def _resolve_group(group) -> Group:
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        raise RuntimeError("call paddle_tpu.distributed.init_parallel_env() or fleet.init() first")
+    if group is None:
+        # default group = the full data-parallel axis if >1, else first >1 axis
+        for axis in hcg.mesh.axis_names:
+            if dict(zip(hcg.mesh.axis_names, hcg.mesh.devices.shape))[axis] > 1:
+                return Group(axis, hcg)
+        return Group(hcg.mesh.axis_names[0], hcg)
+    if isinstance(group, Group):
+        return group
+    if isinstance(group, str):
+        return Group(group, hcg)
+    raise TypeError(f"bad group {group!r}")
+
+
+def new_group(ranks=None, axis=None, backend=None, timeout=None):
+    """Reference new_group parity: here a group IS a mesh axis name."""
+    g = _resolve_group(axis)
+    _custom_groups[len(_custom_groups)] = g
+    return g
+
+
+def get_group(gid=0):
+    return _custom_groups.get(gid)
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _wrap_like(out, x):
+    if isinstance(x, Tensor):
+        x._value = out
+        return x
+    return Tensor._wrap(out)
+
+
+def _axis_spec(arr_ndim, axis_name, shard_dim=0):
+    spec = [None] * arr_ndim
+    spec[shard_dim] = axis_name
+    return P(*spec)
+
+
+def shard_to_group(host_batches, group=None, shard_dim=0):
+    """Place a list of per-rank numpy arrays as one array sharded over the
+    group axis (test/debug helper: builds the reference's 'one tensor per
+    rank' picture on the mesh)."""
+    g = _resolve_group(group)
+    stacked = np.concatenate([np.asarray(b) for b in host_batches], axis=shard_dim)
+    sharding = NamedSharding(g.hcg.mesh, _axis_spec(stacked.ndim, g.axis, shard_dim))
+    return Tensor._wrap(jax.device_put(stacked, sharding))
+
+
+def unshard(t):
+    return np.asarray(jax.device_get(_v(t)))
+
+
+def _shard_mapped(g: Group, fn, *arrays, in_specs=None, out_specs=None):
+    mesh = g.hcg.mesh
+    in_specs = in_specs if in_specs is not None else tuple(
+        _axis_spec(a.ndim, g.axis) for a in arrays)
+    out_specs = out_specs if out_specs is not None else in_specs[0]
+    mapped = jax.shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_vma=False,
+    )
+    return mapped(*arrays)
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    g = _resolve_group(group)
+    arr = _v(tensor)
+    red = {
+        ReduceOp.SUM: jax.lax.psum,
+        ReduceOp.MAX: jax.lax.pmax,
+        ReduceOp.MIN: jax.lax.pmin,
+        ReduceOp.AVG: jax.lax.pmean,
+        ReduceOp.PROD: lambda x, n: jnp.exp(jax.lax.psum(jnp.log(x), n)),
+    }[op]
+    out = _shard_mapped(g, lambda x: red(x, g.axis), arr)
+    return _wrap_like(out, tensor)
+
+
+def all_gather(tensor_list, tensor=None, group=None, sync_op=True, axis=0):
+    """Two call shapes, like the reference: all_gather(out_list, x) or
+    all_gather(x) -> Tensor (concatenated along axis 0 per-rank shards)."""
+    if tensor is None:
+        tensor, tensor_list = tensor_list, None
+    g = _resolve_group(group)
+    arr = _v(tensor)
+    n = g.nranks
+
+    def body(x):
+        return jax.lax.all_gather(x, g.axis, axis=0, tiled=False)
+
+    spec_in = _axis_spec(arr.ndim, g.axis)
+    # every rank holds the identical gathered stack -> replicated out spec
+    out_spec = P(*([None] * (arr.ndim + 1)))
+    out = _shard_mapped(g, body, arr, in_specs=(spec_in,), out_specs=out_spec)
+    # out: [n, *local_shape] along leading axis
+    got = jax.device_get(out)
+    shards = [Tensor._wrap(jnp.asarray(got[i])) for i in range(n)]
+    if tensor_list is not None:
+        tensor_list.extend(shards)
+        return tensor_list
+    return Tensor._wrap(jnp.concatenate([s._value for s in shards], axis=axis))
+
+
+def reduce_scatter(tensor, tensor_or_op=None, op=ReduceOp.SUM, group=None, sync_op=True):
+    g = _resolve_group(group)
+    arr = _v(tensor)
+
+    def body(x):
+        return jax.lax.psum_scatter(x, g.axis, scatter_dimension=0, tiled=True)
+
+    out = _shard_mapped(g, body, arr)
+    return _wrap_like(out, tensor)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    g = _resolve_group(group)
+    arr = _v(tensor)
+
+    def body(x):
+        # take src rank's shard everywhere
+        idx = jax.lax.axis_index(g.axis)
+        full = jax.lax.all_gather(x, g.axis, axis=0, tiled=False)
+        return full[src]
+
+    out = _shard_mapped(g, body, arr)
+    return _wrap_like(out, tensor)
+
+
+def all_to_all(out_tensor_list, in_tensor_list=None, group=None, sync_op=True):
+    """Reference alltoall (rank i sends in_tensor_list[j] to rank j).
+
+    Single-tensor form (used by MoE dispatch inside jit): the group-sharded
+    tensor's local [n*k, ...] rows are exchanged with a REAL
+    ``lax.all_to_all``. List form is a host-side emulation for the
+    single-controller eager API: with every rank holding the same list, rank
+    r receives in_list[r] from each sender, so each output entry is the
+    group-sharded concat of the input list."""
+    g = _resolve_group(group)
+    if in_tensor_list is None:
+        # single-tensor form: local rows [n*k, ...] exchanged across ranks
+        arr = _v(out_tensor_list)
+
+        def body(x):
+            xs = x.reshape(g.nranks, -1, *x.shape[1:])
+            swapped = jax.lax.all_to_all(xs, g.axis, 0, 0, tiled=False)
+            return swapped.reshape(-1, *x.shape[1:])
+
+        out = _shard_mapped(g, body, arr)
+        return Tensor._wrap(out)
+    n = g.nranks
+    if len(in_tensor_list) != n:
+        raise ValueError(f"in_tensor_list must have {n} entries, got {len(in_tensor_list)}")
+    gathered = shard_to_group([np.asarray(_v(t)) for t in in_tensor_list], group=g)
+    got = jax.device_get(gathered._value)
+    per = got.shape[0] // n
+    out_tensor_list.clear()
+    out_tensor_list.extend(
+        Tensor._wrap(jnp.asarray(got[i * per:(i + 1) * per])) for i in range(n))
+    return out_tensor_list
+
+
+alltoall = all_to_all
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    # psum everywhere == reduce + broadcast; dst semantics preserved at API level
+    return all_reduce(tensor, op=op, group=group)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    g = _resolve_group(group)
+    if tensor_list is not None:
+        full = jnp.stack([_v(t) for t in tensor_list], axis=0)
+    else:
+        full = _v(tensor)
+    n = g.nranks
+    shard = full[g.hcg._coord(g.axis) % n] if tensor_list is not None else full
+    return _wrap_like(jnp.asarray(shard), tensor)
+
+
+def barrier(group=None):
+    jax.block_until_ready(jnp.zeros(()))
+    return None
+
+
+def ppermute(tensor, perm, group=None):
+    """Raw ppermute over the group axis (the p2p primitive under pipeline)."""
+    g = _resolve_group(group)
+    arr = _v(tensor)
+
+    def body(x):
+        return jax.lax.ppermute(x, g.axis, perm)
+
+    out = _shard_mapped(g, body, arr)
+    return Tensor._wrap(out)
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """Point-to-point send ≈ ppermute src→dst (reference send_v2/p2p).
+    Eager debugging only; pipeline uses ppermute inside the jitted schedule."""
+    g = _resolve_group(group)
+    src = g.hcg._coord(g.axis)
+    return ppermute(tensor, [(src, dst)], group=g)
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    g = _resolve_group(group)
+    dst = g.hcg._coord(g.axis)
+    out = ppermute(tensor, [(src, dst)], group=g)
+    return _wrap_like(_v(out), tensor)

@@ -1,0 +1,273 @@
+"""DistributedEngine: builds ONE jitted SPMD train step from
+(layer, loss, optimizer, strategy).
+
+This is the TPU-native replacement for the reference's entire distributed
+runtime composition — fleet.distributed_model + HybridParallelOptimizer +
+EagerReducer + GroupSharded stages + auto-parallel Engine/Partitioner/
+Resharder (/root/reference/python/paddle/distributed/fleet/,
+auto_parallel/static/engine.py:55). Instead of rewriting programs and
+inserting comm ops, it:
+
+1. lays every parameter out on the hybrid Mesh via a NamedSharding
+   (tp layers annotate their own specs; a ZeRO policy shards the rest
+   over the 'sharding' axis — stage 1/2 shard optimizer state + grads,
+   stage 3 also shards params),
+2. shards the batch over the data axes ('dp','sharding'),
+3. jits the (forward, loss, backward, update) closure with those shardings —
+   GSPMD infers every collective (grad psum/reduce-scatter, tp allreduce,
+   ZeRO all-gathers) and the latency-hiding scheduler overlaps them with
+   compute, which is what the reference's comm-stream machinery does by hand.
+
+Gradient accumulation and bf16 AMP are folded into the same jitted step.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from ..framework import random as frandom
+from ..nn.layer import functional_call, functional_state
+from .mesh import HybridCommunicateGroup, build_mesh, set_hybrid_communicate_group
+from .strategy import DistributedStrategy
+
+__all__ = ["DistributedEngine", "shard_params_for_zero"]
+
+DATA_AXES = ("dp", "sharding")
+
+
+def _divisible_dim(shape, spec, degree):
+    """First unsharded dim divisible by the ZeRO degree, else None."""
+    current = list(spec) if spec is not None else [None] * len(shape)
+    while len(current) < len(shape):
+        current.append(None)
+    for i, s in enumerate(shape):
+        if current[i] is None and s % degree == 0 and s >= degree:
+            return i
+    return None
+
+
+def shard_params_for_zero(params, specs, degree, axis="sharding"):
+    """ZeRO-3 policy: extend each param's spec with the sharding axis on the
+    first divisible dim (reference GroupShardedStage3 param sharding,
+    /root/reference/python/paddle/distributed/fleet/meta_parallel/sharding/
+    group_sharded_stage3.py:59 — XLA all-gathers on use instead of the
+    reference's explicit layer-granular gathers)."""
+    out = {}
+    for name, spec in specs.items():
+        shape = np.shape(params[name]) if not isinstance(params[name], tuple) else params[name]
+        if spec is not None and axis in tuple(spec):
+            out[name] = spec
+            continue
+        dim = _divisible_dim(shape, spec, degree)
+        if dim is None:
+            out[name] = spec
+            continue
+        base = list(spec) if spec is not None else [None] * len(shape)
+        while len(base) < len(shape):
+            base.append(None)
+        base[dim] = axis
+        out[name] = P(*base)
+    return out
+
+
+class DistributedEngine:
+    def __init__(self, layer, loss_fn=None, optimizer=None,
+                 strategy: DistributedStrategy | None = None, mesh=None,
+                 input_specs=None, label_specs=None):
+        self.layer = layer
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.strategy = strategy or DistributedStrategy()
+        self.mesh = mesh if mesh is not None else build_mesh(self.strategy)
+        self.hcg = HybridCommunicateGroup(self.strategy, self.mesh)
+        set_hybrid_communicate_group(self.hcg)
+
+        self._input_specs = input_specs
+        self._label_specs = label_specs
+        self._train_step = None
+        self._state = None  # (params, buffers, opt_state) as device arrays
+        self._step_count = 0
+
+    # ------------------------------------------------------------------
+    def _param_specs(self):
+        named = dict(self.layer.named_parameters())
+        specs = {n: getattr(p, "sharding_spec", None) for n, p in named.items()}
+        h = self.strategy.hybrid_configs
+        zdeg = h.sharding_degree
+        if zdeg > 1 and self.strategy.sharding.stage >= 3:
+            shapes = {n: tuple(p.shape) for n, p in named.items()}
+            specs = shard_params_for_zero(shapes, specs, zdeg)
+        return {n: (s if s is not None else P()) for n, s in specs.items()}
+
+    def _opt_specs(self, param_specs, opt_state):
+        """Stage>=1: optimizer moments sharded like ZeRO over 'sharding'."""
+        h = self.strategy.hybrid_configs
+        zdeg = h.sharding_degree
+        out = {}
+        for name, st in opt_state.items():
+            pspec = param_specs.get(name, P())
+            entry = {}
+            for k, v in st.items():
+                if np.ndim(v) == 0 or zdeg <= 1 or self.strategy.sharding.stage < 1 \
+                        or "sharding" in tuple(pspec):
+                    entry[k] = pspec if np.ndim(v) else P()
+                else:
+                    dim = _divisible_dim(np.shape(v), pspec, zdeg)
+                    if dim is None:
+                        entry[k] = pspec
+                    else:
+                        base = list(pspec)
+                        while len(base) < np.ndim(v):
+                            base.append(None)
+                        base[dim] = "sharding"
+                        entry[k] = P(*base)
+            out[name] = entry
+        return out
+
+    def _data_spec(self, arr):
+        if np.ndim(arr) == 0:
+            return P()
+        return P(DATA_AXES, *([None] * (np.ndim(arr) - 1)))
+
+    def _nsh(self, spec):
+        return NamedSharding(self.mesh, spec)
+
+    # ------------------------------------------------------------------
+    def _init_state(self):
+        params, buffers = functional_state(self.layer)
+        pspecs = self._param_specs()
+        params = {
+            n: jax.device_put(v, self._nsh(pspecs[n])) for n, v in params.items()
+        }
+        buffers = {n: jax.device_put(v, self._nsh(P())) for n, v in buffers.items()}
+        opt_state = self.optimizer.init_state_tree(params) if self.optimizer else {}
+        ospecs = self._opt_specs(pspecs, opt_state)
+        opt_state = {
+            n: {k: jax.device_put(v, self._nsh(ospecs[n][k]))
+                for k, v in st.items()}
+            for n, st in opt_state.items()
+        }
+        self._state = (params, buffers, opt_state)
+        self._pspecs, self._ospecs = pspecs, ospecs
+
+    def _build_train_step(self):
+        layer, loss_fn, opt = self.layer, self.loss_fn, self.optimizer
+        amp = self.strategy.amp
+        amp_dtype = jnp.bfloat16 if (amp.enable and amp.dtype == "bfloat16") else None
+        accum = max(1, self.strategy.gradient_merge_steps)
+
+        def forward_loss(params, buffers, rng, inputs, labels):
+            cast_in = [
+                i.astype(amp_dtype)
+                if amp_dtype is not None and jnp.issubdtype(i.dtype, jnp.inexact)
+                else i
+                for i in inputs
+            ]
+            if amp_dtype is not None:
+                cast_params = {
+                    k: (v.astype(amp_dtype)
+                        if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                    for k, v in params.items()
+                }
+            else:
+                cast_params = params
+            outs, new_buf = functional_call(
+                layer, cast_params, buffers, *cast_in, rng=rng, training=True)
+            outs = outs if isinstance(outs, (list, tuple)) else [outs]
+            from ..hapi.model import _pure_loss
+
+            f32_outs = [
+                o.astype(jnp.float32) if jnp.issubdtype(o.dtype, jnp.inexact) else o
+                for o in outs
+            ]
+            loss = _pure_loss(loss_fn, f32_outs, labels)
+            loss = jnp.mean(loss)
+            return loss, new_buf
+
+        def train_step(params, buffers, opt_state, lr, rng, inputs, labels):
+            if accum > 1:
+                # micro-batch gradient accumulation inside the step
+                def micro(i, carry):
+                    gsum, lsum, buf = carry
+                    mb_in = [jax.lax.dynamic_index_in_dim(x, i, 0, False) for x in inputs]
+                    mb_lb = [jax.lax.dynamic_index_in_dim(x, i, 0, False) for x in labels]
+                    (l, buf2), g = jax.value_and_grad(forward_loss, has_aux=True)(
+                        params, buf, jax.random.fold_in(rng, i), mb_in, mb_lb)
+                    gsum = jax.tree_util.tree_map(lambda a, b: a + b, gsum, g)
+                    return gsum, lsum + l, buf2
+
+                zero_g = jax.tree_util.tree_map(jnp.zeros_like, params)
+                grads, loss, new_buf = jax.lax.fori_loop(
+                    0, accum, micro, (zero_g, jnp.zeros(()), buffers))
+                grads = jax.tree_util.tree_map(lambda g: g / accum, grads)
+                loss = loss / accum
+            else:
+                (loss, new_buf), grads = jax.value_and_grad(
+                    forward_loss, has_aux=True)(params, buffers, rng, inputs, labels)
+            new_params, new_opt = opt.apply_gradients(params, grads, opt_state, lr)
+            return loss, new_buf, new_params, new_opt
+
+        pshard = {n: self._nsh(s) for n, s in self._pspecs.items()}
+        oshard = {n: {k: self._nsh(s) for k, s in st.items()}
+                  for n, st in self._ospecs.items()}
+        bshard = {n: self._nsh(P()) for n in self._state[1]}
+        return jax.jit(
+            train_step,
+            in_shardings=(pshard, bshard, oshard, None, None, None, None),
+            out_shardings=(None, bshard, pshard, oshard),
+            donate_argnums=(0, 2),
+        )
+
+    # ------------------------------------------------------------------
+    def step(self, inputs, labels):
+        """Run one training step; returns host loss."""
+        if self._state is None:
+            self._init_state()
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
+        inputs = [self._put_batch(np.asarray(_np(i))) for i in _as_list(inputs)]
+        labels = [self._put_batch(np.asarray(_np(l))) for l in _as_list(labels)]
+        params, buffers, opt_state = self._state
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        rng = jax.random.fold_in(
+            jax.random.PRNGKey(frandom.default_seed()), self._step_count)
+        loss, new_buf, new_params, new_opt = self._train_step(
+            params, buffers, opt_state, lr, rng, inputs, labels)
+        self._state = (new_params, new_buf, new_opt)
+        self._step_count += 1
+        return loss
+
+    def _put_batch(self, arr):
+        return jax.device_put(arr, self._nsh(self._data_spec(arr)))
+
+    def sync_to_layer(self):
+        """Write engine state back into the mutable Layer (for save/export)."""
+        if self._state is None:
+            return
+        params, buffers, _ = self._state
+        named_p = dict(self.layer.named_parameters())
+        for n, v in params.items():
+            named_p[n]._value = jnp.asarray(jax.device_get(v))
+        named_b = dict(self.layer.named_buffers())
+        for n, v in buffers.items():
+            named_b[n]._value = jnp.asarray(jax.device_get(v))
+
+    @property
+    def state(self):
+        if self._state is None:
+            self._init_state()
+        return self._state
+
+
+def _np(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]

@@ -1,0 +1,58 @@
+"""Activation recompute (reference: /root/reference/python/paddle/distributed/
+fleet/recompute/recompute.py:69 — RecomputeFunction PyLayer with RNG-state
+tracking). TPU-native: ``jax.checkpoint`` (remat) is the whole mechanism —
+under jit it discards activations and replays forward in backward; RNG
+determinism holds because functional keys are replayed identically."""
+from __future__ import annotations
+
+import jax
+
+from ..core.autograd import in_pure_mode
+from ..core.tensor import Tensor
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+def recompute(function, *args, use_reentrant=True, preserve_rng_state=True, **kwargs):
+    """Checkpoint a sub-forward. Inside a traced (jit/grad) region this is
+    jax.checkpoint over the Tensor args (non-tensor args close over); in
+    plain eager mode the tape already holds only per-op vjp closures, so it
+    calls straight through."""
+    if not in_pure_mode():
+        return function(*args, **kwargs)
+
+    tpos = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+    arrays = [args[i]._value for i in tpos]
+
+    def pure(*arrs):
+        call_args = list(args)
+        for i, a in zip(tpos, arrs):
+            call_args[i] = Tensor._wrap(a)
+        out = function(*call_args, **kwargs)
+        if isinstance(out, (list, tuple)):
+            return tuple(o._value if isinstance(o, Tensor) else o for o in out)
+        return out._value if isinstance(out, Tensor) else out
+
+    out = jax.checkpoint(pure)(*arrays)
+    if isinstance(out, tuple):
+        return tuple(Tensor._wrap(o) for o in out)
+    return Tensor._wrap(out)
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """reference recompute_sequential: checkpoint each segment of a Sequential."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    layers = list(functions)
+    n = len(layers)
+    per = max(1, n // segments)
+    out = args[0] if len(args) == 1 else args
+    for i in range(0, n, per):
+        seg = layers[i : i + per]
+
+        def seg_fn(x, seg=seg):
+            for l in seg:
+                x = l(x)
+            return x
+
+        out = recompute(seg_fn, out)
+    return out

@@ -1,0 +1,46 @@
+"""Kernel selection layer.
+
+Mirrors the role of PHI's per-backend kernel registry (SURVEY §2.1): ops with
+both an XLA composition and a hand-written Pallas kernel pick at call time.
+Default policy: Pallas on real TPU devices, XLA composition elsewhere
+(Pallas-on-CPU runs in interpret mode — correct but slow, used by tests).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+__all__ = ["use_pallas", "set_use_pallas", "attention_impl"]
+
+_FORCE = os.environ.get("PADDLE_TPU_USE_PALLAS")  # "1" | "0" | None
+_override = None
+
+
+def set_use_pallas(flag: bool | None):
+    global _override
+    _override = flag
+
+
+def use_pallas() -> bool:
+    if _override is not None:
+        return _override
+    if _FORCE is not None:
+        return _FORCE == "1"
+    try:
+        return jax.default_backend() in ("tpu",)
+    except Exception:
+        return False
+
+
+def attention_impl():
+    from ..nn.functional.attention import sdpa_ref
+
+    if use_pallas():
+        try:
+            from .flash_attention import flash_attention_pallas
+
+            return flash_attention_pallas
+        except Exception:
+            return sdpa_ref
+    return sdpa_ref

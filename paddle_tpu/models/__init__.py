@@ -1,0 +1,3 @@
+from .llama import LlamaConfig, LlamaDecoderLayer, LlamaForCausalLM, llama_7b, llama_tiny  # noqa: F401
+
+__all__ = ["LlamaConfig", "LlamaForCausalLM", "LlamaDecoderLayer", "llama_7b", "llama_tiny"]

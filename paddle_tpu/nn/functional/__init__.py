@@ -1,14 +1,15 @@
 """paddle.nn.functional parity surface."""
 from .activation import *  # noqa: F401,F403
+from .attention import *  # noqa: F401,F403
 from .common import *  # noqa: F401,F403
 from .conv import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .norm import *  # noqa: F401,F403
 from .pooling import *  # noqa: F401,F403
 
-from . import activation, common, conv, loss, norm, pooling  # noqa: F401
+from . import activation, attention, common, conv, loss, norm, pooling  # noqa: F401
 
 __all__ = (
-    activation.__all__ + common.__all__ + conv.__all__
+    activation.__all__ + attention.__all__ + common.__all__ + conv.__all__
     + loss.__all__ + norm.__all__ + pooling.__all__
 )

@@ -1,0 +1,69 @@
+"""Attention functionals.
+
+Parity: /root/reference/python/paddle/nn/functional/flash_attention.py (the
+reference vendors flash-attn CUDA kernels, third_party/flashattn) and
+scaled_dot_product_attention. On TPU the default path is plain einsum
+attention that XLA fuses well at moderate sequence lengths; the Pallas
+flash/splash kernel in paddle_tpu.kernels registers over the same entry
+point for long sequences (selected by ``paddle_tpu.kernels.use_pallas``).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply
+
+__all__ = ["scaled_dot_product_attention", "flash_attention", "sdpa_ref"]
+
+
+def sdpa_ref(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None):
+    """Reference einsum attention on raw arrays, [B, S, H, D] layout (paddle's
+    flash_attention layout). GQA supported: Hk may divide Hq."""
+    B, Sq, Hq, D = q.shape
+    Sk, Hk = k.shape[1], k.shape[2]
+    if Hk != Hq:
+        rep = Hq // Hk
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    # [B, H, Sq, Sk]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if is_causal:
+        mask = jnp.tril(jnp.ones((Sq, Sk), bool), k=Sk - Sq)
+        logits = jnp.where(mask, logits, -1e30)
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            logits = jnp.where(attn_mask, logits, -1e30)
+        else:
+            logits = logits + attn_mask
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False, training=True,
+                                 name=None, scale=None):
+    """paddle layout [batch, seq, heads, head_dim]."""
+    from ...kernels import attention_impl
+
+    impl = attention_impl()
+
+    def body(q, k, v, m=None):
+        return impl(q, k, v, attn_mask=m, dropout_p=dropout_p,
+                    is_causal=is_causal, scale=scale)
+
+    if attn_mask is None:
+        return apply(body, query, key, value, op_name="sdpa")
+    return apply(body, query, key, value, attn_mask, op_name="sdpa")
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, rng_name="",
+                    training=True, name=None):
+    """reference flash_attention API shape: returns (out, softmax?)."""
+    out = scaled_dot_product_attention(
+        query, key, value, dropout_p=dropout, is_causal=causal, training=training)
+    return (out, None) if return_softmax else (out, None)
