@@ -1,0 +1,101 @@
+"""Benchmark harness — prints ONE JSON line.
+
+Measures decoder-LM training throughput (tokens/sec/chip) and MFU on the
+available accelerator, mirroring the reference's ips Benchmark instrument
+(/root/reference/python/paddle/profiler/timer.py:349) plus the MFU counter
+BASELINE.md requires. ``--smoke`` runs a tiny CPU-safe config.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+# v5e peak bf16 TFLOP/s per chip (public spec); f32 fallback for CPU runs
+PEAK_FLOPS = {"tpu": 197e12, "axon": 197e12, "cpu": 1e12}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true", help="tiny CPU-safe run")
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--seq", type=int, default=None)
+    args = ap.parse_args()
+
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.models import LlamaConfig, llama_tiny
+    from paddle_tpu.models.llama_pipeline import LlamaPipelineTrainer
+    from paddle_tpu.optimizer import AdamW
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform in ("tpu", "axon")
+
+    if args.smoke or not on_tpu:
+        cfg = llama_tiny(vocab=256, hidden=64, layers=2, heads=4, kv_heads=2,
+                         inter=128, seq=128)
+        batch = args.batch or 4
+        seq = args.seq or 128
+        steps = min(args.steps, 5)
+    else:
+        # ~350M-param Llama proportioned like Llama-2, sized for one v5e chip
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_hidden_layers=16, num_attention_heads=16,
+            num_key_value_heads=16, max_position_embeddings=2048)
+        batch = args.batch or 8
+        seq = args.seq or 2048
+        steps = args.steps
+
+    mesh = build_mesh(degrees={"dp": 1})
+    trainer = LlamaPipelineTrainer(cfg, mesh, AdamW(learning_rate=1e-4),
+                                   n_micro=1, zero_stage=1)
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
+    y = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
+
+    # warmup/compile
+    jax.block_until_ready(trainer.step(x, y))
+    jax.block_until_ready(trainer.step(x, y))
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = trainer.step(x, y)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    tokens = batch * seq * steps
+    tok_per_sec = tokens / dt
+    flops_per_token = trainer.flops_per_token(seq)
+    achieved = tok_per_sec * flops_per_token
+    peak = PEAK_FLOPS.get(platform, 1e12)
+    mfu = achieved / peak
+
+    # north star: >=45% MFU (BASELINE.md config #4)
+    result = {
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": round(tok_per_sec, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "extra": {
+            "mfu": round(mfu, 4),
+            "platform": platform,
+            "params": trainer.num_params(),
+            "batch": batch,
+            "seq": seq,
+            "steps": steps,
+            "loss": float(np.asarray(loss)),
+        },
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

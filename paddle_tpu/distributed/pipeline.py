@@ -118,15 +118,17 @@ def spmd_pipeline(stage_fn, stage_params, x_micro, mesh, n_stages, remat=True,
         p_local = jax.tree_util.tree_map(lambda a: a[0], params)
         stage_id = jax.lax.axis_index("pp")
 
-        h0 = jnp.zeros_like(xs[0])
-        out0 = jnp.zeros((M,) + xs.shape[1:], xs.dtype)
+        # carries are varying over 'pp' from the start (check_vma typing)
+        h0 = jax.lax.pvary(jnp.zeros_like(xs[0]), ("pp",))
+        out0 = jax.lax.pvary(jnp.zeros((M,) + xs.shape[1:], xs.dtype), ("pp",))
 
         def tick(carry, t):
             h_in, outputs = carry
             # stage 0 consumes micro-batch t while t < M; later stages consume
             # what arrived over the wire last tick
             mb_idx = jnp.clip(t, 0, M - 1)
-            first_in = jax.lax.dynamic_index_in_dim(xs, mb_idx, 0, keepdims=False)
+            first_in = jax.lax.pvary(
+                jax.lax.dynamic_index_in_dim(xs, mb_idx, 0, keepdims=False), ("pp",))
             inp = jnp.where(stage_id == 0, first_in, h_in)
             h_out = body(p_local, inp, *extra)
             # last stage banks its result for micro-batch t - (S-1)
@@ -151,11 +153,17 @@ def spmd_pipeline(stage_fn, stage_params, x_micro, mesh, n_stages, remat=True,
         return jax.lax.psum(outputs, "pp")
 
     pp_specs = jax.tree_util.tree_map(lambda _: P("pp"), stage_params)
+    # partial-manual shard_map: only 'pp' is manual; dp/sharding/mp stay
+    # automatic so GSPMD keeps partitioning the tensor-parallel matmuls and
+    # data-parallel batch INSIDE each stage body (pipeline composes with TP/DP)
+    # check_vma=True is required: jax 0.9's check_vma=False path builds an
+    # internal spec over ALL mesh axes, which breaks partial-manual mode
     mapped = jax.shard_map(
         per_stage,
         mesh=mesh,
         in_specs=(pp_specs, P()) + tuple(P() for _ in extra_args),
         out_specs=P(),
-        check_vma=False,
+        axis_names={"pp"},
+        check_vma=True,
     )
     return mapped(stage_params, x_micro, *extra_args)
