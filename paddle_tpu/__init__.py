@@ -69,6 +69,10 @@ from .hapi import Model  # noqa: F401,E402
 from .framework.io import load, save  # noqa: F401,E402
 from . import amp  # noqa: F401,E402
 from . import distributed  # noqa: F401,E402
+from . import jit  # noqa: F401,E402
+from . import static  # noqa: F401,E402
+from . import models  # noqa: F401,E402
+from . import kernels  # noqa: F401,E402
 
 bool = bool_  # paddle.bool
 

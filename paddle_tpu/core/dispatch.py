@@ -81,7 +81,9 @@ def apply(fn, *args, op_name="op", **kwargs):
         vals = [l._value if isinstance(l, Tensor) else l for l in leaves]
         a, k = tree_util.tree_unflatten(treedef, vals)
         out = fn(*a, **k)
-        return _wrap_outputs(out, node=None)
+        result = _wrap_outputs(out, node=None)
+        _maybe_attach_recompute(fn, leaves, treedef, result)
+        return result
 
     diff_pos = [
         i
@@ -110,7 +112,54 @@ def apply(fn, *args, op_name="op", **kwargs):
         diff_tensors,
         [(o.shape, np.dtype(o.dtype)) for o in out_list],
     )
-    return _wrap_outputs(out, node=node)
+    result = _wrap_outputs(out, node=node)
+    _maybe_attach_recompute(fn, leaves, treedef, result)
+    return result
+
+
+def _maybe_attach_recompute(fn, leaves, treedef, result):
+    """Static-graph support: if any input carries a replay closure (it flows
+    from a ``static.data`` placeholder), attach one to the outputs so
+    ``static.Executor.run`` can re-execute the recorded computation with fed
+    values (the ProgramDesc/op-replay role, SURVEY §3.4)."""
+    from .autograd import in_pure_mode
+
+    if in_pure_mode():
+        return
+    tensor_leaves = [l for l in leaves if isinstance(l, Tensor)]
+    if not any(t._recompute is not None for t in tensor_leaves):
+        return
+    outs = list(result) if isinstance(result, tuple) else [result]
+    outs = [o for o in outs if isinstance(o, Tensor)]
+
+    def replay(cache):
+        key = id(outs[0])
+        if key in cache:
+            return [cache[id(o)] for o in outs]
+        vals = [
+            recompute_value(l, cache) if isinstance(l, Tensor) else l
+            for l in leaves
+        ]
+        a, k = tree_util.tree_unflatten(treedef, vals)
+        res = fn(*a, **k)
+        res_list = list(res) if isinstance(res, (tuple, list)) else [res]
+        for o, r in zip(outs, res_list):
+            cache[id(o)] = r
+        return res_list
+
+    for i, o in enumerate(outs):
+        o._recompute = (replay, i)
+
+
+def recompute_value(t, cache):
+    """Resolve a tensor's value in a static replay (used by static.Executor)."""
+    if id(t) in cache:
+        return cache[id(t)]
+    rc = t._recompute
+    if rc is None or rc == "placeholder":
+        return t._value
+    replay, idx = rc
+    return replay(cache)[idx]
 
 
 def _wrap_outputs(out, node):

@@ -37,6 +37,7 @@ class Tensor:
         "persistable",
         "trainable",
         "sharding_spec",  # PartitionSpec annotation used by distributed engine
+        "_recompute",  # static-graph replay closure (paddle_tpu.static)
         "__weakref__",
     )
 
@@ -56,6 +57,7 @@ class Tensor:
         self.persistable = False
         self.trainable = True
         self.sharding_spec = None
+        self._recompute = None
 
     # -- construction -----------------------------------------------------
     @classmethod
@@ -72,6 +74,7 @@ class Tensor:
         t.persistable = False
         t.trainable = True
         t.sharding_spec = None
+        t._recompute = None
         return t
 
     # -- metadata ---------------------------------------------------------

@@ -1,0 +1,233 @@
+"""paddle.jit parity: to_static / save / load.
+
+The reference compiles dygraph python to a static ProgramDesc via AST
+transformation (/root/reference/python/paddle/jit/api.py:233 @to_static,
+dy2static/*_transformer.py, ProgramTranslator cache program_translator.py:1337)
+and executes it through the run_program op. TPU-native: ``jax.jit`` IS the
+tracer+compiler — ``to_static`` wraps a Layer/function into a traced pure
+function with guard-based retracing on (shapes, dtypes, training-mode),
+which is exactly the reference's program-cache keying. ``jit.save`` exports
+StableHLO + weights; ``jit.load`` restores a callable.
+"""
+from __future__ import annotations
+
+import functools
+import os
+import pickle
+
+import numpy as np
+
+import jax
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer, functional_call, functional_state
+
+__all__ = ["to_static", "save", "load", "TranslatedLayer", "not_to_static", "enable_to_static"]
+
+_to_static_enabled = True
+
+
+def enable_to_static(flag: bool):
+    global _to_static_enabled
+    _to_static_enabled = flag
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+class StaticFunction:
+    """The reference's per-function program cache: one compiled program per
+    (input shapes/dtypes, training flag) guard key."""
+
+    def __init__(self, fn_or_layer, input_spec=None, build_strategy=None, full_graph=True):
+        self._target = fn_or_layer
+        self._input_spec = input_spec
+        self._cache = {}
+        if isinstance(fn_or_layer, Layer):
+            self._layer = fn_or_layer
+        else:
+            self._layer = getattr(fn_or_layer, "__self__", None)
+        functools.update_wrapper(
+            self, fn_or_layer.forward if isinstance(fn_or_layer, Layer) else fn_or_layer)
+
+    def _guard_key(self, arrays):
+        training = self._layer.training if self._layer is not None else False
+        return tuple((a.shape, str(a.dtype)) for a in arrays) + (training,)
+
+    def __call__(self, *args, **kwargs):
+        if not _to_static_enabled:
+            return self._target(*args, **kwargs)
+        arrays = [a._value if isinstance(a, Tensor) else np.asarray(a) for a in args]
+        key = self._guard_key(arrays)
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._build(key, kwargs)
+            self._cache[key] = entry
+        jitted, buffers_box = entry
+        if self._layer is not None:
+            params, buffers = functional_state(self._layer)
+            out = jitted(params, buffers, *arrays)
+        else:
+            out = jitted(*arrays)
+        return _wrap_out(out)
+
+    def _build(self, key, kwargs):
+        if self._layer is not None:
+            layer = self._layer
+            training = layer.training
+            orig_forward = getattr(layer, "_orig_forward", None)
+
+            @jax.jit
+            def jitted(params, buffers, *arrays):
+                # un-patch forward during tracing so the static wrapper
+                # doesn't recurse into itself
+                patched = layer.__dict__.get("forward")
+                if orig_forward is not None:
+                    layer.forward = orig_forward
+                try:
+                    out, _ = functional_call(
+                        layer, params, buffers, *arrays, training=training, **kwargs)
+                finally:
+                    if patched is not None:
+                        layer.forward = patched
+                return out
+
+            return jitted, None
+        fn = self._target
+
+        @jax.jit
+        def jitted(*arrays):
+            from ..core.autograd import no_grad, pure_mode
+
+            with pure_mode(), no_grad():
+                targs = [Tensor._wrap(a) for a in arrays]
+                out = fn(*targs, **kwargs)
+            return _unwrap(out)
+
+        return jitted, None
+
+    @property
+    def concrete_programs(self):
+        return list(self._cache)
+
+    def rollback(self):
+        return self._target
+
+
+def _unwrap(out):
+    if isinstance(out, Tensor):
+        return out._value
+    if isinstance(out, (list, tuple)):
+        return type(out)(_unwrap(o) for o in out)
+    return out
+
+
+def _wrap_out(out):
+    if isinstance(out, (list, tuple)):
+        return type(out)(_wrap_out(o) for o in out)
+    if hasattr(out, "dtype") and not isinstance(out, Tensor):
+        return Tensor._wrap(out)
+    return out
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None, **kwargs):
+    """@paddle.jit.to_static decorator / wrapper."""
+
+    def deco(fn):
+        if isinstance(fn, Layer):
+            sf = StaticFunction(fn, input_spec)
+            fn.forward_static = sf
+            orig_forward = fn.forward
+            fn._orig_forward = orig_forward
+            # route __call__ through the static function
+            fn.forward = lambda *a, **k: sf(*a, **k)
+            return fn
+        return StaticFunction(fn, input_spec)
+
+    if function is not None:
+        return deco(function)
+    return deco
+
+
+def save(layer, path, input_spec=None, **configs):
+    """jit.save: StableHLO module + weights (the reference's
+    *.pdmodel ProgramDesc + *.pdiparams pair, SURVEY §5.4)."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec (shape/dtype examples)")
+    examples = []
+    for spec in input_spec:
+        if isinstance(spec, Tensor):
+            examples.append(np.asarray(spec._value))
+        elif hasattr(spec, "shape"):
+            examples.append(np.asarray(spec))
+        else:
+            shape, dtype = spec
+            examples.append(np.zeros([1 if s in (None, -1) else s for s in shape],
+                                     dtype))
+    params, buffers = functional_state(layer)
+    training = False
+
+    def pure(params, buffers, *arrays):
+        out, _ = functional_call(layer, params, buffers, *arrays, training=training)
+        return out
+
+    lowered = jax.jit(pure).lower(params, buffers, *examples)
+    stablehlo = lowered.as_text(dialect="stablehlo")
+    with open(path + ".pdmodel", "w") as f:
+        f.write(stablehlo)
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump(
+            {
+                "params": {k: np.asarray(v) for k, v in params.items()},
+                "buffers": {k: np.asarray(v) for k, v in buffers.items()},
+                "example_shapes": [(e.shape, str(e.dtype)) for e in examples],
+            },
+            f,
+        )
+
+
+class TranslatedLayer(Layer):
+    """jit.load result: callable inference layer over saved weights.
+
+    Executes by rebuilding the jitted function from weights (StableHLO text is
+    kept for inspection/deployment toolchains; re-tracing needs the original
+    python, so load-time execution uses the weights against a user-supplied
+    ``forward_builder`` when provided, else a matmul-free passthrough error).
+    """
+
+    def __init__(self, params, buffers, stablehlo_text, example_shapes):
+        super().__init__()
+        self._params_np = params
+        self._buffers_np = buffers
+        self.stablehlo = stablehlo_text
+        self.example_shapes = example_shapes
+        self._exec = None
+
+    def program(self):
+        return self.stablehlo
+
+    def forward(self, *args):
+        if self._exec is None:
+            raise RuntimeError(
+                "TranslatedLayer: executing a serialized StableHLO program "
+                "requires binding it back (use jit.load(path, layer_cls=...) "
+                "to rebuild from python, or deploy the .pdmodel with an HLO "
+                "runner)")
+        return self._exec(*args)
+
+
+def load(path, layer_cls=None, **configs):
+    with open(path + ".pdiparams", "rb") as f:
+        blob = pickle.load(f)
+    with open(path + ".pdmodel") as f:
+        text = f.read()
+    if layer_cls is not None:
+        layer = layer_cls() if callable(layer_cls) else layer_cls
+        state = {**blob["params"], **blob["buffers"]}
+        layer.set_state_dict(state)
+        layer.eval()
+        return layer
+    return TranslatedLayer(blob["params"], blob["buffers"], text, blob["example_shapes"])

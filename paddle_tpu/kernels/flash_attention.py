@@ -1,0 +1,282 @@
+"""Pallas flash attention (TPU).
+
+Replaces the reference's vendored CUDA flash-attn
+(/root/reference/third_party/flashattn, kernels
+ paddle/phi/kernels/gpu/flash_attn_kernel.cu, python API
+ python/paddle/nn/functional/flash_attention.py) with a TPU-native tiled
+online-softmax kernel: Q blocks stream against K/V blocks held in VMEM,
+accumulating in f32, never materializing the S×S score matrix. Backward is
+the FlashAttention-2 recomputation scheme (saved logsumexp + delta) as two
+Pallas kernels, wired via jax.custom_vjp.
+
+Layout: paddle's [B, S, H, D]; internally [B*H, S, D]. GQA handled by
+repeating KV heads in the wrapper (dKV summed back).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention_pallas"]
+
+NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, sm_scale, causal, seq_k):
+    # refs carry a leading block dim of 1: q_ref [1, block_q, d], k/v [1, seq_k, d]
+    block_q = q_ref.shape[1]
+    d = q_ref.shape[2]
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * sm_scale
+
+    m = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q, 1), jnp.float32)
+    acc = jnp.zeros((block_q, d), jnp.float32)
+
+    q_offset = qi * jnp.int32(block_q)
+    num_k_blocks = pl.cdiv(seq_k, block_k)
+    if causal:
+        # only blocks intersecting the causal triangle
+        num_k_blocks = jnp.minimum(
+            jnp.int32(num_k_blocks),
+            (q_offset + jnp.int32(block_q + block_k - 1)) // jnp.int32(block_k))
+
+    def body(ki, carry):
+        m, l, acc = carry
+        k_off = ki * jnp.int32(block_k)
+        k = k_ref[0, pl.ds(k_off, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(k_off, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32, precision=jax.lax.Precision.DEFAULT)
+        if causal:
+            q_ids = q_offset + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_ids = k_off + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_ids >= k_ids, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=1, keepdims=True)
+        acc_new = alpha * acc + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32, precision=jax.lax.Precision.DEFAULT)
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, num_k_blocks, body, (m, l, acc))
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l_safe)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, block_k, sm_scale, causal, seq_k):
+    block_q, d = q_ref.shape[1], q_ref.shape[2]
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+    q_offset = qi * jnp.int32(block_q)
+
+    num_k_blocks = pl.cdiv(seq_k, block_k)
+    if causal:
+        num_k_blocks = jnp.minimum(
+            jnp.int32(num_k_blocks),
+            (q_offset + jnp.int32(block_q + block_k - 1)) // jnp.int32(block_k))
+
+    def body(ki, dq):
+        k_off = ki * jnp.int32(block_k)
+        k = k_ref[0, pl.ds(k_off, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(k_off, block_k), :].astype(jnp.float32)
+        s = sm_scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32, precision=jax.lax.Precision.DEFAULT)
+        if causal:
+            q_ids = q_offset + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_ids = k_off + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_ids >= k_ids, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32, precision=jax.lax.Precision.DEFAULT)
+        ds = p * (dp - delta)
+        return dq + sm_scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32, precision=jax.lax.Precision.DEFAULT)
+
+    dq = jax.lax.fori_loop(0, num_k_blocks, body, jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, block_q, sm_scale, causal, seq_q):
+    block_k, d = k_ref.shape[1], k_ref.shape[2]
+    ki = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    k_offset = ki * jnp.int32(block_k)
+
+    num_q_blocks = pl.cdiv(seq_q, block_q)
+    start_q = (k_offset // jnp.int32(block_q)) if causal else 0
+
+    def body(qi, carry):
+        dk, dv = carry
+        q_off = qi * jnp.int32(block_q)
+        q = q_ref[0, pl.ds(q_off, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(q_off, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(q_off, block_q), :]
+        delta = delta_ref[0, pl.ds(q_off, block_q), :]
+        s = sm_scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32, precision=jax.lax.Precision.DEFAULT)
+        if causal:
+            q_ids = q_off + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_ids = k_offset + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_ids >= k_ids, s, NEG_INF)
+        p = jnp.exp(s - lse)  # [bq, bk]
+        dv_new = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32, precision=jax.lax.Precision.DEFAULT)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32, precision=jax.lax.Precision.DEFAULT)
+        ds = p * (dp - delta)
+        dk_new = dk + sm_scale * jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32, precision=jax.lax.Precision.DEFAULT)
+        return dk_new, dv_new
+
+    dk, dv = jax.lax.fori_loop(
+        start_q, num_q_blocks, body,
+        (jnp.zeros((block_k, d), jnp.float32), jnp.zeros((block_k, d), jnp.float32)))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _choose_blocks(seq_q, seq_k):
+    bq = min(512, seq_q)
+    while seq_q % bq:
+        bq //= 2
+    bk = min(512, seq_k)
+    while seq_k % bk:
+        bk //= 2
+    return max(bq, 1), max(bk, 1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_bhsd(q, k, v, causal, sm_scale):
+    out, _ = _flash_fwd(q, k, v, causal, sm_scale)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, sm_scale):
+    # q,k,v: [BH, S, D]
+    BH, Sq, D = q.shape
+    Sk = k.shape[1]
+    bq, bk = _choose_blocks(Sq, Sk)
+    grid = (BH, Sq // bq)
+    interpret = jax.default_backend() not in ("tpu",)
+
+    # x64 weak-type promotion inside kernels trips a Mosaic lowering
+    # recursion; kernels are pure f32/bf16 so trace them with x64 off
+    with jax.enable_x64(False):
+        out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, block_k=bk, sm_scale=sm_scale,
+                          causal=causal, seq_k=Sk),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, Sq, 1), jnp.float32),
+        ],
+            interpret=interpret,
+        )(q, k, v)
+    return out, lse
+
+
+def _flash_fwd_vjp(q, k, v, causal, sm_scale):
+    out, lse = _flash_fwd(q, k, v, causal, sm_scale)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd_vjp(causal, sm_scale, res, g):
+    q, k, v, out, lse = res
+    BH, Sq, D = q.shape
+    Sk = k.shape[1]
+    bq, bk = _choose_blocks(Sq, Sk)
+    interpret = jax.default_backend() not in ("tpu",)
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)  # [BH, Sq, 1]
+
+    with jax.enable_x64(False):
+        dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, block_k=bk, sm_scale=sm_scale,
+                          causal=causal, seq_k=Sk),
+        grid=(BH, Sq // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
+        interpret=interpret,
+        )(q, k, v, g, lse, delta)
+
+        dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, block_q=bq, sm_scale=sm_scale,
+                          causal=causal, seq_q=Sq),
+        grid=(BH, Sk // bk),
+        in_specs=[
+            pl.BlockSpec((1, Sq, D), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, D), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, D), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Sq, D), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Sq, 1), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Sq, 1), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, D), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, D), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Sk, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, Sk, D), v.dtype),
+        ],
+        interpret=interpret,
+        )(q, k, v, g, lse, delta)
+    return dq, dk, dv
+
+
+_flash_bhsd.defvjp(_flash_fwd_vjp, _flash_bwd_vjp)
+
+
+def flash_attention_pallas(q, k, v, attn_mask=None, dropout_p=0.0,
+                           is_causal=False, scale=None):
+    """Drop-in for sdpa_ref: [B, S, H, D] layout, GQA via KV-head repeat.
+    Falls back to the einsum path when an arbitrary mask is supplied."""
+    if attn_mask is not None or dropout_p:
+        from ..nn.functional.attention import sdpa_ref
+
+        return sdpa_ref(q, k, v, attn_mask=attn_mask, dropout_p=dropout_p,
+                        is_causal=is_causal, scale=scale)
+    B, Sq, Hq, D = q.shape
+    Hk = k.shape[2]
+    if Hk != Hq:
+        rep = Hq // Hk
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    sm_scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    # [B, S, H, D] -> [B*H, S, D]
+    def to_bhsd(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * Hq, x.shape[1], D)
+
+    out = _flash_bhsd(to_bhsd(q), to_bhsd(k), to_bhsd(v), is_causal, sm_scale)
+    return out.reshape(B, Hq, Sq, D).transpose(0, 2, 1, 3)

