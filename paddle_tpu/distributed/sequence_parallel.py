@@ -1,0 +1,157 @@
+"""Sequence / context parallelism: ring attention over the 'sep' mesh axis.
+
+BEYOND-reference capability (SURVEY §5.7: the reference has no ring
+attention / Ulysses / context parallelism — sequences scale only via
+TP+recompute). Design per the ring-attention recipe: Q/K/V sharded on the
+sequence dim; each ring step computes blockwise attention against the
+resident KV shard, then rotates KV one hop over ICI with ``ppermute``;
+partial results merge with the flash-attention online-softmax rule, so the
+full S×S score matrix never exists on any chip AND sequence memory scales
+1/sep_degree.
+
+Also provides the Ulysses-style all-to-all head-scatter
+(``ulysses_attention``): resharding [B, S/p, H, D] -> [B, S, H/p, D] with two
+all_to_alls around any single-device attention kernel.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["ring_attention", "ulysses_attention"]
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, sm_scale, mask):
+    """Blockwise attention returning (unnormalized acc, row max, row sumexp).
+
+    q [B,Sq,H,D], k/v [B,Sk,H,D]; mask: None | 'causal_diag'."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * sm_scale
+    if mask == "causal_diag":
+        Sq, Sk = q.shape[1], k.shape[1]
+        tri = jnp.tril(jnp.ones((Sq, Sk), bool))
+        s = jnp.where(tri, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)  # [B,H,Sq,1]
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    acc = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    return acc, m, l
+
+
+def ring_attention(q, k, v, mesh=None, axis="sep", causal=True, scale=None):
+    """q,k,v: [B, S, H, D] GLOBAL arrays sharded over `axis` on dim 1.
+    Returns attention output with the same sharding. Must run inside jit
+    (GSPMD context); eager single-device falls back to plain attention."""
+    from ..nn.functional.attention import sdpa_ref
+
+    if mesh is None:
+        from .mesh import current_mesh
+
+        mesh = current_mesh()
+    if mesh is None or dict(zip(mesh.axis_names, mesh.devices.shape)).get(axis, 1) == 1:
+        return sdpa_ref(q, k, v, is_causal=causal, scale=scale)
+
+    n = dict(zip(mesh.axis_names, mesh.devices.shape))[axis]
+    sm_scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+
+    def local(q, k, v):
+        my = jax.lax.axis_index(axis)
+        B, Sl, H, D = q.shape
+        perm = [(i, (i + 1) % n) for i in range(n)]
+
+        m0 = jnp.full((B, H, Sl, 1), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((B, H, Sl, 1), jnp.float32)
+        acc0 = jnp.zeros((B, Sl, H, D), jnp.float32)
+
+        def step(carry, r):
+            acc, m, l, kr, vr = carry
+            # kv block currently resident came from rank (my - r) mod n
+            src = (my - r) % n
+            if causal:
+                # src < my: full block; src == my: causal diagonal; src > my: skip
+                use_full = src < my
+                use_diag = src == my
+                a_f, m_f, l_f = _block_attn(q, kr, vr, sm_scale, None)
+                a_d, m_d, l_d = _block_attn(q, kr, vr, sm_scale, "causal_diag")
+                a_b = jnp.where(use_diag, a_d, a_f)
+                m_b = jnp.where(use_diag, m_d, m_f)
+                l_b = jnp.where(use_diag, l_d, l_f)
+                skip = jnp.logical_not(jnp.logical_or(use_full, use_diag))
+                m_b = jnp.where(skip, NEG_INF, m_b)
+                l_b = jnp.where(skip, 0.0, l_b)
+                a_b = jnp.where(skip, 0.0, a_b)
+            else:
+                a_b, m_b, l_b = _block_attn(q, kr, vr, sm_scale, None)
+            m_new = jnp.maximum(m, m_b)
+            alpha = jnp.exp(m - m_new)
+            beta = jnp.exp(m_b - m_new)
+            l_new = alpha * l + beta * l_b
+            # acc layout [B,S,H,D] vs stats [B,H,S,1]: move axes for scaling
+            scale_old = jnp.moveaxis(alpha, 1, 2)  # [B,Sq,H,1]
+            scale_new = jnp.moveaxis(beta, 1, 2)
+            acc_new = acc * scale_old + a_b * scale_new
+            kr = jax.lax.ppermute(kr, axis, perm)
+            vr = jax.lax.ppermute(vr, axis, perm)
+            return (acc_new, m_new, l_new, kr, vr), None
+
+        (acc, m, l, _, _), _ = jax.lax.scan(
+            step, (acc0, m0, l0, k, v), jnp.arange(n))
+        denom = jnp.moveaxis(jnp.maximum(l, 1e-30), 1, 2)
+        return (acc / denom).astype(q.dtype)
+
+    spec = P(None, axis, None, None)
+    return jax.shard_map(
+        local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        axis_names={axis}, check_vma=True,
+    )(q, k, v)
+
+
+def ulysses_attention(q, k, v, mesh=None, axis="sep", causal=True, scale=None,
+                      attn_fn=None):
+    """Ulysses SP: all-to-all scatter heads / gather sequence, run full-seq
+    attention per head group, then reverse. Requires H % sep == 0."""
+    from ..nn.functional.attention import sdpa_ref
+
+    if mesh is None:
+        from .mesh import current_mesh
+
+        mesh = current_mesh()
+    attn = attn_fn or (lambda a, b, c: sdpa_ref(a, b, c, is_causal=causal, scale=scale))
+    if mesh is None or dict(zip(mesh.axis_names, mesh.devices.shape)).get(axis, 1) == 1:
+        return attn(q, k, v)
+
+    n = dict(zip(mesh.axis_names, mesh.devices.shape))[axis]
+
+    def local(q, k, v):
+        # local [B, S/n, H, D] -> exchange to [B, S, H/n, D]
+        def seq_to_head(x):
+            B, Sl, H, D = x.shape
+            xs = x.reshape(B, Sl, n, H // n, D)
+            xs = jnp.moveaxis(xs, 2, 0)  # [n, B, Sl, H/n, D]
+            xs = jax.lax.all_to_all(xs, axis, 0, 0, tiled=False)
+            return jnp.moveaxis(xs, 0, 1).reshape(x.shape[0], Sl * n, H // n, D)
+
+        def head_to_seq(x, H):
+            B, S, Hl, D = x.shape
+            xs = x.reshape(B, n, S // n, Hl, D)
+            xs = jnp.moveaxis(xs, 1, 0)
+            xs = jax.lax.all_to_all(xs, axis, 0, 0, tiled=False)
+            # index 0 = source rank = owner of head group -> heads ordered
+            # (rank, local_head) to restore the global head order
+            xs = jnp.moveaxis(xs, 0, 2)  # [B, S/n, n, Hl, D]
+            return xs.reshape(B, S // n, n * Hl, D)
+
+        H = q.shape[2]
+        qf, kf, vf = seq_to_head(q), seq_to_head(k), seq_to_head(v)
+        out = attn(qf, kf, vf)
+        return head_to_seq(out, H)
+
+    spec = P(None, axis, None, None)
+    return jax.shard_map(
+        local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        axis_names={axis}, check_vma=True,
+    )(q, k, v)

@@ -159,8 +159,15 @@ class LlamaPipelineTrainer:
             return out
 
         # remat each block: backward replays the block forward instead of
-        # keeping S^2 attention residuals per layer (reference recompute role)
-        block_apply_ck = jax.checkpoint(block_apply)
+        # keeping S^2 attention residuals per layer (reference recompute role).
+        # Policy: keep matmul outputs (cheap HBM, expensive to recompute on
+        # MXU); everything elementwise is recomputed.
+        import os
+
+        policy = None
+        if os.environ.get("PADDLE_TPU_REMAT_POLICY", "dots") == "dots":
+            policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        block_apply_ck = jax.checkpoint(block_apply, policy=policy)
 
         def stage_fn(stage_params, h):
             # stage_params leaves [L/S, ...]; scan the blocks of this stage
