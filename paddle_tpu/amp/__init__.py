@@ -125,6 +125,8 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        self._unscaled = False
+        self._stepped = False
 
     def is_enable(self):
         return self._enable
@@ -135,7 +137,10 @@ class GradScaler:
         return loss * self._scale
 
     def unscale_(self, optimizer):
-        if not self._enable:
+        """Idempotent per step (reference grad_scaler.py tracks an OptimizerState
+        so the canonical ``unscale_(); step(); update()`` sequence divides by
+        the scale exactly once)."""
+        if not self._enable or self._unscaled:
             return
         inv = 1.0 / self._scale
         found_inf = False
@@ -146,18 +151,32 @@ class GradScaler:
                 found_inf = found_inf or not finite
                 p._grad = g
         self._found_inf = found_inf
+        self._unscaled = True
 
     def step(self, optimizer):
+        """Unscales (if the caller hasn't) and steps unless inf/nan was found.
+        Does NOT update the scale — callers follow with ``update()`` as in the
+        reference sequence ``scaler.step(opt); scaler.update()``."""
         if not self._enable:
             optimizer.step()
             return
+        if self._stepped:
+            raise RuntimeError(
+                "scaler.step() has already been called since the last "
+                "update(); call scaler.update() after each step() "
+                "(reference grad_scaler.py raises the same way)")
         self.unscale_(optimizer)
         if not self._found_inf:
             optimizer.step()
-        self.update()
+        self._stepped = True
 
     def update(self):
-        if not (self._enable and self._dynamic):
+        if not self._enable:
+            return
+        self._unscaled = False
+        self._stepped = False
+        if not self._dynamic:
+            self._found_inf = False
             return
         if self._found_inf:
             self._bad_steps += 1
@@ -176,6 +195,7 @@ class GradScaler:
     def minimize(self, optimizer, scaled_loss):
         scaled_loss.backward()
         self.step(optimizer)
+        self.update()
         optimizer.clear_grad()
 
     def get_loss_scaling(self):

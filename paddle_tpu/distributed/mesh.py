@@ -72,6 +72,12 @@ def build_mesh(strategy: DistributedStrategy | None = None, devices=None,
             f"mesh needs {total} devices ({dict(zip(AXIS_ORDER, shape))}), "
             f"only {len(devices)} available")
     dev_array = np.array(devices[:total]).reshape(shape)
+    # record where this mesh's computations actually run so kernel selection
+    # (Pallas vs XLA, compiled vs interpret) doesn't trust the default
+    # backend — the axon TPU plugin ignores JAX_PLATFORMS=cpu (kernels doc)
+    from ..kernels import set_platform
+
+    set_platform(dev_array.flat[0].platform)
     return Mesh(dev_array, AXIS_ORDER)
 
 

@@ -61,6 +61,9 @@ class Model:
         self._predict_step_fn = None
         self._amp_dtype = None
         self._opt_state = None
+        self._grad_step_fn = None
+        self._apply_step_fn = None
+        self._accum_grads = None
 
     # ------------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
@@ -78,33 +81,65 @@ class Model:
         self._opt_state = None  # drop any previous optimizer's accumulators
 
     # -- jitted steps ---------------------------------------------------
-    def _build_train_step(self):
-        net, loss_fn, opt = self.network, self._loss, self._optimizer
+    def _make_loss_of(self, params_free_args):
+        """Shared loss closure builder for the fused and accumulation steps
+        (one definition so AMP cast rules can't diverge between paths)."""
+        net, loss_fn = self.network, self._loss
         amp_dtype = self._amp_dtype
+        buffers, rng, inputs, labels = params_free_args
 
-        def step(params, buffers, opt_state, lr, rng, inputs, labels):
+        def loss_of(p):
             from ..nn.layer import functional_call
 
-            def loss_of(p):
-                cast_in = [
-                    i.astype(amp_dtype) if amp_dtype is not None and
-                    jnp.issubdtype(i.dtype, jnp.floating) else i
-                    for i in inputs
-                ]
-                outs, new_buf = functional_call(
-                    net, p, buffers, *cast_in, rng=rng, training=True)
-                outs = outs if isinstance(outs, (list, tuple)) else [outs]
-                outs = [o.astype(jnp.float32) if amp_dtype is not None and
-                        jnp.issubdtype(o.dtype, jnp.floating) else o for o in outs]
-                loss = _pure_loss(loss_fn, outs, labels)
-                return loss, (outs, new_buf)
+            cast_in = [
+                i.astype(amp_dtype) if amp_dtype is not None and
+                jnp.issubdtype(i.dtype, jnp.floating) else i
+                for i in inputs
+            ]
+            outs, new_buf = functional_call(
+                net, p, buffers, *cast_in, rng=rng, training=True)
+            outs = outs if isinstance(outs, (list, tuple)) else [outs]
+            outs = [o.astype(jnp.float32) if amp_dtype is not None and
+                    jnp.issubdtype(o.dtype, jnp.floating) else o for o in outs]
+            loss = _pure_loss(loss_fn, outs, labels)
+            return loss, (outs, new_buf)
 
+        return loss_of
+
+    def _build_train_step(self):
+        opt = self._optimizer
+
+        def step(params, buffers, opt_state, lr, rng, inputs, labels):
+            loss_of = self._make_loss_of((buffers, rng, inputs, labels))
             (loss, (outs, new_buf)), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(params)
             new_params, new_opt = opt.apply_gradients(params, grads, opt_state, lr)
             return loss, list(outs), new_buf, new_params, new_opt
 
         return jax.jit(step, donate_argnums=(0, 2))
+
+    def _build_grad_step(self):
+        """Gradient-only step for accumulation (reference dygraph semantics:
+        backward() sums into .grad across batches; hapi model.py:817
+        ``update=False`` defers minimize)."""
+
+        def step(params, buffers, rng, acc, inputs, labels):
+            loss_of = self._make_loss_of((buffers, rng, inputs, labels))
+            (loss, (outs, new_buf)), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(params)
+            if acc is not None:
+                grads = jax.tree_util.tree_map(jnp.add, acc, grads)
+            return loss, list(outs), new_buf, grads
+
+        return jax.jit(step, donate_argnums=(3,))
+
+    def _build_apply_step(self):
+        opt = self._optimizer
+
+        def step(params, opt_state, lr, grads):
+            return opt.apply_gradients(params, grads, opt_state, lr)
+
+        return jax.jit(step, donate_argnums=(0, 1, 3))
 
     def _build_eval_step(self):
         net, loss_fn = self.network, self._loss
@@ -150,8 +185,6 @@ class Model:
 
     # -- public batch APIs ----------------------------------------------
     def train_batch(self, inputs, labels=None, update=True):
-        if self._train_step_fn is None:
-            self._train_step_fn = self._build_train_step()
         inputs = [_to_np(i) for i in _as_list(inputs)]
         labels = [_to_np(l) for l in _as_list(labels)]
         params, buffers = self._get_state()
@@ -161,13 +194,51 @@ class Model:
             jax.random.PRNGKey(frandom.default_seed()),
             self._optimizer._step_count,
         )
-        loss, outs, new_buf, new_params, new_opt = self._train_step_fn(
-            params, buffers, opt_state, lr, rng, inputs, labels)
-        self._set_state(new_params, new_buf)
-        self._opt_state = new_opt
+        if update and self._accum_grads is None:
+            # fast path: one fused loss+grad+apply program
+            if self._train_step_fn is None:
+                self._train_step_fn = self._build_train_step()
+            loss, outs, new_buf, new_params, new_opt = self._train_step_fn(
+                params, buffers, opt_state, lr, rng, inputs, labels)
+            self._set_state(new_params, new_buf)
+            self._opt_state = new_opt
+        else:
+            # accumulation: grads sum across batches; apply on update=True
+            if self._grad_step_fn is None:
+                self._grad_step_fn = self._build_grad_step()
+            loss, outs, new_buf, grads = self._grad_step_fn(
+                params, buffers, rng, self._accum_grads, inputs, labels)
+            if update:
+                if self._apply_step_fn is None:
+                    self._apply_step_fn = self._build_apply_step()
+                new_params, new_opt = self._apply_step_fn(
+                    params, opt_state, lr, grads)
+                self._set_state(new_params, new_buf)
+                self._opt_state = new_opt
+                self._accum_grads = None
+            else:
+                self._set_state(params, new_buf)
+                self._accum_grads = grads
         self._optimizer._step_count += 1
         metrics_out = self._update_metrics(outs, labels)
         return [float(np.asarray(loss))], metrics_out
+
+    def _flush_accum_grads(self):
+        """Apply any leftover accumulated grads (loader without len(), or a
+        num_iters break mid-accumulation-group) so they neither drop nor leak
+        into the next epoch's first group."""
+        if self._accum_grads is None:
+            return
+        params, buffers = self._get_state()
+        opt_state = self._opt_state_tree(params)
+        lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
+        if self._apply_step_fn is None:
+            self._apply_step_fn = self._build_apply_step()
+        new_params, new_opt = self._apply_step_fn(
+            params, opt_state, lr, self._accum_grads)
+        self._set_state(new_params, buffers)
+        self._opt_state = new_opt
+        self._accum_grads = None
 
     def eval_batch(self, inputs, labels=None):
         if self._eval_step_fn is None:
@@ -240,13 +311,18 @@ class Model:
             for step, batch in enumerate(train_loader):
                 cb_list.on_train_batch_begin(step)
                 inputs, labels = self._split_batch(batch)
-                loss, metrics = self.train_batch(inputs, labels)
+                # reference model.py:2320 — apply grads every k-th batch
+                # (and on the final batch of the epoch when steps is known)
+                update = (step + 1) % accumulate_grad_batches == 0 or (
+                    steps is not None and step + 1 == steps)
+                loss, metrics = self.train_batch(inputs, labels, update=update)
                 logs = self._make_logs(loss, metrics)
                 cb_list.on_train_batch_end(step, logs)
                 iters_done += 1
                 if num_iters is not None and iters_done >= num_iters:
                     self.stop_training = True
                     break
+            self._flush_accum_grads()
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 eval_logs = self._run_eval(eval_loader, cb_list)
                 logs.update({f"eval_{k}": v for k, v in eval_logs.items()})

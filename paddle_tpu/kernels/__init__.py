@@ -4,6 +4,13 @@ Mirrors the role of PHI's per-backend kernel registry (SURVEY §2.1): ops with
 both an XLA composition and a hand-written Pallas kernel pick at call time.
 Default policy: Pallas on real TPU devices, XLA composition elsewhere
 (Pallas-on-CPU runs in interpret mode — correct but slow, used by tests).
+
+Platform detection: ``jax.default_backend()`` is NOT authoritative here — the
+axon TPU plugin registers itself even under ``JAX_PLATFORMS=cpu``, so a CPU
+test mesh still reports a tpu default backend. Any code that builds a concrete
+``Mesh`` calls :func:`set_platform` with the mesh's actual device platform
+(``mesh.devices.flat[0].platform``), and kernel selection trusts that hint
+first.
 """
 from __future__ import annotations
 
@@ -11,10 +18,14 @@ import os
 
 import jax
 
-__all__ = ["use_pallas", "set_use_pallas", "attention_impl"]
+__all__ = [
+    "use_pallas", "set_use_pallas", "attention_impl",
+    "set_platform", "active_platform",
+]
 
 _FORCE = os.environ.get("PADDLE_TPU_USE_PALLAS")  # "1" | "0" | None
 _override = None
+_platform_hint: str | None = None
 
 
 def set_use_pallas(flag: bool | None):
@@ -22,15 +33,31 @@ def set_use_pallas(flag: bool | None):
     _override = flag
 
 
+def set_platform(platform: str | None):
+    """Record where jitted computations will actually run ("tpu"/"cpu"/None).
+
+    Called by ``build_mesh`` and the distributed trainers with the concrete
+    mesh's device platform; ``None`` restores default-backend detection.
+    """
+    global _platform_hint
+    _platform_hint = platform
+
+
+def active_platform() -> str:
+    if _platform_hint:
+        return _platform_hint
+    try:
+        return jax.default_backend()
+    except Exception:
+        return "cpu"
+
+
 def use_pallas() -> bool:
     if _override is not None:
         return _override
     if _FORCE is not None:
         return _FORCE == "1"
-    try:
-        return jax.default_backend() in ("tpu",)
-    except Exception:
-        return False
+    return active_platform() == "tpu"
 
 
 def attention_impl():

@@ -149,6 +149,74 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
+def _out_vma(*examples):
+    """Union of the inputs' varying-manual-axes sets.
+
+    Inside a ``check_vma=True`` partial-manual shard_map (the pp pipeline),
+    ``pallas_call`` out_shapes must declare which mesh axes the outputs vary
+    over; outputs vary exactly over the union of the input vmas. Outside
+    shard_map this is the empty frozenset, which is also valid.
+    """
+    vma = frozenset()
+    for e in examples:
+        vma |= getattr(jax.typeof(e), "vma", frozenset())
+    return vma
+
+
+def _interpret_mode() -> bool:
+    from . import active_platform
+
+    return active_platform() not in ("tpu",)
+
+
+def _use_jnp_mirror(vma) -> bool:
+    """Interpret-mode pallas cannot trace inside a ``check_vma=True``
+    shard_map (the HLO interpreter's internal dynamic_slice indices carry no
+    vma; the Mosaic simulator's io_callback breaks under jax.checkpoint), so
+    CPU tests of the sharded pipeline run a jnp mirror of the exact kernel
+    math instead. On TPU the real kernel runs everywhere (vma supplied)."""
+    return _interpret_mode() and bool(vma)
+
+
+def _fwd_mirror(q, k, v, causal, sm_scale):
+    """jnp transcription of ``_fwd_kernel``'s online-softmax math (unblocked:
+    the block loop is associative, so one pass gives identical results)."""
+    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32) * sm_scale,
+                   k.astype(jnp.float32))
+    if causal:
+        Sq, Sk = q.shape[1], k.shape[1]
+        q_ids = jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 0)
+        k_ids = jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 1)
+        s = jnp.where(q_ids >= k_ids, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    l_safe = jnp.maximum(l, 1e-30)
+    out = jnp.einsum("bqk,bkd->bqd", p / l_safe,
+                     v.astype(jnp.float32)).astype(q.dtype)
+    lse = m + jnp.log(l_safe)
+    return out, lse
+
+
+def _bwd_mirror(q, k, v, g, lse, delta, causal, sm_scale):
+    """jnp transcription of the ``_bwd_dq_kernel``/``_bwd_dkv_kernel`` math."""
+    s = sm_scale * jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
+                              k.astype(jnp.float32))
+    if causal:
+        Sq, Sk = q.shape[1], k.shape[1]
+        q_ids = jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 0)
+        k_ids = jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 1)
+        s = jnp.where(q_ids >= k_ids, s, NEG_INF)
+    p = jnp.exp(s - lse)
+    gf = g.astype(jnp.float32)
+    dp = jnp.einsum("bqd,bkd->bqk", gf, v.astype(jnp.float32))
+    ds = p * (dp - delta)
+    dq = sm_scale * jnp.einsum("bqk,bkd->bqd", ds, k.astype(jnp.float32))
+    dk = sm_scale * jnp.einsum("bqk,bqd->bkd", ds, q.astype(jnp.float32))
+    dv = jnp.einsum("bqk,bqd->bkd", p, gf)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
 def _choose_blocks(seq_q, seq_k):
     bq = min(512, seq_q)
     while seq_q % bq:
@@ -171,7 +239,10 @@ def _flash_fwd(q, k, v, causal, sm_scale):
     Sk = k.shape[1]
     bq, bk = _choose_blocks(Sq, Sk)
     grid = (BH, Sq // bq)
-    interpret = jax.default_backend() not in ("tpu",)
+    interpret = _interpret_mode()
+    vma = _out_vma(q, k, v)
+    if _use_jnp_mirror(vma):
+        return _fwd_mirror(q, k, v, causal, sm_scale)
 
     # x64 weak-type promotion inside kernels trips a Mosaic lowering
     # recursion; kernels are pure f32/bf16 so trace them with x64 off
@@ -190,8 +261,8 @@ def _flash_fwd(q, k, v, causal, sm_scale):
             pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
-            jax.ShapeDtypeStruct((BH, Sq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((BH, Sq, D), q.dtype, vma=vma),
+            jax.ShapeDtypeStruct((BH, Sq, 1), jnp.float32, vma=vma),
         ],
             interpret=interpret,
         )(q, k, v)
@@ -208,9 +279,12 @@ def _flash_bwd_vjp(causal, sm_scale, res, g):
     BH, Sq, D = q.shape
     Sk = k.shape[1]
     bq, bk = _choose_blocks(Sq, Sk)
-    interpret = jax.default_backend() not in ("tpu",)
+    interpret = _interpret_mode()
+    vma = _out_vma(q, k, v, g)
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)  # [BH, Sq, 1]
+    if _use_jnp_mirror(vma):
+        return _bwd_mirror(q, k, v, g, lse, delta, causal, sm_scale)
 
     with jax.enable_x64(False):
         dq = pl.pallas_call(
@@ -226,7 +300,7 @@ def _flash_bwd_vjp(causal, sm_scale, res, g):
             pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((BH, Sq, D), q.dtype, vma=vma),
         interpret=interpret,
         )(q, k, v, g, lse, delta)
 
@@ -247,8 +321,8 @@ def _flash_bwd_vjp(causal, sm_scale, res, g):
             pl.BlockSpec((1, bk, D), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, Sk, D), k.dtype),
-            jax.ShapeDtypeStruct((BH, Sk, D), v.dtype),
+            jax.ShapeDtypeStruct((BH, Sk, D), k.dtype, vma=vma),
+            jax.ShapeDtypeStruct((BH, Sk, D), v.dtype, vma=vma),
         ],
         interpret=interpret,
         )(q, k, v, g, lse, delta)

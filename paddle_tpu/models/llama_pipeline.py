@@ -238,6 +238,11 @@ class LlamaPipelineTrainer:
 
     # ------------------------------------------------------------------
     def step(self, x, y):
+        # re-assert the kernel platform hint for THIS mesh: another mesh may
+        # have been built since construction, and the hint is process-global
+        from ..kernels import set_platform
+
+        set_platform(self.mesh.devices.flat[0].platform)
         if self._state is None:
             self._init_state()
         if self._step_fn is None:

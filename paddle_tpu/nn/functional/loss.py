@@ -89,8 +89,12 @@ def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", nam
         lbl_i = lbl.astype(jnp.int32)
         valid = lbl_i != ignore_index
         safe = jnp.where(valid, lbl_i, 0)
-        picked = jnp.take_along_axis(logp, safe[..., None] if logp.ndim == lbl_i.ndim + 1 else safe, axis=1)
-        picked = picked.squeeze(1) if picked.ndim > lbl_i.ndim else picked
+        if logp.ndim == lbl_i.ndim + 1:
+            # class axis is 1 for both [N, C] and spatial [N, C, d1, ...] input
+            picked = jnp.take_along_axis(
+                logp, jnp.expand_dims(safe, 1), axis=1).squeeze(1)
+        else:
+            picked = jnp.take_along_axis(logp, safe, axis=1)
         loss = jnp.where(valid, -picked, 0.0)
         if w is not None:
             wsel = jnp.where(valid, jnp.take(w, safe), 0.0)

@@ -34,6 +34,9 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=Fa
             out = out + b.reshape(shape)
         return out
 
+    if weight is None and bias is not None:
+        return apply(lambda v, rm, rv, b: body(v, rm, rv, None, b),
+                     x, running_mean, running_var, bias, op_name="batch_norm")
     args = [x, running_mean, running_var]
     if weight is not None:
         args.append(weight)
@@ -107,6 +110,9 @@ def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None
             out = out + b.reshape(shape)
         return out
 
+    if weight is None and bias is not None:
+        return apply(lambda v, b: body(v, None, b), x, bias,
+                     op_name="instance_norm")
     args = [x]
     if weight is not None:
         args.append(weight)
@@ -132,6 +138,9 @@ def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None, data_format=
             out = out + b.reshape(shape)
         return out
 
+    if weight is None and bias is not None:
+        return apply(lambda v, b: body(v, None, b), x, bias,
+                     op_name="group_norm")
     args = [x]
     if weight is not None:
         args.append(weight)

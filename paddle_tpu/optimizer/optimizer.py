@@ -127,14 +127,28 @@ class Optimizer:
         return "decay" in inspect.signature(self.update).parameters
 
     # -- serialization ----------------------------------------------------
+    def _param_keys(self):
+        """Accumulator keys aligned with the parameter list: the parameter's
+        name when it has one (mirroring the reference's name-based .pdopt
+        layout), positional for unnamed params. Duplicate names get a
+        deterministic ``__<n>`` suffix on both save and load so state never
+        silently collides."""
+        keys, seen = [], {}
+        for i, p in enumerate(self._parameter_list or []):
+            key = p.name if getattr(p, "name", None) else f"param{i}"
+            n = seen.get(key, 0)
+            seen[key] = n + 1
+            keys.append(key if n == 0 else f"{key}__{n}")
+        return keys
+
     def state_dict(self):
         out = {"_step_count": self._step_count}
         if self._parameter_list is not None:
-            for i, p in enumerate(self._parameter_list):
+            for p, key in zip(self._parameter_list, self._param_keys()):
                 st = self._accumulators.get(id(p))
                 if st:
                     for k, v in st.items():
-                        out[f"param{i}.{k}"] = Tensor._wrap(v)
+                        out[f"{key}.{k}"] = Tensor._wrap(v)
         if self._lr_scheduler is not None:
             out["LR_Scheduler"] = self._lr_scheduler.state_dict()
         return out
@@ -142,8 +156,8 @@ class Optimizer:
     def set_state_dict(self, state):
         self._step_count = state.get("_step_count", 0)
         if self._parameter_list is not None:
-            for i, p in enumerate(self._parameter_list):
-                prefix = f"param{i}."
+            for p, key in zip(self._parameter_list, self._param_keys()):
+                prefix = f"{key}."
                 st = {}
                 for k, v in state.items():
                     if isinstance(k, str) and k.startswith(prefix):

@@ -1,0 +1,110 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def test_batch_norm_bias_without_weight_is_additive():
+    # ADVICE: bias used to bind to the weight slot and multiply instead of add
+    x = paddle.to_tensor(np.random.RandomState(0).standard_normal(
+        (4, 3, 5, 5)).astype(np.float32))
+    rm = paddle.zeros([3])
+    rv = paddle.ones([3])
+    bias = paddle.to_tensor(np.full(3, 5.0, np.float32))
+    out = F.batch_norm(x, rm, rv, weight=None, bias=bias, epsilon=0.0)
+    ref = F.batch_norm(x, rm, rv, weight=None, bias=None, epsilon=0.0)
+    np.testing.assert_allclose(out.numpy(), ref.numpy() + 5.0, rtol=1e-6)
+
+
+def test_instance_and_group_norm_bias_without_weight():
+    x = paddle.to_tensor(np.random.RandomState(1).standard_normal(
+        (2, 4, 6)).astype(np.float32))
+    bias = paddle.to_tensor(np.full(4, 2.0, np.float32))
+    out_i = F.instance_norm(x, weight=None, bias=bias)
+    ref_i = F.instance_norm(x, weight=None, bias=None)
+    np.testing.assert_allclose(
+        out_i.numpy(), ref_i.numpy() + 2.0, rtol=1e-5, atol=1e-5)
+    out_g = F.group_norm(x, 2, weight=None, bias=bias)
+    ref_g = F.group_norm(x, 2, weight=None, bias=None)
+    np.testing.assert_allclose(
+        out_g.numpy(), ref_g.numpy() + 2.0, rtol=1e-5, atol=1e-5)
+
+
+def test_nll_loss_spatial():
+    # ADVICE: [N, C, H, W] log-probs with [N, H, W] labels used to raise
+    rng = np.random.RandomState(2)
+    logits = rng.standard_normal((2, 3, 4, 5)).astype(np.float32)
+    logp = logits - np.log(np.exp(logits).sum(1, keepdims=True))
+    label = rng.randint(0, 3, (2, 4, 5)).astype(np.int64)
+    out = F.nll_loss(paddle.to_tensor(logp), paddle.to_tensor(label))
+    expected = -np.take_along_axis(logp, label[:, None], axis=1).mean()
+    np.testing.assert_allclose(float(out.numpy()), expected, rtol=1e-5)
+
+
+def test_optimizer_state_dict_keyed_by_param_name():
+    # ADVICE: position-keyed accumulators mis-assign on reordered param lists
+    w1 = paddle.Parameter(np.ones(2, np.float32), name="w1")
+    w2 = paddle.Parameter(np.full(2, 2.0, np.float32), name="w2")
+    opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w1, w2])
+    w1._grad = np.ones(2, np.float32)
+    w2._grad = np.full(2, 3.0, np.float32)
+    opt.step()
+    sd = opt.state_dict()
+    assert any(k.startswith("w1.") for k in sd)
+    assert any(k.startswith("w2.") for k in sd)
+
+    # restore into an optimizer whose parameter list is REVERSED
+    opt2 = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w2, w1])
+    opt2.set_state_dict(sd)
+    m1 = np.asarray(opt2._accumulators[id(w1)]["moment1"])
+    m1_orig = np.asarray(opt._accumulators[id(w1)]["moment1"])
+    np.testing.assert_allclose(m1, m1_orig)
+
+
+def test_fit_accumulate_grad_batches():
+    # sum-of-grads semantics (reference hapi model.py:817 update=False)
+    import paddle_tpu.nn as nn
+
+    def make():
+        paddle.seed(0)
+        net = nn.Linear(3, 1)
+        model = paddle.Model(net)
+        model.prepare(
+            optimizer=paddle.optimizer.SGD(
+                learning_rate=0.1, parameters=net.parameters()),
+            loss=nn.MSELoss())
+        return net, model
+
+    rng = np.random.RandomState(3)
+    xa = rng.standard_normal((2, 3)).astype(np.float32)
+    ya = rng.standard_normal((2, 1)).astype(np.float32)
+    xb = rng.standard_normal((2, 3)).astype(np.float32)
+    yb = rng.standard_normal((2, 1)).astype(np.float32)
+
+    # accumulate over two half-batches
+    net1, m1 = make()
+    m1.train_batch([xa], [ya], update=False)
+    m1.train_batch([xb], [yb], update=True)
+
+    # single step on summed grads == step with grad(xa)+grad(xb)
+    net2, m2 = make()
+    import jax.numpy as jnp
+
+    from paddle_tpu.nn.layer import functional_call, functional_state
+
+    params, bufs = functional_state(net2)
+    import jax
+
+    def loss_of(p, x, y):
+        out, _ = functional_call(net2, p, bufs, jnp.asarray(x))
+        return jnp.mean((out - jnp.asarray(y)) ** 2)
+
+    g1 = jax.grad(loss_of)(params, xa, ya)
+    g2 = jax.grad(loss_of)(params, xb, yb)
+    expected = {k: params[k] - 0.1 * (g1[k] + g2[k]) for k in params}
+    got = dict(net1.named_parameters())
+    for k in expected:
+        np.testing.assert_allclose(
+            got[k].numpy(), np.asarray(expected[k]), rtol=1e-5, atol=1e-6)

@@ -224,7 +224,9 @@ class TestAmpRecompute:
         loss = (w * 3.0).sum()
         scaled = scaler.scale(loss)
         scaled.backward()
-        scaler.step(opt)  # unscales then steps
+        scaler.unscale_(opt)       # explicit unscale...
+        scaler.step(opt)           # ...must NOT divide by the scale twice
+        scaler.update()
         np.testing.assert_allclose(w.numpy(), 1.0 - 0.1 * 3.0)
 
     def test_grad_scaler_skips_on_inf(self):
@@ -233,6 +235,7 @@ class TestAmpRecompute:
         scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
         w._grad = np.array([np.inf], np.float32)
         scaler.step(opt)
+        scaler.update()
         np.testing.assert_allclose(w.numpy(), 1.0)  # step skipped
         assert scaler.get_loss_scaling() < 4.0  # backed off
 

@@ -1,0 +1,91 @@
+"""Flash-attention kernel parity (interpret mode on CPU).
+
+The reference validates its vendored flash-attn against a naive softmax
+attention (/root/reference/test/legacy_test/test_flash_attention.py); here the
+Pallas kernel (HLO-interpret mode), the jnp mirror used inside sharded CPU
+tests, and sdpa_ref must all agree on outputs and gradients.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import kernels
+from paddle_tpu.kernels.flash_attention import (
+    _bwd_mirror, _flash_bhsd, _flash_fwd, _fwd_mirror, flash_attention_pallas,
+)
+from paddle_tpu.nn.functional.attention import sdpa_ref
+
+
+@pytest.fixture(autouse=True)
+def _cpu_interpret():
+    """Pin to CPU + interpret mode: under axon the default backend stays
+    'tpu' even with JAX_PLATFORMS=cpu, and on-chip MXU default precision
+    would swamp the f32 parity tolerances."""
+    kernels.set_platform("cpu")
+    with jax.default_device(jax.devices("cpu")[0]):
+        yield
+    kernels.set_platform(None)
+
+
+def _rand_qkv(rng, B=2, S=64, Hq=4, Hk=4, D=16):
+    q = rng.standard_normal((B, S, Hq, D)).astype(np.float32)
+    k = rng.standard_normal((B, S, Hk, D)).astype(np.float32)
+    v = rng.standard_normal((B, S, Hk, D)).astype(np.float32)
+    return jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("gqa", [False, True])
+def test_pallas_kernel_matches_sdpa_ref(causal, gqa):
+    rng = np.random.default_rng(0)
+    q, k, v = _rand_qkv(rng, Hk=2 if gqa else 4)
+
+    def loss_pallas(q, k, v):
+        return jnp.sum(flash_attention_pallas(q, k, v, is_causal=causal) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(sdpa_ref(q, k, v, is_causal=causal) ** 2)
+
+    out_p = flash_attention_pallas(q, k, v, is_causal=causal)
+    out_r = sdpa_ref(q, k, v, is_causal=causal)
+    np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_r),
+                               atol=2e-5, rtol=2e-5)
+
+    gp = jax.grad(loss_pallas, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_jnp_mirror_matches_interpret_kernel(causal):
+    """The mirror used inside sharded CPU tests must transcribe the kernel
+    math exactly — fwd out + lse, and the bwd dq/dk/dv formulas."""
+    rng = np.random.default_rng(1)
+    B, S, D = 3, 32, 16
+    q = jnp.asarray(rng.standard_normal((B, S, D)).astype(np.float32))
+    k = jnp.asarray(rng.standard_normal((B, S, D)).astype(np.float32))
+    v = jnp.asarray(rng.standard_normal((B, S, D)).astype(np.float32))
+    sm = 1.0 / np.sqrt(D)
+
+    out_k, lse_k = _flash_fwd(q, k, v, causal, sm)
+    out_m, lse_m = _fwd_mirror(q, k, v, causal, sm)
+    np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_m),
+                               atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(lse_k), np.asarray(lse_m),
+                               atol=2e-5, rtol=2e-5)
+
+    g = jnp.asarray(rng.standard_normal((B, S, D)).astype(np.float32))
+
+    def f(q, k, v):
+        return jnp.vdot(_flash_bhsd(q, k, v, causal, sm), g)
+
+    dq_k, dk_k, dv_k = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    delta = jnp.sum(g * out_m.astype(jnp.float32), axis=-1, keepdims=True)
+    dq_m, dk_m, dv_m = _bwd_mirror(q, k, v, g, lse_m, delta, causal, sm)
+    for a, b in zip((dq_k, dk_k, dv_k), (dq_m, dk_m, dv_m)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=2e-4)
