@@ -28,7 +28,14 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import nn
 
-__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer", "spmd_pipeline", "stack_stage_params"]
+__all__ = [
+    "LayerDesc", "SharedLayerDesc", "PipelineLayer", "spmd_pipeline",
+    "spmd_pipeline_1f1b", "make_pipeline_1f1b_loss", "stack_stage_params",
+]
+
+
+def _pvary(x, axes=("pp",)):
+    return jax.lax.pcast(x, axes, to="varying")
 
 
 class LayerDesc:
@@ -119,16 +126,16 @@ def spmd_pipeline(stage_fn, stage_params, x_micro, mesh, n_stages, remat=True,
         stage_id = jax.lax.axis_index("pp")
 
         # carries are varying over 'pp' from the start (check_vma typing)
-        h0 = jax.lax.pvary(jnp.zeros_like(xs[0]), ("pp",))
-        out0 = jax.lax.pvary(jnp.zeros((M,) + xs.shape[1:], xs.dtype), ("pp",))
+        h0 = _pvary(jnp.zeros_like(xs[0]))
+        out0 = _pvary(jnp.zeros((M,) + xs.shape[1:], xs.dtype))
 
         def tick(carry, t):
             h_in, outputs = carry
             # stage 0 consumes micro-batch t while t < M; later stages consume
             # what arrived over the wire last tick
             mb_idx = jnp.clip(t, 0, M - 1)
-            first_in = jax.lax.pvary(
-                jax.lax.dynamic_index_in_dim(xs, mb_idx, 0, keepdims=False), ("pp",))
+            first_in = _pvary(
+                jax.lax.dynamic_index_in_dim(xs, mb_idx, 0, keepdims=False))
             inp = jnp.where(stage_id == 0, first_in, h_in)
             h_out = body(p_local, inp, *extra)
             # last stage banks its result for micro-batch t - (S-1)
@@ -167,3 +174,186 @@ def spmd_pipeline(stage_fn, stage_params, x_micro, mesh, n_stages, remat=True,
         check_vma=True,
     )
     return mapped(stage_params, x_micro, *extra_args)
+
+
+# ---------------------------------------------------------------------------
+# 1F1B schedule
+# ---------------------------------------------------------------------------
+
+def spmd_pipeline_1f1b(stage_fn, loss_fn, stage_params, edge_params, x_micro,
+                       y_micro, mesh, n_stages):
+    """One-forward-one-backward schedule with a hand-scheduled backward pass
+    (parity: the reference's steady-state 1F1B,
+    /root/reference/python/paddle/distributed/fleet/meta_parallel/
+    pipeline_parallel.py:372 forward_backward_pipeline).
+
+    Unlike ``spmd_pipeline`` (whose backward is autodiff-of-scan, i.e. GPipe:
+    all M micro-batch residual sets live until the drain), each tick here runs
+    ONE forward micro-batch AND ONE backward micro-batch per stage:
+
+    - stage ``i`` forwards micro-batch ``f = t - i`` at tick ``t``,
+    - stage ``i`` backwards micro-batch ``b = t - 2(S-1) + i`` at tick ``t``
+      (so the LAST stage backwards a micro-batch the same tick it forwards
+      it — the defining 1F1B property), and the cotangent hops stage
+      ``i+1 → i`` via a reverse ``ppermute`` exactly one tick after the
+      downstream stage produced it.
+
+    Only the stage INPUT of each in-flight micro-batch is stored, in a ring
+    buffer of ``2S-1`` slots (the max in-flight count at stage 0) — the 1F1B
+    memory profile: O(S) saved activations per stage instead of O(M); the
+    stage body is rematerialized inside ``jax.vjp`` during the backward unit.
+
+    The per-micro-batch loss head runs INSIDE the last stage's tick (that is
+    what lets backward start while forwards are still filling), so callers
+    pass ``loss_fn(edge_params, h_last, y_mb) -> scalar`` mean-per-token loss.
+
+    stage_fn:    (params_one_stage, h) -> h      pure, same for all stages
+    stage_params: pytree, every leaf [S, ...]    sharded over 'pp' dim 0
+    edge_params: pytree (norm/head etc.)         replicated over 'pp'
+    x_micro:     [M, mb, ...]                    replicated over 'pp'
+    y_micro:     [M, mb, ...] int labels         replicated over 'pp'
+
+    Returns (mean_loss, d_stage_params, d_edge_params, d_x_micro) — gradients
+    computed by the schedule itself; wrap with ``make_pipeline_1f1b_loss`` to
+    splice into outer autodiff.
+    """
+    M = x_micro.shape[0]
+    S = n_stages
+    Sm1 = S - 1
+    R = max(2 * S - 1, 1)
+    T = M + 2 * Sm1
+
+    def per_stage(bparams, eparams, xs, ys):
+        p_local = jax.tree_util.tree_map(lambda a: a[0], bparams)
+        eparams = jax.tree_util.tree_map(_pvary, eparams)
+        xs = _pvary(xs)
+        ys = _pvary(ys)
+        stage_id = jax.lax.axis_index("pp")
+        f32 = jnp.float32
+
+        h0 = _pvary(jnp.zeros(xs.shape[1:], xs.dtype))
+        g0 = _pvary(jnp.zeros(xs.shape[1:], f32))
+        ring0 = _pvary(jnp.zeros((R,) + xs.shape[1:], xs.dtype))
+        gp0 = jax.tree_util.tree_map(
+            lambda a: _pvary(jnp.zeros(a.shape, f32)), p_local)
+        ge0 = jax.tree_util.tree_map(
+            lambda a: _pvary(jnp.zeros(jnp.shape(a), f32)), eparams)
+        gxs0 = _pvary(jnp.zeros((M,) + xs.shape[1:], f32))
+        loss0 = _pvary(jnp.zeros((), f32))
+
+        def tick(carry, t):
+            h_in, g_in, ring, gp, ge, gxs, loss_acc = carry
+
+            # ---- forward unit: micro-batch f = t - stage_id --------------
+            f = t - stage_id
+            do_f = (f >= 0) & (f < M)
+            f_idx = jnp.clip(f, 0, M - 1)
+            x_f = jax.lax.dynamic_index_in_dim(xs, f_idx, 0, keepdims=False)
+            a_in = jnp.where(stage_id == 0, x_f, h_in)
+            ring = jax.lax.cond(
+                do_f,
+                lambda r: jax.lax.dynamic_update_index_in_dim(
+                    r, a_in, f_idx % R, 0),
+                lambda r: r,
+                ring)
+            h_out = stage_fn(p_local, a_in)
+
+            # ---- backward unit: micro-batch b = t - 2(S-1) + stage_id ----
+            b = t - 2 * Sm1 + stage_id
+            do_b = (b >= 0) & (b < M)
+            b_idx = jnp.clip(b, 0, M - 1)
+            y_b = jax.lax.dynamic_index_in_dim(ys, b_idx, 0, keepdims=False)
+
+            # last stage: per-micro-batch loss head on THIS tick's h_out
+            loss_val, loss_vjp = jax.vjp(
+                lambda e, h: loss_fn(e, h, y_b), eparams, h_out)
+            ge_unit, gh_last = loss_vjp(_pvary(jnp.ones((), f32)))
+            g_use = jnp.where(stage_id == Sm1,
+                              gh_last.astype(f32), g_in)
+
+            a_b = jax.lax.dynamic_index_in_dim(ring, b_idx % R, 0,
+                                               keepdims=False)
+            _, stage_vjp = jax.vjp(stage_fn, p_local, a_b)
+            gp_unit, ga = stage_vjp(g_use.astype(h_out.dtype))
+
+            gp = jax.tree_util.tree_map(
+                lambda acc, g: acc + jnp.where(do_b, g.astype(f32), 0.0),
+                gp, gp_unit)
+            last_b = do_b & (stage_id == Sm1)
+            ge = jax.tree_util.tree_map(
+                lambda acc, g: acc + jnp.where(last_b, g.astype(f32), 0.0),
+                ge, ge_unit)
+            loss_acc = loss_acc + jnp.where(last_b, loss_val.astype(f32), 0.0)
+
+            gxs = jax.lax.cond(
+                do_b & (stage_id == 0),
+                lambda g: jax.lax.dynamic_update_index_in_dim(
+                    g, ga.astype(f32), b_idx, 0),
+                lambda g: g,
+                gxs)
+
+            # ---- hand-offs: activations forward, cotangents backward -----
+            h_next = jax.lax.ppermute(
+                h_out, "pp", [(i, (i + 1) % S) for i in range(S)])
+            g_next = jax.lax.ppermute(
+                ga.astype(f32), "pp", [(i, (i - 1) % S) for i in range(S)])
+            return (h_next, g_next, ring, gp, ge, gxs, loss_acc), None
+
+        (_, _, _, gp, ge, gxs, loss_acc), _ = jax.lax.scan(
+            tick, (h0, g0, ring0, gp0, ge0, gxs0, loss0), jnp.arange(T))
+
+        # mean over micro-batches; only last stage accumulated loss/edge
+        # grads, only stage 0 banked input cotangents — psum replicates
+        loss = jax.lax.psum(loss_acc, "pp") / M
+        gp = jax.tree_util.tree_map(
+            lambda a, p: (a / M).astype(p.dtype)[None], gp, p_local)
+        ge = jax.tree_util.tree_map(
+            lambda a, p: (jax.lax.psum(a, "pp") / M).astype(
+                jnp.asarray(p).dtype),
+            ge, jax.tree_util.tree_map(lambda x: x, eparams))
+        gxs = jax.lax.psum(gxs, "pp") / M
+        return loss, gp, ge, gxs.astype(x_micro.dtype)
+
+    pp_specs = jax.tree_util.tree_map(lambda _: P("pp"), stage_params)
+    e_specs = jax.tree_util.tree_map(lambda _: P(), edge_params)
+    mapped = jax.shard_map(
+        per_stage,
+        mesh=mesh,
+        in_specs=(pp_specs, e_specs, P(), P()),
+        out_specs=(P(), pp_specs, e_specs, P()),
+        axis_names={"pp"},
+        check_vma=True,
+    )
+    return mapped(stage_params, edge_params, x_micro, y_micro)
+
+
+def make_pipeline_1f1b_loss(stage_fn, loss_fn, mesh, n_stages):
+    """Wrap the 1F1B schedule as a scalar-loss callable whose vjp is the
+    schedule's own hand-computed gradients — outer ``jax.value_and_grad``
+    then flows through it transparently (embedding grads arrive via the
+    x_micro cotangent)."""
+
+    @jax.custom_vjp
+    def ploss(stage_params, edge_params, x_micro, y_micro):
+        loss, _, _, _ = spmd_pipeline_1f1b(
+            stage_fn, loss_fn, stage_params, edge_params, x_micro, y_micro,
+            mesh, n_stages)
+        return loss
+
+    def fwd(stage_params, edge_params, x_micro, y_micro):
+        loss, gb, ge, gxs = spmd_pipeline_1f1b(
+            stage_fn, loss_fn, stage_params, edge_params, x_micro, y_micro,
+            mesh, n_stages)
+        return loss, (gb, ge, gxs, jnp.shape(y_micro))
+
+    def bwd(res, gbar):
+        import numpy as _np
+
+        gb, ge, gxs, y_shape = res
+        scale = lambda t: jax.tree_util.tree_map(
+            lambda a: (a * gbar).astype(a.dtype), t)
+        gy = _np.zeros(y_shape, jax.dtypes.float0)
+        return scale(gb), scale(ge), scale(gxs), gy
+
+    ploss.defvjp(fwd, bwd)
+    return ploss

@@ -33,7 +33,8 @@ class LlamaPipelineTrainer:
     """Builds and owns the hybrid train step + sharded state."""
 
     def __init__(self, config: LlamaConfig, mesh, optimizer, n_micro=None,
-                 zero_stage=2, compute_dtype="auto", seed=0):
+                 zero_stage=2, compute_dtype="auto", seed=0,
+                 pp_schedule="1f1b"):
         from .. import nn
         from ..distributed.mp_layers import ColumnParallelLinear, VocabParallelEmbedding
         from ..framework import random as frandom
@@ -55,6 +56,9 @@ class LlamaPipelineTrainer:
         self.n_stages = shape.get("pp", 1)
         self.zdeg = shape.get("sharding", 1)
         self.zero_stage = zero_stage
+        # "1f1b" (reference pipeline_parallel.py:372, the default schedule
+        # there too) or "fthenb" (GPipe fill-drain, autodiff backward)
+        self.pp_schedule = pp_schedule
         self.n_micro = n_micro or max(2 * self.n_stages, 2)
         assert config.num_hidden_layers % self.n_stages == 0, \
             "layers must divide evenly over pipeline stages"
@@ -206,6 +210,34 @@ class LlamaPipelineTrainer:
             h_micro = jax.lax.with_sharding_constraint(
                 h_micro, NamedSharding(mesh, P(None, ("dp", "sharding"), None, None)))
 
+            def head_loss(norm_p, head_p, hh, yy):
+                """norm (f32) + lm head (compute dtype) + CE, mean per token.
+
+                CE picks the label logit with a one-hot contraction, not a
+                gather: gathers are slow on TPU and XLA's SPMD partitioner
+                cannot partition them inside the partial-manual pp region
+                (PartitionGather check-fails)."""
+                h32 = hh.astype(jnp.float32)
+                hn, _ = functional_call(norm, norm_p, {}, h32)
+                logits, _ = functional_call(
+                    head, head_p, {}, hn.astype(cdt) if cdt is not None else hn)
+                logits = logits.astype(jnp.float32)
+                logp = jax.nn.log_softmax(logits, axis=-1)
+                onehot = jax.nn.one_hot(yy.astype(jnp.int32), logits.shape[-1],
+                                        dtype=logp.dtype)
+                return -jnp.mean(jnp.sum(logp * onehot, axis=-1))
+
+            if S > 1 and self.pp_schedule == "1f1b":
+                from ..distributed.pipeline import make_pipeline_1f1b_loss
+
+                def mb_loss(ep, hh, yy):
+                    return head_loss(ep["norm"], ep["head"], hh, yy)
+
+                ploss = make_pipeline_1f1b_loss(stage_fn, mb_loss, mesh, S)
+                y_micro = y.reshape(M, mb, Sq)
+                return ploss(bparams, {"norm": nparams, "head": hparams},
+                             h_micro, y_micro)
+
             if S > 1:
                 h_micro = spmd_pipeline(stage_fn, bparams, h_micro, mesh, S)
             else:
@@ -213,13 +245,7 @@ class LlamaPipelineTrainer:
                 h_micro = jax.vmap(lambda hm: stage_fn(squeezed, hm))(h_micro)
 
             h = h_micro.reshape(B, Sq, H)
-            h32 = h.astype(jnp.float32)
-            hn, _ = functional_call(norm, nparams, {}, h32)
-            logits, _ = functional_call(head, hparams, {}, hn.astype(cdt) if cdt is not None else hn)
-            logits = logits.astype(jnp.float32)
-            logp = jax.nn.log_softmax(logits, axis=-1)
-            picked = jnp.take_along_axis(logp, y[..., None].astype(jnp.int32), axis=-1)
-            return -jnp.mean(picked)
+            return head_loss(nparams, hparams, h, y)
 
         def train_step(params, opt_state, lr, x, y):
             loss, grads = jax.value_and_grad(loss_fn)(params, x, y)

@@ -1,0 +1,50 @@
+"""1F1B schedule correctness on the 8-device CPU mesh.
+
+Parity target: the reference's steady-state 1F1B must produce the same losses
+and updated weights as fill-drain — it is a re-ordering of the same compute
+(/root/reference/python/paddle/distributed/fleet/meta_parallel/
+pipeline_parallel.py:372). Here the hand-scheduled backward (ring buffer +
+reverse ppermute) is checked against the autodiff fill-drain backward.
+"""
+import numpy as np
+import pytest
+
+import jax
+
+from paddle_tpu.distributed.mesh import build_mesh
+from paddle_tpu.models import llama_tiny
+from paddle_tpu.models.llama_pipeline import LlamaPipelineTrainer
+from paddle_tpu.optimizer import AdamW
+
+
+def _losses(schedule, steps=3, degrees=None, n_micro=4, seed=0):
+    mesh = build_mesh(degrees=degrees or {"pp": 2, "dp": 2, "mp": 2})
+    cfg = llama_tiny(vocab=64, hidden=32, layers=4, heads=4, kv_heads=2,
+                     inter=64, seq=32)
+    trainer = LlamaPipelineTrainer(
+        cfg, mesh, AdamW(learning_rate=1e-2), n_micro=n_micro, zero_stage=2,
+        seed=seed, pp_schedule=schedule)
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(steps):
+        x = rng.randint(0, 64, (8, 16)).astype(np.int64)
+        y = rng.randint(0, 64, (8, 16)).astype(np.int64)
+        loss = trainer.step(x, y)
+        out.append(float(np.asarray(loss)))
+    return out
+
+
+def test_1f1b_matches_fill_drain():
+    l_1f1b = _losses("1f1b")
+    l_gpipe = _losses("fthenb")
+    # identical compute re-ordered: losses (and therefore the updated weights
+    # feeding later losses) must agree to fp tolerance at every step
+    np.testing.assert_allclose(l_1f1b, l_gpipe, rtol=2e-4, atol=2e-5)
+
+
+def test_1f1b_pp4():
+    # deeper pipeline, micro-batches > 2*stages (real steady state)
+    losses = _losses("1f1b", steps=2, degrees={"pp": 4, "dp": 2}, n_micro=8)
+    assert all(np.isfinite(l) for l in losses)
+    ref = _losses("fthenb", steps=2, degrees={"pp": 4, "dp": 2}, n_micro=8)
+    np.testing.assert_allclose(losses, ref, rtol=2e-4, atol=2e-5)
