@@ -168,10 +168,15 @@ class LlamaPipelineTrainer:
         # MXU); everything elementwise is recomputed.
         import os
 
-        policy = None
-        if os.environ.get("PADDLE_TPU_REMAT_POLICY", "dots") == "dots":
-            policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-        block_apply_ck = jax.checkpoint(block_apply, policy=policy)
+        remat_policy = os.environ.get("PADDLE_TPU_REMAT_POLICY", "dots")
+        if remat_policy == "off":
+            # no rematerialization: all residuals saved (HBM permitting)
+            block_apply_ck = block_apply
+        else:
+            policy = None
+            if remat_policy == "dots":
+                policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            block_apply_ck = jax.checkpoint(block_apply, policy=policy)
 
         def stage_fn(stage_params, h):
             # stage_params leaves [L/S, ...]; scan the blocks of this stage
@@ -275,8 +280,15 @@ class LlamaPipelineTrainer:
             self._step_fn = self._build_step()
         params, opt_state = self._state
         data_sharding = NamedSharding(self.mesh, P(("dp", "sharding"), None))
-        x = jax.device_put(np.asarray(x), data_sharding)
-        y = jax.device_put(np.asarray(y), data_sharding)
+
+        def _put(a):
+            # device-resident arrays reshard in place; never bounce via host
+            if isinstance(a, jax.Array):
+                return jax.device_put(a, data_sharding)
+            return jax.device_put(np.asarray(a), data_sharding)
+
+        x = _put(x)
+        y = _put(y)
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         loss, params, opt_state = self._step_fn(params, opt_state, lr, x, y)
         self._state = (params, opt_state)

@@ -1,0 +1,362 @@
+"""paddle.profiler parity, TPU-native.
+
+Reference surface: the unified host+device Profiler
+(/root/reference/python/paddle/profiler/profiler.py:340 — scheduler windows,
+start/stop/step, export_chrome_tracing) and the throughput Benchmark
+instrument (timer.py:349 — reader_cost / batch_cost / ips via TimerHook).
+
+TPU stance: device tracing is jax.profiler (XLA's TraceMe + TPU device
+traces, viewable in TensorBoard/Perfetto/xprof) — we wrap rather than rebuild
+the event collector; host annotations use jax.profiler.TraceAnnotation so
+they interleave with XLA's own events in the same trace. The Benchmark math
+(TimeAverager, ips) is host-side and implemented here directly, extended
+with the model-FLOPs/MFU counter BASELINE.md requires (the reference has no
+MFU notion; tokens/sec/chip × flops/token ÷ peak is the TPU north-star
+metric).
+"""
+from __future__ import annotations
+
+import time
+from enum import Enum
+
+import jax
+
+__all__ = [
+    "Profiler", "ProfilerTarget", "ProfilerState", "RecordEvent",
+    "make_scheduler", "export_chrome_tracing", "Benchmark", "benchmark",
+    "TimeAverager", "transformer_flops_per_token", "peak_flops", "mfu",
+]
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    CUSTOM_DEVICE = 3
+    TPU = 4  # beyond-reference: the native target here
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0):
+    """Window scheduler (reference profiler.py:114): per-step state out of
+    [skip_first][closed][ready][record...] cycles."""
+    period = closed + ready + record
+
+    def scheduler(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        step -= skip_first
+        if repeat > 0 and step // period >= repeat:
+            return ProfilerState.CLOSED
+        pos = step % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def _default_scheduler(step: int) -> ProfilerState:
+    return ProfilerState.RECORD
+
+
+def export_chrome_tracing(dir_name: str, worker_name: str | None = None):
+    """on_trace_ready factory (reference profiler.py:212). jax.profiler
+    already writes trace.json.gz under the log dir; this returns a handler
+    that records where."""
+
+    def handle_fn(prof):
+        prof._last_export_dir = dir_name
+
+    handle_fn._dir_name = dir_name
+    return handle_fn
+
+
+class RecordEvent:
+    """Host-side named span (reference event_tracing.h RecordEvent / python
+    RecordEvent). Emits a jax.profiler.TraceAnnotation so it nests with XLA
+    device events in the exported trace; also usable as a decorator."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._ann = None
+        self.begin_ns = None
+        self.end_ns = None
+
+    def begin(self):
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+        self.begin_ns = time.perf_counter_ns()
+
+    def end(self):
+        if self._ann is not None:
+            self.end_ns = time.perf_counter_ns()
+            self._ann.__exit__(None, None, None)
+            self._ann = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapped(*a, **k):
+            with RecordEvent(self.name):
+                return fn(*a, **k)
+
+        return wrapped
+
+
+class Profiler:
+    """Scheduler-windowed tracing (reference profiler.py:340).
+
+    ``start``/``stop`` bracket a jax.profiler trace; ``step`` advances the
+    scheduler and forwards throughput accounting to the Benchmark. On
+    RECORD→CLOSED transitions the trace is stopped and on_trace_ready fires.
+    """
+
+    def __init__(self, *, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only: bool = False, record_shapes: bool = False,
+                 profile_memory: bool = False, with_flops: bool = False):
+        if callable(scheduler):
+            self._scheduler = scheduler
+        elif isinstance(scheduler, (tuple, list)) and len(scheduler) == 2:
+            lo, hi = scheduler
+            self._scheduler = make_scheduler(
+                closed=max(lo, 0), ready=0, record=hi - lo, repeat=1)
+        else:
+            self._scheduler = _default_scheduler
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self.step_num = 0
+        self.current_state = ProfilerState.CLOSED
+        self._tracing = False
+        self._last_export_dir = None
+        self._benchmark = Benchmark()
+
+    # -- lifecycle -------------------------------------------------------
+    def _trace_dir(self):
+        if self._on_trace_ready is not None and \
+                getattr(self._on_trace_ready, "_dir_name", None):
+            return self._on_trace_ready._dir_name
+        import tempfile
+
+        return tempfile.mkdtemp(prefix="paddle_tpu_trace_")
+
+    def _start_trace(self):
+        if not self._tracing and not self._timer_only:
+            self._dir = self._trace_dir()
+            jax.profiler.start_trace(self._dir)
+            self._tracing = True
+
+    def _stop_trace(self):
+        if self._tracing:
+            jax.profiler.stop_trace()
+            self._tracing = False
+            self._last_export_dir = self._dir
+            if self._on_trace_ready is not None:
+                self._on_trace_ready(self)
+
+    def start(self):
+        self._benchmark.begin()
+        self.current_state = self._scheduler(self.step_num)
+        if self.current_state in (ProfilerState.READY, ProfilerState.RECORD,
+                                  ProfilerState.RECORD_AND_RETURN):
+            self._start_trace()
+        return self
+
+    def stop(self):
+        self._benchmark.end()
+        self._stop_trace()
+        self.current_state = ProfilerState.CLOSED
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def step(self, num_samples=None):
+        self._benchmark.step(num_samples)
+        self.step_num += 1
+        new_state = self._scheduler(self.step_num)
+        recording = self.current_state in (
+            ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN,
+            ProfilerState.READY)
+        should_record = new_state in (
+            ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN,
+            ProfilerState.READY)
+        if recording and not should_record:
+            self._stop_trace()
+        elif should_record and not recording:
+            self._start_trace()
+        self.current_state = new_state
+
+    def step_info(self, unit="samples"):
+        return self._benchmark.step_info(unit)
+
+    def export(self, path=None, format="json"):
+        """jax traces are written at stop time; returns the trace dir."""
+        return self._last_export_dir
+
+    def summary(self, **kwargs):
+        return self._benchmark.report()
+
+
+# ---------------------------------------------------------------------------
+# Benchmark (ips instrument) — reference timer.py:349
+# ---------------------------------------------------------------------------
+
+class TimeAverager:
+    """reference timer.py:302 — running averages with sample accounting."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._total_time = 0.0
+        self._count = 0
+        self._total_samples = 0
+
+    def record(self, usetime, num_samples=None):
+        self._total_time += usetime
+        self._count += 1
+        if num_samples:
+            self._total_samples += num_samples
+
+    def get_average(self):
+        return self._total_time / self._count if self._count else 0.0
+
+    def get_ips_average(self):
+        if not self._total_samples or self._total_time == 0.0:
+            return 0.0
+        return self._total_samples / self._total_time
+
+    @property
+    def count(self):
+        return self._count
+
+
+class Benchmark:
+    """reader_cost / batch_cost / ips throughput instrument
+    (reference timer.py:349; hapi and the bench harness consume it)."""
+
+    def __init__(self):
+        self.reader = TimeAverager()
+        self.batch = TimeAverager()
+        self._reader_t0 = None
+        self._batch_t0 = None
+        self.num_samples = None
+        self.speed_unit = "samples/s"
+
+    def begin(self):
+        now = time.perf_counter()
+        self._batch_t0 = now
+        self._reader_t0 = now
+
+    def before_reader(self):
+        self._reader_t0 = time.perf_counter()
+
+    def after_reader(self):
+        if self._reader_t0 is not None:
+            self.reader.record(time.perf_counter() - self._reader_t0)
+
+    def step(self, num_samples=None):
+        """Close out one step (reference Benchmark.step)."""
+        now = time.perf_counter()
+        if self._batch_t0 is not None:
+            self.batch.record(now - self._batch_t0, num_samples)
+        self._batch_t0 = now
+        self.num_samples = num_samples
+
+    after_step = step
+
+    def end(self):
+        self._batch_t0 = None
+
+    # -- reporting -------------------------------------------------------
+    def reader_average(self):
+        return self.reader.get_average()
+
+    def batch_average(self):
+        return self.batch.get_average()
+
+    def speed_average(self):
+        return self.batch.get_ips_average()
+
+    def step_info(self, unit="samples"):
+        msg = ""
+        if self.reader.count:
+            msg += f" reader_cost: {self.reader_average():.5f} s"
+        if self.batch.count:
+            msg += f" batch_cost: {self.batch_average():.5f} s"
+        ips = self.speed_average()
+        if ips:
+            msg += f" ips: {ips:.3f} {unit}/s"
+        return msg
+
+    def report(self):
+        return {
+            "reader_cost": self.reader_average(),
+            "batch_cost": self.batch_average(),
+            "ips": self.speed_average(),
+        }
+
+    def reset(self):
+        self.reader.reset()
+        self.batch.reset()
+
+
+_GLOBAL_BENCHMARK = Benchmark()
+
+
+def benchmark() -> Benchmark:
+    """Global instance (reference timer.py benchmark())."""
+    return _GLOBAL_BENCHMARK
+
+
+# ---------------------------------------------------------------------------
+# MFU accounting (beyond-reference; BASELINE.md north-star metric)
+# ---------------------------------------------------------------------------
+
+# public peak dense bf16 TFLOP/s per chip; f32 placeholder for CPU runs
+_PEAK_FLOPS = {
+    "tpu": 197e12,   # v5e (v5litepod) public spec
+    "axon": 197e12,
+    "cpu": 1e12,
+}
+
+
+def peak_flops(platform: str | None = None) -> float:
+    if platform is None:
+        platform = jax.devices()[0].platform
+    return _PEAK_FLOPS.get(platform, 1e12)
+
+
+def transformer_flops_per_token(n_params: int, n_layers: int, hidden: int,
+                                seq_len: int) -> float:
+    """6N weight flops + 12·L·H·S attention flops per trained token (the
+    standard PaLM-appendix accounting; matches bench.py round 1)."""
+    return 6.0 * n_params + 12.0 * n_layers * hidden * seq_len
+
+
+def mfu(tokens_per_sec: float, flops_per_token: float,
+        platform: str | None = None) -> float:
+    return tokens_per_sec * flops_per_token / peak_flops(platform)

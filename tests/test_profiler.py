@@ -1,0 +1,70 @@
+"""paddle_tpu.profiler: Benchmark math, scheduler windows, trace lifecycle."""
+import os
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import profiler as prof
+
+
+def test_benchmark_ips_math():
+    b = prof.Benchmark()
+    b.begin()
+    for _ in range(3):
+        b.before_reader()
+        time.sleep(0.01)
+        b.after_reader()
+        time.sleep(0.02)
+        b.step(num_samples=100)
+    b.end()
+    r = b.report()
+    assert r["reader_cost"] >= 0.01
+    assert r["batch_cost"] >= 0.02
+    # 100 samples per ~0.03s step => ips in the low thousands
+    assert 100 < r["ips"] < 100 / 0.02
+    assert "ips" in b.step_info("samples")
+
+
+def test_make_scheduler_windows():
+    sched = prof.make_scheduler(closed=1, ready=1, record=2, repeat=1,
+                                skip_first=1)
+    states = [sched(i) for i in range(6)]
+    S = prof.ProfilerState
+    assert states[0] == S.CLOSED        # skip_first
+    assert states[1] == S.CLOSED        # closed window
+    assert states[2] == S.READY
+    assert states[3] == S.RECORD
+    assert states[4] == S.RECORD_AND_RETURN
+    assert states[5] == S.CLOSED        # repeat=1 exhausted
+
+
+def test_profiler_trace_roundtrip(tmp_path):
+    d = str(tmp_path / "trace")
+    p = prof.Profiler(on_trace_ready=prof.export_chrome_tracing(d))
+    p.start()
+    with prof.RecordEvent("train_step"):
+        jax.block_until_ready(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+    p.step(num_samples=64)
+    p.stop()
+    assert p.export() == d
+    # jax.profiler writes plugins/profile/<run>/ under the log dir
+    found = [os.path.join(dp, f) for dp, _, fs in os.walk(d) for f in fs]
+    assert found, "no trace files written"
+    assert p.summary()["ips"] > 0
+
+
+def test_record_event_as_decorator():
+    @prof.RecordEvent("fn")
+    def f(a):
+        return a + 1
+
+    assert f(1) == 2
+
+
+def test_mfu_accounting():
+    f = prof.transformer_flops_per_token(100, 2, 4, 8)
+    assert f == 6 * 100 + 12 * 2 * 4 * 8
+    assert prof.mfu(1e9, 1000.0, "cpu") == 1e12 / 1e12
