@@ -45,7 +45,9 @@ def top1_gating(logits, capacity, noisy=False, key=None):
     pos_in_expert = jnp.sum(pos * expert_mask, axis=-1)  # [N]
     keep = pos_in_expert < capacity
     gate = jnp.sum(probs * expert_mask, axis=-1) * keep
-    dispatch = expert_mask[..., None] * _one_hot(pos_in_expert, capacity) * keep[:, None, None]
+    # [N,E,1] * [N,1,C] -> [N,E,C]
+    slot = _one_hot(pos_in_expert.astype(jnp.int32), capacity)[:, None, :]
+    dispatch = expert_mask[..., None] * slot * keep[:, None, None]
     combine = gate[:, None, None] * dispatch
     return dispatch, combine, aux
 
@@ -77,8 +79,10 @@ def top2_gating(logits, capacity):
     denom = jnp.maximum(g1 + g2, 1e-9)
     g1, g2 = g1 / denom, g2 / denom
 
-    d1 = mask1[..., None] * _one_hot(pos_in1, capacity) * keep1[:, None, None]
-    d2 = mask2[..., None] * _one_hot(pos_in2, capacity) * keep2[:, None, None]
+    slot1 = _one_hot(pos_in1.astype(jnp.int32), capacity)[:, None, :]
+    slot2 = _one_hot(pos_in2.astype(jnp.int32), capacity)[:, None, :]
+    d1 = mask1[..., None] * slot1 * keep1[:, None, None]
+    d2 = mask2[..., None] * slot2 * keep2[:, None, None]
     dispatch = (d1 + d2).astype(jnp.float32)
     combine = g1[:, None, None] * d1 + g2[:, None, None] * d2
     return dispatch, combine, aux

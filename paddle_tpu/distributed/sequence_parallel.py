@@ -63,9 +63,11 @@ def ring_attention(q, k, v, mesh=None, axis="sep", causal=True, scale=None):
         B, Sl, H, D = q.shape
         perm = [(i, (i + 1) % n) for i in range(n)]
 
-        m0 = jnp.full((B, H, Sl, 1), NEG_INF, jnp.float32)
-        l0 = jnp.zeros((B, H, Sl, 1), jnp.float32)
-        acc0 = jnp.zeros((B, Sl, H, D), jnp.float32)
+        # carries must be typed varying-over-axis from tick 0 (check_vma)
+        pv = lambda a: jax.lax.pcast(a, (axis,), to="varying")
+        m0 = pv(jnp.full((B, H, Sl, 1), NEG_INF, jnp.float32))
+        l0 = pv(jnp.zeros((B, H, Sl, 1), jnp.float32))
+        acc0 = pv(jnp.zeros((B, Sl, H, D), jnp.float32))
 
         def step(carry, r):
             acc, m, l, kr, vr = carry
