@@ -73,6 +73,8 @@ from . import jit  # noqa: F401,E402
 from . import static  # noqa: F401,E402
 from . import models  # noqa: F401,E402
 from . import kernels  # noqa: F401,E402
+from . import inference  # noqa: F401,E402
+from . import profiler  # noqa: F401,E402
 
 bool = bool_  # paddle.bool
 

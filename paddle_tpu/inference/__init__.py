@@ -1,0 +1,137 @@
+"""paddle.inference parity: Config + create_predictor over saved programs.
+
+Reference: AnalysisPredictor and its zero-copy handle workflow
+(/root/reference/paddle/fluid/inference/api/analysis_predictor.h,
+python/paddle/inference/) — config points at the saved model pair,
+``predictor.get_input_handle(name).copy_from_cpu(...); predictor.run();
+out_handle.copy_to_cpu()``.
+
+TPU-native: the "analysis + IR pass pipeline + engine subgraphs" role is
+XLA's compiler; the saved .pdmodel is a jax.export archive that deserializes
+to an executable (see paddle_tpu.jit.save/load), so the Predictor is a thin
+handle layer over a jitted call — device placement, batching, and fusion all
+come from XLA.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+__all__ = ["Config", "Predictor", "create_predictor"]
+
+
+class Config:
+    """reference paddle.inference.Config(prog_file, params_file) — here the
+    two files are <prefix>.pdmodel / <prefix>.pdiparams."""
+
+    def __init__(self, prog_file=None, params_file=None):
+        if prog_file is not None and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[: -len(".pdmodel")]
+        self._prefix = prog_file
+        self._device = None
+        self._memory_pool_mb = None
+
+    def set_prog_file(self, path):
+        self.__init__(path)
+
+    def prog_file(self):
+        return (self._prefix or "") + ".pdmodel"
+
+    def params_file(self):
+        return (self._prefix or "") + ".pdiparams"
+
+    # device selection: TPU is the native target; these keep API parity
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._device = ("tpu", device_id)  # gpu requests map to the chip
+
+    def enable_tpu(self, device_id=0):
+        self._device = ("tpu", device_id)
+
+    def disable_gpu(self):
+        self._device = ("cpu", 0)
+
+    def enable_memory_optim(self, *a, **k):
+        pass  # XLA buffer assignment already does this
+
+    def switch_ir_optim(self, *a, **k):
+        pass  # XLA pass pipeline is always on
+
+    def device(self):
+        return self._device
+
+
+class _IOHandle:
+    """Zero-copy-style tensor handle (reference ZeroCopyTensor)."""
+
+    def __init__(self):
+        self._value = None
+
+    def copy_from_cpu(self, array):
+        self._value = np.asarray(array)
+
+    def reshape(self, shape):
+        if self._value is not None:
+            self._value = self._value.reshape(shape)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._value)
+
+    def shape(self):
+        return None if self._value is None else list(self._value.shape)
+
+
+class Predictor:
+    def __init__(self, config: Config):
+        from ..jit import load as jit_load
+
+        self._config = config
+        self._layer = jit_load(config._prefix)
+        n_in = len(self._layer.in_shapes or [])
+        self._inputs = {f"input_{i}": _IOHandle() for i in range(max(n_in, 1))}
+        self._outputs = {}
+        dev = config.device()
+        self._device = None
+        if dev is not None:
+            plat, idx = dev
+            try:
+                self._device = jax.devices(plat)[idx]
+            except RuntimeError:
+                self._device = None
+
+    def get_input_names(self):
+        return list(self._inputs)
+
+    def get_input_handle(self, name):
+        return self._inputs[name]
+
+    def run(self, inputs=None):
+        """Either the handle workflow (run() with handles filled) or the
+        direct form run([arrays...]) -> [arrays...]."""
+        if inputs is not None:
+            arrays = [np.asarray(a) for a in inputs]
+        else:
+            arrays = [h._value for h in self._inputs.values() if h._value is not None]
+        if self._device is not None:
+            arrays = [jax.device_put(a, self._device) for a in arrays]
+        out = self._layer(*arrays)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        outs = [np.asarray(o._value if hasattr(o, "_value") else o) for o in outs]
+        self._outputs = {}
+        for i, o in enumerate(outs):
+            h = _IOHandle()
+            h.copy_from_cpu(o)
+            self._outputs[f"output_{i}"] = h
+        if inputs is not None:
+            return outs
+        return None
+
+    def get_output_names(self):
+        return list(self._outputs)
+
+    def get_output_handle(self, name):
+        return self._outputs[name]
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

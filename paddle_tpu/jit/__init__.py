@@ -151,83 +151,111 @@ def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
     return deco
 
 
+def _spec_to_struct(spec, sym_count):
+    """input_spec entry -> jax.ShapeDtypeStruct; None/-1 dims become export
+    symbolic dimensions so the saved program is shape-polymorphic."""
+    from jax import export as jexport
+
+    if isinstance(spec, Tensor):
+        a = np.asarray(spec._value)
+        return jax.ShapeDtypeStruct(a.shape, a.dtype)
+    if hasattr(spec, "shape") and hasattr(spec, "dtype"):
+        a = np.asarray(spec)
+        return jax.ShapeDtypeStruct(a.shape, a.dtype)
+    shape, dtype = spec
+    dims = []
+    for s in shape:
+        if s in (None, -1):
+            (d,) = jexport.symbolic_shape(f"_pd_b{next(sym_count)}")
+            dims.append(d)
+        else:
+            dims.append(int(s))
+    return jax.ShapeDtypeStruct(tuple(dims), np.dtype(dtype))
+
+
 def save(layer, path, input_spec=None, **configs):
-    """jit.save: StableHLO module + weights (the reference's
-    *.pdmodel ProgramDesc + *.pdiparams pair, SURVEY §5.4)."""
+    """jit.save: a serialized, re-executable StableHLO program + weights —
+    the reference's *.pdmodel ProgramDesc + *.pdiparams pair (SURVEY §5.4,
+    python/paddle/jit/api.py jit.save). The .pdmodel holds a jax.export
+    archive: ``jit.load`` deserializes it to a callable WITHOUT the original
+    python class, exactly like the reference's inference loader; None/-1 dims
+    in input_spec export shape-polymorphic."""
+    import itertools
+
+    from jax import export as jexport
+
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     if input_spec is None:
         raise ValueError("jit.save requires input_spec (shape/dtype examples)")
-    examples = []
-    for spec in input_spec:
-        if isinstance(spec, Tensor):
-            examples.append(np.asarray(spec._value))
-        elif hasattr(spec, "shape"):
-            examples.append(np.asarray(spec))
-        else:
-            shape, dtype = spec
-            examples.append(np.zeros([1 if s in (None, -1) else s for s in shape],
-                                     dtype))
+    sym_count = itertools.count()
+    structs = [_spec_to_struct(s, sym_count) for s in input_spec]
     params, buffers = functional_state(layer)
-    training = False
 
     def pure(params, buffers, *arrays):
-        out, _ = functional_call(layer, params, buffers, *arrays, training=training)
-        return out
+        out, _ = functional_call(layer, params, buffers, *arrays, training=False)
+        return _unwrap(out)
 
-    lowered = jax.jit(pure).lower(params, buffers, *examples)
-    stablehlo = lowered.as_text(dialect="stablehlo")
-    with open(path + ".pdmodel", "w") as f:
-        f.write(stablehlo)
+    p_structs = {k: jax.ShapeDtypeStruct(v.shape, v.dtype) for k, v in params.items()}
+    b_structs = {k: jax.ShapeDtypeStruct(v.shape, v.dtype) for k, v in buffers.items()}
+    # export for both cpu and tpu so a saved model loads anywhere
+    exported = jexport.export(jax.jit(pure), platforms=("cpu", "tpu"))(
+        p_structs, b_structs, *structs)
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    with open(path + ".pdmodel.txt", "w") as f:
+        f.write(exported.mlir_module())
     with open(path + ".pdiparams", "wb") as f:
         pickle.dump(
             {
                 "params": {k: np.asarray(v) for k, v in params.items()},
                 "buffers": {k: np.asarray(v) for k, v in buffers.items()},
-                "example_shapes": [(e.shape, str(e.dtype)) for e in examples],
+                "in_shapes": [(tuple(str(d) for d in s.shape), str(s.dtype))
+                              for s in structs],
             },
             f,
         )
 
 
 class TranslatedLayer(Layer):
-    """jit.load result: callable inference layer over saved weights.
+    """jit.load result: an executable inference layer over the deserialized
+    StableHLO program + saved weights — the reference's TranslatedLayer
+    (python/paddle/jit/translated_layer.py) whose forward runs the loaded
+    program, no original python needed."""
 
-    Executes by rebuilding the jitted function from weights (StableHLO text is
-    kept for inspection/deployment toolchains; re-tracing needs the original
-    python, so load-time execution uses the weights against a user-supplied
-    ``forward_builder`` when provided, else a matmul-free passthrough error).
-    """
-
-    def __init__(self, params, buffers, stablehlo_text, example_shapes):
+    def __init__(self, params, buffers, exported, in_shapes):
         super().__init__()
         self._params_np = params
         self._buffers_np = buffers
-        self.stablehlo = stablehlo_text
-        self.example_shapes = example_shapes
-        self._exec = None
+        self._exported = exported
+        self.in_shapes = in_shapes
+        self.eval()
 
     def program(self):
-        return self.stablehlo
+        """StableHLO text of the loaded module (reference .program())."""
+        return self._exported.mlir_module()
 
     def forward(self, *args):
-        if self._exec is None:
-            raise RuntimeError(
-                "TranslatedLayer: executing a serialized StableHLO program "
-                "requires binding it back (use jit.load(path, layer_cls=...) "
-                "to rebuild from python, or deploy the .pdmodel with an HLO "
-                "runner)")
-        return self._exec(*args)
+        arrays = [a._value if isinstance(a, Tensor) else np.asarray(a)
+                  for a in args]
+        out = self._exported.call(self._params_np, self._buffers_np, *arrays)
+        return _wrap_out(out)
 
 
 def load(path, layer_cls=None, **configs):
+    """jit.load: deserialize .pdmodel into a callable TranslatedLayer.
+    ``layer_cls`` optionally rebuilds the original python layer instead
+    (reference jit.load returns the original class when code is present)."""
     with open(path + ".pdiparams", "rb") as f:
         blob = pickle.load(f)
-    with open(path + ".pdmodel") as f:
-        text = f.read()
     if layer_cls is not None:
         layer = layer_cls() if callable(layer_cls) else layer_cls
         state = {**blob["params"], **blob["buffers"]}
         layer.set_state_dict(state)
         layer.eval()
         return layer
-    return TranslatedLayer(blob["params"], blob["buffers"], text, blob["example_shapes"])
+    from jax import export as jexport
+
+    with open(path + ".pdmodel", "rb") as f:
+        exported = jexport.deserialize(f.read())
+    return TranslatedLayer(blob["params"], blob["buffers"], exported,
+                           blob.get("in_shapes"))

@@ -1,0 +1,99 @@
+"""jit.save -> jit.load roundtrip and the inference Predictor.
+
+VERDICT r1 #4: the saved program must be re-executable WITHOUT the original
+python class (reference: jit.save/load + AnalysisPredictor,
+/root/reference/python/paddle/jit/api.py, paddle/fluid/inference/api/).
+The cross-process test proves it: the child process never sees the model
+definition.
+"""
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _mlp():
+    paddle.seed(42)
+
+    class MLP(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(8, 16)
+            self.fc2 = nn.Linear(16, 4)
+
+        def forward(self, x):
+            return self.fc2(nn.functional.relu(self.fc1(x)))
+
+    return MLP()
+
+
+def test_save_load_roundtrip_same_process(tmp_path):
+    net = _mlp()
+    x = np.random.RandomState(0).standard_normal((3, 8)).astype(np.float32)
+    expected = net(paddle.to_tensor(x)).numpy()
+
+    prefix = str(tmp_path / "model")
+    paddle.jit.save(net, prefix, input_spec=[([None, 8], "float32")])
+    assert os.path.exists(prefix + ".pdmodel")
+    assert os.path.exists(prefix + ".pdiparams")
+
+    loaded = paddle.jit.load(prefix)
+    got = loaded(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, expected, atol=1e-5, rtol=1e-5)
+    # shape-polymorphic: a different batch size works on the same program
+    x2 = np.random.RandomState(1).standard_normal((7, 8)).astype(np.float32)
+    got2 = loaded(paddle.to_tensor(x2)).numpy()
+    np.testing.assert_allclose(got2, net(paddle.to_tensor(x2)).numpy(),
+                               atol=1e-5, rtol=1e-5)
+    assert "stablehlo" in loaded.program() or "func.func" in loaded.program()
+
+
+def test_load_executes_without_original_python(tmp_path):
+    net = _mlp()
+    x = np.random.RandomState(0).standard_normal((3, 8)).astype(np.float32)
+    expected = net(paddle.to_tensor(x)).numpy()
+    prefix = str(tmp_path / "model")
+    paddle.jit.save(net, prefix, input_spec=[([None, 8], "float32")])
+    np.save(str(tmp_path / "x.npy"), x)
+
+    child = textwrap.dedent(f"""
+        import numpy as np
+        import paddle_tpu as paddle
+        x = np.load({str(tmp_path / 'x.npy')!r})
+        layer = paddle.jit.load({prefix!r})
+        out = layer(paddle.to_tensor(x))
+        np.save({str(tmp_path / 'out.npy')!r}, out.numpy())
+    """)
+    subprocess.run([sys.executable, "-c", child], check=True,
+                   cwd="/root/repo", timeout=300)
+    got = np.load(str(tmp_path / "out.npy"))
+    np.testing.assert_allclose(got, expected, atol=1e-5, rtol=1e-5)
+
+
+def test_predictor_handle_workflow(tmp_path):
+    net = _mlp()
+    x = np.random.RandomState(2).standard_normal((5, 8)).astype(np.float32)
+    expected = net(paddle.to_tensor(x)).numpy()
+    prefix = str(tmp_path / "model")
+    paddle.jit.save(net, prefix, input_spec=[([None, 8], "float32")])
+
+    config = paddle.inference.Config(prefix + ".pdmodel")
+    predictor = paddle.inference.create_predictor(config)
+
+    names = predictor.get_input_names()
+    assert names
+    predictor.get_input_handle(names[0]).copy_from_cpu(x)
+    predictor.run()
+    out_names = predictor.get_output_names()
+    got = predictor.get_output_handle(out_names[0]).copy_to_cpu()
+    np.testing.assert_allclose(got, expected, atol=1e-5, rtol=1e-5)
+
+    # direct form
+    (got2,) = predictor.run([x])
+    np.testing.assert_allclose(got2, expected, atol=1e-5, rtol=1e-5)

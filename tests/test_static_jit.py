@@ -53,7 +53,7 @@ def test_jit_save_exports_stablehlo(tmp_path):
     paddle.jit.save(net, path, input_spec=[paddle.ones([1, 4])])
     assert os.path.exists(path + ".pdmodel")
     assert os.path.exists(path + ".pdiparams")
-    text = open(path + ".pdmodel").read()
+    text = open(path + ".pdmodel.txt").read()  # human-readable StableHLO dump
     assert "stablehlo" in text or "module" in text
     loaded = paddle.jit.load(path, layer_cls=Net)
     x = paddle.ones([2, 4])
