@@ -89,6 +89,12 @@ class DistributedEngine:
         self._input_specs = input_specs
         self._label_specs = label_specs
         self._train_step = None
+        self._train_step_outs = None
+        self._grad_step = None
+        self._apply_step = None
+        self._eval_step = None
+        self._predict_step = None
+        self._accum_grads = None
         self._state = None  # (params, buffers, opt_state) as device arrays
         self._step_count = 0
 
@@ -155,37 +161,12 @@ class DistributedEngine:
         self._pspecs, self._ospecs = pspecs, ospecs
 
     def _build_train_step(self):
-        layer, loss_fn, opt = self.layer, self.loss_fn, self.optimizer
-        amp = self.strategy.amp
-        amp_dtype = jnp.bfloat16 if (amp.enable and amp.dtype == "bfloat16") else None
+        opt = self.optimizer
         accum = max(1, self.strategy.gradient_merge_steps)
+        fl_outs = self._forward_loss_outs()  # single AMP-cast definition
 
         def forward_loss(params, buffers, rng, inputs, labels):
-            cast_in = [
-                i.astype(amp_dtype)
-                if amp_dtype is not None and jnp.issubdtype(i.dtype, jnp.inexact)
-                else i
-                for i in inputs
-            ]
-            if amp_dtype is not None:
-                cast_params = {
-                    k: (v.astype(amp_dtype)
-                        if jnp.issubdtype(v.dtype, jnp.floating) else v)
-                    for k, v in params.items()
-                }
-            else:
-                cast_params = params
-            outs, new_buf = functional_call(
-                layer, cast_params, buffers, *cast_in, rng=rng, training=True)
-            outs = outs if isinstance(outs, (list, tuple)) else [outs]
-            from ..hapi.model import _pure_loss
-
-            f32_outs = [
-                o.astype(jnp.float32) if jnp.issubdtype(o.dtype, jnp.inexact) else o
-                for o in outs
-            ]
-            loss = _pure_loss(loss_fn, f32_outs, labels)
-            loss = jnp.mean(loss)
+            loss, (new_buf, _) = fl_outs(params, buffers, rng, inputs, labels, True)
             return loss, new_buf
 
         def train_step(params, buffers, opt_state, lr, rng, inputs, labels):
@@ -211,16 +192,198 @@ class DistributedEngine:
             new_params, new_opt = opt.apply_gradients(params, grads, opt_state, lr)
             return loss, new_buf, new_params, new_opt
 
-        pshard = {n: self._nsh(s) for n, s in self._pspecs.items()}
-        oshard = {n: {k: self._nsh(s) for k, s in st.items()}
-                  for n, st in self._ospecs.items()}
-        bshard = {n: self._nsh(P()) for n in self._state[1]}
+        pshard, bshard, oshard = self._shardings()
         return jax.jit(
             train_step,
             in_shardings=(pshard, bshard, oshard, None, None, None, None),
             out_shardings=(None, bshard, pshard, oshard),
             donate_argnums=(0, 2),
         )
+
+    # -- hapi/Model integration ----------------------------------------
+    # These steps also return the (f32) network outputs so host-side metric
+    # objects can update per batch — the role of the reference's
+    # DynamicGraphAdapter.train_batch outputs under DataParallel
+    # (/root/reference/python/paddle/hapi/model.py:817,838).
+    def _forward_loss_outs(self):
+        layer, loss_fn = self.layer, self.loss_fn
+        amp = self.strategy.amp
+        amp_dtype = jnp.bfloat16 if (amp.enable and amp.dtype == "bfloat16") else None
+
+        def forward_loss(params, buffers, rng, inputs, labels, training):
+            cast_in = [
+                i.astype(amp_dtype)
+                if amp_dtype is not None and jnp.issubdtype(i.dtype, jnp.inexact)
+                else i
+                for i in inputs
+            ]
+            if amp_dtype is not None:
+                cast_params = {
+                    k: (v.astype(amp_dtype)
+                        if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                    for k, v in params.items()
+                }
+            else:
+                cast_params = params
+            outs, new_buf = functional_call(
+                layer, cast_params, buffers, *cast_in, rng=rng, training=training)
+            outs = outs if isinstance(outs, (list, tuple)) else [outs]
+            f32_outs = [
+                o.astype(jnp.float32) if jnp.issubdtype(o.dtype, jnp.inexact) else o
+                for o in outs
+            ]
+            from ..hapi.model import _pure_loss
+
+            if loss_fn is not None and len(labels) > 0:
+                loss = jnp.mean(_pure_loss(loss_fn, f32_outs, labels))
+            else:
+                loss = jnp.zeros(())
+            return loss, (new_buf, f32_outs)
+
+        return forward_loss
+
+    def _shardings(self):
+        pshard = {n: self._nsh(s) for n, s in self._pspecs.items()}
+        oshard = {n: {k: self._nsh(s) for k, s in st.items()}
+                  for n, st in self._ospecs.items()}
+        bshard = {n: self._nsh(P()) for n in self._state[1]}
+        return pshard, bshard, oshard
+
+    def _build_train_step_outs(self):
+        opt = self.optimizer
+        forward_loss = self._forward_loss_outs()
+
+        def step(params, buffers, opt_state, lr, rng, inputs, labels):
+            (loss, (new_buf, outs)), grads = jax.value_and_grad(
+                forward_loss, has_aux=True)(
+                    params, buffers, rng, inputs, labels, True)
+            new_params, new_opt = opt.apply_gradients(params, grads, opt_state, lr)
+            return loss, outs, new_buf, new_params, new_opt
+
+        pshard, bshard, oshard = self._shardings()
+        return jax.jit(
+            step,
+            in_shardings=(pshard, bshard, oshard, None, None, None, None),
+            out_shardings=(None, None, bshard, pshard, oshard),
+            donate_argnums=(0, 2),
+        )
+
+    def _build_grad_step(self):
+        """Gradient-only sharded step for hapi accumulate_grad_batches: grads
+        sum across micro-batches, laid out like the params they update."""
+        forward_loss = self._forward_loss_outs()
+
+        def step(params, buffers, rng, acc, inputs, labels):
+            (loss, (new_buf, outs)), grads = jax.value_and_grad(
+                forward_loss, has_aux=True)(
+                    params, buffers, rng, inputs, labels, True)
+            if acc is not None:
+                grads = jax.tree_util.tree_map(jnp.add, acc, grads)
+            return loss, outs, new_buf, grads
+
+        pshard, bshard, _ = self._shardings()
+        # acc rides its previous out_sharding (first call passes None, whose
+        # pytree would not match a dict in_sharding)
+        return jax.jit(
+            step,
+            in_shardings=(pshard, bshard, None, None, None, None),
+            out_shardings=(None, None, bshard, pshard),
+            donate_argnums=(3,),
+        )
+
+    def _build_apply_step(self):
+        opt = self.optimizer
+        pshard, _, oshard = self._shardings()
+
+        def step(params, opt_state, lr, grads):
+            return opt.apply_gradients(params, grads, opt_state, lr)
+
+        return jax.jit(
+            step,
+            in_shardings=(pshard, oshard, None, None),
+            out_shardings=(pshard, oshard),
+            donate_argnums=(0, 1, 3),
+        )
+
+    def _build_eval_step(self):
+        forward_loss = self._forward_loss_outs()
+
+        def step(params, buffers, inputs, labels):
+            loss, (_, outs) = forward_loss(
+                params, buffers, jax.random.PRNGKey(0), inputs, labels, False)
+            return loss, outs
+
+        pshard, bshard, _ = self._shardings()
+        return jax.jit(step, in_shardings=(pshard, bshard, None, None))
+
+    def _prep_step(self, inputs, labels=None):
+        if self._state is None:
+            self._init_state()
+        inputs = [self._put_batch(np.asarray(_np(i))) for i in _as_list(inputs)]
+        labels = [self._put_batch(np.asarray(_np(l))) for l in _as_list(labels)]
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32) \
+            if self.optimizer is not None else jnp.zeros(())
+        rng = jax.random.fold_in(
+            jax.random.PRNGKey(frandom.default_seed()), self._step_count)
+        return inputs, labels, lr, rng
+
+    def train_step_outs(self, inputs, labels, update=True):
+        """One training step returning (host loss, outputs). update=False
+        accumulates gradients (reference update=False defers minimize)."""
+        inputs, labels, lr, rng = self._prep_step(inputs, labels)
+        params, buffers, opt_state = self._state
+        if update and self._accum_grads is None:
+            if self._train_step_outs is None:
+                self._train_step_outs = self._build_train_step_outs()
+            loss, outs, new_buf, new_params, new_opt = self._train_step_outs(
+                params, buffers, opt_state, lr, rng, inputs, labels)
+            self._state = (new_params, new_buf, new_opt)
+        else:
+            if self._grad_step is None:
+                self._grad_step = self._build_grad_step()
+            loss, outs, new_buf, grads = self._grad_step(
+                params, buffers, rng, self._accum_grads, inputs, labels)
+            if update:
+                if self._apply_step is None:
+                    self._apply_step = self._build_apply_step()
+                new_params, new_opt = self._apply_step(params, opt_state, lr, grads)
+                self._state = (new_params, new_buf, new_opt)
+                self._accum_grads = None
+            else:
+                self._state = (params, new_buf, opt_state)
+                self._accum_grads = grads
+        self._step_count += 1
+        return loss, outs
+
+    def flush_accum_grads(self):
+        if self._accum_grads is None:
+            return
+        params, buffers, opt_state = self._state
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        if self._apply_step is None:
+            self._apply_step = self._build_apply_step()
+        new_params, new_opt = self._apply_step(
+            params, opt_state, lr, self._accum_grads)
+        self._state = (new_params, buffers, new_opt)
+        self._accum_grads = None
+
+    def eval_step(self, inputs, labels):
+        inputs, labels, _, _ = self._prep_step(inputs, labels)
+        if self._eval_step is None:
+            self._eval_step = self._build_eval_step()
+        params, buffers, _ = self._state
+        loss, outs = self._eval_step(params, buffers, inputs, labels)
+        return loss, outs
+
+    def predict_step(self, inputs):
+        _, outs = self.eval_step(inputs, [])
+        return outs
+
+    def reset_state(self):
+        """Drop device state so the next step re-reads the mutable Layer
+        (after Model.load / set_state_dict)."""
+        self._state = None
+        self._accum_grads = None
 
     # ------------------------------------------------------------------
     def step(self, inputs, labels):

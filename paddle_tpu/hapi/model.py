@@ -64,6 +64,7 @@ class Model:
         self._grad_step_fn = None
         self._apply_step_fn = None
         self._accum_grads = None
+        self._engine = None
 
     # ------------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
@@ -79,6 +80,20 @@ class Model:
         self._eval_step_fn = None
         self._predict_step_fn = None
         self._opt_state = None  # drop any previous optimizer's accumulators
+        self._engine = None
+        # Under an active hybrid topology, fit/evaluate/predict route through
+        # the SPMD DistributedEngine — the reference wraps the network in
+        # DataParallel inside Model.prepare for the same purpose
+        # (/root/reference/python/paddle/hapi/model.py:838).
+        from ..distributed.mesh import get_hybrid_communicate_group
+
+        hcg = get_hybrid_communicate_group()
+        if hcg is not None and hcg.nranks > 1:
+            from ..distributed.engine import DistributedEngine
+
+            self._engine = DistributedEngine(
+                self.network, loss_fn=loss, optimizer=optimizer,
+                strategy=hcg.strategy, mesh=hcg.mesh)
 
     # -- jitted steps ---------------------------------------------------
     def _make_loss_of(self, params_free_args):
@@ -187,6 +202,11 @@ class Model:
     def train_batch(self, inputs, labels=None, update=True):
         inputs = [_to_np(i) for i in _as_list(inputs)]
         labels = [_to_np(l) for l in _as_list(labels)]
+        if self._engine is not None:
+            loss, outs = self._engine.train_step_outs(inputs, labels, update=update)
+            self._optimizer._step_count += 1
+            metrics_out = self._update_metrics(outs, labels)
+            return [float(np.asarray(loss))], metrics_out
         params, buffers = self._get_state()
         opt_state = self._opt_state_tree(params)
         lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
@@ -227,6 +247,9 @@ class Model:
         """Apply any leftover accumulated grads (loader without len(), or a
         num_iters break mid-accumulation-group) so they neither drop nor leak
         into the next epoch's first group."""
+        if self._engine is not None:
+            self._engine.flush_accum_grads()
+            return
         if self._accum_grads is None:
             return
         params, buffers = self._get_state()
@@ -241,6 +264,12 @@ class Model:
         self._accum_grads = None
 
     def eval_batch(self, inputs, labels=None):
+        if self._engine is not None:
+            inputs = [_to_np(i) for i in _as_list(inputs)]
+            labels = [_to_np(l) for l in _as_list(labels)]
+            loss, outs = self._engine.eval_step(inputs, labels)
+            metrics_out = self._update_metrics(outs, labels)
+            return [float(np.asarray(loss))], metrics_out
         if self._eval_step_fn is None:
             self._eval_step_fn = self._build_eval_step()
         inputs = [_to_np(i) for i in _as_list(inputs)]
@@ -251,6 +280,10 @@ class Model:
         return [float(np.asarray(loss))], metrics_out
 
     def predict_batch(self, inputs):
+        if self._engine is not None:
+            inputs = [_to_np(i) for i in _as_list(inputs)]
+            outs = self._engine.predict_step(inputs)
+            return [np.asarray(o) for o in outs]
         if self._predict_step_fn is None:
             self._predict_step_fn = self._build_predict_step()
         inputs = [_to_np(i) for i in _as_list(inputs)]
@@ -422,6 +455,8 @@ class Model:
 
     # -- persistence ----------------------------------------------------
     def save(self, path, training=True):
+        if self._engine is not None:
+            self._engine.sync_to_layer()
         fio.save(self.network.state_dict(), path + ".pdparams")
         if training and self._optimizer is not None:
             fio.save(self._optimizer.state_dict(), path + ".pdopt")
@@ -429,6 +464,8 @@ class Model:
     def load(self, path, skip_mismatch=False, reset_optimizer=False):
         state = fio.load(path + ".pdparams")
         self.network.set_state_dict(state)
+        if self._engine is not None:
+            self._engine.reset_state()
         import os
 
         if not reset_optimizer and self._optimizer is not None and os.path.exists(path + ".pdopt"):

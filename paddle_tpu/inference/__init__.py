@@ -26,19 +26,29 @@ class Config:
     two files are <prefix>.pdmodel / <prefix>.pdiparams."""
 
     def __init__(self, prog_file=None, params_file=None):
-        if prog_file is not None and prog_file.endswith(".pdmodel"):
-            prog_file = prog_file[: -len(".pdmodel")]
-        self._prefix = prog_file
+        self._prefix = None
+        self._params_file = None
         self._device = None
         self._memory_pool_mb = None
+        if prog_file is not None:
+            self.set_prog_file(prog_file)
+        if params_file is not None:
+            self.set_params_file(params_file)
 
     def set_prog_file(self, path):
-        self.__init__(path)
+        if path.endswith(".pdmodel"):
+            path = path[: -len(".pdmodel")]
+        self._prefix = path
+
+    def set_params_file(self, path):
+        self._params_file = path
 
     def prog_file(self):
         return (self._prefix or "") + ".pdmodel"
 
     def params_file(self):
+        if self._params_file is not None:
+            return self._params_file
         return (self._prefix or "") + ".pdiparams"
 
     # device selection: TPU is the native target; these keep API parity
@@ -86,7 +96,7 @@ class Predictor:
         from ..jit import load as jit_load
 
         self._config = config
-        self._layer = jit_load(config._prefix)
+        self._layer = jit_load(config._prefix, params_file=config.params_file())
         n_in = len(self._layer.in_shapes or [])
         self._inputs = {f"input_{i}": _IOHandle() for i in range(max(n_in, 1))}
         self._outputs = {}
@@ -96,7 +106,7 @@ class Predictor:
             plat, idx = dev
             try:
                 self._device = jax.devices(plat)[idx]
-            except RuntimeError:
+            except (RuntimeError, IndexError):
                 self._device = None
 
     def get_input_names(self):
@@ -111,7 +121,11 @@ class Predictor:
         if inputs is not None:
             arrays = [np.asarray(a) for a in inputs]
         else:
-            arrays = [h._value for h in self._inputs.values() if h._value is not None]
+            missing = [n for n, h in self._inputs.items() if h._value is None]
+            if missing:
+                raise ValueError(
+                    f"input handle(s) not filled before run(): {missing}")
+            arrays = [h._value for h in self._inputs.values()]
         if self._device is not None:
             arrays = [jax.device_put(a, self._device) for a in arrays]
         out = self._layer(*arrays)

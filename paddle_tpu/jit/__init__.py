@@ -241,11 +241,13 @@ class TranslatedLayer(Layer):
         return _wrap_out(out)
 
 
-def load(path, layer_cls=None, **configs):
+def load(path, layer_cls=None, params_file=None, **configs):
     """jit.load: deserialize .pdmodel into a callable TranslatedLayer.
     ``layer_cls`` optionally rebuilds the original python layer instead
-    (reference jit.load returns the original class when code is present)."""
-    with open(path + ".pdiparams", "rb") as f:
+    (reference jit.load returns the original class when code is present);
+    ``params_file`` overrides the default <path>.pdiparams weight file
+    (inference.Config's two-file form)."""
+    with open(params_file or (path + ".pdiparams"), "rb") as f:
         blob = pickle.load(f)
     if layer_cls is not None:
         layer = layer_cls() if callable(layer_cls) else layer_cls

@@ -15,6 +15,12 @@ from paddle_tpu.distributed.strategy import HybridConfig, ShardingConfig
 def _env():
     dist.init_parallel_env()
     yield
+    # Model.prepare engages the DistributedEngine whenever a hybrid topology
+    # is active — clear it so later (single-process-API) test modules stay
+    # on the plain jit path.
+    from paddle_tpu.distributed.mesh import set_hybrid_communicate_group
+
+    set_hybrid_communicate_group(None)
 
 
 def _shards(fn, n=8):

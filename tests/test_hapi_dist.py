@@ -1,0 +1,92 @@
+"""Model.fit through the DistributedEngine (VERDICT round-1 item #6).
+
+The reference hooks hapi Model to the parallel env by wrapping the network in
+DataParallel inside Model.prepare (/root/reference/python/paddle/hapi/model.py:838);
+here an active HybridCommunicateGroup makes Model.prepare route every batch
+through the SPMD engine. Parity gate: same data + seed must give the same loss
+trajectory as the plain single-process jit path.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.mesh import set_hybrid_communicate_group
+from paddle_tpu.io import Dataset
+
+
+class MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(16, 32)
+        self.fc2 = nn.Linear(32, 4)
+
+    def forward(self, x):
+        return self.fc2(nn.functional.relu(self.fc1(x)))
+
+
+class ToyData(Dataset):
+    def __init__(self, n=64):
+        rng = np.random.RandomState(7)
+        self.x = rng.rand(n, 16).astype(np.float32)
+        self.y = rng.randint(0, 4, (n,)).astype(np.int64)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def _fit_losses(distributed, accumulate=1, epochs=2):
+    set_hybrid_communicate_group(None)
+    if distributed:
+        fleet.init(is_collective=True)
+    paddle.seed(0)
+    net = MLP()
+    model = paddle.Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.Adam(parameters=net.parameters(), learning_rate=1e-2),
+        loss=paddle.nn.CrossEntropyLoss(),
+        metrics=paddle.metric.Accuracy(),
+    )
+    assert (model._engine is not None) == distributed
+    hist = model.fit(ToyData(), batch_size=16, epochs=epochs, shuffle=False,
+                     verbose=0, accumulate_grad_batches=accumulate)
+    losses = [float(np.atleast_1d(v)[0]) for v in hist.history["loss"]]
+    set_hybrid_communicate_group(None)
+    return losses, model, net
+
+
+class TestModelFitEngine:
+    def test_loss_parity_with_single_process(self):
+        ref, _, _ = _fit_losses(distributed=False)
+        dist, _, _ = _fit_losses(distributed=True)
+        np.testing.assert_allclose(ref, dist, rtol=2e-4, atol=2e-5)
+
+    def test_accumulation_parity(self):
+        ref, _, _ = _fit_losses(distributed=False, accumulate=2)
+        dist, _, _ = _fit_losses(distributed=True, accumulate=2)
+        np.testing.assert_allclose(ref, dist, rtol=2e-4, atol=2e-5)
+
+    def test_eval_predict_save_through_engine(self, tmp_path):
+        _, model, net = _fit_losses(distributed=True, epochs=1)
+        fleet.init(is_collective=True)
+        model._engine is not None
+        ev = model.evaluate(ToyData(), batch_size=16, verbose=0)
+        assert "acc" in ev
+        preds = model.predict(ToyData(), batch_size=16, stack_outputs=True)
+        assert preds[0].shape == (64, 4)
+        # save syncs engine state back to the mutable layer
+        path = str(tmp_path / "ckpt")
+        model.save(path)
+        state = paddle.load(path + ".pdparams")
+        got = np.asarray(state["fc1.weight"].numpy() if hasattr(state["fc1.weight"], "numpy")
+                         else state["fc1.weight"])
+        assert got.shape == (16, 32)
+        # trained weights must differ from a fresh init with the same seed
+        paddle.seed(0)
+        fresh = MLP()
+        assert not np.allclose(got, fresh.fc1.weight.numpy())
+        set_hybrid_communicate_group(None)

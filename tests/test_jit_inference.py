@@ -70,8 +70,9 @@ def test_load_executes_without_original_python(tmp_path):
         out = layer(paddle.to_tensor(x))
         np.save({str(tmp_path / 'out.npy')!r}, out.numpy())
     """)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     subprocess.run([sys.executable, "-c", child], check=True,
-                   cwd="/root/repo", timeout=300)
+                   cwd=repo_root, timeout=300)
     got = np.load(str(tmp_path / "out.npy"))
     np.testing.assert_allclose(got, expected, atol=1e-5, rtol=1e-5)
 
