@@ -18,6 +18,11 @@ from .collective import (  # noqa: F401
     shard_to_group,
     unshard,
 )
+from .checkpoint import (  # noqa: F401
+    DistributedSaver,
+    load_distributed_checkpoint,
+    save_distributed_checkpoint,
+)
 from .engine import DistributedEngine  # noqa: F401
 from .mesh import (  # noqa: F401
     HybridCommunicateGroup,
@@ -58,6 +63,7 @@ __all__ = [
     "ppermute", "new_group", "shard_to_group", "unshard",
     "DistributedStrategy", "HybridCommunicateGroup", "build_mesh", "P",
     "DistributedEngine", "fleet", "collective",
+    "DistributedSaver", "save_distributed_checkpoint", "load_distributed_checkpoint",
     "VocabParallelEmbedding", "ColumnParallelLinear", "RowParallelLinear",
     "ParallelCrossEntropy", "mark_sharding",
 ]

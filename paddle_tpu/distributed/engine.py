@@ -210,7 +210,8 @@ class DistributedEngine:
         amp = self.strategy.amp
         amp_dtype = jnp.bfloat16 if (amp.enable and amp.dtype == "bfloat16") else None
 
-        def forward_loss(params, buffers, rng, inputs, labels, training):
+        def forward_loss(params, buffers, rng, inputs, labels, training,
+                         compute_loss=True):
             cast_in = [
                 i.astype(amp_dtype)
                 if amp_dtype is not None and jnp.issubdtype(i.dtype, jnp.inexact)
@@ -234,7 +235,7 @@ class DistributedEngine:
             ]
             from ..hapi.model import _pure_loss
 
-            if loss_fn is not None and len(labels) > 0:
+            if loss_fn is not None and compute_loss:
                 loss = jnp.mean(_pure_loss(loss_fn, f32_outs, labels))
             else:
                 loss = jnp.zeros(())
@@ -316,6 +317,18 @@ class DistributedEngine:
         pshard, bshard, _ = self._shardings()
         return jax.jit(step, in_shardings=(pshard, bshard, None, None))
 
+    def _build_predict_step(self):
+        forward_loss = self._forward_loss_outs()
+
+        def step(params, buffers, inputs):
+            _, (_, outs) = forward_loss(
+                params, buffers, jax.random.PRNGKey(0), inputs, [], False,
+                compute_loss=False)
+            return outs
+
+        pshard, bshard, _ = self._shardings()
+        return jax.jit(step, in_shardings=(pshard, bshard, None))
+
     def _prep_step(self, inputs, labels=None):
         if self._state is None:
             self._init_state()
@@ -376,8 +389,11 @@ class DistributedEngine:
         return loss, outs
 
     def predict_step(self, inputs):
-        _, outs = self.eval_step(inputs, [])
-        return outs
+        inputs, _, _, _ = self._prep_step(inputs)
+        if self._predict_step is None:
+            self._predict_step = self._build_predict_step()
+        params, buffers, _ = self._state
+        return self._predict_step(params, buffers, inputs)
 
     def reset_state(self):
         """Drop device state so the next step re-reads the mutable Layer
@@ -385,19 +401,27 @@ class DistributedEngine:
         self._state = None
         self._accum_grads = None
 
+    def save_checkpoint(self, path, async_save=False):
+        """Sharded checkpoint of (params, buffers, opt_state) + step counts;
+        reload with load_checkpoint on ANY mesh shape (reshard-on-load)."""
+        from .checkpoint import DistributedSaver
+
+        saver = DistributedSaver(self)
+        saver.save(path, async_save=async_save)
+        return saver
+
+    def load_checkpoint(self, path):
+        from .checkpoint import DistributedSaver
+
+        DistributedSaver(self).load(path)
+
     # ------------------------------------------------------------------
     def step(self, inputs, labels):
         """Run one training step; returns host loss."""
-        if self._state is None:
-            self._init_state()
+        inputs, labels, lr, rng = self._prep_step(inputs, labels)
         if self._train_step is None:
             self._train_step = self._build_train_step()
-        inputs = [self._put_batch(np.asarray(_np(i))) for i in _as_list(inputs)]
-        labels = [self._put_batch(np.asarray(_np(l))) for l in _as_list(labels)]
         params, buffers, opt_state = self._state
-        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-        rng = jax.random.fold_in(
-            jax.random.PRNGKey(frandom.default_seed()), self._step_count)
         loss, new_buf, new_params, new_opt = self._train_step(
             params, buffers, opt_state, lr, rng, inputs, labels)
         self._state = (new_params, new_buf, new_opt)

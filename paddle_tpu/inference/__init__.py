@@ -120,12 +120,16 @@ class Predictor:
         direct form run([arrays...]) -> [arrays...]."""
         if inputs is not None:
             arrays = [np.asarray(a) for a in inputs]
-        else:
+        elif self._layer.in_shapes:
+            # arity known: every declared input handle must be filled
             missing = [n for n, h in self._inputs.items() if h._value is None]
             if missing:
                 raise ValueError(
                     f"input handle(s) not filled before run(): {missing}")
             arrays = [h._value for h in self._inputs.values()]
+        else:
+            # arity unknown (older save blob): pass whatever was filled
+            arrays = [h._value for h in self._inputs.values() if h._value is not None]
         if self._device is not None:
             arrays = [jax.device_put(a, self._device) for a in arrays]
         out = self._layer(*arrays)

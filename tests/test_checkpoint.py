@@ -1,0 +1,98 @@
+"""Sharded checkpoint + reshard-on-load (VERDICT round-1 item #7).
+
+Gate: train 2 steps on dp2 x mp2 x sharding2 (ZeRO-2) -> save -> reload on a
+dp4 x sharding2 mesh -> the next losses continue identically vs an
+uninterrupted run. Reference behavior being reproduced: DistributedSaver +
+converter.py topology reshard (/root/reference/python/paddle/distributed/
+auto_parallel/static/dist_saver.py).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import (
+    ColumnParallelLinear, DistributedEngine, DistributedStrategy,
+    RowParallelLinear,
+)
+from paddle_tpu.distributed.checkpoint import DistributedSaver
+from paddle_tpu.distributed.mesh import set_hybrid_communicate_group
+from paddle_tpu.distributed.strategy import HybridConfig, ShardingConfig
+
+
+class TPNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.col = ColumnParallelLinear(16, 32)
+        self.row = RowParallelLinear(32, 8)
+
+    def forward(self, x):
+        return self.row(nn.functional.relu(self.col(x)))
+
+
+def _data(step):
+    rng = np.random.RandomState(100 + step)
+    x = rng.rand(16, 16).astype(np.float32)
+    y = rng.randint(0, 8, (16,)).astype(np.int64)
+    return x, y
+
+
+def _make_engine(dp, mp, sharding, stage):
+    set_hybrid_communicate_group(None)
+    paddle.seed(0)
+    net = TPNet()
+    strat = DistributedStrategy(
+        hybrid_configs=HybridConfig(dp_degree=dp, mp_degree=mp,
+                                    sharding_degree=sharding),
+        sharding=ShardingConfig(stage=stage),
+    )
+    opt = paddle.optimizer.Adam(parameters=net.parameters(), learning_rate=1e-2)
+    return DistributedEngine(net, loss_fn=paddle.nn.CrossEntropyLoss(),
+                             optimizer=opt, strategy=strat)
+
+
+def _run_steps(engine, steps):
+    out = []
+    for s in steps:
+        x, y = _data(s)
+        out.append(float(np.asarray(engine.step([x], [y]))))
+    return out
+
+
+class TestShardedCheckpoint:
+    def test_reshard_on_load_continues_identically(self, tmp_path):
+        # uninterrupted baseline on topology A
+        ref = _run_steps(_make_engine(2, 2, 2, stage=2), range(4))
+
+        # interrupted: 2 steps on A, save, reload on topology B, 2 more steps
+        engA = _make_engine(2, 2, 2, stage=2)
+        first = _run_steps(engA, range(2))
+        np.testing.assert_allclose(first, ref[:2], rtol=1e-5)
+        ckpt = str(tmp_path / "ckpt")
+        engA.save_checkpoint(ckpt)
+
+        engB = _make_engine(4, 1, 2, stage=1)  # different mesh + ZeRO stage
+        engB.load_checkpoint(ckpt)
+        cont = _run_steps(engB, range(2, 4))
+        np.testing.assert_allclose(cont, ref[2:], rtol=2e-4, atol=1e-6)
+        set_hybrid_communicate_group(None)
+
+    def test_async_save_roundtrip(self, tmp_path):
+        eng = _make_engine(2, 2, 2, stage=2)
+        _run_steps(eng, range(2))
+        ckpt = str(tmp_path / "async_ckpt")
+        saver = eng.save_checkpoint(ckpt, async_save=True)
+        saver.wait()
+        eng2 = _make_engine(2, 2, 2, stage=2)
+        eng2.load_checkpoint(ckpt)
+        p1, _, o1 = eng.state
+        p2, _, o2 = eng2.state
+        for n in p1:
+            np.testing.assert_allclose(np.asarray(p1[n]), np.asarray(p2[n]),
+                                       rtol=1e-6)
+        for n in o1:
+            for k in o1[n]:
+                np.testing.assert_allclose(np.asarray(o1[n][k]),
+                                           np.asarray(o2[n][k]), rtol=1e-6)
+        assert eng2._step_count == 2
+        set_hybrid_communicate_group(None)

@@ -73,7 +73,7 @@ class TestModelFitEngine:
     def test_eval_predict_save_through_engine(self, tmp_path):
         _, model, net = _fit_losses(distributed=True, epochs=1)
         fleet.init(is_collective=True)
-        model._engine is not None
+        assert model._engine is not None
         ev = model.evaluate(ToyData(), batch_size=16, verbose=0)
         assert "acc" in ev
         preds = model.predict(ToyData(), batch_size=16, stack_outputs=True)
