@@ -73,6 +73,12 @@ from . import jit  # noqa: F401,E402
 from . import static  # noqa: F401,E402
 from . import models  # noqa: F401,E402
 from . import kernels  # noqa: F401,E402
+from .ops import parity as _ops_parity  # noqa: F401,E402  (needs nn+kernels)
+for _k, _v in _ops_parity.PUBLIC_OPS.items():
+    if _k not in globals():
+        globals()[_k] = _v
+del _k, _v
+from . import fft  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 

@@ -73,7 +73,7 @@ def apply(fn, *args, op_name="op", **kwargs):
     tensor_pos = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
 
     record = _recording() and any(
-        not leaves[i].stop_gradient and is_floating(leaves[i]._value.dtype)
+        not leaves[i].stop_gradient and _diffable(leaves[i]._value.dtype)
         for i in tensor_pos
     )
 
@@ -88,7 +88,7 @@ def apply(fn, *args, op_name="op", **kwargs):
     diff_pos = [
         i
         for i in tensor_pos
-        if not leaves[i].stop_gradient and is_floating(leaves[i]._value.dtype)
+        if not leaves[i].stop_gradient and _diffable(leaves[i]._value.dtype)
     ]
     diff_set = set(diff_pos)
     diff_tensors = [leaves[i] for i in diff_pos]
@@ -171,7 +171,12 @@ def _wrap_outputs(out, node):
     return _wrap_one(out, node, 0)
 
 
+def _diffable(d) -> bool:
+    """Float or complex dtypes carry gradients (complex: fft, as_complex...)."""
+    return is_floating(d) or np.issubdtype(np.dtype(d), np.complexfloating)
+
+
 def _wrap_one(o, node, idx):
-    if node is not None and is_floating(o.dtype):
+    if node is not None and _diffable(o.dtype):
         return Tensor._wrap(o, stop_gradient=False, node=node, output_index=idx)
     return Tensor._wrap(o, stop_gradient=True, output_index=idx)

@@ -104,7 +104,10 @@ def _shards_of(arr):
     for sh in arr.addressable_shards:
         key = _index_key(sh.index, shape)
         if key not in seen:
-            seen[key] = (sh.index, np.asarray(sh.data))
+            # copy=True: np.asarray of a device buffer can be a zero-copy
+            # view, and the train step donates these buffers — an async
+            # writer must not race XLA reusing the memory
+            seen[key] = (sh.index, np.array(sh.data, copy=True))
     return [(k, idx, data) for k, (idx, data) in seen.items()]
 
 
@@ -203,12 +206,18 @@ class DistributedSaver:
                 extra = pickle.load(f)
 
         merged = {}
-        for fn in sorted(os.listdir(path)):
-            if fn.startswith("shards.") and fn.endswith(".pkl"):
-                with open(os.path.join(path, fn), "rb") as f:
-                    blob = pickle.load(f)
-                for name, shards in blob.items():
-                    merged.setdefault(name, {}).update(shards)
+        # read exactly the files this save wrote — a directory reused by a
+        # smaller topology may hold stale shards.N.pkl from an older run
+        nproc = int(meta.get("process_count", 1))
+        for rank in range(nproc):
+            fp = os.path.join(path, f"shards.{rank}.pkl")
+            if not os.path.exists(fp):
+                continue  # node-local file on another host; coverage check
+                # below reports what's actually missing
+            with open(fp, "rb") as f:
+                blob = pickle.load(f)
+            for name, shards in blob.items():
+                merged.setdefault(name, {}).update(shards)
 
         flat = {}
         for name, info in meta["arrays"].items():

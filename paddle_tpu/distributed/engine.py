@@ -310,8 +310,12 @@ class DistributedEngine:
         forward_loss = self._forward_loss_outs()
 
         def step(params, buffers, inputs, labels):
+            # len(labels) is static at trace time: label-free eval (public
+            # eval_batch with labels=None) reports zero loss instead of
+            # calling a label-expecting loss_fn with no label args
             loss, (_, outs) = forward_loss(
-                params, buffers, jax.random.PRNGKey(0), inputs, labels, False)
+                params, buffers, jax.random.PRNGKey(0), inputs, labels, False,
+                compute_loss=len(labels) > 0)
             return loss, outs
 
         pshard, bshard, _ = self._shardings()

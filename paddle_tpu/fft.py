@@ -1,0 +1,164 @@
+"""paddle.fft parity (reference /root/reference/python/paddle/fft.py —
+~1.6K LoC of norm/axis plumbing over the fft_c2c/fft_r2c/fft_c2r kernels,
+paddle/phi/kernels/gpu/fft_kernel.cu). TPU-native: jnp.fft lowers to XLA's
+FFT HLO; the three underlying kernels register in the op table for coverage
+and kernel-policy parity."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.tensor import Tensor
+from .ops.registry import defop
+
+__all__ = [
+    "fft", "ifft", "fft2", "ifft2", "fftn", "ifftn",
+    "rfft", "irfft", "rfft2", "irfft2", "rfftn", "irfftn",
+    "hfft", "ihfft", "hfft2", "ihfft2", "hfftn", "ihfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+_NORMS = (None, "backward", "ortho", "forward")
+
+
+def _norm(norm):
+    if norm not in _NORMS:
+        raise ValueError(f"norm must be one of {_NORMS}, got {norm!r}")
+    return norm
+
+
+# The three reference FFT kernels (complex->complex, real->complex,
+# complex->real); every public function below lowers to one of them.
+@defop("fft_c2c")
+def _fft_c2c(x, axes=None, norm="backward", forward=True):
+    fn = jnp.fft.fftn if forward else jnp.fft.ifftn
+    return fn(x, axes=axes, norm=norm)
+
+
+@defop("fft_r2c")
+def _fft_r2c(x, axes=None, norm="backward", forward=True, onesided=True):
+    out = jnp.fft.rfftn(x, axes=axes, norm=norm)
+    return out if forward else jnp.conj(out)
+
+
+@defop("fft_c2r")
+def _fft_c2r(x, axes=None, norm="backward", forward=True, last_dim_size=None):
+    if last_dim_size is not None:
+        axes_t = tuple(axes) if axes is not None else tuple(range(x.ndim))
+        s = tuple(x.shape[a] for a in axes_t[:-1]) + (int(last_dim_size),)
+    else:
+        s = None
+    return jnp.fft.irfftn(x, s=s, axes=axes, norm=norm)
+
+
+def _wrap(fn):
+    # route through the dispatch tape so fft grads flow (real-input
+    # transforms; complex-input transforms are treated as leaves)
+    from .core.dispatch import apply
+
+    def call(x):
+        return apply(fn, x, op_name="fft")
+
+    return call
+
+
+def fft(x, n=None, axis=-1, norm="backward", name=None):
+    return _wrap(lambda a: jnp.fft.fft(a, n=n, axis=axis, norm=_norm(norm)))(x)
+
+
+def ifft(x, n=None, axis=-1, norm="backward", name=None):
+    return _wrap(lambda a: jnp.fft.ifft(a, n=n, axis=axis, norm=_norm(norm)))(x)
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _wrap(lambda a: jnp.fft.fft2(a, s=s, axes=axes, norm=_norm(norm)))(x)
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _wrap(lambda a: jnp.fft.ifft2(a, s=s, axes=axes, norm=_norm(norm)))(x)
+
+
+def fftn(x, s=None, axes=None, norm="backward", name=None):
+    return _wrap(lambda a: jnp.fft.fftn(a, s=s, axes=axes, norm=_norm(norm)))(x)
+
+
+def ifftn(x, s=None, axes=None, norm="backward", name=None):
+    return _wrap(lambda a: jnp.fft.ifftn(a, s=s, axes=axes, norm=_norm(norm)))(x)
+
+
+def rfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _wrap(lambda a: jnp.fft.rfft(a, n=n, axis=axis, norm=_norm(norm)))(x)
+
+
+def irfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _wrap(lambda a: jnp.fft.irfft(a, n=n, axis=axis, norm=_norm(norm)))(x)
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _wrap(lambda a: jnp.fft.rfft2(a, s=s, axes=axes, norm=_norm(norm)))(x)
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _wrap(lambda a: jnp.fft.irfft2(a, s=s, axes=axes, norm=_norm(norm)))(x)
+
+
+def rfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _wrap(lambda a: jnp.fft.rfftn(a, s=s, axes=axes, norm=_norm(norm)))(x)
+
+
+def irfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _wrap(lambda a: jnp.fft.irfftn(a, s=s, axes=axes, norm=_norm(norm)))(x)
+
+
+def hfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _wrap(lambda a: jnp.fft.hfft(a, n=n, axis=axis, norm=_norm(norm)))(x)
+
+
+def ihfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _wrap(lambda a: jnp.fft.ihfft(a, n=n, axis=axis, norm=_norm(norm)))(x)
+
+
+def _hfft_nd(a, s, axes, norm, inverse):
+    # hfftn/ihfftn don't exist in numpy/jnp; compose from c2c + 1d h-transforms
+    axes = tuple(axes) if axes is not None else tuple(range(a.ndim))
+    if inverse:
+        out = jnp.fft.ihfft(a, n=None if s is None else s[-1],
+                            axis=axes[-1], norm=norm)
+        if len(axes) > 1:
+            out = jnp.fft.ifftn(out, axes=axes[:-1], norm=norm)
+        return out
+    if len(axes) > 1:
+        a = jnp.fft.fftn(a, axes=axes[:-1], norm=norm)
+    return jnp.fft.hfft(a, n=None if s is None else s[-1],
+                        axis=axes[-1], norm=norm)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _wrap(lambda a: _hfft_nd(a, s, axes, _norm(norm), False))(x)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _wrap(lambda a: _hfft_nd(a, s, axes, _norm(norm), True))(x)
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _wrap(lambda a: _hfft_nd(a, s, axes, _norm(norm), False))(x)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _wrap(lambda a: _hfft_nd(a, s, axes, _norm(norm), True))(x)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor._wrap(jnp.fft.fftfreq(n, d=d).astype(dtype or "float32"))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor._wrap(jnp.fft.rfftfreq(n, d=d).astype(dtype or "float32"))
+
+
+def fftshift(x, axes=None, name=None):
+    return _wrap(lambda a: jnp.fft.fftshift(a, axes=axes))(x)
+
+
+def ifftshift(x, axes=None, name=None):
+    return _wrap(lambda a: jnp.fft.ifftshift(a, axes=axes))(x)

@@ -47,6 +47,11 @@ def active_platform() -> str:
     if _platform_hint:
         return _platform_hint
     try:
+        # an explicitly pinned default device (tests pin the virtual CPU
+        # pool this way) decides where un-meshed eager/jit ops actually run
+        dev = jax.config.jax_default_device
+        if dev is not None:
+            return dev if isinstance(dev, str) else dev.platform
         return jax.default_backend()
     except Exception:
         return "cpu"

@@ -15,6 +15,8 @@ from .layers.conv import *  # noqa: F401,F403
 from .layers.loss import *  # noqa: F401,F403
 from .layers.norm import *  # noqa: F401,F403
 from .layers.pooling import *  # noqa: F401,F403
+from .layers.rnn import *  # noqa: F401,F403
+from .layers.transformer import *  # noqa: F401,F403
 
 from .layers import activation as _act
 from .layers import common as _common
@@ -22,9 +24,12 @@ from .layers import conv as _conv
 from .layers import loss as _loss
 from .layers import norm as _norm
 from .layers import pooling as _pooling
+from .layers import rnn as _rnn
+from .layers import transformer as _transformer
 
 __all__ = (
     ["Layer", "LayerList", "Sequential", "ParameterList", "functional", "initializer"]
     + _act.__all__ + _common.__all__ + _conv.__all__
     + _loss.__all__ + _norm.__all__ + _pooling.__all__
+    + _rnn.__all__ + _transformer.__all__
 )

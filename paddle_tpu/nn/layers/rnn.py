@@ -1,0 +1,383 @@
+"""Recurrent layers (parity: /root/reference/python/paddle/nn/layer/rnn.py —
+SimpleRNNCell/LSTMCell/GRUCell, RNN/BiRNN wrappers, SimpleRNN/LSTM/GRU).
+
+TPU-first: the whole time loop is ONE ``lax.scan`` inside a single dispatched
+op (the reference's ``rnn`` op backed by cuDNN, legacy_ops.yaml `rnn`), so XLA
+sees a static-shaped loop it can pipeline on the MXU instead of a Python loop
+of per-step kernels. Variable lengths are handled by masking (carry the last
+valid state), which is the static-shape TPU idiom for the reference's
+sequence_length semantics.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+import numpy as np
+
+from ...core.dispatch import apply
+from ...ops.registry import defop
+from .. import initializer as I
+from ..layer import Layer, LayerList
+
+__all__ = [
+    "RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell",
+    "RNN", "BiRNN", "SimpleRNN", "LSTM", "GRU",
+]
+
+
+# ---------------------------------------------------------------------------
+# cell step bodies (raw jnp)
+# ---------------------------------------------------------------------------
+def _simple_step(x, h, wih, whh, bih, bhh, activation="tanh"):
+    pre = x @ wih.T + h @ whh.T
+    if bih is not None:
+        pre = pre + bih + bhh
+    return jnp.tanh(pre) if activation == "tanh" else jax.nn.relu(pre)
+
+
+def _lstm_step(x, h, c, wih, whh, bih, bhh):
+    gates = x @ wih.T + h @ whh.T
+    if bih is not None:
+        gates = gates + bih + bhh
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c2 = f * c + i * g
+    h2 = o * jnp.tanh(c2)
+    return h2, c2
+
+
+def _gru_step(x, h, wih, whh, bih, bhh):
+    xi = x @ wih.T
+    hi = h @ whh.T
+    if bih is not None:
+        xi = xi + bih
+        hi = hi + bhh
+    xr, xz, xc = jnp.split(xi, 3, axis=-1)
+    hr, hz, hc = jnp.split(hi, 3, axis=-1)
+    r = jax.nn.sigmoid(xr + hr)
+    z = jax.nn.sigmoid(xz + hz)
+    c = jnp.tanh(xc + r * hc)
+    return z * h + (1.0 - z) * c
+
+
+@defop("rnn")
+def _rnn_layer_op(x, h0, c0, wih, whh, bih, bhh, seq_lens=None, mode="LSTM",
+                  activation="tanh", reverse=False):
+    """One direction of one recurrent layer as a single lax.scan.
+
+    x [batch, time, in]; h0/c0 [batch, hidden]. Returns (outputs, h_n, c_n);
+    c_n is h_n for non-LSTM modes so the op has a static output arity.
+    """
+    xs = jnp.swapaxes(x, 0, 1)  # [time, batch, in]
+    T = xs.shape[0]
+    steps = jnp.arange(T - 1, -1, -1) if reverse else jnp.arange(T)
+
+    def step(carry, t):
+        h, c = carry
+        xt = xs[t]
+        if mode == "LSTM":
+            h2, c2 = _lstm_step(xt, h, c, wih, whh, bih, bhh)
+        elif mode == "GRU":
+            h2 = _gru_step(xt, h, wih, whh, bih, bhh)
+            c2 = c
+        else:
+            h2 = _simple_step(xt, h, wih, whh, bih, bhh, activation)
+            c2 = c
+        if seq_lens is not None:
+            valid = (t < seq_lens)[:, None]
+            h2 = jnp.where(valid, h2, h)
+            c2 = jnp.where(valid, c2, c)
+            out = jnp.where(valid, h2, jnp.zeros_like(h2))
+        else:
+            out = h2
+        return (h2, c2), out
+
+    (h_n, c_n), outs = lax.scan(step, (h0, c0), steps)
+    if reverse:
+        outs = jnp.flip(outs, axis=0)
+    return jnp.swapaxes(outs, 0, 1), h_n, c_n
+
+
+# ---------------------------------------------------------------------------
+# cells
+# ---------------------------------------------------------------------------
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        batch = batch_ref.shape[batch_dim_idx]
+        n = self.state_shape
+        if isinstance(n[0], (list, tuple)):
+            return tuple(
+                apply(lambda: jnp.full((batch, s[-1]), init_value, "float32"),
+                      op_name="full")
+                for s in n
+            )
+        return apply(lambda: jnp.full((batch, n[-1]), init_value, "float32"),
+                     op_name="full")
+
+    def _make_weights(self, input_size, hidden_size, gates):
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [gates * hidden_size, input_size], default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            [gates * hidden_size, hidden_size], default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            [gates * hidden_size], is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter(
+            [gates * hidden_size], is_bias=True, default_initializer=u)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        self._make_weights(input_size, hidden_size, 1)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h = apply(_simple_step, inputs, states, self.weight_ih, self.weight_hh,
+                  self.bias_ih, self.bias_hh, activation=self.activation,
+                  op_name="simple_rnn_cell")
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self._make_weights(input_size, hidden_size, 4)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h, c = states
+        h2, c2 = apply(_lstm_step, inputs, h, c, self.weight_ih, self.weight_hh,
+                       self.bias_ih, self.bias_hh, op_name="lstm_cell")
+        return h2, (h2, c2)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self._make_weights(input_size, hidden_size, 3)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h = apply(_gru_step, inputs, states, self.weight_ih, self.weight_hh,
+                  self.bias_ih, self.bias_hh, op_name="gru_cell")
+        return h, h
+
+
+_MODE_OF = {SimpleRNNCell: "RNN", LSTMCell: "LSTM", GRUCell: "GRU"}
+
+
+# ---------------------------------------------------------------------------
+# wrappers
+# ---------------------------------------------------------------------------
+class RNN(Layer):
+    """Run a cell over time (reference rnn.py RNN): scan when the cell is one
+    of ours, per-step Python loop for custom cells."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None, **kwargs):
+        mode = _MODE_OF.get(type(self.cell))
+        if mode is not None:
+            return self._scan_forward(inputs, initial_states, sequence_length, mode)
+        return self._loop_forward(inputs, initial_states, sequence_length, **kwargs)
+
+    def _scan_forward(self, inputs, initial_states, sequence_length, mode):
+        x = inputs if not self.time_major else inputs.transpose([1, 0, 2])
+        if initial_states is None:
+            initial_states = self.cell.get_initial_states(x)
+        if mode == "LSTM":
+            h0, c0 = initial_states
+        else:
+            h0 = initial_states
+            c0 = h0
+        outs, h_n, c_n = _rnn_layer_op(
+            x, h0, c0, self.cell.weight_ih, self.cell.weight_hh,
+            self.cell.bias_ih, self.cell.bias_hh, seq_lens=sequence_length,
+            mode=mode, activation=getattr(self.cell, "activation", "tanh"),
+            reverse=self.is_reverse)
+        if self.time_major:
+            outs = outs.transpose([1, 0, 2])
+        states = (h_n, c_n) if mode == "LSTM" else h_n
+        return outs, states
+
+    def _loop_forward(self, inputs, initial_states, sequence_length, **kwargs):
+        from ... import ops as P
+
+        x = inputs if not self.time_major else inputs.transpose([1, 0, 2])
+        T = x.shape[1]
+        states = initial_states
+        if states is None:
+            states = self.cell.get_initial_states(x)
+        outs = []
+        order = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        for t in order:
+            out, states = self.cell(x[:, t], states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        y = P.stack(outs, axis=1)
+        if self.time_major:
+            y = y.transpose([1, 0, 2])
+        return y, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ... import ops as P
+
+        fw_states, bw_states = (initial_states if initial_states is not None
+                                else (None, None))
+        out_fw, st_fw = self.rnn_fw(inputs, fw_states, sequence_length)
+        out_bw, st_bw = self.rnn_bw(inputs, bw_states, sequence_length)
+        out = P.concat([out_fw, out_bw], axis=-1)
+        return out, (st_fw, st_bw)
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh"):
+        super().__init__()
+        if direction not in ("forward", "bidirect", "bidirectional"):
+            raise ValueError(f"unknown direction {direction!r}")
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        self.num_directions = 2 if self.bidirectional else 1
+
+        def make_cell(in_size):
+            if mode == "LSTM":
+                return LSTMCell(in_size, hidden_size)
+            if mode == "GRU":
+                return GRUCell(in_size, hidden_size)
+            return SimpleRNNCell(in_size, hidden_size, activation=activation)
+
+        layers = []
+        for l in range(num_layers):
+            in_size = input_size if l == 0 else hidden_size * self.num_directions
+            if self.bidirectional:
+                layers.append(BiRNN(make_cell(in_size), make_cell(in_size),
+                                    time_major=time_major))
+            else:
+                layers.append(RNN(make_cell(in_size), time_major=time_major))
+        self.layers = LayerList(layers)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ... import ops as P
+        from .. import functional as F
+
+        batch_idx = 1 if self.time_major else 0
+        batch = inputs.shape[batch_idx]
+        x = inputs
+        final_h, final_c = [], []
+        for l, layer in enumerate(self.layers):
+            init = None
+            if initial_states is not None:
+                init = self._slice_states(initial_states, l)
+            x, st = layer(x, init, sequence_length)
+            if self.dropout > 0 and l < self.num_layers - 1:
+                x = F.dropout(x, p=self.dropout, training=self.training)
+            self._collect(st, final_h, final_c)
+        h_n = P.stack(final_h, axis=0)
+        if self.mode == "LSTM":
+            c_n = P.stack(final_c, axis=0)
+            return x, (h_n, c_n)
+        return x, h_n
+
+    def _slice_states(self, initial_states, l):
+        nd = self.num_directions
+        if self.mode == "LSTM":
+            h, c = initial_states
+            if self.bidirectional:
+                return ((h[l * nd], c[l * nd]), (h[l * nd + 1], c[l * nd + 1]))
+            return (h[l], c[l])
+        h = initial_states
+        if self.bidirectional:
+            return (h[l * nd], h[l * nd + 1])
+        return h[l]
+
+    def _collect(self, st, final_h, final_c):
+        if self.bidirectional:
+            for s in st:
+                self._collect_one(s, final_h, final_c)
+        else:
+            self._collect_one(st, final_h, final_c)
+
+    def _collect_one(self, s, final_h, final_c):
+        if self.mode == "LSTM":
+            final_h.append(s[0])
+            final_c.append(s[1])
+        else:
+            final_h.append(s)
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        super().__init__("RNN", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, activation)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout)

@@ -1,0 +1,244 @@
+"""Parity-layer ops + fft namespace + the op-coverage CI gate
+(VERDICT round-1 item #8: >=85% of the reference ops.yaml+legacy_ops.yaml).
+Oracles: numpy/scipy formulas and torch (CPU) where it implements the op.
+"""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.registry import OPS, op_coverage
+
+
+def _run(name, *args, **kw):
+    out = OPS[name].fn(*args, **kw)
+    def unwrap(o):
+        return np.asarray(o.numpy() if hasattr(o, "numpy") else o)
+    if isinstance(out, (list, tuple)):
+        return [unwrap(o) for o in out]
+    return unwrap(out)
+
+
+class TestOpCoverageGate:
+    def test_coverage_at_least_85_percent(self):
+        cov = op_coverage()
+        print(f"\nop coverage: {cov['covered']}/{cov['total']} "
+              f"= {cov['pct']:.1%}; missing: {cov['missing']}")
+        assert cov["pct"] >= 0.85
+
+
+class TestMathParity:
+    def test_cumulative_ops(self):
+        x = np.random.RandomState(0).randn(3, 7).astype(np.float32)
+        np.testing.assert_allclose(_run("cumsum", x, axis=1),
+                                   np.cumsum(x, 1), rtol=1e-6)
+        np.testing.assert_allclose(_run("cumprod", x, dim=1),
+                                   np.cumprod(x, 1), rtol=1e-5)
+        vals, idx = _run("cummax", x, axis=1)
+        tv, ti = torch.cummax(torch.from_numpy(x), dim=1)
+        np.testing.assert_allclose(vals, tv.numpy(), rtol=1e-6)
+        np.testing.assert_array_equal(idx, ti.numpy())
+        vals, idx = _run("cummin", x, axis=1)
+        tv, ti = torch.cummin(torch.from_numpy(x), dim=1)
+        np.testing.assert_allclose(vals, tv.numpy(), rtol=1e-6)
+        np.testing.assert_array_equal(idx, ti.numpy())
+        # associative_scan reassociates the f32 sums -> ~1e-4 noise
+        np.testing.assert_allclose(
+            _run("logcumsumexp", x, axis=1),
+            torch.logcumsumexp(torch.from_numpy(x), dim=1).numpy(),
+            rtol=1e-3, atol=1e-4)
+
+    def test_reductions_and_norms(self):
+        x = np.random.RandomState(1).randn(4, 5).astype(np.float32)
+        np.testing.assert_allclose(_run("logsumexp", x, axis=1),
+                                   torch.logsumexp(torch.from_numpy(x), 1),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(_run("trace", x), np.trace(x), rtol=1e-6)
+        np.testing.assert_allclose(_run("p_norm", x, porder=3.0, axis=1),
+                                   np.power(np.sum(np.abs(x) ** 3, 1), 1 / 3),
+                                   rtol=1e-4)
+        np.testing.assert_allclose(_run("frobenius_norm", x, axis=[0, 1]),
+                                   np.linalg.norm(x), rtol=1e-5)
+        np.testing.assert_allclose(_run("squared_l2_norm", x),
+                                   (x ** 2).sum(), rtol=1e-5)
+        got = _run("renorm", x, 2.0, 0, 1.0)
+        want = torch.renorm(torch.from_numpy(x), 2, 0, 1.0).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+
+    def test_complex_and_special(self):
+        a = np.random.rand(4).astype(np.float32)
+        b = np.random.rand(4).astype(np.float32)
+        c = _run("complex", a, b)
+        assert np.allclose(c, a + 1j * b)
+        np.testing.assert_allclose(_run("real", c), a, rtol=1e-6)
+        np.testing.assert_allclose(_run("imag", c), b, rtol=1e-6)
+        from scipy import special as sp
+
+        x = np.linspace(0.1, 3, 7).astype(np.float32)
+        np.testing.assert_allclose(_run("i0", x), sp.i0(x), rtol=1e-4)
+        np.testing.assert_allclose(_run("i1e", x), sp.i1e(x), rtol=1e-4)
+        np.testing.assert_allclose(_run("polygamma", x, 1),
+                                   sp.polygamma(1, x), rtol=1e-4)
+
+    def test_indexing_ops(self):
+        x = np.random.RandomState(2).randn(3, 4).astype(np.float32)
+        np.testing.assert_allclose(_run("diagonal", x), np.diagonal(x))
+        d = _run("diag_embed", x)  # [3,4] -> [3,4,4]
+        assert d.shape == (3, 4, 4)
+        np.testing.assert_allclose(d[1], np.diag(x[1]))
+        counts = _run("bincount", np.array([0, 1, 1, 3]), minlength=6)
+        np.testing.assert_array_equal(counts, [1, 2, 0, 1, 0, 0])
+        r, c = _run("tril_indices", 4, 4, 0)
+        tr = torch.tril_indices(4, 4, 0)
+        np.testing.assert_array_equal(r, tr[0].numpy())
+        np.testing.assert_array_equal(c, tr[1].numpy())
+
+    def test_linalg_ops(self):
+        rng = np.random.RandomState(3)
+        a = rng.randn(4, 4).astype(np.float32)
+        spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+        np.testing.assert_allclose(_run("inverse", spd), np.linalg.inv(spd),
+                                   rtol=1e-3, atol=1e-5)
+        L = np.linalg.cholesky(spd).astype(np.float32)
+        b = rng.randn(4, 2).astype(np.float32)
+        got = _run("cholesky_solve", b, L, upper=False)
+        np.testing.assert_allclose(got, np.linalg.solve(spd, b),
+                                   rtol=1e-3, atol=1e-5)
+        rank = _run("matrix_rank_tol", spd, np.float32(1e-5))
+        assert int(rank) == 4
+
+
+class TestSignalAndDecode:
+    def test_frame_overlap_add_roundtrip(self):
+        x = np.random.RandomState(4).randn(2, 32).astype(np.float32)
+        frames = _run("frame", x, 8, 8)  # non-overlapping
+        assert frames.shape == (2, 8, 4)
+        back = _run("overlap_add", frames, 8)
+        np.testing.assert_allclose(back, x, rtol=1e-6)
+
+    def test_edit_distance(self):
+        hyp = np.array([[1, 2, 3, 4]], np.int64)
+        ref = np.array([[1, 3, 3, 9]], np.int64)
+        d, n = _run("edit_distance", hyp, ref, normalized=False)
+        assert d[0, 0] == 2.0  # substitute 2->3 is wrong; 2->3, 4->9
+        d2, _ = _run("edit_distance", hyp, ref, normalized=True)
+        np.testing.assert_allclose(d2[0, 0], 2.0 / 4.0)
+
+    def test_viterbi_matches_brute_force(self):
+        rng = np.random.RandomState(5)
+        emit = rng.rand(1, 4, 3).astype(np.float32)
+        trans = rng.rand(3, 3).astype(np.float32)
+        scores, path = _run("viterbi_decode", emit,
+                            trans, np.array([4], np.int64))
+        best, arg = -1e9, None
+        import itertools
+
+        for seq in itertools.product(range(3), repeat=4):
+            s = emit[0, 0, seq[0]] + sum(
+                trans[seq[i - 1], seq[i]] + emit[0, i, seq[i]]
+                for i in range(1, 4))
+            if s > best:
+                best, arg = s, seq
+        np.testing.assert_allclose(scores[0], best, rtol=1e-5)
+        np.testing.assert_array_equal(path[0], arg)
+
+    def test_nms_suppresses_overlaps(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 10.5, 10.5], [20, 20, 30, 30]],
+                         np.float32)
+        scores = np.array([0.9, 0.8, 0.7], np.float32)
+        keep = _run("nms", boxes, scores, 0.5)
+        np.testing.assert_array_equal(np.sort(keep), [0, 2])
+
+
+class TestVisionParity:
+    def test_grid_sample_matches_torch(self):
+        rng = np.random.RandomState(6)
+        x = rng.rand(2, 3, 5, 7).astype(np.float32)
+        grid = (rng.rand(2, 4, 6, 2).astype(np.float32) * 2 - 1)
+        got = _run("grid_sample", x, grid, mode="bilinear",
+                   padding_mode="zeros", align_corners=True)
+        want = torch.nn.functional.grid_sample(
+            torch.from_numpy(x), torch.from_numpy(grid), mode="bilinear",
+            padding_mode="zeros", align_corners=True).numpy()
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_affine_grid_matches_torch(self):
+        theta = np.array([[[1.0, 0.2, 0.1], [0.0, 1.0, -0.3]]], np.float32)
+        got = _run("affine_grid", theta, [1, 3, 4, 5], align_corners=True)
+        want = torch.nn.functional.affine_grid(
+            torch.from_numpy(theta), [1, 3, 4, 5], align_corners=True).numpy()
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_box_coder_roundtrip(self):
+        priors = np.array([[0, 0, 10, 10], [5, 5, 15, 20]], np.float32)
+        targets = np.array([[1, 1, 9, 11], [4, 6, 16, 18]], np.float32)
+        enc = _run("box_coder", priors, None, targets,
+                   code_type="encode_center_size")
+        dec = _run("box_coder", priors, None, enc[np.arange(2), np.arange(2)],
+                   code_type="decode_center_size")
+        np.testing.assert_allclose(dec, targets, atol=1e-4)
+
+
+class TestOptimizerOps:
+    def test_adam_step_matches_formula(self):
+        p = np.ones(4, np.float32)
+        g = np.full(4, 0.5, np.float32)
+        m = np.zeros(4, np.float32)
+        v = np.zeros(4, np.float32)
+        out = _run("adam_", p, g, np.float32(0.1), m, v,
+                   np.float32(1.0), np.float32(1.0))
+        m2 = 0.1 * g
+        v2 = 0.001 * g * g
+        mhat = m2 / (1 - 0.9)
+        vhat = v2 / (1 - 0.999)
+        p2 = p - 0.1 * mhat / (np.sqrt(vhat) + 1e-8)
+        np.testing.assert_allclose(out[0], p2, rtol=1e-5)
+
+    def test_loss_scaling_update(self):
+        scale, good, bad = _run(
+            "update_loss_scaling_", np.float32(1024.0),
+            np.int32(0), np.int32(1), np.asarray(True),
+            incr_every_n_steps=2, decr_every_n_nan_or_inf=2)
+        assert scale == 512.0 and good == 0 and bad == 0
+
+    def test_check_finite_and_unscale(self):
+        outs = _run("check_finite_and_unscale_",
+                    [np.array([2.0, 4.0], np.float32),
+                     np.array([np.inf], np.float32)], np.float32(2.0))
+        np.testing.assert_allclose(outs[0], [1.0, 2.0])
+        assert bool(outs[-1]) is True
+
+
+class TestFFT:
+    def test_fft_family_matches_numpy(self):
+        rng = np.random.RandomState(7)
+        x = rng.randn(4, 8).astype(np.float32)
+        from paddle_tpu import fft as pfft
+
+        np.testing.assert_allclose(pfft.fft(paddle.to_tensor(x)).numpy(),
+                                   np.fft.fft(x), atol=1e-4)
+        np.testing.assert_allclose(pfft.rfft(paddle.to_tensor(x)).numpy(),
+                                   np.fft.rfft(x), atol=1e-4)
+        c = (rng.randn(4, 5) + 1j * rng.randn(4, 5)).astype(np.complex64)
+        np.testing.assert_allclose(pfft.irfft(paddle.to_tensor(c)).numpy(),
+                                   np.fft.irfft(c), atol=1e-4)
+        np.testing.assert_allclose(pfft.fft2(paddle.to_tensor(x)).numpy(),
+                                   np.fft.fft2(x), atol=1e-4)
+        np.testing.assert_allclose(pfft.hfft(paddle.to_tensor(c)).numpy(),
+                                   np.fft.hfft(c), atol=1e-4)
+        np.testing.assert_allclose(
+            pfft.fftshift(paddle.to_tensor(x)).numpy(), np.fft.fftshift(x))
+        np.testing.assert_allclose(pfft.fftfreq(8, 0.5).numpy(),
+                                   np.fft.fftfreq(8, 0.5), atol=1e-6)
+
+    def test_fft_grad_flows(self):
+        x = paddle.to_tensor(np.random.rand(8).astype(np.float32))
+        x.stop_gradient = False
+        from paddle_tpu import fft as pfft
+
+        y = pfft.rfft(x)
+        loss = paddle.sum(paddle.abs(y) ** 2)
+        loss.backward()
+        assert x.grad is not None
+        # Parseval: d/dx sum|rfft(x)|^2 ~ 2*N*x (up to one-sided factors)
+        assert float(np.abs(x.grad.numpy()).sum()) > 0
