@@ -53,6 +53,7 @@ from .core.dtype import (  # noqa: F401,E402
     set_default_dtype,
     uint8,
 )
+from .framework.flags import get_flags, set_flags  # noqa: F401,E402
 from .framework.random import seed  # noqa: F401,E402
 from .ops import *  # noqa: F401,F403,E402
 from .ops import __all__ as _ops_all

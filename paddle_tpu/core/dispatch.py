@@ -15,6 +15,7 @@ abstract evaluation, which happens for free inside tracing.
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax import tree_util
 
@@ -83,6 +84,7 @@ def apply(fn, *args, op_name="op", **kwargs):
         out = fn(*a, **k)
         result = _wrap_outputs(out, node=None)
         _maybe_attach_recompute(fn, leaves, treedef, result)
+        _debug_hooks(op_name, result)
         return result
 
     diff_pos = [
@@ -114,7 +116,40 @@ def apply(fn, *args, op_name="op", **kwargs):
     )
     result = _wrap_outputs(out, node=node)
     _maybe_attach_recompute(fn, leaves, treedef, result)
+    _debug_hooks(op_name, result)
     return result
+
+
+def _debug_hooks(op_name, result):
+    """FLAGS_check_nan_inf: raise on non-finite op outputs with the op name
+    (reference nan_inf_utils_detail.cc + eager nan_inf_utils.cc);
+    FLAGS_benchmark: block so per-op timing is honest (reference's
+    stream-sync benchmark mode)."""
+    from ..framework.flags import flag_value
+
+    check = flag_value("FLAGS_check_nan_inf")
+    bench = flag_value("FLAGS_benchmark")
+    if not (check or bench):
+        return
+    outs = result if isinstance(result, (tuple, list)) else [result]
+    for o in outs:
+        if not isinstance(o, Tensor):
+            continue
+        v = o._value
+        if isinstance(v, jax.core.Tracer):
+            # inside jit/vmap tracing the value isn't concrete; the checks
+            # re-run on the eager boundary where results materialize
+            continue
+        if bench:
+            jax.block_until_ready(v)
+        if check and jnp.issubdtype(v.dtype, jnp.inexact):
+            bad_nan = int(jnp.sum(jnp.isnan(v)))
+            bad_inf = int(jnp.sum(jnp.isinf(v)))
+            if bad_nan or bad_inf:
+                raise RuntimeError(
+                    f"[FLAGS_check_nan_inf] op '{op_name}' produced "
+                    f"{bad_nan} NaN / {bad_inf} Inf values "
+                    f"(shape {tuple(v.shape)}, dtype {v.dtype})")
 
 
 def _maybe_attach_recompute(fn, leaves, treedef, result):

@@ -1,0 +1,16 @@
+"""python -m paddle_tpu.distributed.launch — multi-process bootstrap CLI.
+
+Reference: /root/reference/python/paddle/distributed/launch/main.py:18 +
+controllers/collective.py (rank/env layout, per-worker logs, watcher) and the
+elastic manager's level-1 fault tolerance (fleet/elastic/manager.py:124 —
+restart the pod with the same world size).
+
+TPU-native: the launcher only lays out env and forks workers; rendezvous is
+``jax.distributed.initialize`` (driven by the env this CLI sets), and the TPU
+runtime's own coordination service replaces TCPStore. On multi-host TPU pods
+the platform launcher usually does this job — this CLI is for single-host
+multi-process (CPU test rigs) and for driving pod-slice processes uniformly.
+"""
+from .main import launch, main  # noqa: F401
+
+__all__ = ["launch", "main"]

@@ -1,0 +1,150 @@
+"""Launcher implementation: env layout, worker spawn, watch, restart."""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+__all__ = ["launch", "main"]
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _parse(argv):
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.distributed.launch",
+        description="Launch N training processes with distributed env set "
+                    "(reference paddle.distributed.launch parity).")
+    p.add_argument("--nnodes", type=int, default=1,
+                   help="number of nodes (this CLI drives one)")
+    p.add_argument("--node_rank", type=int, default=0)
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="worker processes on this node")
+    p.add_argument("--master", default=None,
+                   help="coordinator host:port (default: local free port)")
+    p.add_argument("--log_dir", default="log",
+                   help="per-rank worker logs directory (workerlog.N)")
+    p.add_argument("--max_restarts", type=int, default=0,
+                   help="elastic level-1: restart the whole pod up to K "
+                        "times when any worker fails")
+    p.add_argument("--devices", default=None,
+                   help="comma list forwarded as PADDLE_TPU_VISIBLE_DEVICES")
+    p.add_argument("--backend", choices=["auto", "cpu", "tpu"], default="auto",
+                   help="cpu: force workers onto the CPU backend (strips any "
+                        "site-injected TPU plugin; the reference's Gloo-mode "
+                        "analogue for machines without accelerators)")
+    p.add_argument("training_script", help="script to run")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _worker_env(args, master, local_rank):
+    world = args.nnodes * args.nproc_per_node
+    rank = args.node_rank * args.nproc_per_node + local_rank
+    env = dict(os.environ)
+    env.update({
+        # our bootstrap (read by init_parallel_env -> jax.distributed)
+        "PADDLE_TPU_COORDINATOR": master,
+        "PADDLE_TPU_NUM_PROCESSES": str(world),
+        "PADDLE_TPU_PROCESS_ID": str(rank),
+        # reference-compatible names so existing scripts keep working
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(world),
+        "PADDLE_LOCAL_RANK": str(local_rank),
+        "PADDLE_RANK_IN_NODE": str(local_rank),
+        "PADDLE_MASTER": master,
+    })
+    if args.devices:
+        env["PADDLE_TPU_VISIBLE_DEVICES"] = args.devices
+    if args.backend == "cpu":
+        env["JAX_PLATFORMS"] = "cpu"
+        # site-injected accelerator plugins (e.g. a sitecustomize that
+        # force-registers a TPU PJRT client) would override JAX_PLATFORMS
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+            if p and "axon" not in p)
+    elif args.backend == "tpu":
+        env["JAX_PLATFORMS"] = env.get("JAX_PLATFORMS", "") or "tpu"
+    return env
+
+
+def _spawn(args, master):
+    os.makedirs(args.log_dir, exist_ok=True)
+    procs = []
+    for lr in range(args.nproc_per_node):
+        rank = args.node_rank * args.nproc_per_node + lr
+        log_path = os.path.join(args.log_dir, f"workerlog.{rank}")
+        logf = open(log_path, "w")
+        cmd = [sys.executable, args.training_script] + args.training_script_args
+        proc = subprocess.Popen(cmd, env=_worker_env(args, master, lr),
+                                stdout=logf, stderr=subprocess.STDOUT)
+        procs.append((proc, logf, rank))
+    return procs
+
+
+def _watch(procs, poll_s=0.2):
+    """Reference watcher role (launch/controllers/watcher.py): first failure
+    aborts the pod; returns 0 only if every worker exits 0."""
+    try:
+        while procs:
+            alive = []
+            for proc, logf, rank in procs:
+                rc = proc.poll()
+                if rc is None:
+                    alive.append((proc, logf, rank))
+                elif rc != 0:
+                    sys.stderr.write(
+                        f"[launch] rank {rank} failed with exit {rc}; "
+                        f"aborting pod (see workerlog.{rank})\n")
+                    for p2, f2, _ in procs:
+                        if p2.poll() is None:
+                            p2.terminate()
+                    for p2, f2, _ in procs:
+                        try:
+                            p2.wait(timeout=10)
+                        except subprocess.TimeoutExpired:
+                            p2.kill()
+                        f2.close()
+                    return rc
+                else:
+                    logf.close()
+            procs = alive
+            if procs:
+                time.sleep(poll_s)
+        return 0
+    except KeyboardInterrupt:
+        for proc, logf, _ in procs:
+            proc.send_signal(signal.SIGINT)
+        for proc, logf, _ in procs:
+            proc.wait()
+            logf.close()
+        return 130
+
+
+def launch(argv):
+    args = _parse(argv)
+    master = args.master or f"127.0.0.1:{_free_port()}"
+    attempt = 0
+    while True:
+        procs = _spawn(args, master)
+        rc = _watch(procs)
+        if rc == 0 or attempt >= args.max_restarts:
+            return rc
+        attempt += 1
+        sys.stderr.write(
+            f"[launch] restarting pod (attempt {attempt}/{args.max_restarts})\n")
+        # a fresh coordinator port avoids stale-rendezvous collisions
+        if args.master is None:
+            master = f"127.0.0.1:{_free_port()}"
+
+
+def main():
+    return launch(sys.argv[1:])

@@ -31,15 +31,25 @@ def init_parallel_env(strategy: DistributedStrategy | None = None):
     if _initialized:
         return ParallelEnv()
     coord = os.environ.get("PADDLE_TPU_COORDINATOR")
-    if coord and jax.process_count() == 1:
+    nproc = int(os.environ.get("PADDLE_TPU_NUM_PROCESSES", "1"))
+    if coord and nproc > 1:
+        # must run BEFORE any backend use (jax.devices()/process_count()
+        # would freeze a single-process topology); multi-proc CPU rides the
+        # gloo collectives implementation
+        if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+            try:
+                jax.config.update("jax_cpu_collectives_implementation", "gloo")
+            except Exception:
+                pass  # older jax without the knob: mpi/none fallback
         try:
             jax.distributed.initialize(
                 coordinator_address=coord,
-                num_processes=int(os.environ.get("PADDLE_TPU_NUM_PROCESSES", "1")),
+                num_processes=nproc,
                 process_id=int(os.environ.get("PADDLE_TPU_PROCESS_ID", "0")),
             )
-        except Exception:
-            pass  # already initialized or single-process run
+        except RuntimeError as e:
+            if "already" not in str(e).lower():
+                raise
     if strategy is None:
         strategy = DistributedStrategy()
         # default: pure DP over every device in the mesh pool

@@ -1,0 +1,96 @@
+"""Global flags tier (reference: paddle.set_flags/get_flags over 91 exported
+gflags, /root/reference/paddle/phi/core/flags.cc +
+paddle/fluid/pybind/global_value_getter_setter.cc).
+
+TPU-native: one typed in-process registry seeded from FLAGS_* environment
+variables (the reference's env override path), consumed by the dispatch layer
+(nan/inf checks), the kernel policy, and XLA knob plumbing. Unknown flags
+raise, matching the reference's enforce behavior.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+__all__ = ["set_flags", "get_flags", "register_flag"]
+
+
+@dataclass
+class _Flag:
+    name: str
+    default: object
+    doc: str
+    value: object = None
+
+    def __post_init__(self):
+        self.value = self.default
+
+
+_REGISTRY: dict[str, _Flag] = {}
+
+
+def register_flag(name: str, default, doc: str = ""):
+    """Declare a flag (framework modules call this at import). Env var of the
+    same name overrides the default, like the reference's gflags env hook."""
+    flag = _Flag(name, default, doc)
+    env = os.environ.get(name)
+    if env is not None:
+        flag.value = _coerce(env, default)
+    _REGISTRY[name] = flag
+    return flag
+
+
+def _coerce(text, like):
+    if isinstance(like, bool):
+        return text.lower() in ("1", "true", "yes", "on")
+    if isinstance(like, int):
+        return int(text)
+    if isinstance(like, float):
+        return float(text)
+    return text
+
+
+def set_flags(flags: dict):
+    """paddle.set_flags({"FLAGS_check_nan_inf": True})"""
+    for name, value in flags.items():
+        if name not in _REGISTRY:
+            raise ValueError(
+                f"unknown flag {name!r}; known: {sorted(_REGISTRY)}")
+        cur = _REGISTRY[name]
+        cur.value = _coerce(value, cur.default) if isinstance(value, str) else value
+
+
+def get_flags(names):
+    """paddle.get_flags("FLAGS_check_nan_inf") or a list of names."""
+    if isinstance(names, str):
+        names = [names]
+    out = {}
+    for n in names:
+        if n not in _REGISTRY:
+            raise ValueError(
+                f"unknown flag {n!r}; known: {sorted(_REGISTRY)}")
+        out[n] = _REGISTRY[n].value
+    return out
+
+
+def flag_value(name: str):
+    """Fast internal accessor (no dict copy) for hot paths."""
+    return _REGISTRY[name].value
+
+
+# -- the exported flag set (reference flags.cc roles that survive on TPU) ----
+register_flag("FLAGS_check_nan_inf", False,
+              "check every op output for NaN/Inf and raise with the op name "
+              "(reference nan_inf_utils_detail.cc)")
+register_flag("FLAGS_use_pallas", "",
+              "'1'/'0' force the Pallas kernel path on/off; empty = platform "
+              "default (PHI kernel-key selection role)")
+register_flag("FLAGS_benchmark", False,
+              "block on every op result (like the reference's stream-sync "
+              "benchmark mode) — makes per-op timing honest")
+register_flag("FLAGS_cudnn_deterministic", False,
+              "determinism request; XLA:TPU is deterministic by default so "
+              "this only pins rng-behind-dropout choices")
+register_flag("FLAGS_allocator_strategy", "auto_growth",
+              "accepted for API parity; XLA's BFC allocator is the "
+              "implementation either way")

@@ -19,7 +19,15 @@ import threading
 import jax
 
 _state = threading.local()
-_global = {"seed": 0, "key": jax.random.PRNGKey(0)}
+# key is materialized lazily: building it at import would initialize JAX
+# backends before a launcher can call jax.distributed.initialize
+_global = {"seed": 0, "key": None}
+
+
+def _global_key():
+    if _global["key"] is None:
+        _global["key"] = jax.random.PRNGKey(_global["seed"])
+    return _global["key"]
 
 
 def seed(s: int):
@@ -30,7 +38,7 @@ def seed(s: int):
 
 
 def get_cuda_rng_state():  # parity shim
-    return [_global["key"]]
+    return [_global_key()]
 
 
 @contextlib.contextmanager
@@ -51,7 +59,7 @@ def next_key():
         k = jax.random.fold_in(scope["key"], scope["counter"])
         scope["counter"] += 1
         return k
-    _global["key"], sub = jax.random.split(_global["key"])
+    _global["key"], sub = jax.random.split(_global_key())
     return sub
 
 

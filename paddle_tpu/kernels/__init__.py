@@ -62,6 +62,11 @@ def use_pallas() -> bool:
         return _override
     if _FORCE is not None:
         return _FORCE == "1"
+    from ..framework.flags import flag_value
+
+    fv = flag_value("FLAGS_use_pallas")
+    if fv != "" and fv is not None:
+        return str(fv).lower() in ("1", "true")
     return active_platform() == "tpu"
 
 

@@ -1,3 +1,14 @@
+from .conformer import (  # noqa: F401
+    ConformerConfig,
+    ConformerEncoder,
+    ConformerForCTC,
+    ConformerForRNNT,
+    conformer_tiny,
+)
 from .llama import LlamaConfig, LlamaDecoderLayer, LlamaForCausalLM, llama_7b, llama_tiny  # noqa: F401
 
-__all__ = ["LlamaConfig", "LlamaForCausalLM", "LlamaDecoderLayer", "llama_7b", "llama_tiny"]
+__all__ = [
+    "LlamaConfig", "LlamaForCausalLM", "LlamaDecoderLayer", "llama_7b", "llama_tiny",
+    "ConformerConfig", "ConformerEncoder", "ConformerForCTC", "ConformerForRNNT",
+    "conformer_tiny",
+]

@@ -1,0 +1,166 @@
+"""Conformer ASR encoder (BASELINE config #5's model family — the reference
+ecosystem trains Conformer/Whisper-style ASR on warpctc/warprnnt losses;
+architecture per Gulati et al. 2020).
+
+TPU-first: all sequence ops are batched matmuls/convs with static shapes (the
+MXU path); the convolution module uses NCL depthwise conv; attention lowers
+through scaled_dot_product_attention (flash kernel on chip). Heads for both
+CTC and RNN-T decoding sit on top of the same encoder.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .. import nn
+from ..nn import functional as F
+
+
+@dataclass
+class ConformerConfig:
+    input_dim: int = 80          # log-mel features
+    hidden: int = 144
+    num_layers: int = 4
+    num_heads: int = 4
+    ff_mult: int = 4
+    conv_kernel: int = 15
+    dropout: float = 0.1
+    vocab_size: int = 128        # incl. blank at index 0
+    subsample: int = 4           # time reduction of the conv frontend
+
+
+def conformer_tiny(vocab=32, hidden=32, layers=2, heads=2):
+    return ConformerConfig(input_dim=16, hidden=hidden, num_layers=layers,
+                           num_heads=heads, conv_kernel=7, vocab_size=vocab,
+                           dropout=0.0)
+
+
+class ConvSubsampling(nn.Layer):
+    """Two stride-2 Conv2D blocks: 4x time reduction (standard frontend)."""
+
+    def __init__(self, input_dim, hidden):
+        super().__init__()
+        self.conv1 = nn.Conv2D(1, hidden, 3, stride=2, padding=1)
+        self.conv2 = nn.Conv2D(hidden, hidden, 3, stride=2, padding=1)
+        self.proj = nn.Linear(hidden * ((input_dim + 3) // 4), hidden)
+
+    def forward(self, x):
+        # x: [B, T, F] -> [B, 1, T, F]
+        b, t, f = x.shape
+        h = x.reshape([b, 1, t, f])
+        h = F.relu(self.conv1(h))
+        h = F.relu(self.conv2(h))
+        b2, c, t2, f2 = h.shape
+        h = h.transpose([0, 2, 1, 3]).reshape([b2, t2, c * f2])
+        return self.proj(h)
+
+
+class FeedForwardModule(nn.Layer):
+    def __init__(self, cfg: ConformerConfig):
+        super().__init__()
+        self.norm = nn.LayerNorm(cfg.hidden)
+        self.fc1 = nn.Linear(cfg.hidden, cfg.hidden * cfg.ff_mult)
+        self.fc2 = nn.Linear(cfg.hidden * cfg.ff_mult, cfg.hidden)
+        self.dropout = nn.Dropout(cfg.dropout)
+
+    def forward(self, x):
+        h = self.norm(x)
+        h = self.dropout(F.swish(self.fc1(h)))
+        return self.dropout(self.fc2(h))
+
+
+class ConvModule(nn.Layer):
+    """pointwise->GLU->depthwise->BN->swish->pointwise (Conformer fig.2)."""
+
+    def __init__(self, cfg: ConformerConfig):
+        super().__init__()
+        self.norm = nn.LayerNorm(cfg.hidden)
+        self.pw1 = nn.Conv1D(cfg.hidden, 2 * cfg.hidden, 1)
+        self.dw = nn.Conv1D(cfg.hidden, cfg.hidden, cfg.conv_kernel,
+                            padding=cfg.conv_kernel // 2, groups=cfg.hidden)
+        self.bn = nn.BatchNorm1D(cfg.hidden)
+        self.pw2 = nn.Conv1D(cfg.hidden, cfg.hidden, 1)
+        self.dropout = nn.Dropout(cfg.dropout)
+
+    def forward(self, x):
+        h = self.norm(x).transpose([0, 2, 1])  # [B, C, T]
+        h = F.glu(self.pw1(h), axis=1)
+        h = F.swish(self.bn(self.dw(h)))
+        h = self.pw2(h).transpose([0, 2, 1])
+        return self.dropout(h)
+
+
+class ConformerBlock(nn.Layer):
+    def __init__(self, cfg: ConformerConfig):
+        super().__init__()
+        self.ff1 = FeedForwardModule(cfg)
+        self.norm_attn = nn.LayerNorm(cfg.hidden)
+        self.attn = nn.MultiHeadAttention(cfg.hidden, cfg.num_heads,
+                                          dropout=cfg.dropout)
+        self.conv = ConvModule(cfg)
+        self.ff2 = FeedForwardModule(cfg)
+        self.norm_out = nn.LayerNorm(cfg.hidden)
+        self.dropout = nn.Dropout(cfg.dropout)
+
+    def forward(self, x):
+        x = x + 0.5 * self.ff1(x)
+        h = self.norm_attn(x)
+        x = x + self.dropout(self.attn(h, h, h))
+        x = x + self.conv(x)
+        x = x + 0.5 * self.ff2(x)
+        return self.norm_out(x)
+
+
+class ConformerEncoder(nn.Layer):
+    def __init__(self, cfg: ConformerConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.subsample = ConvSubsampling(cfg.input_dim, cfg.hidden)
+        self.dropout = nn.Dropout(cfg.dropout)
+        self.blocks = nn.LayerList([ConformerBlock(cfg)
+                                    for _ in range(cfg.num_layers)])
+
+    def forward(self, feats):
+        h = self.dropout(self.subsample(feats))
+        for blk in self.blocks:
+            h = blk(h)
+        return h
+
+
+class ConformerForCTC(nn.Layer):
+    """Encoder + linear CTC head: returns [T', B, V] log-probs ready for
+    F.ctc_loss (blank=0)."""
+
+    def __init__(self, cfg: ConformerConfig):
+        super().__init__()
+        self.encoder = ConformerEncoder(cfg)
+        self.head = nn.Linear(cfg.hidden, cfg.vocab_size)
+
+    def forward(self, feats):
+        h = self.head(self.encoder(feats))
+        return F.log_softmax(h, axis=-1).transpose([1, 0, 2])
+
+
+class ConformerForRNNT(nn.Layer):
+    """Encoder + LSTM predictor + additive joint network -> RNN-T logits
+    [B, T', U+1, V] for F.rnnt_loss."""
+
+    def __init__(self, cfg: ConformerConfig, predictor_hidden=None):
+        super().__init__()
+        ph = predictor_hidden or cfg.hidden
+        self.encoder = ConformerEncoder(cfg)
+        self.embed = nn.Embedding(cfg.vocab_size, ph)
+        self.predictor = nn.LSTM(ph, ph)
+        self.enc_proj = nn.Linear(cfg.hidden, ph)
+        self.joint = nn.Linear(ph, cfg.vocab_size)
+
+    def forward(self, feats, labels):
+        from .. import ops as P
+
+        enc = self.enc_proj(self.encoder(feats))  # [B, T', H]
+        emb = self.embed(labels)  # [B, U, H]
+        b = emb.shape[0]
+        bos = P.zeros([b, 1, emb.shape[2]], "float32")
+        pred_in = P.concat([bos, emb], axis=1)  # [B, U+1, H]
+        pred, _ = self.predictor(pred_in)
+        joint = enc.unsqueeze(2) + pred.unsqueeze(1)  # [B, T', U+1, H]
+        return self.joint(F.swish(joint))

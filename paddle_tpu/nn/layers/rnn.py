@@ -251,7 +251,17 @@ class RNN(Layer):
         outs = []
         order = range(T - 1, -1, -1) if self.is_reverse else range(T)
         for t in order:
-            out, states = self.cell(x[:, t], states)
+            out, new_states = self.cell(x[:, t], states)
+            if sequence_length is not None:
+                # same masking semantics as the scan path: padded steps keep
+                # the previous state and emit zeros
+                valid = (sequence_length > t).unsqueeze(-1)
+                out = P.where(valid, out, P.zeros_like(out))
+                states = jax.tree_util.tree_map(
+                    lambda new, old: P.where(valid, new, old),
+                    new_states, states)
+            else:
+                states = new_states
             outs.append(out)
         if self.is_reverse:
             outs = outs[::-1]

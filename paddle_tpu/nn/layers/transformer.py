@@ -57,7 +57,9 @@ class MultiHeadAttention(Layer):
             return self.StaticCache(k, v)
         if value is None:
             b = key.shape[0]
-            k = P.zeros([b, 0, self.num_heads, self.head_dim], "float32")
+            # seed with the key's dtype: an f32 empty cache would promote
+            # every later concat (and so the whole decode) out of bf16
+            k = P.zeros([b, 0, self.num_heads, self.head_dim], key.dtype)
             return self.Cache(k, k)
         return self.Cache(self._split_heads(self.k_proj(key)),
                           self._split_heads(self.v_proj(value)))

@@ -285,8 +285,8 @@ def _nanmedian(x, axis=None, keepdim=False):
 def _multiplex(inputs, index):
     stacked = jnp.stack(inputs, axis=0)  # [n, batch, ...]
     idx = index.reshape(-1).astype(jnp.int32)
-    return jnp.take_along_axis(
-        stacked, idx[None, :, *([None] * (stacked.ndim - 2))], axis=0)[0]
+    idx = idx.reshape((1, -1) + (1,) * (stacked.ndim - 2))
+    return jnp.take_along_axis(stacked, idx, axis=0)[0]
 
 
 @defop("inverse")
@@ -1207,6 +1207,7 @@ def _register_aliases():
     _alias("nll_loss", F.nll_loss)
     _alias("cross_entropy_with_softmax", F.softmax_with_cross_entropy)
     _alias("warpctc", F.ctc_loss)
+    _alias("warprnnt", F.rnnt_loss)
     _alias("flash_attn", F.flash_attention)
     _alias("flash_attn_unpadded", F.flash_attention)
     _alias("memory_efficient_attention", F.scaled_dot_product_attention)

@@ -1,0 +1,108 @@
+"""Flags tier, nan/inf checker, launch CLI, packaging (VERDICT item #10)."""
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestFlags:
+    def test_set_get_roundtrip(self):
+        assert paddle.get_flags("FLAGS_check_nan_inf") == {
+            "FLAGS_check_nan_inf": False}
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            assert paddle.get_flags(["FLAGS_check_nan_inf"])[
+                "FLAGS_check_nan_inf"] is True
+        finally:
+            paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+    def test_unknown_flag_raises(self):
+        with pytest.raises(ValueError, match="unknown flag"):
+            paddle.set_flags({"FLAGS_not_a_flag": 1})
+        with pytest.raises(ValueError, match="unknown flag"):
+            paddle.get_flags("FLAGS_not_a_flag")
+
+    def test_check_nan_inf_names_the_op(self):
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            x = paddle.to_tensor(np.array([0.0, 1.0], np.float32))
+            with pytest.raises(RuntimeError, match=r"op 'log'.*Inf"):
+                paddle.log(x)  # log(0) = -inf
+        finally:
+            paddle.set_flags({"FLAGS_check_nan_inf": False})
+        # disabled again: no raise
+        paddle.log(paddle.to_tensor(np.array([0.0], np.float32)))
+
+
+class TestLaunchCLI:
+    def test_two_process_cpu_launch(self, tmp_path):
+        """The CLI must lay out rank env, bootstrap jax.distributed across 2
+        CPU processes, and collect both exits (reference collective
+        controller behavior)."""
+        script = tmp_path / "worker.py"
+        script.write_text(textwrap.dedent("""
+            import os
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import paddle_tpu as paddle
+            import paddle_tpu.distributed as dist
+            import jax
+
+            env = dist.init_parallel_env()
+            world = int(os.environ["PADDLE_TRAINERS_NUM"])
+            rank = int(os.environ["PADDLE_TRAINER_ID"])
+            assert jax.process_count() == world, jax.process_count()
+            assert jax.process_index() == rank
+            out = os.environ["TEST_OUT_DIR"]
+            with open(os.path.join(out, f"ok.{rank}"), "w") as f:
+                f.write(f"{rank}/{world}")
+        """))
+        out_dir = tmp_path / "out"
+        out_dir.mkdir()
+        env = dict(os.environ, TEST_OUT_DIR=str(out_dir), JAX_PLATFORMS="cpu",
+                   PYTHONPATH=REPO)
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node", "2", "--backend", "cpu",
+             "--log_dir", str(tmp_path / "log"), str(script)],
+            cwd=REPO, env=env, timeout=300, capture_output=True, text=True)
+        logs = ""
+        logdir = tmp_path / "log"
+        if logdir.exists():
+            for f in sorted(logdir.iterdir()):
+                logs += f"\n--- {f.name} ---\n" + f.read_text()
+        assert r.returncode == 0, f"launch failed: {r.stderr}\n{logs}"
+        assert (out_dir / "ok.0").exists() and (out_dir / "ok.1").exists(), logs
+
+    def test_failure_aborts_pod(self, tmp_path):
+        script = tmp_path / "boom.py"
+        script.write_text(
+            "import os, sys, time\n"
+            "rank = int(os.environ['PADDLE_TRAINER_ID'])\n"
+            "sys.exit(3) if rank == 1 else time.sleep(60)\n")
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node", "2", "--log_dir", str(tmp_path / "log"),
+             str(script)],
+            cwd=REPO, env=dict(os.environ, PYTHONPATH=REPO),
+            timeout=120, capture_output=True, text=True)
+        assert r.returncode == 3
+        assert "rank 1 failed" in r.stderr
+
+
+class TestPackaging:
+    def test_pyproject_is_installable_metadata(self):
+        # cheap structural check (full pip install -e is exercised by CI
+        # tooling, not unit tests): the build backend can see the package
+        import tomllib
+
+        with open(os.path.join(REPO, "pyproject.toml"), "rb") as f:
+            meta = tomllib.load(f)
+        assert meta["project"]["name"] == "paddle-tpu"
+        assert "jax" in meta["project"]["dependencies"]

@@ -74,7 +74,9 @@ def test_load_executes_without_original_python(tmp_path):
     subprocess.run([sys.executable, "-c", child], check=True,
                    cwd=repo_root, timeout=300)
     got = np.load(str(tmp_path / "out.npy"))
-    np.testing.assert_allclose(got, expected, atol=1e-5, rtol=1e-5)
+    # the child may execute on a different backend (chip vs pinned-CPU
+    # parent): allow f32 matmul cross-platform noise
+    np.testing.assert_allclose(got, expected, atol=5e-4, rtol=1e-4)
 
 
 def test_predictor_handle_workflow(tmp_path):
