@@ -80,6 +80,8 @@ for _k, _v in _ops_parity.PUBLIC_OPS.items():
         globals()[_k] = _v
 del _k, _v
 from . import fft  # noqa: F401,E402
+from . import distribution  # noqa: F401,E402
+from . import sparse  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 

@@ -1,0 +1,54 @@
+"""paddle.distribution parity (reference
+/root/reference/python/paddle/distribution/ — ~6K LoC of Distribution
+subclasses, transforms, and the KL registry).
+
+TPU-native: every density/statistic is a jnp formula routed through the
+dispatch tape (so log_prob/entropy are differentiable wrt parameters — the
+reference gets this from dygraph autograd), and sampling draws from
+framework.random's key stream so ``paddle.seed`` reproduces draws.
+"""
+from .distributions import (  # noqa: F401
+    Bernoulli,
+    Beta,
+    Categorical,
+    Cauchy,
+    Dirichlet,
+    Distribution,
+    Exponential,
+    ExponentialFamily,
+    Geometric,
+    Gumbel,
+    Independent,
+    Laplace,
+    LogNormal,
+    Multinomial,
+    Normal,
+    Uniform,
+)
+from .kl import kl_divergence, register_kl  # noqa: F401
+from .transform import (  # noqa: F401
+    AbsTransform,
+    AffineTransform,
+    ChainTransform,
+    ExpTransform,
+    PowerTransform,
+    ReshapeTransform,
+    SigmoidTransform,
+    SoftmaxTransform,
+    StackTransform,
+    StickBreakingTransform,
+    TanhTransform,
+    Transform,
+)
+from .transformed_distribution import TransformedDistribution  # noqa: F401
+
+__all__ = [
+    "Distribution", "ExponentialFamily", "Normal", "Uniform", "Categorical",
+    "Bernoulli", "Beta", "Cauchy", "Dirichlet", "Exponential", "Geometric",
+    "Gumbel", "Independent", "Laplace", "LogNormal", "Multinomial",
+    "TransformedDistribution", "kl_divergence", "register_kl",
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "PowerTransform", "ReshapeTransform", "SigmoidTransform",
+    "SoftmaxTransform", "StackTransform", "StickBreakingTransform",
+    "TanhTransform",
+]

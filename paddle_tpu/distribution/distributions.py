@@ -1,0 +1,530 @@
+"""Distribution classes (reference python/paddle/distribution/*.py).
+
+Each statistic is the published closed form as a jnp body dispatched through
+``apply`` (differentiable wrt Tensor parameters); ``sample`` uses jax.random
+with keys from the global stream. Shapes follow the reference convention:
+``batch_shape`` from broadcast parameters, ``sample(shape)`` prepends shape.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor, to_tensor
+from ..framework.random import next_key
+
+__all__ = [
+    "Distribution", "ExponentialFamily", "Normal", "Uniform", "Categorical",
+    "Bernoulli", "Beta", "Cauchy", "Dirichlet", "Exponential", "Geometric",
+    "Gumbel", "Independent", "Laplace", "LogNormal", "Multinomial",
+]
+
+
+def _p(x, dtype="float32"):
+    """Coerce a parameter to Tensor."""
+    if isinstance(x, Tensor):
+        return x
+    return to_tensor(np.asarray(x, dtype))
+
+
+def _shape(sample_shape):
+    if sample_shape is None:
+        return ()
+    if isinstance(sample_shape, int):
+        return (sample_shape,)
+    return tuple(int(s) for s in sample_shape)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return apply(jnp.exp, self.log_prob(value), op_name="exp")
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+
+        return kl_divergence(self, other)
+
+    def _op(self, body, *args, name="dist_op"):
+        return apply(body, *args, op_name=name)
+
+
+class ExponentialFamily(Distribution):
+    """Marker base (reference exponential_family.py keeps a Bregman-based
+    generic KL; concrete pairs here register closed forms in kl.py)."""
+
+
+class Normal(ExponentialFamily):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _p(loc)
+        self.scale = _p(scale)
+        shape = jnp.broadcast_shapes(self.loc._value.shape, self.scale._value.shape)
+        super().__init__(shape)
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return self._op(lambda s: jnp.square(s), self.scale, name="square")
+
+    @property
+    def stddev(self):
+        return self.scale
+
+    def sample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        eps = jax.random.normal(next_key(), shape)
+        return self._op(lambda l, s: l + s * eps, self.loc, self.scale,
+                        name="normal_sample")
+
+    rsample = sample
+
+    def log_prob(self, value):
+        return self._op(
+            lambda v, l, s: -0.5 * jnp.square((v - l) / s)
+            - jnp.log(s) - 0.5 * math.log(2 * math.pi),
+            _p(value), self.loc, self.scale, name="normal_log_prob")
+
+    def entropy(self):
+        return self._op(
+            lambda l, s: 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s)
+            + jnp.zeros_like(l),
+            self.loc, self.scale, name="normal_entropy")
+
+    def probs(self, value):
+        return self.prob(value)
+
+
+class LogNormal(ExponentialFamily):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _p(loc)
+        self.scale = _p(scale)
+        self._base = Normal(loc, scale)
+        super().__init__(self._base.batch_shape)
+
+    @property
+    def mean(self):
+        return self._op(lambda l, s: jnp.exp(l + jnp.square(s) / 2),
+                        self.loc, self.scale, name="lognormal_mean")
+
+    @property
+    def variance(self):
+        return self._op(
+            lambda l, s: (jnp.exp(jnp.square(s)) - 1)
+            * jnp.exp(2 * l + jnp.square(s)),
+            self.loc, self.scale, name="lognormal_var")
+
+    def sample(self, shape=()):
+        return apply(jnp.exp, self._base.sample(shape), op_name="exp")
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _p(value)
+        return self._op(
+            lambda v, l, s: -0.5 * jnp.square((jnp.log(v) - l) / s)
+            - jnp.log(s * v) - 0.5 * math.log(2 * math.pi),
+            v, self.loc, self.scale, name="lognormal_log_prob")
+
+    def entropy(self):
+        return self._op(
+            lambda l, s: 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s) + l,
+            self.loc, self.scale, name="lognormal_entropy")
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _p(low)
+        self.high = _p(high)
+        shape = jnp.broadcast_shapes(self.low._value.shape, self.high._value.shape)
+        super().__init__(shape)
+
+    def sample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        u = jax.random.uniform(next_key(), shape)
+        return self._op(lambda lo, hi: lo + (hi - lo) * u, self.low, self.high,
+                        name="uniform_sample")
+
+    rsample = sample
+
+    def log_prob(self, value):
+        return self._op(
+            lambda v, lo, hi: jnp.where(
+                (v >= lo) & (v < hi), -jnp.log(hi - lo), -jnp.inf),
+            _p(value), self.low, self.high, name="uniform_log_prob")
+
+    def entropy(self):
+        return self._op(lambda lo, hi: jnp.log(hi - lo), self.low, self.high,
+                        name="uniform_entropy")
+
+
+class Bernoulli(ExponentialFamily):
+    def __init__(self, probs, name=None):
+        self.probs = _p(probs)
+        super().__init__(self.probs._value.shape)
+
+    @property
+    def mean(self):
+        return self.probs
+
+    @property
+    def variance(self):
+        return self._op(lambda p: p * (1 - p), self.probs, name="bern_var")
+
+    def sample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        u = jax.random.uniform(next_key(), shape)
+        return self._op(lambda p: (u < p).astype(jnp.float32), self.probs,
+                        name="bern_sample")
+
+    def log_prob(self, value):
+        return self._op(
+            lambda v, p: v * jnp.log(jnp.clip(p, 1e-12))
+            + (1 - v) * jnp.log(jnp.clip(1 - p, 1e-12)),
+            _p(value), self.probs, name="bern_log_prob")
+
+    def entropy(self):
+        return self._op(
+            lambda p: -(p * jnp.log(jnp.clip(p, 1e-12))
+                        + (1 - p) * jnp.log(jnp.clip(1 - p, 1e-12))),
+            self.probs, name="bern_entropy")
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = _p(logits)
+        super().__init__(self.logits._value.shape[:-1])
+
+    def _log_pmf(self):
+        return self._op(lambda lg: jax.nn.log_softmax(lg, axis=-1),
+                        self.logits, name="cat_log_pmf")
+
+    def sample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        out = jax.random.categorical(next_key(), self.logits._value,
+                                     shape=shape)
+        return Tensor._wrap(out.astype(jnp.int64))
+
+    def log_prob(self, value):
+        return self._op(
+            lambda v, lg: jnp.take_along_axis(
+                jax.nn.log_softmax(lg, axis=-1),
+                v.astype(jnp.int32)[..., None], axis=-1).squeeze(-1),
+            _p(value, "int64"), self.logits, name="cat_log_prob")
+
+    def probs(self, value):
+        return self.prob(value)
+
+    def entropy(self):
+        return self._op(
+            lambda lg: -jnp.sum(
+                jax.nn.softmax(lg, -1) * jax.nn.log_softmax(lg, -1), axis=-1),
+            self.logits, name="cat_entropy")
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _p(probs)
+        super().__init__(self.probs._value.shape[:-1],
+                         self.probs._value.shape[-1:])
+
+    def sample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        logits = jnp.log(jnp.clip(self.probs._value, 1e-12))
+        draws = jax.random.categorical(
+            next_key(), logits, shape=(self.total_count,) + shape)
+        k = self.probs._value.shape[-1]
+        counts = jax.nn.one_hot(draws, k).sum(axis=0)
+        return Tensor._wrap(counts.astype(jnp.float32))
+
+    def log_prob(self, value):
+        def body(v, p):
+            logp = jnp.log(jnp.clip(p, 1e-12))
+            return (jax.scipy.special.gammaln(v.sum(-1) + 1)
+                    - jnp.sum(jax.scipy.special.gammaln(v + 1), -1)
+                    + jnp.sum(v * logp, -1))
+
+        return self._op(body, _p(value), self.probs, name="multinomial_log_prob")
+
+    def entropy(self):
+        # no closed form; Monte-Carlo estimate (reference uses the same idea
+        # for generic distributions)
+        samples = self.sample((128,))
+        lp = self.log_prob(samples)
+        return apply(lambda x: -jnp.mean(x, axis=0), lp, op_name="mean")
+
+
+class Beta(ExponentialFamily):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _p(alpha)
+        self.beta = _p(beta)
+        shape = jnp.broadcast_shapes(self.alpha._value.shape,
+                                     self.beta._value.shape)
+        super().__init__(shape)
+
+    @property
+    def mean(self):
+        return self._op(lambda a, b: a / (a + b), self.alpha, self.beta,
+                        name="beta_mean")
+
+    @property
+    def variance(self):
+        return self._op(
+            lambda a, b: a * b / (jnp.square(a + b) * (a + b + 1)),
+            self.alpha, self.beta, name="beta_var")
+
+    def sample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        out = jax.random.beta(next_key(), self.alpha._value, self.beta._value,
+                              shape=shape)
+        return Tensor._wrap(out)
+
+    def log_prob(self, value):
+        def body(v, a, b):
+            betaln = (jax.scipy.special.gammaln(a)
+                      + jax.scipy.special.gammaln(b)
+                      - jax.scipy.special.gammaln(a + b))
+            return (a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v) - betaln
+
+        return self._op(body, _p(value), self.alpha, self.beta,
+                        name="beta_log_prob")
+
+    def entropy(self):
+        def body(a, b):
+            dg = jax.scipy.special.digamma
+            betaln = (jax.scipy.special.gammaln(a)
+                      + jax.scipy.special.gammaln(b)
+                      - jax.scipy.special.gammaln(a + b))
+            return (betaln - (a - 1) * dg(a) - (b - 1) * dg(b)
+                    + (a + b - 2) * dg(a + b))
+
+        return self._op(body, self.alpha, self.beta, name="beta_entropy")
+
+
+class Dirichlet(ExponentialFamily):
+    def __init__(self, concentration, name=None):
+        self.concentration = _p(concentration)
+        super().__init__(self.concentration._value.shape[:-1],
+                         self.concentration._value.shape[-1:])
+
+    def sample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        out = jax.random.dirichlet(next_key(), self.concentration._value,
+                                   shape=shape)
+        return Tensor._wrap(out)
+
+    def log_prob(self, value):
+        def body(v, c):
+            norm = (jnp.sum(jax.scipy.special.gammaln(c), -1)
+                    - jax.scipy.special.gammaln(jnp.sum(c, -1)))
+            return jnp.sum((c - 1) * jnp.log(v), -1) - norm
+
+        return self._op(body, _p(value), self.concentration,
+                        name="dirichlet_log_prob")
+
+    def entropy(self):
+        def body(c):
+            dg = jax.scipy.special.digamma
+            k = c.shape[-1]
+            c0 = jnp.sum(c, -1)
+            norm = (jnp.sum(jax.scipy.special.gammaln(c), -1)
+                    - jax.scipy.special.gammaln(c0))
+            return (norm + (c0 - k) * dg(c0)
+                    - jnp.sum((c - 1) * dg(c), -1))
+
+        return self._op(body, self.concentration, name="dirichlet_entropy")
+
+
+class Exponential(ExponentialFamily):
+    def __init__(self, rate, name=None):
+        self.rate = _p(rate)
+        super().__init__(self.rate._value.shape)
+
+    @property
+    def mean(self):
+        return self._op(lambda r: 1.0 / r, self.rate, name="exp_mean")
+
+    def sample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        e = jax.random.exponential(next_key(), shape)
+        return self._op(lambda r: e / r, self.rate, name="exp_sample")
+
+    rsample = sample
+
+    def log_prob(self, value):
+        return self._op(
+            lambda v, r: jnp.where(v >= 0, jnp.log(r) - r * v, -jnp.inf),
+            _p(value), self.rate, name="exp_log_prob")
+
+    def entropy(self):
+        return self._op(lambda r: 1.0 - jnp.log(r), self.rate,
+                        name="exp_entropy")
+
+
+class Geometric(ExponentialFamily):
+    """P(X=k) = (1-p)^k p, k = 0, 1, ... (failures before first success)."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _p(probs)
+        super().__init__(self.probs._value.shape)
+
+    @property
+    def mean(self):
+        return self._op(lambda p: (1 - p) / p, self.probs, name="geom_mean")
+
+    def sample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        u = jax.random.uniform(next_key(), shape, minval=1e-12)
+        return self._op(
+            lambda p: jnp.floor(jnp.log(u) / jnp.log1p(-p)),
+            self.probs, name="geom_sample")
+
+    def log_prob(self, value):
+        return self._op(
+            lambda v, p: v * jnp.log1p(-p) + jnp.log(p),
+            _p(value), self.probs, name="geom_log_prob")
+
+    def entropy(self):
+        return self._op(
+            lambda p: -((1 - p) * jnp.log1p(-p) + p * jnp.log(p)) / p,
+            self.probs, name="geom_entropy")
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _p(loc)
+        self.scale = _p(scale)
+        shape = jnp.broadcast_shapes(self.loc._value.shape,
+                                     self.scale._value.shape)
+        super().__init__(shape)
+
+    def sample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        c = jax.random.cauchy(next_key(), shape)
+        return self._op(lambda l, s: l + s * c, self.loc, self.scale,
+                        name="cauchy_sample")
+
+    rsample = sample
+
+    def log_prob(self, value):
+        return self._op(
+            lambda v, l, s: -math.log(math.pi) - jnp.log(s)
+            - jnp.log1p(jnp.square((v - l) / s)),
+            _p(value), self.loc, self.scale, name="cauchy_log_prob")
+
+    def entropy(self):
+        return self._op(
+            lambda l, s: math.log(4 * math.pi) + jnp.log(s) + jnp.zeros_like(l),
+            self.loc, self.scale, name="cauchy_entropy")
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _p(loc)
+        self.scale = _p(scale)
+        shape = jnp.broadcast_shapes(self.loc._value.shape,
+                                     self.scale._value.shape)
+        super().__init__(shape)
+
+    def sample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        e = jax.random.laplace(next_key(), shape)
+        return self._op(lambda l, s: l + s * e, self.loc, self.scale,
+                        name="laplace_sample")
+
+    rsample = sample
+
+    def log_prob(self, value):
+        return self._op(
+            lambda v, l, s: -jnp.abs(v - l) / s - jnp.log(2 * s),
+            _p(value), self.loc, self.scale, name="laplace_log_prob")
+
+    def entropy(self):
+        return self._op(
+            lambda l, s: 1 + jnp.log(2 * s) + jnp.zeros_like(l),
+            self.loc, self.scale, name="laplace_entropy")
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _p(loc)
+        self.scale = _p(scale)
+        shape = jnp.broadcast_shapes(self.loc._value.shape,
+                                     self.scale._value.shape)
+        super().__init__(shape)
+
+    def sample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        g = jax.random.gumbel(next_key(), shape)
+        return self._op(lambda l, s: l + s * g, self.loc, self.scale,
+                        name="gumbel_sample")
+
+    rsample = sample
+
+    def log_prob(self, value):
+        return self._op(
+            lambda v, l, s: -(v - l) / s - jnp.exp(-(v - l) / s) - jnp.log(s),
+            _p(value), self.loc, self.scale, name="gumbel_log_prob")
+
+    def entropy(self):
+        euler = 0.5772156649015329
+        return self._op(
+            lambda l, s: jnp.log(s) + 1 + euler + jnp.zeros_like(l),
+            self.loc, self.scale, name="gumbel_entropy")
+
+
+class Independent(Distribution):
+    """Reinterpret trailing batch dims as event dims (reference
+    independent.py)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        bs = base.batch_shape
+        super().__init__(bs[: len(bs) - self.rank], bs[len(bs) - self.rank:]
+                         + base.event_shape)
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        return apply(
+            lambda x: jnp.sum(x, axis=tuple(range(-self.rank, 0))),
+            lp, op_name="independent_sum")
+
+    def entropy(self):
+        ent = self.base.entropy()
+        return apply(
+            lambda x: jnp.sum(x, axis=tuple(range(-self.rank, 0))),
+            ent, op_name="independent_sum")

@@ -1,0 +1,173 @@
+"""KL-divergence registry (reference python/paddle/distribution/kl.py:37,69 —
+kl_divergence dispatch over a (type_p, type_q) registration table with
+most-derived-match resolution)."""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+from jax.scipy.special import digamma, gammaln
+
+from ..core.dispatch import apply
+from .distributions import (
+    Bernoulli,
+    Beta,
+    Categorical,
+    Dirichlet,
+    Distribution,
+    Exponential,
+    Geometric,
+    Gumbel,
+    Laplace,
+    LogNormal,
+    Normal,
+    Uniform,
+)
+
+__all__ = ["kl_divergence", "register_kl"]
+
+_REGISTRY: dict[tuple, callable] = {}
+
+
+def register_kl(cls_p, cls_q):
+    if not (issubclass(cls_p, Distribution) and issubclass(cls_q, Distribution)):
+        raise TypeError("cls_p and cls_q must be subclass of Distribution")
+
+    def deco(fn):
+        _REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+
+    return deco
+
+
+def kl_divergence(p, q):
+    # most-derived registered match (reference _dispatch total-order search)
+    matches = [
+        (cp, cq) for (cp, cq) in _REGISTRY
+        if isinstance(p, cp) and isinstance(q, cq)
+    ]
+    if not matches:
+        raise NotImplementedError(
+            f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+
+    def depth(pair):
+        cp, cq = pair
+        return (type(p).__mro__.index(cp), type(q).__mro__.index(cq))
+
+    cp, cq = min(matches, key=depth)
+    return _REGISTRY[(cp, cq)](p, q)
+
+
+def _op(body, *tensors, name):
+    return apply(body, *tensors, op_name=name)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    return _op(
+        lambda l1, s1, l2, s2: jnp.log(s2 / s1)
+        + (jnp.square(s1) + jnp.square(l1 - l2)) / (2 * jnp.square(s2)) - 0.5,
+        p.loc, p.scale, q.loc, q.scale, name="kl_normal")
+
+
+@register_kl(LogNormal, LogNormal)
+def _kl_lognormal(p, q):
+    # KL is invariant under the shared exp() pushforward
+    return _kl_normal_normal(p._base, q._base)
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    return _op(
+        lambda al, ah, bl, bh: jnp.where(
+            (bl <= al) & (ah <= bh),
+            jnp.log((bh - bl) / (ah - al)), jnp.inf),
+        p.low, p.high, q.low, q.high, name="kl_uniform")
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    def body(a, b):
+        a = jnp.clip(a, 1e-12, 1 - 1e-12)
+        b = jnp.clip(b, 1e-12, 1 - 1e-12)
+        return a * jnp.log(a / b) + (1 - a) * jnp.log((1 - a) / (1 - b))
+
+    return _op(body, p.probs, q.probs, name="kl_bernoulli")
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    def body(lp, lq):
+        import jax
+
+        a = jax.nn.log_softmax(lp, -1)
+        b = jax.nn.log_softmax(lq, -1)
+        return jnp.sum(jnp.exp(a) * (a - b), -1)
+
+    return _op(body, p.logits, q.logits, name="kl_categorical")
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    def body(a1, b1, a2, b2):
+        def betaln(a, b):
+            return gammaln(a) + gammaln(b) - gammaln(a + b)
+
+        return (betaln(a2, b2) - betaln(a1, b1)
+                + (a1 - a2) * digamma(a1) + (b1 - b2) * digamma(b1)
+                + (a2 - a1 + b2 - b1) * digamma(a1 + b1))
+
+    return _op(body, p.alpha, p.beta, q.alpha, q.beta, name="kl_beta")
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet(p, q):
+    def body(c1, c2):
+        s1 = jnp.sum(c1, -1)
+        return (gammaln(s1) - jnp.sum(gammaln(c1), -1)
+                - gammaln(jnp.sum(c2, -1)) + jnp.sum(gammaln(c2), -1)
+                + jnp.sum((c1 - c2) * (digamma(c1)
+                                       - digamma(s1)[..., None]), -1))
+
+    return _op(body, p.concentration, q.concentration, name="kl_dirichlet")
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    return _op(
+        lambda r1, r2: jnp.log(r1 / r2) + r2 / r1 - 1.0,
+        p.rate, q.rate, name="kl_exponential")
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric(p, q):
+    def body(a, b):
+        return (-(1 - a) / a * jnp.log1p(-b) - jnp.log(b)
+                + (1 - a) / a * jnp.log1p(-a) + jnp.log(a))
+
+    return _op(body, p.probs, q.probs, name="kl_geometric")
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace(p, q):
+    def body(l1, s1, l2, s2):
+        d = jnp.abs(l1 - l2)
+        return (jnp.log(s2 / s1) + d / s2
+                + s1 / s2 * jnp.exp(-d / s1) - 1.0)
+
+    return _op(body, p.loc, p.scale, q.loc, q.scale, name="kl_laplace")
+
+
+@register_kl(Gumbel, Gumbel)
+def _kl_gumbel(p, q):
+    euler = 0.5772156649015329
+
+    def body(l1, s1, l2, s2):
+        # E_p[(X - l2)/s2] = (l1 - l2)/s2 + euler*s1/s2;
+        # E_p[exp(-(X-l2)/s2)] = exp((l2-l1)/s2) * Gamma(1 + s1/s2)
+        t = s1 / s2
+        return (jnp.log(s2 / s1) + euler * t - 1.0 - euler
+                + (l1 - l2) / s2
+                + jnp.exp((l2 - l1) / s2 + gammaln(1.0 + t)))
+
+    return _op(body, p.loc, p.scale, q.loc, q.scale, name="kl_gumbel")

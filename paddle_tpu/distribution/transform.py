@@ -1,0 +1,258 @@
+"""Bijective transforms (reference python/paddle/distribution/transform.py):
+forward/inverse + log|det J| for TransformedDistribution."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = [
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "PowerTransform", "ReshapeTransform", "SigmoidTransform",
+    "SoftmaxTransform", "StackTransform", "StickBreakingTransform",
+    "TanhTransform",
+]
+
+
+def _op(body, *args, name):
+    return apply(body, *args, op_name=name)
+
+
+class Transform:
+    _event_rank = 0  # dims consumed by one application
+
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+    def inverse_log_det_jacobian(self, y):
+        return _op(lambda a: -a,
+                   self.forward_log_det_jacobian(self.inverse(y)), name="neg")
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        return _op(jnp.exp, x, name="exp")
+
+    def inverse(self, y):
+        return _op(jnp.log, y, name="log")
+
+    def forward_log_det_jacobian(self, x):
+        return _op(lambda v: v, x, name="identity")
+
+
+class AbsTransform(Transform):
+    def forward(self, x):
+        return _op(jnp.abs, x, name="abs")
+
+    def inverse(self, y):
+        return y  # one branch of the two-valued inverse (reference behavior)
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError("AbsTransform is not bijective")
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = loc if isinstance(loc, Tensor) else to_tensor(loc)
+        self.scale = scale if isinstance(scale, Tensor) else to_tensor(scale)
+
+    def forward(self, x):
+        return _op(lambda v, l, s: l + s * v, x, self.loc, self.scale,
+                   name="affine_fwd")
+
+    def inverse(self, y):
+        return _op(lambda v, l, s: (v - l) / s, y, self.loc, self.scale,
+                   name="affine_inv")
+
+    def forward_log_det_jacobian(self, x):
+        return _op(lambda v, s: jnp.broadcast_to(jnp.log(jnp.abs(s)), v.shape),
+                   x, self.scale, name="affine_logdet")
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = power if isinstance(power, Tensor) else to_tensor(power)
+
+    def forward(self, x):
+        return _op(lambda v, p: jnp.power(v, p), x, self.power, name="pow")
+
+    def inverse(self, y):
+        return _op(lambda v, p: jnp.power(v, 1.0 / p), y, self.power,
+                   name="pow_inv")
+
+    def forward_log_det_jacobian(self, x):
+        return _op(
+            lambda v, p: jnp.log(jnp.abs(p * jnp.power(v, p - 1))),
+            x, self.power, name="pow_logdet")
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        return _op(lambda v: 1 / (1 + jnp.exp(-v)), x, name="sigmoid")
+
+    def inverse(self, y):
+        return _op(lambda v: jnp.log(v) - jnp.log1p(-v), y, name="logit")
+
+    def forward_log_det_jacobian(self, x):
+        return _op(
+            lambda v: -v - 2 * jnp.log1p(jnp.exp(-v)), x,
+            name="sigmoid_logdet")
+
+
+class TanhTransform(Transform):
+    def forward(self, x):
+        return _op(jnp.tanh, x, name="tanh")
+
+    def inverse(self, y):
+        return _op(jnp.arctanh, y, name="atanh")
+
+    def forward_log_det_jacobian(self, x):
+        # log(1 - tanh(x)^2) = 2 (log2 - x - softplus(-2x))
+        return _op(
+            lambda v: 2.0 * (jnp.log(2.0) - v - jnp.logaddexp(0.0, -2.0 * v)),
+            x, name="tanh_logdet")
+
+
+class SoftmaxTransform(Transform):
+    _event_rank = 1
+
+    def forward(self, x):
+        import jax
+
+        return _op(lambda v: jax.nn.softmax(v, -1), x, name="softmax_t")
+
+    def inverse(self, y):
+        return _op(jnp.log, y, name="log")
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError("softmax is not square-bijective")
+
+
+class StickBreakingTransform(Transform):
+    _event_rank = 1
+
+    def forward(self, x):
+        def body(v):
+            offset = v.shape[-1] - jnp.cumsum(jnp.ones_like(v), -1) + 1
+            z = 1 / (1 + jnp.exp(-(v - jnp.log(offset))))
+            zc = jnp.cumprod(1 - z, -1)
+            lead = jnp.concatenate([jnp.ones_like(zc[..., :1]), zc], -1)
+            pad_z = jnp.concatenate([z, jnp.ones_like(z[..., :1])], -1)
+            return pad_z * lead
+
+        return _op(body, x, name="stick_fwd")
+
+    def inverse(self, y):
+        def body(v):
+            rem = 1 - jnp.cumsum(v[..., :-1], -1)
+            rem = jnp.concatenate([jnp.ones_like(v[..., :1]), rem[..., :-1]], -1)
+            z = v[..., :-1] / rem
+            offset = z.shape[-1] - jnp.cumsum(jnp.ones_like(z), -1) + 1
+            return jnp.log(z / (1 - z)) + jnp.log(offset)
+
+        return _op(body, y, name="stick_inv")
+
+    def forward_log_det_jacobian(self, x):
+        def body(v):
+            offset = v.shape[-1] - jnp.cumsum(jnp.ones_like(v), -1) + 1
+            t = v - jnp.log(offset)
+            z = 1 / (1 + jnp.exp(-t))
+            zc = jnp.cumprod(1 - z, -1)
+            lead = jnp.concatenate([jnp.ones_like(zc[..., :1]), zc[..., :-1]], -1)
+            return jnp.sum(jnp.log(z) + jnp.log1p(-z) + jnp.log(lead), -1)
+
+        return _op(body, x, name="stick_logdet")
+
+
+class ReshapeTransform(Transform):
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+        self._event_rank = len(self.in_event_shape)
+
+    def forward(self, x):
+        def body(v):
+            lead = v.shape[: v.ndim - len(self.in_event_shape)]
+            return v.reshape(lead + self.out_event_shape)
+
+        return _op(body, x, name="reshape_t")
+
+    def inverse(self, y):
+        def body(v):
+            lead = v.shape[: v.ndim - len(self.out_event_shape)]
+            return v.reshape(lead + self.in_event_shape)
+
+        return _op(body, y, name="reshape_t_inv")
+
+    def forward_log_det_jacobian(self, x):
+        def body(v):
+            lead = v.shape[: v.ndim - len(self.in_event_shape)]
+            return jnp.zeros(lead)
+
+        return _op(body, x, name="zeros")
+
+
+class StackTransform(Transform):
+    """Apply transforms[i] along slices of `axis` (reference StackTransform)."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = axis
+
+    def _map(self, fn_name, x):
+        from .. import ops as P
+
+        parts = P.unstack(x, axis=self.axis)
+        outs = [getattr(t, fn_name)(p)
+                for t, p in zip(self.transforms, parts)]
+        return P.stack(outs, axis=self.axis)
+
+    def forward(self, x):
+        return self._map("forward", x)
+
+    def inverse(self, y):
+        return self._map("inverse", y)
+
+    def forward_log_det_jacobian(self, x):
+        return self._map("forward_log_det_jacobian", x)
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+        self._event_rank = max([t._event_rank for t in self.transforms] + [0])
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        total = None
+        for t in self.transforms:
+            ld = t.forward_log_det_jacobian(x)
+            # sum sub-event dims so ranks line up across the chain
+            drop = self._event_rank - t._event_rank
+            if drop > 0:
+                ld = apply(
+                    lambda v, d=drop: jnp.sum(
+                        v, axis=tuple(range(-d, 0))) if v.ndim >= d else v,
+                    ld, op_name="sum")
+            total = ld if total is None else total + ld
+            x = t.forward(x)
+        return total

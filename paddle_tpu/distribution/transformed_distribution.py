@@ -1,0 +1,30 @@
+"""TransformedDistribution (reference transformed_distribution.py):
+push a base distribution through a chain of bijectors."""
+from __future__ import annotations
+
+from .distributions import Distribution
+from .transform import ChainTransform
+
+__all__ = ["TransformedDistribution"]
+
+
+class TransformedDistribution(Distribution):
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transforms = (transforms if isinstance(transforms, (list, tuple))
+                           else [transforms])
+        self._chain = ChainTransform(list(self.transforms))
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        return self._chain.forward(x)
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        return self._chain.forward(x)
+
+    def log_prob(self, value):
+        """log p_Y(y) = log p_X(T^-1(y)) - log|det J_T(T^-1(y))|"""
+        x = self._chain.inverse(value)
+        return self.base.log_prob(x) - self._chain.forward_log_det_jacobian(x)

@@ -1,0 +1,105 @@
+"""paddle.sparse.nn parity-lite (reference python/paddle/sparse/nn/):
+activation layers + softmax + 3D submanifold conv on COO voxels."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..nn.layer import Layer
+
+__all__ = ["ReLU", "Softmax", "SubmConv3D"]
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        from . import relu
+
+        return relu(x)
+
+
+class Softmax(Layer):
+    """Row-wise softmax over the last sparse dim restricted to the nonzero
+    pattern (reference sparse softmax semantics)."""
+
+    def __init__(self, axis=-1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        from . import SparseCooTensor, _as_coo
+
+        if self.axis not in (-1, None):
+            raise NotImplementedError(
+                "sparse Softmax supports the last axis only (reference "
+                "sparse softmax has the same restriction)")
+        x = _as_coo(x)
+        ind = x._bcoo.indices  # [nnz, ndim]
+        # a "row" is one fiber along the last dim: key on ALL leading dims
+        lead_shape = x._bcoo.shape[:-1]
+        rows = jnp.zeros(ind.shape[0], jnp.int32)
+        for d, size in enumerate(lead_shape):
+            rows = rows * size + ind[:, d].astype(jnp.int32)
+        vals = x._bcoo.data
+        n_rows = max(1, int(np.prod(lead_shape)))
+        row_max = jax.ops.segment_max(vals, rows, n_rows)
+        ex = jnp.exp(vals - row_max[rows])
+        denom = jax.ops.segment_sum(ex, rows, n_rows)
+        out = ex / denom[rows]
+        return SparseCooTensor(jsparse.BCOO((out, ind), shape=x._bcoo.shape))
+
+
+class SubmConv3D(Layer):
+    """Submanifold 3D convolution on sparse voxels (reference
+    sparse/nn/layer/conv.py SubmConv3D): outputs keep the input's active
+    sites. Dense-gather implementation: for each active site, gather its
+    kernel-window neighbors via a hash of active coordinates."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, bias_attr=None):
+        super().__init__()
+        if stride not in (1, (1, 1, 1), [1, 1, 1]):
+            # submanifold conv is only pattern-preserving at stride 1; the
+            # reference's strided variant is Conv3D, not SubmConv3D
+            raise NotImplementedError("SubmConv3D supports stride=1 only")
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        k = (kernel_size if isinstance(kernel_size, (list, tuple))
+             else (kernel_size,) * 3)
+        self.kernel_size = tuple(k)
+        self.weight = self.create_parameter(
+            [int(np.prod(k)), in_channels, out_channels])
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter([out_channels], is_bias=True))
+
+    def forward(self, x):
+        """x: SparseCooTensor of shape [N, D, H, W, C] (reference layout)."""
+        from . import SparseCooTensor
+
+        ind = np.asarray(jax.device_get(x._bcoo.indices))  # [nnz, 4] n,d,h,w
+        vals = x._bcoo.data  # [nnz, C]
+        shape = x._bcoo.shape
+        table = {tuple(r): i for i, r in enumerate(ind)}
+        kd, kh, kw = self.kernel_size
+        offs = [(a - kd // 2, b - kh // 2, c - kw // 2)
+                for a in range(kd) for b in range(kh) for c in range(kw)]
+        nnz = ind.shape[0]
+        gathered = []
+        for (da, db, dc) in offs:
+            sel = np.full(nnz, -1, np.int64)
+            for i, (n, d, h, w) in enumerate(ind):
+                j = table.get((n, d + da, h + db, w + dc))
+                if j is not None:
+                    sel[i] = j
+            mask = jnp.asarray(sel >= 0)[:, None]
+            safe = jnp.asarray(np.maximum(sel, 0))
+            gathered.append(jnp.where(mask, vals[safe], 0.0))
+        stacked = jnp.stack(gathered, axis=0)  # [K, nnz, Cin]
+        out = jnp.einsum("kne,keo->no", stacked, self.weight._value)
+        if self.bias is not None:
+            out = out + self.bias._value
+        out_shape = tuple(shape[:-1]) + (self.out_channels,)
+        return SparseCooTensor(
+            jsparse.BCOO((out, x._bcoo.indices), shape=out_shape))

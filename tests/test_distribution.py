@@ -1,0 +1,204 @@
+"""paddle.distribution parity. Oracle: scipy.stats closed forms + sampling
+moments + torch.distributions KL where closed forms exist."""
+import numpy as np
+import pytest
+import scipy.stats as st
+import torch
+import torch.distributions as td
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+
+def _np(t):
+    return np.asarray(t.numpy())
+
+
+class TestLogProbParity:
+    def test_normal(self):
+        d = D.Normal(1.0, 2.0)
+        x = np.linspace(-3, 5, 9).astype(np.float32)
+        np.testing.assert_allclose(_np(d.log_prob(paddle.to_tensor(x))),
+                                   st.norm(1, 2).logpdf(x), rtol=1e-5)
+        np.testing.assert_allclose(_np(d.entropy()), st.norm(1, 2).entropy(),
+                                   rtol=1e-6)
+
+    def test_lognormal(self):
+        d = D.LogNormal(0.3, 0.8)
+        x = np.linspace(0.1, 4, 7).astype(np.float32)
+        np.testing.assert_allclose(
+            _np(d.log_prob(paddle.to_tensor(x))),
+            st.lognorm(s=0.8, scale=np.exp(0.3)).logpdf(x), rtol=1e-5)
+
+    def test_uniform(self):
+        d = D.Uniform(-1.0, 3.0)
+        x = np.array([-0.5, 0.0, 2.9], np.float32)
+        np.testing.assert_allclose(_np(d.log_prob(paddle.to_tensor(x))),
+                                   st.uniform(-1, 4).logpdf(x), rtol=1e-6)
+        assert _np(d.log_prob(paddle.to_tensor(np.float32(5.0)))) == -np.inf
+
+    def test_beta_dirichlet(self):
+        d = D.Beta(2.0, 3.0)
+        x = np.array([0.2, 0.5, 0.9], np.float32)
+        np.testing.assert_allclose(_np(d.log_prob(paddle.to_tensor(x))),
+                                   st.beta(2, 3).logpdf(x), rtol=1e-5)
+        np.testing.assert_allclose(_np(d.entropy()), st.beta(2, 3).entropy(),
+                                   rtol=1e-5)
+        c = np.array([1.5, 2.0, 3.0], np.float32)
+        dd = D.Dirichlet(paddle.to_tensor(c))
+        v = np.array([0.2, 0.3, 0.5], np.float32)
+        np.testing.assert_allclose(_np(dd.log_prob(paddle.to_tensor(v))),
+                                   st.dirichlet(c).logpdf(v), rtol=1e-5)
+
+    def test_discrete(self):
+        b = D.Bernoulli(0.3)
+        np.testing.assert_allclose(
+            _np(b.log_prob(paddle.to_tensor(np.float32(1.0)))),
+            np.log(0.3), rtol=1e-6)
+        logits = np.log(np.array([0.2, 0.5, 0.3], np.float32))
+        c = D.Categorical(paddle.to_tensor(logits))
+        np.testing.assert_allclose(
+            _np(c.log_prob(paddle.to_tensor(np.array(1, np.int64)))),
+            np.log(0.5), rtol=1e-5)
+        np.testing.assert_allclose(
+            _np(c.entropy()), st.entropy([0.2, 0.5, 0.3]), rtol=1e-5)
+        g = D.Geometric(0.25)
+        k = np.array([0.0, 1.0, 4.0], np.float32)
+        np.testing.assert_allclose(_np(g.log_prob(paddle.to_tensor(k))),
+                                   st.geom(0.25, loc=-1).logpmf(k), rtol=1e-5)
+        m = D.Multinomial(5, paddle.to_tensor(
+            np.array([0.2, 0.3, 0.5], np.float32)))
+        v = np.array([1.0, 2.0, 2.0], np.float32)
+        np.testing.assert_allclose(
+            _np(m.log_prob(paddle.to_tensor(v))),
+            st.multinomial(5, [0.2, 0.3, 0.5]).logpmf(v), rtol=1e-5)
+
+    def test_heavy_tails(self):
+        for ours, ref in [
+            (D.Cauchy(0.5, 1.5), st.cauchy(0.5, 1.5)),
+            (D.Laplace(0.5, 1.5), st.laplace(0.5, 1.5)),
+            (D.Gumbel(0.5, 1.5), st.gumbel_r(0.5, 1.5)),
+            (D.Exponential(2.0), st.expon(scale=0.5)),
+        ]:
+            x = np.linspace(0.1, 3, 5).astype(np.float32)
+            np.testing.assert_allclose(_np(ours.log_prob(paddle.to_tensor(x))),
+                                       ref.logpdf(x), rtol=1e-4)
+            np.testing.assert_allclose(_np(ours.entropy()), ref.entropy(),
+                                       rtol=1e-5)
+
+
+class TestSampling:
+    def test_sample_moments(self):
+        paddle.seed(0)
+        n = 20000
+        cases = [
+            (D.Normal(1.0, 2.0), 1.0, 4.0),
+            (D.Uniform(0.0, 4.0), 2.0, 16.0 / 12),
+            (D.Exponential(2.0), 0.5, 0.25),
+            (D.Laplace(1.0, 1.0), 1.0, 2.0),
+            (D.Beta(2.0, 2.0), 0.5, 1.0 / 20),
+        ]
+        for d, mean, var in cases:
+            s = _np(d.sample((n,)))
+            assert abs(s.mean() - mean) < 0.08, type(d).__name__
+            assert abs(s.var() - var) < max(0.15, 0.1 * var), type(d).__name__
+
+    def test_seed_reproducible(self):
+        paddle.seed(42)
+        a = _np(D.Normal(0.0, 1.0).sample((4,)))
+        paddle.seed(42)
+        b = _np(D.Normal(0.0, 1.0).sample((4,)))
+        np.testing.assert_array_equal(a, b)
+
+    def test_categorical_frequencies(self):
+        paddle.seed(1)
+        logits = np.log(np.array([0.1, 0.6, 0.3], np.float32))
+        c = D.Categorical(paddle.to_tensor(logits))
+        s = _np(c.sample((20000,)))
+        freq = np.bincount(s, minlength=3) / len(s)
+        np.testing.assert_allclose(freq, [0.1, 0.6, 0.3], atol=0.02)
+
+
+class TestKL:
+    def test_closed_forms_match_torch(self):
+        pairs = [
+            (D.Normal(0.0, 1.0), D.Normal(1.0, 2.0),
+             td.Normal(0.0, 1.0), td.Normal(1.0, 2.0)),
+            (D.Laplace(0.0, 1.0), D.Laplace(0.5, 2.0),
+             td.Laplace(0.0, 1.0), td.Laplace(0.5, 2.0)),
+            (D.Exponential(2.0), D.Exponential(0.5),
+             td.Exponential(2.0), td.Exponential(0.5)),
+            (D.Beta(2.0, 3.0), D.Beta(1.0, 1.0),
+             td.Beta(2.0, 3.0), td.Beta(1.0, 1.0)),
+            (D.Gumbel(0.0, 1.0), D.Gumbel(0.5, 2.0),
+             td.Gumbel(0.0, 1.0), td.Gumbel(0.5, 2.0)),
+        ]
+        for p, q, tp, tq in pairs:
+            got = float(_np(D.kl_divergence(p, q)))
+            want = float(td.kl_divergence(tp, tq))
+            np.testing.assert_allclose(got, want, rtol=1e-4), type(p).__name__
+
+    def test_categorical_and_dirichlet_kl(self):
+        lp = np.log(np.array([0.2, 0.5, 0.3], np.float32))
+        lq = np.log(np.array([0.3, 0.3, 0.4], np.float32))
+        got = float(_np(D.kl_divergence(
+            D.Categorical(paddle.to_tensor(lp)),
+            D.Categorical(paddle.to_tensor(lq)))))
+        want = float(td.kl_divergence(td.Categorical(logits=torch.tensor(lp)),
+                                      td.Categorical(logits=torch.tensor(lq))))
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+        c1 = np.array([1.0, 2.0, 3.0], np.float32)
+        c2 = np.array([2.0, 2.0, 2.0], np.float32)
+        got = float(_np(D.kl_divergence(
+            D.Dirichlet(paddle.to_tensor(c1)), D.Dirichlet(paddle.to_tensor(c2)))))
+        want = float(td.kl_divergence(td.Dirichlet(torch.tensor(c1)),
+                                      td.Dirichlet(torch.tensor(c2))))
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_register_kl_and_missing(self):
+        class MyDist(D.Normal):
+            pass
+
+        with pytest.raises(NotImplementedError):
+            D.kl_divergence(D.Bernoulli(0.5), D.Normal(0.0, 1.0))
+
+        # subclass resolution picks the Normal/Normal form
+        v = float(_np(D.kl_divergence(MyDist(0.0, 1.0), D.Normal(0.0, 1.0))))
+        assert abs(v) < 1e-6
+
+
+class TestTransformed:
+    def test_lognormal_via_transform(self):
+        base = D.Normal(0.2, 0.7)
+        t = D.TransformedDistribution(base, [D.ExpTransform()])
+        x = np.linspace(0.2, 3, 7).astype(np.float32)
+        np.testing.assert_allclose(
+            _np(t.log_prob(paddle.to_tensor(x))),
+            st.lognorm(s=0.7, scale=np.exp(0.2)).logpdf(x), rtol=1e-5)
+
+    def test_affine_chain(self):
+        base = D.Normal(0.0, 1.0)
+        t = D.TransformedDistribution(
+            base, [D.AffineTransform(1.0, 2.0)])
+        x = np.linspace(-3, 5, 7).astype(np.float32)
+        np.testing.assert_allclose(_np(t.log_prob(paddle.to_tensor(x))),
+                                   st.norm(1, 2).logpdf(x), rtol=1e-5)
+
+    def test_tanh_logdet_consistency(self):
+        tr = D.TanhTransform()
+        x = paddle.to_tensor(np.array([-1.0, 0.0, 1.2], np.float32))
+        y = tr.forward(x)
+        back = tr.inverse(y)
+        np.testing.assert_allclose(_np(back), _np(x), rtol=1e-5)
+        ld = _np(tr.forward_log_det_jacobian(x))
+        want = np.log(1 - np.tanh(_np(x)) ** 2)
+        np.testing.assert_allclose(ld, want, rtol=1e-4)
+
+    def test_grad_through_log_prob(self):
+        loc = paddle.to_tensor(np.float32(0.5))
+        loc.stop_gradient = False
+        d = D.Normal(loc, paddle.to_tensor(np.float32(1.0)))
+        lp = d.log_prob(paddle.to_tensor(np.float32(2.0)))
+        lp.backward()
+        # d/dloc logN(2; loc, 1) = (2 - loc) = 1.5
+        np.testing.assert_allclose(float(loc.grad.numpy()), 1.5, rtol=1e-5)

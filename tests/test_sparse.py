@@ -1,0 +1,147 @@
+"""paddle.sparse parity. Oracle: dense numpy equivalents (sparse results must
+equal the dense computation observed at the sparsity pattern)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def _coo():
+    indices = np.array([[0, 0, 1, 2], [1, 3, 2, 0]])
+    values = np.array([1.0, 2.0, -3.0, 4.0], np.float32)
+    return sparse.sparse_coo_tensor(indices, values, [3, 4]), indices, values
+
+
+class TestFormats:
+    def test_coo_roundtrip(self):
+        t, indices, values = _coo()
+        assert t.shape == [3, 4] and t.nnz() == 4
+        dense = np.zeros((3, 4), np.float32)
+        dense[indices[0], indices[1]] = values
+        np.testing.assert_allclose(t.to_dense().numpy(), dense)
+        np.testing.assert_allclose(t.values().numpy(), values)
+        np.testing.assert_array_equal(t.indices().numpy(), indices)
+
+    def test_csr_roundtrip(self):
+        t, indices, values = _coo()
+        csr = t.to_sparse_csr()
+        assert csr.nnz() == 4
+        np.testing.assert_array_equal(csr.crows().numpy(), [0, 2, 3, 4])
+        np.testing.assert_array_equal(csr.cols().numpy(), [1, 3, 2, 0])
+        np.testing.assert_allclose(csr.to_dense().numpy(), t.to_dense().numpy())
+        back = csr.to_sparse_coo()
+        np.testing.assert_allclose(back.to_dense().numpy(), t.to_dense().numpy())
+
+    def test_sparse_csr_tensor_ctor(self):
+        csr = sparse.sparse_csr_tensor(
+            [0, 2, 3, 4], [1, 3, 2, 0], [1.0, 2.0, -3.0, 4.0], [3, 4])
+        t, _, _ = _coo()
+        np.testing.assert_allclose(csr.to_dense().numpy(), t.to_dense().numpy())
+
+
+class TestOps:
+    def test_unary(self):
+        t, _, _ = _coo()
+        d = t.to_dense().numpy()
+        np.testing.assert_allclose(sparse.relu(t).to_dense().numpy(),
+                                   np.maximum(d, 0))
+        np.testing.assert_allclose(sparse.square(t).to_dense().numpy(), d * d)
+        np.testing.assert_allclose(sparse.neg(t).to_dense().numpy(), -d)
+
+    def test_binary(self):
+        t, _, _ = _coo()
+        idx2 = np.array([[0, 1, 2], [1, 2, 3]])
+        v2 = np.array([5.0, 1.0, 2.0], np.float32)
+        t2 = sparse.sparse_coo_tensor(idx2, v2, [3, 4])
+        d, d2 = t.to_dense().numpy(), t2.to_dense().numpy()
+        np.testing.assert_allclose(sparse.add(t, t2).to_dense().numpy(), d + d2)
+        np.testing.assert_allclose(
+            sparse.subtract(t, t2).to_dense().numpy(), d - d2)
+        np.testing.assert_allclose(
+            sparse.multiply(t, 2.0).to_dense().numpy(), d * 2)
+        np.testing.assert_allclose((t + t2).to_dense().numpy(), d + d2)
+
+    def test_matmul(self):
+        t, _, _ = _coo()
+        w = np.random.RandomState(0).rand(4, 5).astype(np.float32)
+        out = sparse.matmul(t, paddle.to_tensor(w))
+        np.testing.assert_allclose(out.numpy(), t.to_dense().numpy() @ w,
+                                   rtol=1e-5)
+
+    def test_masked_matmul(self):
+        rng = np.random.RandomState(1)
+        a = rng.rand(3, 6).astype(np.float32)
+        b = rng.rand(6, 4).astype(np.float32)
+        mask, indices, _ = _coo()
+        out = sparse.masked_matmul(paddle.to_tensor(a), paddle.to_tensor(b), mask)
+        full = a @ b
+        got = out.to_dense().numpy()
+        for r, c in zip(*indices):
+            np.testing.assert_allclose(got[r, c], full[r, c], rtol=1e-5)
+        # off-pattern entries stay zero
+        assert got[2, 3] == 0
+
+    def test_divide_same_pattern(self):
+        idx = np.array([[0, 1], [1, 2]])
+        a = sparse.sparse_coo_tensor(idx, np.array([2.0, 6.0], np.float32), [3, 4])
+        b = sparse.sparse_coo_tensor(idx, np.array([1.0, 3.0], np.float32), [3, 4])
+        out = sparse.divide(a, b).to_dense().numpy()
+        want = np.zeros((3, 4), np.float32)
+        want[0, 1], want[1, 2] = 2.0, 2.0
+        np.testing.assert_allclose(out, want)
+
+    def test_cast_preserves_csr(self):
+        t, _, _ = _coo()
+        csr = t.to_sparse_csr()
+        out = sparse.cast(csr, value_dtype="float64")
+        assert isinstance(out, sparse.SparseCsrTensor)
+        assert out.values().numpy().dtype == np.float64
+
+    def test_transpose_sum(self):
+        t, _, _ = _coo()
+        d = t.to_dense().numpy()
+        np.testing.assert_allclose(
+            sparse.transpose(t, [1, 0]).to_dense().numpy(), d.T)
+        np.testing.assert_allclose(sparse.sum(t, axis=1).numpy(), d.sum(1))
+
+
+class TestSparseNN:
+    def test_softmax_rows(self):
+        t, indices, values = _coo()
+        sm = sparse.nn.Softmax()
+        out = sm(t).to_dense().numpy()
+        # row 0 has entries at cols 1,3 -> softmax over those two
+        e = np.exp(np.array([1.0, 2.0]) - 2.0)
+        np.testing.assert_allclose(out[0, [1, 3]], e / e.sum(), rtol=1e-5)
+        np.testing.assert_allclose(out[1, 2], 1.0)  # single-entry row
+
+    def test_softmax_3d_keys_on_leading_dims(self):
+        # one entry per (batch, row) fiber -> each must normalize to 1.0
+        idx = np.array([[0, 0], [0, 1], [0, 1]])
+        t = sparse.sparse_coo_tensor(idx, np.array([1.0, 2.0], np.float32),
+                                     [1, 2, 2])
+        out = sparse.nn.Softmax()(t).to_dense().numpy()
+        np.testing.assert_allclose(out[0, 0, 0], 1.0)
+        np.testing.assert_allclose(out[0, 1, 1], 1.0)
+
+    def test_subm_conv3d_preserves_pattern(self):
+        paddle.seed(0)
+        # active voxels in a [1, 4, 4, 4, 2] grid
+        idx = np.array([[0, 0, 0], [1, 1, 1], [1, 1, 2], [2, 3, 0]]).T
+        idx = np.vstack([np.zeros((1, 4), np.int64), idx])
+        vals = np.random.RandomState(2).rand(4, 2).astype(np.float32)
+        x = sparse.sparse_coo_tensor(idx, vals, [1, 4, 4, 4, 2])
+        conv = sparse.nn.SubmConv3D(2, 3, kernel_size=3)
+        y = conv(x)
+        assert y.shape == [1, 4, 4, 4, 3]
+        assert y.nnz() == 4  # submanifold: pattern preserved
+        # site (1,1,1) has neighbor (1,1,2): output must depend on it
+        vals2 = vals.copy()
+        vals2[2] += 1.0
+        x2 = sparse.sparse_coo_tensor(idx, vals2, [1, 4, 4, 4, 2])
+        y2 = conv(x2)
+        d1 = y.values().numpy()
+        d2 = y2.values().numpy()
+        assert not np.allclose(d1[1], d2[1])  # neighbor influence
+        np.testing.assert_allclose(d1[3], d2[3], rtol=1e-6)  # isolated site
