@@ -82,6 +82,7 @@ del _k, _v
 from . import fft  # noqa: F401,E402
 from . import distribution  # noqa: F401,E402
 from . import sparse  # noqa: F401,E402
+from . import quantization  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 

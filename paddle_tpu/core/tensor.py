@@ -37,6 +37,8 @@ class Tensor:
         "persistable",
         "trainable",
         "sharding_spec",  # PartitionSpec annotation used by distributed engine
+        "placements",  # auto-parallel marker (dist.Shard/Replicate list)
+        "process_mesh",  # auto-parallel ProcessMesh annotation
         "_recompute",  # static-graph replay closure (paddle_tpu.static)
         "__weakref__",
     )

@@ -18,6 +18,17 @@ from .collective import (  # noqa: F401
     shard_to_group,
     unshard,
 )
+from .auto_parallel import (  # noqa: F401
+    Partial,
+    ProcessMesh,
+    Replicate,
+    Shard,
+    dtensor_from_fn,
+    reshard,
+    shard_layer,
+    shard_tensor,
+)
+from .auto_tuner import AutoTuner  # noqa: F401
 from .checkpoint import (  # noqa: F401
     DistributedSaver,
     load_distributed_checkpoint,
@@ -64,6 +75,8 @@ __all__ = [
     "DistributedStrategy", "HybridCommunicateGroup", "build_mesh", "P",
     "DistributedEngine", "fleet", "collective",
     "DistributedSaver", "save_distributed_checkpoint", "load_distributed_checkpoint",
+    "ProcessMesh", "Shard", "Replicate", "Partial", "shard_tensor", "reshard",
+    "shard_layer", "dtensor_from_fn", "AutoTuner",
     "VocabParallelEmbedding", "ColumnParallelLinear", "RowParallelLinear",
     "ParallelCrossEntropy", "mark_sharding",
 ]

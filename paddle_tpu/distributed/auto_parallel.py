@@ -1,0 +1,197 @@
+"""Auto-parallel marker API (reference
+/root/reference/python/paddle/distributed/auto_parallel/process_mesh.py:71,
+interface.py:28 — ProcessMesh + shard_tensor/shard_op markers that the static
+Completer/Partitioner/Resharder pipeline then propagates).
+
+TPU-native: a marker IS the implementation. ProcessMesh wraps a
+jax.sharding.Mesh; Shard/Replicate placements become a PartitionSpec;
+``shard_tensor`` is a device_put and ``reshard`` is another device_put — the
+Completion/Partition/Reshard passes are XLA GSPMD's sharding propagation,
+which runs inside every jit. No cost model or program rewriting is needed.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor, to_tensor
+from .mesh import _device_pool
+
+__all__ = [
+    "ProcessMesh", "Shard", "Replicate", "Partial",
+    "shard_tensor", "reshard", "shard_layer", "dtensor_from_fn",
+    "get_mesh", "set_mesh",
+]
+
+_GLOBAL_MESH = None
+
+
+class Placement:
+    pass
+
+
+class Shard(Placement):
+    """Shard along tensor dim ``dim`` (reference paddle.distributed.Shard)."""
+
+    def __init__(self, dim):
+        self.dim = int(dim)
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+
+class Replicate(Placement):
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+
+class Partial(Placement):
+    """Pending-reduction marker. GSPMD materializes partial sums internally;
+    at the API boundary a Partial tensor is represented reduced+replicated."""
+
+    def __init__(self, reduce_type="sum"):
+        self.reduce_type = reduce_type
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+
+class ProcessMesh:
+    """N-D logical process topology (reference process_mesh.py:71)."""
+
+    def __init__(self, mesh, dim_names=None, shape=None, process_ids=None):
+        arr = np.asarray(mesh)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        if len(dim_names) != arr.ndim:
+            raise ValueError("dim_names must match mesh rank")
+        self._ids = arr
+        self._dim_names = list(dim_names)
+        pool = _device_pool(int(arr.size))
+        if int(arr.max()) >= len(pool):
+            raise ValueError(
+                f"mesh references device {int(arr.max())} but only "
+                f"{len(pool)} devices exist")
+        devs = np.asarray(pool, dtype=object)[arr.reshape(-1)].reshape(arr.shape)
+        self._jax_mesh = Mesh(devs, tuple(self._dim_names))
+
+    @property
+    def shape(self):
+        return list(self._ids.shape)
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self):
+        return self._ids.reshape(-1).tolist()
+
+    @property
+    def mesh(self):
+        return self._ids
+
+    def get_mesh_with_dim(self, dim_name):
+        """Sub-mesh with ``dim_name`` first (reference API)."""
+        idx = self._dim_names.index(dim_name)
+        order = [idx] + [i for i in range(self._ids.ndim) if i != idx]
+        return ProcessMesh(np.transpose(self._ids, order),
+                           [self._dim_names[i] for i in order])
+
+    def jax_mesh(self) -> Mesh:
+        return self._jax_mesh
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and np.array_equal(self._ids, other._ids)
+                and self._dim_names == other._dim_names)
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self._dim_names})"
+
+
+def set_mesh(mesh: ProcessMesh):
+    global _GLOBAL_MESH
+    _GLOBAL_MESH = mesh
+
+
+def get_mesh() -> ProcessMesh | None:
+    return _GLOBAL_MESH
+
+
+def _placements_to_spec(placements, ndim, dim_names):
+    """[Shard(0), Replicate()] over mesh dims -> PartitionSpec over tensor
+    dims (the transpose of the reference's dims_mapping)."""
+    entries = [None] * ndim
+    for mesh_dim, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            if pl.dim >= ndim:
+                raise ValueError(
+                    f"Shard(dim={pl.dim}) out of range for {ndim}-D tensor")
+            axis = dim_names[mesh_dim]
+            if entries[pl.dim] is None:
+                entries[pl.dim] = axis
+            elif isinstance(entries[pl.dim], tuple):
+                entries[pl.dim] = entries[pl.dim] + (axis,)
+            else:
+                entries[pl.dim] = (entries[pl.dim], axis)
+        elif isinstance(pl, (Replicate, Partial)):
+            continue
+        else:
+            raise TypeError(f"unknown placement {pl!r}")
+    return P(*entries)
+
+
+def shard_tensor(data, mesh: ProcessMesh, placements, dtype=None,
+                 stop_gradient=None):
+    """Place a tensor on the mesh with the given placements (reference
+    interface.py shard_tensor). Returns a Tensor whose device array carries
+    the NamedSharding — any jit consuming it starts from this layout."""
+    t = data if isinstance(data, Tensor) else to_tensor(np.asarray(data))
+    spec = _placements_to_spec(placements, np.ndim(t._value), mesh.dim_names)
+    arr = jax.device_put(t._value, NamedSharding(mesh.jax_mesh(), spec))
+    out = Tensor._wrap(arr)
+    out.stop_gradient = t.stop_gradient if stop_gradient is None else stop_gradient
+    out.placements = list(placements)
+    out.process_mesh = mesh
+    return out
+
+
+def reshard(tensor, mesh: ProcessMesh, placements):
+    """Change a tensor's layout (reference reshard API → Resharder pass).
+    One device_put: XLA emits the minimal collective under the hood."""
+    return shard_tensor(tensor, mesh, placements)
+
+
+def shard_layer(layer, process_mesh: ProcessMesh, shard_fn=None,
+                input_fn=None, output_fn=None):
+    """Annotate a Layer's params with mesh placements (reference
+    interface.py shard_op/shard_layer role). shard_fn(name, layer, mesh)
+    returns placements per parameter; default: fully replicated."""
+    for name, param in layer.named_parameters():
+        placements = None
+        if shard_fn is not None:
+            placements = shard_fn(name, param, process_mesh)
+        if placements is None:
+            placements = [Replicate()] * len(process_mesh.shape)
+        spec = _placements_to_spec(placements, np.ndim(param._value),
+                                   process_mesh.dim_names)
+        param.sharding_spec = spec  # consumed by DistributedEngine layouts
+        param.process_mesh = process_mesh
+    return layer
+
+
+def dtensor_from_fn(fn, mesh: ProcessMesh, placements, *args, **kwargs):
+    """Build a sharded tensor from a creation fn (reference
+    dtensor_from_fn): the creation runs jitted with out_shardings so each
+    device materializes only its shard."""
+    t = fn(*args, **kwargs)
+    return shard_tensor(t, mesh, placements)

@@ -1,0 +1,143 @@
+"""Auto-tuner: black-box search over hybrid-parallel configs (reference
+/root/reference/python/paddle/distributed/auto_tuner/ — tuner.py:19 AutoTuner
+with prune rules, a recorder, and trial launches).
+
+TPU-native: a trial doesn't need to fork a pod — it builds a
+DistributedEngine for the candidate {dp, mp, sharding(+stage), pp} degrees on
+the SAME devices, jits one train step, and times a few steps. Pruning uses
+static divisibility facts (world size, batch, hidden/head counts); compile
+time is excluded from the score (XLA compiles once per shape in production).
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import time
+
+import numpy as np
+
+__all__ = ["AutoTuner", "Recorder"]
+
+
+class Recorder:
+    """History of trials (reference recorder.py): sorted, serializable."""
+
+    def __init__(self):
+        self.history = []
+
+    def add(self, cfg, metric, error=None):
+        self.history.append(
+            {"config": dict(cfg), "metric": metric, "error": error})
+
+    def best(self):
+        ok = [h for h in self.history if h["error"] is None]
+        if not ok:
+            return None
+        return min(ok, key=lambda h: h["metric"])
+
+    def save(self, path):
+        with open(path, "w") as f:
+            json.dump(self.history, f, indent=1, default=str)
+
+
+def _divisors(n):
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+class AutoTuner:
+    """Search {dp, mp, sharding, stage} over a fixed device count.
+
+    tuner_cfg keys (reference naming): model_cfg {hidden_size, num_heads,
+    global_batch_size}, candidates overrides {dp_degree, mp_degree,
+    sharding_degree, sharding_stage}, max_time_per_trial, steps_per_trial.
+    """
+
+    def __init__(self, tuner_cfg=None):
+        self.cfg = dict(tuner_cfg or {})
+        self.recorder = Recorder()
+
+    # -- candidate generation + pruning ----------------------------------
+    def candidates(self, world_size):
+        model = self.cfg.get("model_cfg", {})
+        hidden = int(model.get("hidden_size", 0))
+        heads = int(model.get("num_heads", 0))
+        batch = int(model.get("global_batch_size", 0))
+        dps = self.cfg.get("dp_degree") or _divisors(world_size)
+        mps = self.cfg.get("mp_degree") or _divisors(world_size)
+        shs = self.cfg.get("sharding_degree") or _divisors(world_size)
+        stages = self.cfg.get("sharding_stage") or [1]
+        out = []
+        for dp, mp, sh, st in itertools.product(dps, mps, shs, stages):
+            if dp * mp * sh != world_size:
+                continue  # prune: must use every device
+            if mp > 1 and hidden and hidden % mp != 0:
+                continue  # prune: tp must divide hidden
+            if mp > 1 and heads and heads % mp != 0:
+                continue  # prune: tp must divide heads
+            if batch and batch % (dp * sh) != 0:
+                continue  # prune: data axes must divide the batch
+            if sh == 1 and st > 1:
+                continue  # prune: stages need a sharding axis
+            out.append({"dp_degree": dp, "mp_degree": mp,
+                        "sharding_degree": sh, "sharding_stage": st})
+        return out
+
+    # -- trial ------------------------------------------------------------
+    def _run_trial(self, cand, model_fn, data_fn, steps):
+        from ..optimizer import AdamW
+        from .engine import DistributedEngine
+        from .mesh import set_hybrid_communicate_group
+        from .strategy import DistributedStrategy, HybridConfig, ShardingConfig
+
+        set_hybrid_communicate_group(None)
+        layer, loss_fn = model_fn()
+        strat = DistributedStrategy(
+            hybrid_configs=HybridConfig(
+                dp_degree=cand["dp_degree"], mp_degree=cand["mp_degree"],
+                sharding_degree=cand["sharding_degree"]),
+            sharding=ShardingConfig(stage=cand["sharding_stage"]),
+        )
+        opt = AdamW(parameters=layer.parameters(), learning_rate=1e-3)
+        eng = DistributedEngine(layer, loss_fn=loss_fn, optimizer=opt,
+                                strategy=strat)
+        inputs, labels = data_fn()
+        eng.step(inputs, labels)  # compile + first step (excluded)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = eng.step(inputs, labels)
+        np.asarray(loss)  # block
+        return (time.perf_counter() - t0) / steps
+
+    def tune(self, model_fn, data_fn, world_size=None):
+        """model_fn() -> (layer, loss_fn); data_fn() -> (inputs, labels).
+        Returns the best config; full history in self.recorder."""
+        import jax
+
+        from .mesh import _device_pool
+
+        if world_size is None:
+            world_size = len(_device_pool(2))
+        steps = int(self.cfg.get("steps_per_trial", 3))
+        cands = self.candidates(world_size)
+        if not cands:
+            raise ValueError("no valid candidate configs after pruning")
+        from .mesh import (get_hybrid_communicate_group,
+                           set_hybrid_communicate_group)
+
+        prev_hcg = get_hybrid_communicate_group()
+        try:
+            for cand in cands:
+                try:
+                    dt = self._run_trial(cand, model_fn, data_fn, steps)
+                    self.recorder.add(cand, dt)
+                except Exception as e:  # OOM/invalid-shape trials recorded
+                    self.recorder.add(cand, float("inf"), error=repr(e))
+        finally:
+            # trials set the global topology per candidate; don't leak the
+            # last trial's layout to the caller
+            set_hybrid_communicate_group(prev_hcg)
+        best = self.recorder.best()
+        if best is None:
+            raise RuntimeError(
+                f"every trial failed: {self.recorder.history}")
+        return best["config"]

@@ -1,0 +1,21 @@
+"""paddle.quantization parity (reference
+/root/reference/python/paddle/quantization/ — QuantConfig, QAT, PTQ,
+observers + fake quanters).
+
+TPU-native: fake-quantization is a pure function with a straight-through
+estimator expressed as ``x + stop_gradient(q(x) - x)`` — no custom grad op
+needed; converted inference layers store int8 weights and dequantize at the
+matmul edge, which XLA fuses into the MXU feed.
+"""
+from .config import QuantConfig  # noqa: F401
+from .observers import AbsmaxObserver, AbsMaxChannelWiseWeightObserver  # noqa: F401
+from .qat import QAT  # noqa: F401
+from .ptq import PTQ  # noqa: F401
+from .quanters import FakeQuanterWithAbsMaxObserver  # noqa: F401
+from .quantize_layers import QuantedConv2D, QuantedLinear  # noqa: F401
+
+__all__ = [
+    "QuantConfig", "QAT", "PTQ",
+    "AbsmaxObserver", "AbsMaxChannelWiseWeightObserver",
+    "FakeQuanterWithAbsMaxObserver", "QuantedLinear", "QuantedConv2D",
+]

@@ -1,0 +1,52 @@
+"""QuantConfig (reference python/paddle/quantization/config.py): maps layers
+and layer types to (activation, weight) quanter/observer prototypes."""
+from __future__ import annotations
+
+__all__ = ["QuantConfig"]
+
+
+class QuantConfig:
+    def __init__(self, activation=None, weight=None):
+        self._default_act = activation
+        self._default_weight = weight
+        self._layer_cfg = {}  # id(layer) -> (act, weight)
+        self._type_cfg = {}   # type -> (act, weight)
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for l in layers:
+            self._layer_cfg[id(l)] = (activation, weight)
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = (layer_type if isinstance(layer_type, (list, tuple))
+                 else [layer_type])
+        for t in types:
+            self._type_cfg[t] = (activation, weight)
+
+    def remap_layers(self, old_model, new_model):
+        """Translate per-layer configs after a deepcopy (QAT/PTQ quantize
+        with inplace=False): id(old sublayer) -> id(copied sublayer)."""
+        olds = dict(old_model.named_sublayers(include_self=True))
+        news = dict(new_model.named_sublayers(include_self=True))
+        remapped = {}
+        for name, old in olds.items():
+            if id(old) in self._layer_cfg and name in news:
+                remapped[id(news[name])] = self._layer_cfg[id(old)]
+        self._layer_cfg.update(remapped)
+
+    def config_for(self, layer):
+        if id(layer) in self._layer_cfg:
+            return self._layer_cfg[id(layer)]
+        for t, cfg in self._type_cfg.items():
+            if isinstance(layer, t):
+                return cfg
+        if self._default_act is not None or self._default_weight is not None:
+            return (self._default_act, self._default_weight)
+        return None
+
+    def needs_quant(self, layer):
+        from ..nn.layers.common import Linear
+        from ..nn.layers.conv import Conv2D
+
+        return (self.config_for(layer) is not None
+                and isinstance(layer, (Linear, Conv2D)))

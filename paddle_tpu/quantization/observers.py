@@ -1,0 +1,63 @@
+"""Observers: collect ranges during calibration (reference
+python/paddle/quantization/observers/abs_max.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["BaseObserver", "AbsmaxObserver", "AbsMaxChannelWiseWeightObserver"]
+
+
+class BaseObserver:
+    def __init__(self, quant_bits=8):
+        self.quant_bits = quant_bits
+        self._qmax = float(2 ** (quant_bits - 1) - 1)
+
+    def observe(self, x):
+        raise NotImplementedError
+
+    def scales(self):
+        raise NotImplementedError
+
+    def _instance(self, layer=None):
+        import copy
+
+        return copy.deepcopy(self)
+
+
+class AbsmaxObserver(BaseObserver):
+    """Per-tensor abs-max range."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__(quant_bits)
+        self._max = 0.0
+
+    def observe(self, x):
+        v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        self._max = max(self._max, float(jnp.max(jnp.abs(v))))
+
+    def scales(self):
+        return max(self._max, 1e-8) / self._qmax
+
+
+class AbsMaxChannelWiseWeightObserver(BaseObserver):
+    """Per-output-channel abs-max (reference channel_wise_abs_max) — channel
+    axis is the LAST weight dim ([in, out] Linear layout)."""
+
+    def __init__(self, quant_bits=8, quant_axis=-1):
+        super().__init__(quant_bits)
+        self.quant_axis = quant_axis
+        self._max = None
+
+    def observe(self, x):
+        v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        axes = tuple(i for i in range(v.ndim) if i != self.quant_axis % v.ndim)
+        m = np.asarray(jnp.max(jnp.abs(v), axis=axes))
+        self._max = m if self._max is None else np.maximum(self._max, m)
+
+    def scales(self):
+        m = np.maximum(self._max, 1e-8)
+        return m / self._qmax

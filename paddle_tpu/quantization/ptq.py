@@ -1,0 +1,74 @@
+"""PTQ: observer insertion → calibration → conversion (reference
+python/paddle/quantization/ptq.py)."""
+from __future__ import annotations
+
+from ..nn.layer import Layer
+from ..nn.layers.common import Linear
+from .quantize_layers import Int8Linear
+
+__all__ = ["PTQ"]
+
+
+class _ObservedLayer(Layer):
+    def __init__(self, origin, act_observer, weight_observer):
+        super().__init__()
+        self._origin = origin
+        self._act_obs = act_observer
+        self._w_obs = weight_observer
+
+    def forward(self, *args, **kwargs):
+        if self._act_obs is not None and args:
+            self._act_obs.observe(args[0])
+        if self._w_obs is not None and hasattr(self._origin, "weight"):
+            self._w_obs.observe(self._origin.weight)
+        return self._origin(*args, **kwargs)
+
+
+class PTQ:
+    def __init__(self, config):
+        self._config = config
+
+    def quantize(self, model, inplace=False):
+        """Insert observers around quantizable layers; then run calibration
+        batches through the returned model."""
+        if not inplace:
+            import copy
+
+            orig = model
+            model = copy.deepcopy(model)
+            self._config.remap_layers(orig, model)
+        self._observe_children(model)
+        return model
+
+    def _observe_children(self, layer):
+        for name, child in list(layer.named_children()):
+            if self._config.needs_quant(child):
+                act, weight = self._config.config_for(child)
+                setattr(layer, name, _ObservedLayer(
+                    child,
+                    act._instance(child) if act is not None else None,
+                    weight._instance(child) if weight is not None else None))
+            else:
+                self._observe_children(child)
+
+    def convert(self, model, inplace=False):
+        """Replace observed Linears with int8 weight-only inference layers
+        using the calibrated scales."""
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
+        self._convert_children(model)
+        return model
+
+    def _convert_children(self, layer):
+        for name, child in list(layer.named_children()):
+            if isinstance(child, _ObservedLayer):
+                origin = child._origin
+                if isinstance(origin, Linear) and child._w_obs is not None:
+                    setattr(layer, name,
+                            Int8Linear.from_float(origin, child._w_obs))
+                else:
+                    setattr(layer, name, origin)
+            else:
+                self._convert_children(child)

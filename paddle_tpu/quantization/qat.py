@@ -1,0 +1,67 @@
+"""QAT: swap float layers for fake-quantized wrappers (reference
+python/paddle/quantization/qat.py QAT.quantize/convert)."""
+from __future__ import annotations
+
+from ..nn.layers.common import Linear
+from ..nn.layers.conv import Conv2D
+from .quantize_layers import QuantedConv2D, QuantedLinear
+
+__all__ = ["QAT"]
+
+
+class QAT:
+    def __init__(self, config):
+        self._config = config
+
+    def _wrap(self, layer):
+        cfg = self._config.config_for(layer)
+        act, weight = cfg
+        act_q = act._instance(layer) if act is not None else None
+        w_q = weight._instance(layer) if weight is not None else None
+        if isinstance(layer, Linear):
+            return QuantedLinear(layer, act_q, w_q)
+        if isinstance(layer, Conv2D):
+            return QuantedConv2D(layer, act_q, w_q)
+        return layer
+
+    def quantize(self, model, inplace=False):
+        """Replace quantizable sublayers with QAT wrappers (recursive)."""
+        if not inplace:
+            import copy
+
+            orig = model
+            model = copy.deepcopy(model)
+            self._config.remap_layers(orig, model)
+        self._quantize_children(model)
+        return model
+
+    def _quantize_children(self, layer):
+        for name, child in list(layer.named_children()):
+            if self._config.needs_quant(child):
+                setattr(layer, name, self._wrap(child))
+            else:
+                self._quantize_children(child)
+
+    def convert(self, model, inplace=False):
+        """Strip QAT wrappers back to plain layers whose weights carry the
+        learned quantization error (reference convert: replace with
+        quantized inference ops)."""
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
+        self._convert_children(model)
+        return model
+
+    def _convert_children(self, layer):
+        from .quantize_layers import _QuantedBase
+
+        for name, child in list(layer.named_children()):
+            if isinstance(child, _QuantedBase):
+                origin = child._origin
+                if child.weight_quanter is not None:
+                    origin.weight.set_value(
+                        child.weight_quanter(origin.weight))
+                setattr(layer, name, origin)
+            else:
+                self._convert_children(child)

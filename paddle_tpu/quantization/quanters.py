@@ -1,0 +1,54 @@
+"""Fake quanters for QAT (reference
+python/paddle/quantization/quanters/abs_max.py
+FakeQuanterWithAbsMaxObserverLayer): simulate int-k rounding in float with a
+moving-average abs-max range and a straight-through gradient."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..nn.layer import Layer
+
+__all__ = ["FakeQuanterWithAbsMaxObserver"]
+
+
+def fake_quant(x, scale, qmax):
+    """round-to-nearest int-k simulation with STE:
+    x + sg(dequant(quant(x)) - x) — identity gradient, quantized value."""
+    q = jnp.clip(jnp.round(x / scale), -qmax - 1, qmax) * scale
+    return x + jax.lax.stop_gradient(q - x)
+
+
+class FakeQuanterWithAbsMaxObserver(Layer):
+    def __init__(self, moving_rate=0.9, quant_bits=8, dtype="float32",
+                 name=None):
+        super().__init__()
+        self.moving_rate = moving_rate
+        self.quant_bits = quant_bits
+        self._qmax = float(2 ** (quant_bits - 1) - 1)
+        self._state = 0.0  # moving absmax (host scalar; updated in training)
+
+    def _instance(self, layer=None):
+        return FakeQuanterWithAbsMaxObserver(
+            self.moving_rate, self.quant_bits)
+
+    def scales(self):
+        return max(self._state, 1e-8) / self._qmax
+
+    def forward(self, x):
+        if self.training:
+            cur = float(jnp.max(jnp.abs(jax.lax.stop_gradient(x._value))))
+            if self._state == 0.0:
+                self._state = cur
+            else:
+                r = self.moving_rate
+                self._state = r * self._state + (1 - r) * cur
+        if self._state == 0.0:
+            # never calibrated (eval before any training step): pass through
+            # rather than quantize against a degenerate 1e-8 range
+            return x
+        scale = self.scales()
+        return apply(lambda v: fake_quant(v, scale, self._qmax), x,
+                     op_name="fake_quantize_dequantize")

@@ -1,0 +1,80 @@
+"""Quantized layer wrappers (reference
+python/paddle/nn/quant/format.py + quantization/nn): QAT wrappers that
+fake-quantize weight+activation, and converted int8 inference layers."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..nn.layer import Layer
+
+__all__ = ["QuantedLinear", "QuantedConv2D", "Int8Linear"]
+
+
+class _QuantedBase(Layer):
+    def __init__(self, origin, act_quanter=None, weight_quanter=None):
+        super().__init__()
+        self._origin = origin
+        self.activation_quanter = act_quanter
+        self.weight_quanter = weight_quanter
+
+    def _qweight(self):
+        w = self._origin.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        return w
+
+    def _qinput(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        return x
+
+
+class QuantedLinear(_QuantedBase):
+    def forward(self, x):
+        return F.linear(self._qinput(x), self._qweight(), self._origin.bias)
+
+
+class QuantedConv2D(_QuantedBase):
+    def forward(self, x):
+        o = self._origin
+        return F.conv2d(self._qinput(x), self._qweight(), o.bias,
+                        stride=o._stride, padding=o._padding,
+                        dilation=o._dilation, groups=o._groups,
+                        data_format=o._data_format)
+
+
+class Int8Linear(Layer):
+    """Converted inference layer: int8 weights + per-channel scales; the
+    dequant multiply fuses into the matmul epilogue under XLA."""
+
+    def __init__(self, qweight, scales, bias=None):
+        super().__init__()
+        self.register_buffer("qweight", Tensor(np.asarray(qweight, np.int8)))
+        self.register_buffer("scales", Tensor(np.asarray(scales, np.float32)))
+        self.bias = bias
+
+    @staticmethod
+    def from_float(linear, observer):
+        w = np.asarray(linear.weight.numpy())
+        observer.observe(linear.weight)
+        scales = np.asarray(observer.scales())  # per-out-channel or scalar
+        q = np.clip(np.round(w / scales), -128, 127).astype(np.int8)
+        return Int8Linear(q, scales, linear.bias)
+
+    def forward(self, x):
+        def body(v, q, s, b=None):
+            w = q.astype(jnp.float32) * s
+            out = v @ w
+            if b is not None:
+                out = out + b
+            return out
+
+        args = [x, self.qweight, self.scales]
+        if self.bias is not None:
+            args.append(self.bias)
+        return apply(body, *args, op_name="int8_linear")

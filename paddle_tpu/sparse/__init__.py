@@ -28,10 +28,15 @@ __all__ = [
 
 
 class SparseCooTensor:
-    """COO sparse tensor (reference phi::SparseCooTensor). Wraps BCOO."""
+    """COO sparse tensor (reference phi::SparseCooTensor). Wraps BCOO.
 
-    def __init__(self, bcoo):
+    ``values_tensor``: the tape-connected Tensor that produced the values
+    (set by differentiable producers like SubmConv3D) so
+    ``.values().backward()`` reaches upstream parameters."""
+
+    def __init__(self, bcoo, values_tensor=None):
         self._bcoo = bcoo
+        self._values_t = values_tensor
 
     # -- construction ----------------------------------------------------
     @staticmethod
@@ -56,6 +61,8 @@ class SparseCooTensor:
         return Tensor._wrap(jnp.asarray(self._bcoo.indices).T.astype(jnp.int64))
 
     def values(self):
+        if self._values_t is not None:
+            return self._values_t
         return Tensor._wrap(self._bcoo.data)
 
     def to_dense(self):
@@ -106,6 +113,10 @@ class SparseCsrTensor:
 
     @staticmethod
     def _from_coo(coo: SparseCooTensor):
+        if len(coo.shape) != 2:
+            raise ValueError(
+                f"CSR requires a 2-D tensor, got shape {coo.shape} "
+                "(the reference's SparseCsrTensor is 2-D/batched-2-D)")
         coo = coo.coalesce()
         ind = np.asarray(jax.device_get(coo._bcoo.indices))  # [nnz, 2]
         vals = coo._bcoo.data
@@ -271,21 +282,39 @@ def divide(x, y):
 
 def matmul(x, y):
     """sparse @ dense -> dense (the reference's spmm); XLA lowers the BCOO
-    contraction to gather+segment-sum."""
+    contraction to gather+segment-sum. Routed through dispatch so gradients
+    flow to both the dense operand and the sparse values."""
+    from ..core.dispatch import apply
+
     x = _as_coo(x)
-    yv = _dense_val(y)
-    out = x._bcoo @ yv
-    return Tensor._wrap(out)
+    ind, shape = x._bcoo.indices, x._bcoo.shape
+
+    def body(data, yv):
+        return jsparse.BCOO((data, ind), shape=shape) @ yv
+
+    yt = y if isinstance(y, Tensor) else to_tensor(np.asarray(y))
+    return apply(body, Tensor._wrap(x._bcoo.data, stop_gradient=False), yt,
+                 op_name="sparse_matmul")
 
 
 def masked_matmul(x, y, mask):
-    """(dense @ dense) observed only at mask's sparsity (reference sddmm)."""
-    xv, yv = _dense_val(x), _dense_val(y)
+    """(dense @ dense) observed only at mask's sparsity (reference sddmm);
+    differentiable wrt both dense operands."""
+    from ..core.dispatch import apply
+
     mask = _as_coo(mask)
     ind = mask._bcoo.indices  # [nnz, 2]
     rows, cols = ind[:, 0], ind[:, 1]
-    vals = jnp.einsum("nk,nk->n", xv[rows, :], yv[:, cols].T)
-    return SparseCooTensor(jsparse.BCOO((vals, ind), shape=mask._bcoo.shape))
+    shape = mask._bcoo.shape
+
+    def body(xv, yv):
+        return jnp.einsum("nk,nk->n", xv[rows, :], yv[:, cols].T)
+
+    xt = x if isinstance(x, Tensor) else to_tensor(np.asarray(x))
+    yt = y if isinstance(y, Tensor) else to_tensor(np.asarray(y))
+    vals = apply(body, xt, yt, op_name="sparse_masked_matmul")
+    return SparseCooTensor(jsparse.BCOO((vals._value, ind), shape=shape),
+                           values_tensor=vals)
 
 
 def sum(x, axis=None, dtype=None, keepdim=False):

@@ -86,20 +86,33 @@ class SubmConv3D(Layer):
         offs = [(a - kd // 2, b - kh // 2, c - kw // 2)
                 for a in range(kd) for b in range(kh) for c in range(kw)]
         nnz = ind.shape[0]
-        gathered = []
+        sels, masks = [], []
         for (da, db, dc) in offs:
             sel = np.full(nnz, -1, np.int64)
             for i, (n, d, h, w) in enumerate(ind):
                 j = table.get((n, d + da, h + db, w + dc))
                 if j is not None:
                     sel[i] = j
-            mask = jnp.asarray(sel >= 0)[:, None]
-            safe = jnp.asarray(np.maximum(sel, 0))
-            gathered.append(jnp.where(mask, vals[safe], 0.0))
-        stacked = jnp.stack(gathered, axis=0)  # [K, nnz, Cin]
-        out = jnp.einsum("kne,keo->no", stacked, self.weight._value)
+            sels.append(np.maximum(sel, 0))
+            masks.append(sel >= 0)
+        sel_arr = jnp.asarray(np.stack(sels))     # [K, nnz]
+        mask_arr = jnp.asarray(np.stack(masks))   # [K, nnz]
+
+        from ..core.dispatch import apply
+        from ..core.tensor import Tensor
+
+        def body(v, w, b=None):
+            gathered = jnp.where(mask_arr[..., None], v[sel_arr], 0.0)
+            out = jnp.einsum("kne,keo->no", gathered, w)
+            if b is not None:
+                out = out + b
+            return out
+
+        args = [Tensor._wrap(vals, stop_gradient=False), self.weight]
         if self.bias is not None:
-            out = out + self.bias._value
+            args.append(self.bias)
+        out = apply(body, *args, op_name="subm_conv3d")
         out_shape = tuple(shape[:-1]) + (self.out_channels,)
         return SparseCooTensor(
-            jsparse.BCOO((out, x._bcoo.indices), shape=out_shape))
+            jsparse.BCOO((out._value, x._bcoo.indices), shape=out_shape),
+            values_tensor=out)

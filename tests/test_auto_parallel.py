@@ -1,0 +1,136 @@
+"""Auto-parallel marker API + auto-tuner (VERDICT round-1 missing #9)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import distributed as dist
+from paddle_tpu.distributed import (
+    AutoTuner, Partial, ProcessMesh, Replicate, Shard, reshard, shard_layer,
+    shard_tensor,
+)
+
+
+class TestProcessMesh:
+    def test_mesh_shape_and_names(self):
+        mesh = ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]], dim_names=["dp", "mp"])
+        assert mesh.shape == [2, 4]
+        assert mesh.dim_names == ["dp", "mp"]
+        assert mesh.process_ids == list(range(8))
+        sub = mesh.get_mesh_with_dim("mp")
+        assert sub.shape == [4, 2]
+
+    def test_bad_mesh_raises(self):
+        with pytest.raises(ValueError):
+            ProcessMesh([[0, 99]], dim_names=["x"])
+        with pytest.raises(ValueError):
+            ProcessMesh([0, 1], dim_names=["a", "b"])  # 1-D mesh, 2 names
+
+
+class TestShardTensor:
+    def test_placements_produce_expected_sharding(self):
+        mesh = ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]], dim_names=["x", "y"])
+        data = np.arange(8 * 4, dtype=np.float32).reshape(8, 4)
+        t = shard_tensor(data, mesh, [Shard(0), Shard(1)])
+        # dim0 split over x(2), dim1 over y(4): per-device shard is [4, 1]
+        shard_shapes = {s.data.shape for s in t._value.addressable_shards}
+        assert shard_shapes == {(4, 1)}
+        np.testing.assert_allclose(np.asarray(t._value), data)  # global view
+
+        r = shard_tensor(data, mesh, [Replicate(), Shard(0)])
+        shard_shapes = {s.data.shape for s in r._value.addressable_shards}
+        assert shard_shapes == {(2, 4)}  # dim0 over y(4) only
+
+    def test_reshard_changes_layout(self):
+        mesh = ProcessMesh([[0, 1], [2, 3]], dim_names=["a", "b"])
+        t = shard_tensor(np.ones((4, 4), np.float32), mesh,
+                         [Shard(0), Replicate()])
+        r = reshard(t, mesh, [Replicate(), Shard(1)])
+        np.testing.assert_allclose(np.asarray(r._value), 1.0)
+        assert {s.data.shape for s in r._value.addressable_shards} == {(4, 2)}
+
+    def test_partial_is_replicated_at_boundary(self):
+        mesh = ProcessMesh([0, 1], dim_names=["x"])
+        t = shard_tensor(np.ones((2,), np.float32), mesh, [Partial()])
+        assert {s.data.shape for s in t._value.addressable_shards} == {(2,)}
+
+    def test_computation_consumes_marked_tensors(self):
+        """GSPMD propagates the marker layouts through a jit (the
+        Completer/Partitioner role)."""
+        import jax
+
+        mesh = ProcessMesh(list(range(8)), dim_names=["x"])
+        a = shard_tensor(np.random.rand(8, 16).astype(np.float32), mesh,
+                         [Shard(0)])
+        b = shard_tensor(np.random.rand(16, 8).astype(np.float32), mesh,
+                         [Replicate()])
+        out = jax.jit(lambda x, y: x @ y)(a._value, b._value)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(a._value) @ np.asarray(b._value),
+            rtol=1e-4)
+        # result keeps the row sharding
+        assert {s.data.shape for s in out.addressable_shards} == {(1, 8)}
+
+
+class TestShardLayer:
+    def test_annotations_feed_engine(self):
+        mesh = ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]], dim_names=["dp", "mp"])
+        net = nn.Linear(16, 32)
+
+        def shard_fn(name, param, m):
+            if name.endswith("weight"):
+                return [Replicate(), Shard(1)]
+            return None
+
+        shard_layer(net, mesh, shard_fn)
+        assert tuple(net.weight.sharding_spec) == (None, "mp")
+        assert net.bias.sharding_spec is not None
+
+
+class TestAutoTuner:
+    def test_prune_rules(self):
+        t = AutoTuner({"model_cfg": {"hidden_size": 12, "num_heads": 2,
+                                     "global_batch_size": 8}})
+        cands = t.candidates(8)
+        assert cands, "no candidates survived"
+        for c in cands:
+            assert c["dp_degree"] * c["mp_degree"] * c["sharding_degree"] == 8
+            assert c["mp_degree"] in (1, 2)  # heads=2 prunes mp>2
+            assert 8 % (c["dp_degree"] * c["sharding_degree"]) == 0
+
+    def test_tune_finds_runnable_config(self):
+        from paddle_tpu.distributed.mesh import set_hybrid_communicate_group
+
+        def model_fn():
+            paddle.seed(0)
+            net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+            return net, paddle.nn.CrossEntropyLoss()
+
+        def data_fn():
+            rng = np.random.RandomState(0)
+            return ([rng.rand(16, 16).astype(np.float32)],
+                    [rng.randint(0, 4, (16,)).astype(np.int64)])
+
+        tuner = AutoTuner({
+            "model_cfg": {"hidden_size": 32, "global_batch_size": 16},
+            "mp_degree": [1],          # MLP has no tp-annotated layers
+            "sharding_stage": [1, 3],
+            "steps_per_trial": 2,
+        })
+        best = tuner.tune(model_fn, data_fn, world_size=8)
+        assert best["dp_degree"] * best["sharding_degree"] == 8
+        assert len(tuner.recorder.history) >= 2
+        ok = [h for h in tuner.recorder.history if h["error"] is None]
+        assert ok, tuner.recorder.history
+        set_hybrid_communicate_group(None)
+
+    def test_recorder_save(self, tmp_path):
+        r = AutoTuner().recorder
+        r.add({"dp_degree": 8}, 0.5)
+        r.add({"dp_degree": 4}, 0.2)
+        assert r.best()["config"]["dp_degree"] == 4
+        p = str(tmp_path / "hist.json")
+        r.save(p)
+        import json
+
+        assert len(json.load(open(p))) == 2

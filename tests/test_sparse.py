@@ -98,6 +98,17 @@ class TestOps:
         assert isinstance(out, sparse.SparseCsrTensor)
         assert out.values().numpy().dtype == np.float64
 
+    def test_matmul_gradients_flow(self):
+        t, _, _ = _coo()
+        w = paddle.to_tensor(np.random.RandomState(3).rand(4, 5).astype(np.float32))
+        w.stop_gradient = False
+        out = sparse.matmul(t, w)
+        paddle.sum(out).backward()
+        assert w.grad is not None
+        # d(sum(A@W))/dW = A^T @ ones
+        want = t.to_dense().numpy().T @ np.ones((3, 5), np.float32)
+        np.testing.assert_allclose(w.grad.numpy(), want, rtol=1e-5)
+
     def test_transpose_sum(self):
         t, _, _ = _coo()
         d = t.to_dense().numpy()
@@ -145,3 +156,20 @@ class TestSparseNN:
         d2 = y2.values().numpy()
         assert not np.allclose(d1[1], d2[1])  # neighbor influence
         np.testing.assert_allclose(d1[3], d2[3], rtol=1e-6)  # isolated site
+
+    def test_subm_conv3d_weight_gradients(self):
+        paddle.seed(1)
+        idx = np.array([[0, 0, 0, 0], [0, 1, 1, 3], [0, 1, 1, 3], [0, 1, 2, 0]])
+        vals = np.random.RandomState(4).rand(4, 2).astype(np.float32)
+        x = sparse.sparse_coo_tensor(idx, vals, [1, 4, 4, 4, 2])
+        conv = sparse.nn.SubmConv3D(2, 3, kernel_size=3)
+        y = conv(x)
+        paddle.sum(y.values() ** 2).backward()
+        assert conv.weight.grad is not None
+        assert float(np.abs(conv.weight.grad.numpy()).sum()) > 0
+
+    def test_csr_rejects_nd(self):
+        idx = np.array([[0, 0], [0, 1], [0, 1]])
+        t = sparse.sparse_coo_tensor(idx, np.ones(2, np.float32), [1, 2, 2])
+        with pytest.raises(ValueError, match="2-D"):
+            t.to_sparse_csr()
