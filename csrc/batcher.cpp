@@ -1,0 +1,135 @@
+// Native batch assembly for the DataLoader (the role of the reference's C++
+// reader stack — paddle/fluid/operators/reader/ buffered readers + the
+// multiprocess worker/shared-memory queue in imperative/data_loader.cc).
+//
+// Given contiguous sample arrays, worker threads gather index-selected rows
+// into batch buffers ahead of consumption (double-buffered ring), entirely
+// outside the GIL. ctypes C ABI.
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Source {
+  const char* data;     // [n_samples, row_bytes] contiguous
+  uint64_t row_bytes;
+};
+
+struct Batch {
+  std::vector<std::vector<char>> arrays;  // one per source
+  int64_t count = 0;
+};
+
+struct Batcher {
+  std::vector<Source> sources;
+  std::vector<int64_t> indices;
+  int64_t batch_size;
+  bool drop_last;
+  size_t prefetch;
+  std::atomic<bool> stop{false};
+
+  std::mutex mu;
+  std::condition_variable cv_prod, cv_cons;
+  std::deque<Batch> ready;
+  int64_t cursor = 0;  // next batch start in indices
+  std::thread worker;
+
+  int64_t n_batches() const {
+    int64_t n = static_cast<int64_t>(indices.size());
+    return drop_last ? n / batch_size : (n + batch_size - 1) / batch_size;
+  }
+
+  void run() {
+    int64_t total = n_batches();
+    for (int64_t b = 0; b < total && !stop.load(); ++b) {
+      int64_t start = b * batch_size;
+      int64_t count = std::min<int64_t>(batch_size,
+                                        indices.size() - start);
+      Batch out;
+      out.count = count;
+      out.arrays.resize(sources.size());
+      for (size_t s = 0; s < sources.size(); ++s) {
+        const auto& src = sources[s];
+        out.arrays[s].resize(static_cast<size_t>(count) * src.row_bytes);
+        char* dst = out.arrays[s].data();
+        for (int64_t i = 0; i < count; ++i) {
+          std::memcpy(dst + i * src.row_bytes,
+                      src.data + indices[start + i] * src.row_bytes,
+                      src.row_bytes);
+        }
+      }
+      std::unique_lock<std::mutex> lk(mu);
+      cv_prod.wait(lk, [&] { return ready.size() < prefetch || stop.load(); });
+      if (stop.load()) return;
+      ready.push_back(std::move(out));
+      cv_cons.notify_one();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* bt_create(int64_t batch_size, int drop_last, int64_t prefetch) {
+  auto* b = new Batcher();
+  b->batch_size = batch_size;
+  b->drop_last = drop_last != 0;
+  b->prefetch = static_cast<size_t>(prefetch > 0 ? prefetch : 2);
+  return b;
+}
+
+// data must stay alive for the batcher's lifetime (numpy arrays held by the
+// python wrapper)
+void bt_add_source(void* handle, const char* data, uint64_t row_bytes) {
+  static_cast<Batcher*>(handle)->sources.push_back({data, row_bytes});
+}
+
+void bt_start(void* handle, const int64_t* indices, int64_t n) {
+  auto* b = static_cast<Batcher*>(handle);
+  b->indices.assign(indices, indices + n);
+  b->worker = std::thread([b] { b->run(); });
+}
+
+int64_t bt_num_batches(void* handle) {
+  return static_cast<Batcher*>(handle)->n_batches();
+}
+
+// blocks for the next assembled batch; copies each source's rows into the
+// caller's buffers. returns row count (0 = exhausted).
+int64_t bt_next(void* handle, char** outs, uint64_t n_outs) {
+  auto* b = static_cast<Batcher*>(handle);
+  Batch batch;
+  {
+    std::unique_lock<std::mutex> lk(b->mu);
+    b->cv_cons.wait(lk, [&] {
+      return !b->ready.empty() || b->cursor >= b->n_batches() || b->stop.load();
+    });
+    if (b->ready.empty()) return 0;
+    batch = std::move(b->ready.front());
+    b->ready.pop_front();
+    b->cursor++;
+    b->cv_prod.notify_one();
+  }
+  for (uint64_t s = 0; s < n_outs && s < batch.arrays.size(); ++s) {
+    std::memcpy(outs[s], batch.arrays[s].data(), batch.arrays[s].size());
+  }
+  return batch.count;
+}
+
+void bt_destroy(void* handle) {
+  auto* b = static_cast<Batcher*>(handle);
+  b->stop.store(true);
+  b->cv_prod.notify_all();
+  b->cv_cons.notify_all();
+  if (b->worker.joinable()) b->worker.join();
+  delete b;
+}
+
+}  // extern "C"

@@ -1,0 +1,298 @@
+// TCPStore: key-value rendezvous with blocking wait (the native role of the
+// reference's paddle/phi/core/distributed/store/tcp_store.{h,cc} — master
+// hosts the table; workers SET/GET/ADD/WAIT over TCP to coordinate job
+// bootstrap and heartbeats).
+//
+// Wire protocol (little-endian):
+//   request:  u8 cmd | u32 klen | key bytes | (SET: u32 vlen | val bytes)
+//             (ADD: i64 delta) | (WAIT: i64 timeout_ms)
+//   response: SET -> u8 ok
+//             GET -> i32 vlen (-1 missing) | val bytes
+//             ADD -> i64 new_value
+//             WAIT -> u8 ok (1) / timed-out (0)
+//             DEL -> u8 existed
+// Exposed as a C ABI for ctypes (no pybind dependency in this image).
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+enum Cmd : uint8_t { SET = 1, GET = 2, ADD = 3, WAIT = 4, DEL = 5 };
+
+bool read_full(int fd, void* buf, size_t n) {
+  auto* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  auto* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+struct Server {
+  int listen_fd = -1;
+  std::atomic<bool> stop{false};
+  std::thread acceptor;
+  std::vector<std::thread> workers;
+  std::mutex workers_mu;
+  std::vector<int> client_fds;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<std::string, std::string> table;
+
+  void handle(int fd) {
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    for (;;) {
+      uint8_t cmd;
+      if (!read_full(fd, &cmd, 1)) break;
+      uint32_t klen;
+      if (!read_full(fd, &klen, 4) || klen > (1u << 20)) break;
+      std::string key(klen, '\0');
+      if (!read_full(fd, key.data(), klen)) break;
+
+      if (cmd == SET) {
+        uint32_t vlen;
+        if (!read_full(fd, &vlen, 4) || vlen > (1u << 26)) break;
+        std::string val(vlen, '\0');
+        if (!read_full(fd, val.data(), vlen)) break;
+        {
+          std::lock_guard<std::mutex> lk(mu);
+          table[key] = std::move(val);
+        }
+        cv.notify_all();
+        uint8_t ok = 1;
+        if (!write_full(fd, &ok, 1)) break;
+      } else if (cmd == GET) {
+        std::string val;
+        bool found;
+        {
+          std::lock_guard<std::mutex> lk(mu);
+          auto it = table.find(key);
+          found = it != table.end();
+          if (found) val = it->second;
+        }
+        int32_t vlen = found ? static_cast<int32_t>(val.size()) : -1;
+        if (!write_full(fd, &vlen, 4)) break;
+        if (found && !write_full(fd, val.data(), val.size())) break;
+      } else if (cmd == ADD) {
+        int64_t delta;
+        if (!read_full(fd, &delta, 8)) break;
+        int64_t now;
+        {
+          std::lock_guard<std::mutex> lk(mu);
+          int64_t cur = 0;
+          auto it = table.find(key);
+          if (it != table.end()) {
+            try {
+              cur = std::stoll(it->second);
+            } catch (const std::exception&) {
+              cur = 0;  // non-numeric value: ADD restarts the counter rather
+                        // than letting one bad client terminate the server
+            }
+          }
+          now = cur + delta;
+          table[key] = std::to_string(now);
+        }
+        cv.notify_all();
+        if (!write_full(fd, &now, 8)) break;
+      } else if (cmd == WAIT) {
+        int64_t timeout_ms;
+        if (!read_full(fd, &timeout_ms, 8)) break;
+        uint8_t ok;
+        {
+          std::unique_lock<std::mutex> lk(mu);
+          auto pred = [&] { return stop.load() || table.count(key) > 0; };
+          if (timeout_ms < 0) {
+            cv.wait(lk, pred);
+          } else {
+            cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), pred);
+          }
+          ok = table.count(key) > 0 ? 1 : 0;
+        }
+        if (!write_full(fd, &ok, 1)) break;
+      } else if (cmd == DEL) {
+        uint8_t existed;
+        {
+          std::lock_guard<std::mutex> lk(mu);
+          existed = table.erase(key) > 0 ? 1 : 0;
+        }
+        if (!write_full(fd, &existed, 1)) break;
+      } else {
+        break;
+      }
+    }
+    ::close(fd);
+  }
+
+  void accept_loop() {
+    for (;;) {
+      int fd = ::accept(listen_fd, nullptr, nullptr);
+      if (fd < 0) {
+        if (stop.load()) return;
+        continue;
+      }
+      std::lock_guard<std::mutex> lk(workers_mu);
+      client_fds.push_back(fd);
+      workers.emplace_back([this, fd] { handle(fd); });
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// returns opaque handle (0 on failure); binds 0.0.0.0:port (port 0 = ephemeral,
+// query with ts_server_port)
+void* ts_server_start(int port) {
+  auto* s = new Server();
+  s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (s->listen_fd < 0) {
+    delete s;
+    return nullptr;
+  }
+  int one = 1;
+  ::setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      ::listen(s->listen_fd, 128) != 0) {
+    ::close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  s->acceptor = std::thread([s] { s->accept_loop(); });
+  return s;
+}
+
+int ts_server_port(void* handle) {
+  auto* s = static_cast<Server*>(handle);
+  sockaddr_in addr{};
+  socklen_t len = sizeof(addr);
+  if (::getsockname(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), &len) != 0)
+    return -1;
+  return ntohs(addr.sin_port);
+}
+
+void ts_server_stop(void* handle) {
+  auto* s = static_cast<Server*>(handle);
+  s->stop.store(true);
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  s->cv.notify_all();
+  if (s->acceptor.joinable()) s->acceptor.join();
+  {
+    // unblock handler threads stuck in recv so they can be JOINED —
+    // detaching would leave them referencing the Server after delete
+    std::lock_guard<std::mutex> lk(s->workers_mu);
+    for (int fd : s->client_fds) ::shutdown(fd, SHUT_RDWR);
+  }
+  for (auto& w : s->workers)
+    if (w.joinable()) w.join();
+  delete s;
+}
+
+int ts_connect(const char* host, int port, int timeout_ms) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  ::inet_pton(AF_INET, host, &addr.sin_addr);
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  while (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    if (std::chrono::steady_clock::now() > deadline) {
+      ::close(fd);
+      return -1;
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+static bool send_key(int fd, uint8_t cmd, const char* key, uint32_t klen) {
+  return write_full(fd, &cmd, 1) && write_full(fd, &klen, 4) &&
+         write_full(fd, key, klen);
+}
+
+int ts_set(int fd, const char* key, uint32_t klen, const char* val,
+           uint32_t vlen) {
+  if (!send_key(fd, SET, key, klen)) return -1;
+  if (!write_full(fd, &vlen, 4) || !write_full(fd, val, vlen)) return -1;
+  uint8_t ok;
+  return read_full(fd, &ok, 1) ? 0 : -1;
+}
+
+// returns value length; -1 if missing; -2 on io error; -(vlen)-3 when the
+// caller's buffer is too small (value is DRAINED so the connection stays in
+// sync — retry with a larger buffer)
+int ts_get(int fd, const char* key, uint32_t klen, char* out, uint32_t cap) {
+  if (!send_key(fd, GET, key, klen)) return -2;
+  int32_t vlen;
+  if (!read_full(fd, &vlen, 4)) return -2;
+  if (vlen < 0) return -1;
+  if (static_cast<uint32_t>(vlen) > cap) {
+    std::vector<char> sink(static_cast<size_t>(vlen));
+    if (!read_full(fd, sink.data(), sink.size())) return -2;
+    return -vlen - 3;
+  }
+  if (!read_full(fd, out, vlen)) return -2;
+  return vlen;
+}
+
+int64_t ts_add(int fd, const char* key, uint32_t klen, int64_t delta) {
+  if (!send_key(fd, ADD, key, klen)) return INT64_MIN;
+  if (!write_full(fd, &delta, 8)) return INT64_MIN;
+  int64_t now;
+  return read_full(fd, &now, 8) ? now : INT64_MIN;
+}
+
+// 1 key exists, 0 timeout, -1 error
+int ts_wait(int fd, const char* key, uint32_t klen, int64_t timeout_ms) {
+  if (!send_key(fd, WAIT, key, klen)) return -1;
+  if (!write_full(fd, &timeout_ms, 8)) return -1;
+  uint8_t ok;
+  return read_full(fd, &ok, 1) ? ok : -1;
+}
+
+int ts_delete(int fd, const char* key, uint32_t klen) {
+  if (!send_key(fd, DEL, key, klen)) return -1;
+  uint8_t existed;
+  return read_full(fd, &existed, 1) ? existed : -1;
+}
+
+void ts_close(int fd) { ::close(fd); }
+
+}  // extern "C"

@@ -66,6 +66,7 @@ from .pipeline import (  # noqa: F401
 )
 from .recompute import recompute, recompute_sequential  # noqa: F401
 from .strategy import DistributedStrategy  # noqa: F401
+from .tcp_store import TCPStore  # noqa: F401
 
 __all__ = [
     "init_parallel_env", "get_rank", "get_world_size", "ParallelEnv", "DataParallel",
@@ -76,7 +77,7 @@ __all__ = [
     "DistributedEngine", "fleet", "collective",
     "DistributedSaver", "save_distributed_checkpoint", "load_distributed_checkpoint",
     "ProcessMesh", "Shard", "Replicate", "Partial", "shard_tensor", "reshard",
-    "shard_layer", "dtensor_from_fn", "AutoTuner",
+    "shard_layer", "dtensor_from_fn", "AutoTuner", "TCPStore",
     "VocabParallelEmbedding", "ColumnParallelLinear", "RowParallelLinear",
     "ParallelCrossEntropy", "mark_sharding",
 ]

@@ -1,0 +1,104 @@
+"""TCPStore: rendezvous KV store over the native C++ server (reference
+/root/reference/paddle/phi/core/distributed/store/tcp_store.h:120 — master
+hosts the table, workers set/get/add/wait to bootstrap and heartbeat).
+
+On TPU pods jax's own coordination service does job bootstrap; this store
+covers the remaining reference capabilities: barrier-style counters for the
+launch CLI, health heartbeats for elastic restart, and user-level rendezvous.
+"""
+from __future__ import annotations
+
+import ctypes
+
+from ..core import native
+
+__all__ = ["TCPStore"]
+
+
+class TCPStore:
+    def __init__(self, host="127.0.0.1", port=0, is_master=False,
+                 timeout=30.0):
+        lib = native.load()
+        if lib is None:
+            raise RuntimeError(
+                "native runtime unavailable (no C++ toolchain?) — TCPStore "
+                "needs csrc/ built")
+        self._lib = lib
+        self._server = None
+        self.host = host
+        if is_master:
+            self._server = lib.ts_server_start(int(port))
+            if not self._server:
+                raise RuntimeError(f"TCPStore could not bind port {port}")
+            self.port = lib.ts_server_port(self._server)
+        else:
+            self.port = int(port)
+        self._fd = lib.ts_connect(host.encode(), self.port,
+                                  int(timeout * 1000))
+        if self._fd < 0:
+            raise TimeoutError(
+                f"TCPStore could not reach {host}:{self.port}")
+
+    # -- reference API -----------------------------------------------------
+    def set(self, key: str, value):
+        v = value if isinstance(value, bytes) else str(value).encode()
+        k = key.encode()
+        if self._lib.ts_set(self._fd, k, len(k), v, len(v)) != 0:
+            raise RuntimeError("TCPStore set failed")
+
+    def get(self, key: str) -> bytes | None:
+        k = key.encode()
+        cap = 1 << 20
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.ts_get(self._fd, k, len(k), buf, cap)
+            if n == -1:
+                return None
+            if n <= -3:
+                cap = -n - 3  # buffer was too small; value drained — retry
+                continue
+            if n < 0:
+                raise RuntimeError("TCPStore get failed")
+            return buf.raw[:n]
+
+    def add(self, key: str, amount: int = 1) -> int:
+        k = key.encode()
+        out = self._lib.ts_add(self._fd, k, len(k), int(amount))
+        if out == -(2 ** 63):
+            raise RuntimeError("TCPStore add failed")
+        return int(out)
+
+    def wait(self, key: str, timeout=None) -> bool:
+        k = key.encode()
+        ms = -1 if timeout is None else int(timeout * 1000)
+        r = self._lib.ts_wait(self._fd, k, len(k), ms)
+        if r < 0:
+            raise RuntimeError("TCPStore wait failed")
+        return bool(r)
+
+    def delete_key(self, key: str) -> bool:
+        k = key.encode()
+        return bool(self._lib.ts_delete(self._fd, k, len(k)))
+
+    def barrier(self, name: str, world_size: int, timeout=60.0):
+        """All `world_size` callers block until everyone arrived."""
+        n = self.add(f"__barrier/{name}", 1)
+        if n == world_size:
+            self.set(f"__barrier/{name}/done", b"1")
+        ok = self.wait(f"__barrier/{name}/done", timeout)
+        if not ok:
+            raise TimeoutError(f"barrier '{name}' timed out at {n}/{world_size}")
+
+    def close(self):
+        if self._fd >= 0:
+            self._lib.ts_close(self._fd)
+            self._fd = -1
+        if self._server:
+            self._lib.ts_server_stop(self._server)
+            self._server = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -298,6 +298,9 @@ class DataLoader:
         self.iterable_mode = isinstance(dataset, IterableDataset)
         self.batch_size = batch_size
         self.drop_last = drop_last
+        # native batch assembly only understands the uniform default sampler;
+        # a user-supplied sampler owns its batching (sizes may vary)
+        self._own_sampler = batch_sampler is None and not self.iterable_mode
         if self.iterable_mode:
             self.batch_sampler = None
         elif batch_sampler is not None:
@@ -311,7 +314,40 @@ class DataLoader:
             raise TypeError("IterableDataset-backed DataLoader has no len()")
         return len(self.batch_sampler)
 
+    def _native_arrays(self):
+        """Contiguous source arrays for the C++ batcher, or None when this
+        dataset/config can't use it (custom collate, iterable, transform)."""
+        if (self.iterable_mode or self.collate_fn is not default_collate_fn
+                or not self._own_sampler):
+            return None
+        get = getattr(self.dataset, "get_arrays", None)
+        if get is None:
+            return None
+        from .native_batcher import supported
+
+        if not supported():
+            return None
+        return get()
+
+    def _native_iter(self, arrays):
+        """Batch assembly in the C++ worker (reference buffered reader)."""
+        from .native_batcher import NativeBatcher
+
+        flat = [i for batch in self.batch_sampler for i in batch]
+        nb = NativeBatcher(arrays, flat, self.batch_size,
+                           drop_last=self.drop_last,
+                           prefetch=max(2, self.prefetch_factor))
+        try:
+            for outs in nb:
+                yield [Tensor(o) for o in outs]
+        finally:
+            nb.close()
+
     def _raw_iter(self):
+        arrays = self._native_arrays()
+        if arrays is not None:
+            yield from self._native_iter(arrays)
+            return
         if self.iterable_mode:
             batch = []
             for item in self.dataset:

@@ -1,0 +1,87 @@
+"""Native batch assembly: the C++ prefetch core behind DataLoader for
+contiguous-array datasets (the reference's C++ buffered-reader role)."""
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from ..core import native
+
+__all__ = ["NativeBatcher", "supported"]
+
+
+def supported() -> bool:
+    return native.available()
+
+
+class NativeBatcher:
+    """Iterate index-gathered batches of several aligned numpy arrays, with
+    assembly running in a C++ worker thread (outside the GIL)."""
+
+    def __init__(self, arrays, indices, batch_size, drop_last=False,
+                 prefetch=2):
+        lib = native.load()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self._lib = lib
+        # keep C-contiguous copies alive for the batcher's lifetime
+        self._arrays = [np.ascontiguousarray(a) for a in arrays]
+        self._indices = np.ascontiguousarray(np.asarray(indices, np.int64))
+        if len(self._indices):
+            lo, hi = int(self._indices.min()), int(self._indices.max())
+            if lo < 0:
+                raise ValueError(
+                    "native batcher requires non-negative indices "
+                    "(python-style negative indexing is a DataLoader-"
+                    "fallback feature)")
+            for a in self._arrays:
+                if a.shape[0] <= hi:
+                    raise ValueError("index out of range for source array")
+        self.batch_size = int(batch_size)
+        self.drop_last = bool(drop_last)
+        self._h = lib.bt_create(self.batch_size, int(drop_last), int(prefetch))
+        for a in self._arrays:
+            row_bytes = a.dtype.itemsize * int(np.prod(a.shape[1:], dtype=np.int64))
+            lib.bt_add_source(
+                self._h, a.ctypes.data_as(ctypes.c_char_p), row_bytes)
+        lib.bt_start(
+            self._h, self._indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(self._indices))
+        self._remaining = lib.bt_num_batches(self._h)
+
+    def __len__(self):
+        return int(self._lib.bt_num_batches(self._h))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._h is None or self._remaining <= 0:
+            self.close()
+            raise StopIteration
+        outs = []
+        ptrs = (ctypes.c_char_p * len(self._arrays))()
+        for i, a in enumerate(self._arrays):
+            buf = np.empty((self.batch_size,) + a.shape[1:], a.dtype)
+            outs.append(buf)
+            ptrs[i] = ctypes.cast(buf.ctypes.data, ctypes.c_char_p)
+        count = self._lib.bt_next(self._h, ptrs, len(outs))
+        if count == 0:
+            self.close()
+            raise StopIteration
+        self._remaining -= 1
+        if count < self.batch_size:
+            outs = [o[:count] for o in outs]
+        return outs
+
+    def close(self):
+        if self._h is not None:
+            self._lib.bt_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -293,8 +293,9 @@ def matmul(x, y):
         return jsparse.BCOO((data, ind), shape=shape) @ yv
 
     yt = y if isinstance(y, Tensor) else to_tensor(np.asarray(y))
-    return apply(body, Tensor._wrap(x._bcoo.data, stop_gradient=False), yt,
-                 op_name="sparse_matmul")
+    # x.values() keeps the producer's tape link (values_tensor), so grads
+    # reach upstream sparse producers like SubmConv3D
+    return apply(body, x.values(), yt, op_name="sparse_matmul")
 
 
 def masked_matmul(x, y, mask):

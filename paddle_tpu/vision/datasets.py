@@ -78,6 +78,16 @@ class MNIST(Dataset):
             img = self.transform(img)
         return img, np.asarray(self.labels[idx], np.int64)
 
+    def get_arrays(self):
+        """Whole-dataset contiguous arrays for the native batcher (same
+        values __getitem__ yields); None when a transform must run per item.
+        Computed per call (once per epoch) — a cached f32 copy would pin 4x
+        the dataset's memory for its whole lifetime."""
+        if self.transform is not None:
+            return None
+        return (self.images.astype(np.float32)[:, None] / 127.5 - 1.0,
+                np.asarray(self.labels, np.int64))
+
     def __len__(self):
         return len(self.images)
 

@@ -1,0 +1,134 @@
+"""Native C++ runtime components: TCPStore rendezvous + batch-assembly core
+(the reference's native tcp_store.cc and C++ reader stack roles)."""
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core import native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="no C++ toolchain")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestTCPStore:
+    def test_set_get_add_wait_delete(self):
+        from paddle_tpu.distributed import TCPStore
+
+        master = TCPStore(is_master=True)
+        try:
+            master.set("alpha", b"beta")
+            assert master.get("alpha") == b"beta"
+            assert master.get("missing") is None
+            assert master.add("cnt", 3) == 3
+            assert master.add("cnt", -1) == 2
+            assert master.wait("alpha", timeout=1.0) is True
+            assert master.wait("never", timeout=0.2) is False
+            assert master.delete_key("alpha") is True
+            assert master.get("alpha") is None
+        finally:
+            master.close()
+
+    def test_cross_process_rendezvous(self, tmp_path):
+        """A second PROCESS joins the store, waits for a key the parent sets
+        afterwards, and bumps a counter (the launch-bootstrap pattern)."""
+        from paddle_tpu.distributed import TCPStore
+
+        master = TCPStore(is_master=True)
+        try:
+            child = textwrap.dedent(f"""
+                import sys
+                sys.path.insert(0, {REPO!r})
+                from paddle_tpu.distributed import TCPStore
+                s = TCPStore(port={master.port})
+                assert s.wait("go", timeout=30.0)
+                assert s.get("go") == b"now"
+                s.add("joined", 1)
+                s.close()
+            """)
+            proc = subprocess.Popen([sys.executable, "-c", child])
+            master.set("go", b"now")
+            assert proc.wait(timeout=60) == 0
+            assert master.wait("joined", timeout=10.0)
+            assert master.add("joined", 0) == 1
+        finally:
+            master.close()
+
+    def test_barrier(self):
+        import threading
+
+        from paddle_tpu.distributed import TCPStore
+
+        master = TCPStore(is_master=True)
+        results = []
+
+        def member():
+            c = TCPStore(port=master.port)
+            c.barrier("b1", 3, timeout=30.0)
+            results.append(1)
+            c.close()
+
+        try:
+            threads = [threading.Thread(target=member) for _ in range(2)]
+            for t in threads:
+                t.start()
+            master.barrier("b1", 3, timeout=30.0)
+            for t in threads:
+                t.join(timeout=30)
+            assert len(results) == 2
+        finally:
+            master.close()
+
+
+class TestNativeBatcher:
+    def test_matches_python_gather(self):
+        from paddle_tpu.io.native_batcher import NativeBatcher
+
+        rng = np.random.RandomState(0)
+        x = rng.rand(37, 3, 5).astype(np.float32)
+        y = rng.randint(0, 9, (37,)).astype(np.int64)
+        idx = rng.permutation(37).tolist()
+        nb = NativeBatcher([x, y], idx, batch_size=8)
+        got_x, got_y = [], []
+        for bx, by in nb:
+            got_x.append(bx)
+            got_y.append(by)
+        assert len(got_x) == 5  # ceil(37/8) with drop_last=False
+        np.testing.assert_allclose(np.concatenate(got_x), x[idx])
+        np.testing.assert_array_equal(np.concatenate(got_y), y[idx])
+
+    def test_drop_last(self):
+        from paddle_tpu.io.native_batcher import NativeBatcher
+
+        x = np.arange(10, dtype=np.float32)[:, None]
+        nb = NativeBatcher([x], list(range(10)), batch_size=4, drop_last=True)
+        batches = list(nb)
+        assert len(batches) == 2
+        assert all(b[0].shape[0] == 4 for b in batches)
+
+
+class TestDataLoaderNativePath:
+    def test_loader_uses_native_and_matches_python_path(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.io import DataLoader
+        from paddle_tpu.vision.datasets import MNIST
+
+        ds = MNIST(mode="test")
+        assert ds.get_arrays() is not None
+        native_loader = DataLoader(ds, batch_size=64, shuffle=False)
+        # force the python item-by-item path via a pass-through collate
+        from paddle_tpu.io import default_collate_fn
+
+        python_loader = DataLoader(ds, batch_size=64, shuffle=False,
+                                   collate_fn=lambda b: default_collate_fn(b))
+        for (nx, ny), (px, py) in zip(native_loader, python_loader):
+            np.testing.assert_allclose(nx.numpy(), px.numpy(), rtol=1e-6)
+            np.testing.assert_array_equal(ny.numpy(), py.numpy())
+            break  # first batch equality is sufficient per-element proof
+        # full-epoch count parity
+        assert len(list(native_loader)) == len(list(python_loader))
