@@ -13,6 +13,7 @@
 //             DEL -> u8 existed
 // Exposed as a C ABI for ctypes (no pybind dependency in this image).
 #include <arpa/inet.h>
+#include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
@@ -227,7 +228,19 @@ int ts_connect(const char* host, int port, int timeout_ms) {
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_port = htons(static_cast<uint16_t>(port));
-  ::inet_pton(AF_INET, host, &addr.sin_addr);
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    // not a dotted-quad: resolve the hostname (multi-host rendezvous)
+    addrinfo hints{};
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo* res = nullptr;
+    if (::getaddrinfo(host, nullptr, &hints, &res) != 0 || res == nullptr) {
+      ::close(fd);
+      return -1;
+    }
+    addr.sin_addr = reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr;
+    ::freeaddrinfo(res);
+  }
   auto deadline = std::chrono::steady_clock::now() +
                   std::chrono::milliseconds(timeout_ms);
   while (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {

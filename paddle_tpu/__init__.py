@@ -83,6 +83,9 @@ from . import fft  # noqa: F401,E402
 from . import distribution  # noqa: F401,E402
 from . import sparse  # noqa: F401,E402
 from . import quantization  # noqa: F401,E402
+from . import audio  # noqa: F401,E402
+from . import text  # noqa: F401,E402
+from . import onnx  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 

@@ -1,0 +1,16 @@
+"""paddle.audio parity (reference /root/reference/python/paddle/audio/ —
+functional mel/window math + feature Layers).
+
+TPU-first: every feature is frame -> rfft -> matmul composition with static
+shapes, so a whole batch of spectrograms is one fused XLA program feeding
+the MXU (the fbank/DCT applications are matmuls)."""
+from . import functional  # noqa: F401
+from .features import (  # noqa: F401
+    MFCC,
+    LogMelSpectrogram,
+    MelSpectrogram,
+    Spectrogram,
+)
+
+__all__ = ["functional", "Spectrogram", "MelSpectrogram",
+           "LogMelSpectrogram", "MFCC"]

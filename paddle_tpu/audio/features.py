@@ -1,0 +1,107 @@
+"""Audio feature layers (reference python/paddle/audio/features/layers.py:
+Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..nn.layer import Layer
+from . import functional as AF
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+def _stft_power(x, n_fft, hop_length, window, power, center, pad_mode):
+    """x [..., T] -> power spectrogram [..., 1 + n_fft//2, frames]."""
+    if center:
+        pads = [(0, 0)] * (x.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+        x = jnp.pad(x, pads, mode=pad_mode)
+    n = x.shape[-1]
+    num_frames = 1 + (n - n_fft) // hop_length
+    starts = jnp.arange(num_frames) * hop_length
+    idx = starts[:, None] + jnp.arange(n_fft)[None, :]
+    frames = x[..., idx]  # [..., frames, n_fft]
+    frames = frames * window
+    spec = jnp.fft.rfft(frames, axis=-1)  # [..., frames, 1+n_fft//2]
+    mag = jnp.abs(spec)
+    out = jnp.power(mag, power) if power != 1.0 else mag
+    return jnp.swapaxes(out, -1, -2)  # [..., freq, frames]
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        w = AF.get_window(window, self.win_length)
+        if self.win_length < n_fft:  # center-pad window to n_fft
+            lpad = (n_fft - self.win_length) // 2
+            w = np.pad(w, (lpad, n_fft - self.win_length - lpad))
+        self._window = jnp.asarray(w)
+
+    def forward(self, x):
+        return apply(
+            lambda v: _stft_power(v, self.n_fft, self.hop_length,
+                                  self._window, self.power, self.center,
+                                  self.pad_mode),
+            x, op_name="spectrogram")
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 dtype="float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
+                                        power, center, pad_mode)
+        self._fbank = jnp.asarray(AF.compute_fbank_matrix(
+            sr, n_fft, n_mels, f_min, f_max, htk, norm))  # [n_mels, freq]
+
+    def forward(self, x):
+        spec = self._spectrogram(x)
+        return apply(lambda s: jnp.einsum("mf,...ft->...mt", self._fbank, s),
+                     spec, op_name="mel_fbank")
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 ref_value=1.0, amin=1e-10, top_db=None, dtype="float32"):
+        super().__init__()
+        self._mel = MelSpectrogram(sr, n_fft, hop_length, win_length, window,
+                                   power, center, pad_mode, n_mels, f_min,
+                                   f_max, htk, norm)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        return AF.power_to_db(self._mel(x), self.ref_value, self.amin,
+                              self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self._log_mel = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin, top_db)
+        self._dct = jnp.asarray(AF.create_dct(n_mfcc, n_mels))  # [n_mels, n_mfcc]
+
+    def forward(self, x):
+        logmel = self._log_mel(x)
+        return apply(lambda s: jnp.einsum("mk,...mt->...kt", self._dct, s),
+                     logmel, op_name="mfcc_dct")

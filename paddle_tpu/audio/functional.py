@@ -1,0 +1,128 @@
+"""Audio functional math (reference python/paddle/audio/functional/
+functional.py + window.py): mel scales, filterbanks, DCT, windows, dB."""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+__all__ = [
+    "hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+    "compute_fbank_matrix", "power_to_db", "create_dct", "get_window",
+]
+
+
+def _np_in(x):
+    return np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+
+
+def hz_to_mel(freq, htk=False):
+    """Slaney (default) or HTK mel scale (reference functional.py)."""
+    f = _np_in(freq).astype(np.float64)
+    if htk:
+        out = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mels = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        mels = np.where(f >= min_log_hz,
+                        min_log_mel + np.log(np.maximum(f, 1e-10) / min_log_hz)
+                        / logstep, mels)
+        out = mels
+    return out if np.ndim(out) else float(out)
+
+
+def mel_to_hz(mel, htk=False):
+    m = _np_in(mel).astype(np.float64)
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        freqs = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        freqs = np.where(m >= min_log_mel,
+                         min_log_hz * np.exp(logstep * (m - min_log_mel)),
+                         freqs)
+        out = freqs
+    return out if np.ndim(out) else float(out)
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False):
+    mels = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk), n_mels)
+    return mel_to_hz(mels, htk)
+
+
+def fft_frequencies(sr, n_fft):
+    return np.linspace(0, sr / 2, 1 + n_fft // 2)
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney"):
+    """Triangular mel filterbank [n_mels, 1 + n_fft//2]."""
+    f_max = f_max or sr / 2
+    fftfreqs = fft_frequencies(sr, n_fft)
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk)
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1][:, None]
+    upper = ramps[2:] / fdiff[1:][:, None]
+    weights = np.maximum(0.0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2: n_mels + 2] - mel_f[:n_mels])
+        weights = weights * enorm[:, None]
+    return weights.astype(np.float32)
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    def body(x):
+        log_spec = 10.0 * jnp.log10(jnp.maximum(x, amin))
+        log_spec = log_spec - 10.0 * math.log10(max(ref_value, amin))
+        if top_db is not None:
+            log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+        return log_spec
+
+    if isinstance(spect, Tensor):
+        return apply(body, spect, op_name="power_to_db")
+    return np.asarray(body(jnp.asarray(spect)))
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho"):
+    """DCT-II basis [n_mels, n_mfcc] (reference create_dct)."""
+    n = np.arange(n_mels)
+    k = np.arange(n_mfcc)[:, None]
+    basis = np.cos(math.pi / n_mels * (n + 0.5) * k)  # [n_mfcc, n_mels]
+    if norm == "ortho":
+        basis[0] *= 1.0 / math.sqrt(n_mels)
+        basis[1:] *= math.sqrt(2.0 / n_mels)
+    else:
+        basis *= 2.0
+    return basis.T.astype(np.float32)
+
+
+def get_window(window, win_length, fftbins=True):
+    """hann/hamming/blackman/bartlett/ones windows (reference window.py)."""
+    n = win_length
+    denom = n if fftbins else n - 1
+    t = np.arange(n)
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * math.pi * t / denom)
+    elif window == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * math.pi * t / denom)
+    elif window == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * math.pi * t / denom)
+             + 0.08 * np.cos(4 * math.pi * t / denom))
+    elif window in ("bartlett", "triang"):
+        w = 1.0 - np.abs(2.0 * t / denom - 1.0)
+    elif window in ("ones", "rect", "boxcar", None):
+        w = np.ones(n)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    return w.astype(np.float32)

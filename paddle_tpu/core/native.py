@@ -37,12 +37,19 @@ def load():
             return _LIB
         _TRIED = True
         try:
-            if not os.path.exists(_SO):
+            # make's dependency check is cheap and keeps the binary in sync
+            # with edited sources; fall back to a prebuilt .so if make is
+            # unavailable but the artifact exists
+            try:
                 _build()
+            except (RuntimeError, subprocess.SubprocessError, OSError):
+                if not os.path.exists(_SO):
+                    raise
             lib = ctypes.CDLL(_SO)
-        except (OSError, RuntimeError, subprocess.SubprocessError):
+            _bind(lib)  # AttributeError here = stale-ABI binary
+        except (OSError, RuntimeError, subprocess.SubprocessError,
+                AttributeError):
             return None
-        _bind(lib)
         _LIB = lib
         return _LIB
 

@@ -1,0 +1,110 @@
+"""Elastic-lite: heartbeat-based failure detection + restart hooks
+(reference /root/reference/python/paddle/distributed/fleet/elastic/
+manager.py:124 — etcd3 registration, TTL lease heartbeat, watch callbacks,
+ElasticLevel 1 fault-tolerant restarts).
+
+TPU-native stance (SURVEY §5.3): no per-rank elasticity over ICI — recovery
+is pod-restart + checkpoint-resume. This manager provides the detection half
+over the native TCPStore (etcd's role) and the launch CLI provides the
+restart half (--max_restarts); ElasticLevel 2 scale-up/down does not apply
+to a fixed TPU slice.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+__all__ = ["ElasticManager", "Heartbeat"]
+
+
+class Heartbeat:
+    """Worker side: bump the `beat/<rank>` SEQUENCE every interval (the TTL
+    lease role). Sequence numbers — not wall-clock timestamps — so liveness
+    never depends on clock sync between hosts."""
+
+    def __init__(self, store, rank, interval=2.0):
+        self.store = store
+        self.rank = int(rank)
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        self.store.add(f"beat/{self.rank}", 1)
+
+        def run():
+            while not self._stop.wait(self.interval):
+                self.store.add(f"beat/{self.rank}", 1)
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join()
+
+
+class ElasticManager:
+    """Master side: watch every worker's heartbeat; report dead ranks and
+    fire a callback (launcher restarts the pod — elastic level 1)."""
+
+    def __init__(self, store, world_size, timeout=6.0, poll=1.0,
+                 on_failure=None):
+        self.store = store
+        self.world_size = int(world_size)
+        self.timeout = timeout
+        self.poll = poll
+        self.on_failure = on_failure
+        self._stop = threading.Event()
+        self._thread = None
+        self.dead: list[int] = []
+        # rank -> (last seen sequence, master-local time it changed)
+        self._seen: dict[int, tuple[int, float]] = {}
+
+    def wait_for_all(self, timeout=60.0):
+        """Block until every rank has registered a first heartbeat."""
+        deadline = time.time() + timeout
+        for r in range(self.world_size):
+            remain = max(0.1, deadline - time.time())
+            if not self.store.wait(f"beat/{r}", timeout=remain):
+                raise TimeoutError(f"rank {r} never heartbeat")
+
+    def check_once(self) -> list[int]:
+        """Ranks whose heartbeat sequence hasn't advanced within the timeout
+        (measured entirely on the master's clock — immune to cross-host
+        clock skew)."""
+        now = time.monotonic()
+        dead = []
+        for r in range(self.world_size):
+            raw = self.store.get(f"beat/{r}")
+            if raw is None:
+                dead.append(r)
+                continue
+            seq = int(raw)
+            last_seq, last_t = self._seen.get(r, (None, now))
+            if seq != last_seq:
+                self._seen[r] = (seq, now)
+            elif now - last_t > self.timeout:
+                dead.append(r)
+        return dead
+
+    def start(self):
+        def run():
+            while not self._stop.wait(self.poll):
+                dead = self.check_once()
+                if dead:
+                    self.dead = dead
+                    if self.on_failure is not None:
+                        self.on_failure(dead)
+                    return
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join()

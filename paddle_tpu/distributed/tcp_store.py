@@ -9,6 +9,7 @@ launch CLI, health heartbeats for elastic restart, and user-level rendezvous.
 from __future__ import annotations
 
 import ctypes
+import threading
 
 from ..core import native
 
@@ -38,12 +39,18 @@ class TCPStore:
         if self._fd < 0:
             raise TimeoutError(
                 f"TCPStore could not reach {host}:{self.port}")
+        # ctypes releases the GIL: one in-flight request per connection, or
+        # interleaved partial writes corrupt the wire protocol (heartbeat
+        # threads share the store with the main thread)
+        self._io_lock = threading.Lock()
 
     # -- reference API -----------------------------------------------------
     def set(self, key: str, value):
         v = value if isinstance(value, bytes) else str(value).encode()
         k = key.encode()
-        if self._lib.ts_set(self._fd, k, len(k), v, len(v)) != 0:
+        with self._io_lock:
+            r = self._lib.ts_set(self._fd, k, len(k), v, len(v))
+        if r != 0:
             raise RuntimeError("TCPStore set failed")
 
     def get(self, key: str) -> bytes | None:
@@ -51,7 +58,8 @@ class TCPStore:
         cap = 1 << 20
         while True:
             buf = ctypes.create_string_buffer(cap)
-            n = self._lib.ts_get(self._fd, k, len(k), buf, cap)
+            with self._io_lock:
+                n = self._lib.ts_get(self._fd, k, len(k), buf, cap)
             if n == -1:
                 return None
             if n <= -3:
@@ -63,7 +71,8 @@ class TCPStore:
 
     def add(self, key: str, amount: int = 1) -> int:
         k = key.encode()
-        out = self._lib.ts_add(self._fd, k, len(k), int(amount))
+        with self._io_lock:
+            out = self._lib.ts_add(self._fd, k, len(k), int(amount))
         if out == -(2 ** 63):
             raise RuntimeError("TCPStore add failed")
         return int(out)
@@ -71,21 +80,26 @@ class TCPStore:
     def wait(self, key: str, timeout=None) -> bool:
         k = key.encode()
         ms = -1 if timeout is None else int(timeout * 1000)
-        r = self._lib.ts_wait(self._fd, k, len(k), ms)
+        with self._io_lock:
+            r = self._lib.ts_wait(self._fd, k, len(k), ms)
         if r < 0:
             raise RuntimeError("TCPStore wait failed")
         return bool(r)
 
     def delete_key(self, key: str) -> bool:
         k = key.encode()
-        return bool(self._lib.ts_delete(self._fd, k, len(k)))
+        with self._io_lock:
+            r = self._lib.ts_delete(self._fd, k, len(k))
+        return bool(r)
 
     def barrier(self, name: str, world_size: int, timeout=60.0):
-        """All `world_size` callers block until everyone arrived."""
+        """All `world_size` callers block until everyone arrived. Reusable:
+        arrival counts define generations, each with its own done key."""
         n = self.add(f"__barrier/{name}", 1)
-        if n == world_size:
-            self.set(f"__barrier/{name}/done", b"1")
-        ok = self.wait(f"__barrier/{name}/done", timeout)
+        gen = (n - 1) // world_size
+        if n == (gen + 1) * world_size:  # last arrival of this generation
+            self.set(f"__barrier/{name}/done/{gen}", b"1")
+        ok = self.wait(f"__barrier/{name}/done/{gen}", timeout)
         if not ok:
             raise TimeoutError(f"barrier '{name}' timed out at {n}/{world_size}")
 

@@ -1,0 +1,27 @@
+"""paddle.onnx parity surface (reference python/paddle/onnx/export.py — a
+0.2K-LoC delegation to the external paddle2onnx package).
+
+This build has no ONNX exporter dependency (zero-egress image); ``export``
+produces the portable deployment artifact this framework standardizes on —
+a serialized StableHLO program + weights via jit.save (loadable by
+paddle_tpu.inference and any StableHLO consumer). Requesting a literal
+.onnx file raises with instructions, exactly like the reference does when
+paddle2onnx isn't installed.
+"""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    if path.endswith(".onnx"):
+        raise RuntimeError(
+            "ONNX serialization needs the external paddle2onnx-equivalent "
+            "converter, which is not available in this environment. Use a "
+            "prefix path (no .onnx) to export the portable StableHLO "
+            "artifact instead; paddle_tpu.inference.Predictor and any "
+            "StableHLO toolchain can load it.")
+    from . import jit
+
+    jit.save(layer, path, input_spec=input_spec)
+    return path + ".pdmodel"

@@ -1,0 +1,10 @@
+"""paddle.text parity-lite (reference /root/reference/python/paddle/text/ —
+NLP datasets + the ViterbiDecoder layer from paddle.text.viterbi_decode).
+
+Datasets fall back to deterministic synthetic corpora in air-gapped
+environments, same policy as paddle_tpu.vision.datasets.
+"""
+from .datasets import Imdb, UCIHousing  # noqa: F401
+from .viterbi import ViterbiDecoder, viterbi_decode  # noqa: F401
+
+__all__ = ["Imdb", "UCIHousing", "ViterbiDecoder", "viterbi_decode"]

@@ -1,0 +1,66 @@
+"""Text datasets (reference python/paddle/text/datasets/: imdb.py,
+uci_housing.py ...). Synthetic deterministic fallback when the corpora
+aren't on disk (zero-egress environments), mirroring vision.datasets."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["Imdb", "UCIHousing"]
+
+
+class Imdb(Dataset):
+    """Binary sentiment over integer token sequences (reference imdb.py API:
+    items are (doc int64[seq], label int64)). Synthetic corpus: class-
+    dependent token distributions, fixed seed per split."""
+
+    VOCAB = 2048
+    SEQ = 128
+
+    def __init__(self, data_file=None, mode="train", cutoff=150, download=True):
+        self.mode = mode
+        n = 2000 if mode == "train" else 500
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        labels = rng.randint(0, 2, n).astype(np.int64)
+        # positive docs skew to the upper half of the vocab
+        base = rng.randint(1, self.VOCAB // 2, (n, self.SEQ))
+        shift = (labels[:, None] * self.VOCAB // 2)
+        mask = rng.rand(n, self.SEQ) < 0.7
+        self.docs = np.where(mask, base + shift, base).astype(np.int64)
+        self.labels = labels
+        self.word_idx = {f"w{i}": i for i in range(self.VOCAB)}
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.labels)
+
+    def get_arrays(self):
+        return self.docs, self.labels
+
+
+class UCIHousing(Dataset):
+    """13-feature housing regression (reference uci_housing.py). Synthetic:
+    linear ground truth + noise, fixed seed per split."""
+
+    FEATS = 13
+
+    def __init__(self, data_file=None, mode="train", download=True):
+        self.mode = mode
+        n = 404 if mode == "train" else 102
+        rng = np.random.RandomState(2 if mode == "train" else 3)
+        self.features = rng.rand(n, self.FEATS).astype(np.float32)
+        w = np.linspace(-2, 3, self.FEATS).astype(np.float32)
+        self.prices = (self.features @ w + 1.5
+                       + rng.randn(n).astype(np.float32) * 0.05)[:, None]
+
+    def __getitem__(self, idx):
+        return self.features[idx], self.prices[idx]
+
+    def __len__(self):
+        return len(self.prices)
+
+    def get_arrays(self):
+        return self.features, self.prices

@@ -1,0 +1,136 @@
+"""audio/text domain libs, elastic failure detection, onnx export surface.
+Audio oracle: librosa-equivalent formulas via torchaudio-free manual math +
+torch.stft comparison."""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+from paddle_tpu.core import native
+
+
+class TestAudio:
+    def test_spectrogram_matches_torch_stft(self):
+        from paddle_tpu.audio import Spectrogram
+
+        x = np.random.RandomState(0).randn(2, 400).astype(np.float32)
+        spec = Spectrogram(n_fft=64, hop_length=16, window="hann",
+                           power=2.0, center=True, pad_mode="reflect")
+        got = spec(paddle.to_tensor(x)).numpy()
+        want = torch.stft(torch.from_numpy(x), n_fft=64, hop_length=16,
+                          window=torch.hann_window(64, periodic=True),
+                          center=True, pad_mode="reflect",
+                          return_complex=True).abs().pow(2).numpy()
+        assert got.shape == want.shape
+        np.testing.assert_allclose(got, want, atol=1e-3, rtol=1e-3)
+
+    def test_mel_and_mfcc_shapes_and_filterbank(self):
+        from paddle_tpu.audio import LogMelSpectrogram, MFCC
+        from paddle_tpu.audio.functional import (
+            compute_fbank_matrix, hz_to_mel, mel_to_hz)
+
+        # mel scale roundtrip
+        f = np.array([100.0, 440.0, 4000.0])
+        np.testing.assert_allclose(mel_to_hz(hz_to_mel(f)), f, rtol=1e-6)
+        np.testing.assert_allclose(mel_to_hz(hz_to_mel(f, htk=True), htk=True),
+                                   f, rtol=1e-6)
+        fbank = compute_fbank_matrix(16000, 512, n_mels=40)
+        assert fbank.shape == (40, 257)
+        assert (fbank >= 0).all() and fbank.sum() > 0
+
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(3, 800).astype(np.float32))
+        logmel = LogMelSpectrogram(sr=16000, n_fft=128, hop_length=64,
+                                   n_mels=20, f_min=0.0)(x)
+        assert logmel.shape[0] == 3 and logmel.shape[1] == 20
+        mfcc = MFCC(sr=16000, n_mfcc=13, n_fft=128, hop_length=64,
+                    n_mels=20, f_min=0.0)(x)
+        assert mfcc.shape[1] == 13
+
+    def test_feature_grads_flow(self):
+        from paddle_tpu.audio import MelSpectrogram
+
+        x = paddle.to_tensor(
+            np.random.RandomState(2).randn(1, 256).astype(np.float32))
+        x.stop_gradient = False
+        mel = MelSpectrogram(sr=8000, n_fft=64, hop_length=32, n_mels=8,
+                             f_min=0.0)(x)
+        paddle.sum(mel).backward()
+        assert x.grad is not None and float(np.abs(x.grad.numpy()).sum()) > 0
+
+
+class TestText:
+    def test_datasets_learnable(self):
+        from paddle_tpu.text import Imdb, UCIHousing
+
+        imdb = Imdb(mode="train")
+        doc, label = imdb[0]
+        assert doc.shape == (Imdb.SEQ,) and label in (0, 1)
+        assert len(Imdb(mode="test")) == 500
+
+        housing = UCIHousing(mode="train")
+        f, p = housing[3]
+        assert f.shape == (13,) and p.shape == (1,)
+        # linear regression on the synthetic data must fit well
+        X = housing.features
+        Y = housing.prices
+        w, *_ = np.linalg.lstsq(np.c_[X, np.ones(len(X))], Y, rcond=None)
+        resid = np.c_[X, np.ones(len(X))] @ w - Y
+        assert np.abs(resid).mean() < 0.1
+
+    def test_viterbi_decoder_layer(self):
+        from paddle_tpu.text import ViterbiDecoder
+
+        rng = np.random.RandomState(3)
+        emit = paddle.to_tensor(rng.rand(2, 5, 4).astype(np.float32))
+        trans = paddle.to_tensor(rng.rand(4, 4).astype(np.float32))
+        lens = paddle.to_tensor(np.array([5, 5], np.int64))
+        dec = ViterbiDecoder(trans)
+        scores, path = dec(emit, lens)
+        assert path.shape == [2, 5]
+        assert (path.numpy() >= 0).all() and (path.numpy() < 4).all()
+
+
+@pytest.mark.skipif(not native.available(), reason="no C++ toolchain")
+class TestElastic:
+    def test_detects_dead_worker_and_triggers_restart_cb(self):
+        import time
+
+        from paddle_tpu.distributed import TCPStore
+        from paddle_tpu.distributed.elastic import ElasticManager, Heartbeat
+
+        store = TCPStore(is_master=True)
+        try:
+            beats = [Heartbeat(TCPStore(port=store.port), r, interval=0.2).start()
+                     for r in range(3)]
+            mgr = ElasticManager(store, world_size=3, timeout=1.0, poll=0.2)
+            mgr.wait_for_all(timeout=10)
+            assert mgr.check_once() == []
+
+            failed = []
+            mgr.on_failure = lambda dead: failed.append(dead)
+            mgr.start()
+            beats[1].stop()  # rank 1 dies
+            t0 = time.time()
+            while not failed and time.time() - t0 < 15:
+                time.sleep(0.1)
+            assert failed and failed[0] == [1]
+            mgr.stop()
+            for b in beats:
+                b.stop()
+        finally:
+            store.close()
+
+
+class TestOnnxSurface:
+    def test_export_writes_portable_artifact(self, tmp_path):
+        import paddle_tpu.nn as nn
+
+        net = nn.Linear(4, 2)
+        out = paddle.onnx.export(net, str(tmp_path / "m"),
+                                 input_spec=[([None, 4], "float32")])
+        import os
+
+        assert os.path.exists(out)
+        with pytest.raises(RuntimeError, match="paddle2onnx"):
+            paddle.onnx.export(net, str(tmp_path / "m.onnx"))
