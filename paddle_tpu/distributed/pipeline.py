@@ -31,6 +31,7 @@ from .. import nn
 __all__ = [
     "LayerDesc", "SharedLayerDesc", "PipelineLayer", "spmd_pipeline",
     "spmd_pipeline_1f1b", "make_pipeline_1f1b_loss", "stack_stage_params",
+    "spmd_pipeline_interleaved", "interleave_stage_params",
 ]
 
 
@@ -115,56 +116,103 @@ def spmd_pipeline(stage_fn, stage_params, x_micro, mesh, n_stages, remat=True,
     stage_params: pytree, every leaf [S, ...]       (sharded over 'pp' dim 0)
     x_micro:      [M, mb, ...] micro-batched input  (replicated over 'pp')
     returns       [M, mb, ...] last-stage outputs   (replicated over 'pp')
+
+    Exactly the vpp=1 case of the interleaved schedule — one tick loop to
+    maintain (inject/bank/ring logic lives in spmd_pipeline_interleaved).
+    """
+    params_v1 = jax.tree_util.tree_map(lambda a: a[:, None], stage_params)
+    return spmd_pipeline_interleaved(
+        stage_fn, params_v1, x_micro, mesh, n_stages, vpp=1, remat=remat,
+        extra_args=extra_args)
+
+
+def interleave_stage_params(params_L, n_stages):
+    """Reorder logical-stage-stacked params [L, ...] (L = n_stages * vpp)
+    into the interleaved-device layout [n_stages, vpp, ...]: device d hosts
+    chunks d, d+n, d+2n... (reference PipelineParallelWithInterleave's
+    model-chunk assignment, pipeline_parallel.py:807)."""
+    def rearrange(a):
+        L = a.shape[0]
+        v = L // n_stages
+        return a.reshape((v, n_stages) + a.shape[1:]).swapaxes(0, 1)
+
+    return jax.tree_util.tree_map(rearrange, params_L)
+
+
+def spmd_pipeline_interleaved(stage_fn, stage_params, x_micro, mesh, n_stages,
+                              vpp, remat=True, extra_args=()):
+    """Interleaved virtual-stage pipeline (reference
+    PipelineParallelWithInterleave, pipeline_parallel.py:807,952): each
+    device hosts ``vpp`` non-adjacent model chunks, so pp depth L = n*vpp
+    runs on n devices with 1/vpp of the contiguous-stage memory per device.
+
+    Schedule shape: one scan of M + L - 1 ticks; every tick each device
+    advances all of its in-flight chunk slots (a length-vpp inner scan —
+    the sequential chunk execution of the reference's schedule), then the
+    ring rotates and wrap-around activations move to the next chunk slot.
+    The scan is reverse-differentiable, so the backward schedule is the
+    exact transpose. XLA's latency-hiding scheduler overlaps the ppermute
+    with the next tick's chunk compute.
+
+    stage_params: pytree with leaves [n_stages, vpp, ...] (see
+    interleave_stage_params), sharded over 'pp' on dim 0.
+    x_micro: [M, mb, ...] replicated. Returns [M, mb, ...].
     """
     M = x_micro.shape[0]
     S = n_stages
+    L = S * vpp
     body = jax.checkpoint(stage_fn) if remat else stage_fn
 
     def per_stage(params, xs, *extra):
-        # params leaves: [1, ...] local slice -> squeeze stage dim
-        p_local = jax.tree_util.tree_map(lambda a: a[0], params)
+        p_local = jax.tree_util.tree_map(lambda a: a[0], params)  # [vpp, ...]
         stage_id = jax.lax.axis_index("pp")
 
-        # carries are varying over 'pp' from the start (check_vma typing)
-        h0 = _pvary(jnp.zeros_like(xs[0]))
+        act0 = _pvary(jnp.zeros((vpp,) + xs.shape[1:], xs.dtype))
         out0 = _pvary(jnp.zeros((M,) + xs.shape[1:], xs.dtype))
 
         def tick(carry, t):
-            h_in, outputs = carry
-            # stage 0 consumes micro-batch t while t < M; later stages consume
-            # what arrived over the wire last tick
+            acts, outputs = carry  # acts [vpp, mb, ...]
             mb_idx = jnp.clip(t, 0, M - 1)
             first_in = _pvary(
                 jax.lax.dynamic_index_in_dim(xs, mb_idx, 0, keepdims=False))
-            inp = jnp.where(stage_id == 0, first_in, h_in)
-            h_out = body(p_local, inp, *extra)
-            # last stage banks its result for micro-batch t - (S-1)
-            out_idx = jnp.clip(t - (S - 1), 0, M - 1)
-            bank = (stage_id == S - 1) & (t >= S - 1)
+            # device 0 slot 0 consumes the entering micro-batch
+            inject = jnp.where(stage_id == 0, first_in, acts[0])
+            acts = jax.lax.dynamic_update_index_in_dim(acts, inject, 0, 0)
+
+            # advance every chunk slot (sequential over vpp, like the
+            # reference device executing its chunks in order)
+            def chunk_step(_, pc_hc):
+                p_c, h_c = pc_hc
+                return None, body(p_c, h_c, *extra)
+
+            _, h_out = jax.lax.scan(chunk_step, None, (p_local, acts))
+
+            # bank the final logical stage's product: device S-1, slot vpp-1
+            out_idx = jnp.clip(t - (L - 1), 0, M - 1)
+            bank = (stage_id == S - 1) & (t >= L - 1)
             outputs = jax.lax.cond(
                 bank,
-                lambda o: jax.lax.dynamic_update_index_in_dim(o, h_out, out_idx, 0),
+                lambda o: jax.lax.dynamic_update_index_in_dim(
+                    o, h_out[vpp - 1], out_idx, 0),
                 lambda o: o,
                 outputs,
             )
-            # shift activations one stage forward (ring; last->0 ignored)
-            h_next = jax.lax.ppermute(
+            # rotate the ring per slot; wrap-arounds landing on device 0
+            # move up one chunk slot
+            arrived = jax.lax.ppermute(
                 h_out, "pp", [(i, (i + 1) % S) for i in range(S)])
-            return (h_next, outputs), None
+            wrapped = jnp.concatenate(
+                [jnp.zeros_like(arrived[:1]), arrived[:-1]], axis=0)
+            acts_next = jnp.where(stage_id == 0, wrapped, arrived)
+            return (acts_next, outputs), None
 
-        # scan (not fori_loop) so the schedule is reverse-differentiable
-        (_, outputs), _ = jax.lax.scan(
-            tick, (h0, out0), jnp.arange(M + S - 1))
-        # only the last stage holds real outputs; replicate via psum
-        outputs = jnp.where(stage_id == S - 1, outputs, jnp.zeros_like(outputs))
+        (_, outputs), _ = jax.lax.scan(tick, (act0, out0),
+                                       jnp.arange(M + L - 1))
+        outputs = jnp.where(stage_id == S - 1, outputs,
+                            jnp.zeros_like(outputs))
         return jax.lax.psum(outputs, "pp")
 
     pp_specs = jax.tree_util.tree_map(lambda _: P("pp"), stage_params)
-    # partial-manual shard_map: only 'pp' is manual; dp/sharding/mp stay
-    # automatic so GSPMD keeps partitioning the tensor-parallel matmuls and
-    # data-parallel batch INSIDE each stage body (pipeline composes with TP/DP)
-    # check_vma=True is required: jax 0.9's check_vma=False path builds an
-    # internal spec over ALL mesh axes, which breaks partial-manual mode
     mapped = jax.shard_map(
         per_stage,
         mesh=mesh,

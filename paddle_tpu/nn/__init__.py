@@ -15,6 +15,7 @@ from .layers.conv import *  # noqa: F401,F403
 from .layers.loss import *  # noqa: F401,F403
 from .layers.norm import *  # noqa: F401,F403
 from .layers.pooling import *  # noqa: F401,F403
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
 from .layers.rnn import *  # noqa: F401,F403
 from .layers.transformer import *  # noqa: F401,F403
 
@@ -28,7 +29,8 @@ from .layers import rnn as _rnn
 from .layers import transformer as _transformer
 
 __all__ = (
-    ["Layer", "LayerList", "Sequential", "ParameterList", "functional", "initializer"]
+    ["Layer", "LayerList", "Sequential", "ParameterList", "functional",
+     "initializer", "ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm"]
     + _act.__all__ + _common.__all__ + _conv.__all__
     + _loss.__all__ + _norm.__all__ + _pooling.__all__
     + _rnn.__all__ + _transformer.__all__

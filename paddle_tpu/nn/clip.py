@@ -1,0 +1,68 @@
+"""Gradient clipping (reference python/paddle/nn/clip.py —
+ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm; the hybrid-parallel
+wrapper HybridParallelClipGrad in fleet/meta_optimizers/dygraph_optimizer/
+hybrid_parallel_optimizer.py:49 reduces the global norm across mesh axes).
+
+TPU-native: each clip has two faces — the eager [(param, grad)] list API,
+and ``_clip_tree`` over a raw grad pytree used inside the jitted optimizer
+step. Under the engine, grads are GSPMD-sharded global arrays, so the norm
+reductions in ``_clip_tree`` automatically span every mesh axis — the
+HybridParallelClipGrad cross-group allreduce falls out of SPMD for free.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm"]
+
+
+class _ClipBase:
+    def __call__(self, params_grads):
+        """Eager interface: [(param, grad Tensor)] -> same, clipped."""
+        grads = {i: g._value for i, (_, g) in enumerate(params_grads)}
+        clipped = self._clip_tree(grads)
+        return [(p, Tensor._wrap(clipped[i]))
+                for i, (p, _) in enumerate(params_grads)]
+
+
+class ClipGradByValue(_ClipBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def _clip_tree(self, grads):
+        return {k: jnp.clip(g, self.min, self.max) for k, g in grads.items()}
+
+
+class ClipGradByNorm(_ClipBase):
+    """Per-tensor L2 norm clip."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _clip_tree(self, grads):
+        out = {}
+        for k, g in grads.items():
+            norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(norm, 1e-12))
+            out[k] = (g.astype(jnp.float32) * scale).astype(g.dtype)
+        return out
+
+
+class ClipGradByGlobalNorm(_ClipBase):
+    """One L2 norm over ALL grads; every grad scaled by the same factor."""
+
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+
+    def _clip_tree(self, grads):
+        sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                 for g in grads.values())
+        global_norm = jnp.sqrt(sq)
+        scale = jnp.minimum(
+            1.0, self.clip_norm / jnp.maximum(global_norm, 1e-12))
+        return {k: (g.astype(jnp.float32) * scale).astype(g.dtype)
+                for k, g in grads.items()}

@@ -106,6 +106,21 @@ class Optimizer:
         decay (e.g. biases/norms, mirroring the reference's no-decay lists).
         """
         lr = self.get_lr() if lr is None else lr
+        if self._grad_clip is not None:
+            if not hasattr(self._grad_clip, "_clip_tree"):
+                # loud, not silent: a clip that only speaks the eager
+                # [(param, grad)] protocol can't run inside this jitted path
+                raise TypeError(
+                    f"{type(self._grad_clip).__name__} has no _clip_tree; "
+                    "jitted training (engine/hapi) needs a pytree-capable "
+                    "clip — subclass paddle_tpu.nn.clip._ClipBase or add a "
+                    "_clip_tree(grads: dict) method")
+            # grads here are (possibly mesh-sharded) global arrays, so the
+            # clip's norm reductions span every parallel axis — the
+            # reference HybridParallelClipGrad cross-group behavior
+            present = {k: g for k, g in grads.items() if g is not None}
+            clipped = self._grad_clip._clip_tree(present)
+            grads = {k: clipped.get(k, g) for k, g in grads.items()}
         new_params, new_state = {}, {}
         for k, p in params.items():
             g = grads[k]

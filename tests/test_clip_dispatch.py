@@ -1,0 +1,123 @@
+"""Gradient clipping (eager + jitted engine, cross-mesh global norm —
+VERDICT weak #5) and the eager dispatch-overhead budget (weak #7)."""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import DistributedEngine, DistributedStrategy
+from paddle_tpu.distributed.mesh import set_hybrid_communicate_group
+from paddle_tpu.distributed.strategy import HybridConfig, ShardingConfig
+
+
+class TestClipMath:
+    def test_clip_by_value(self):
+        clip = nn.ClipGradByValue(max=0.5)
+        p = paddle.to_tensor(np.zeros(3, np.float32))
+        g = paddle.to_tensor(np.array([-2.0, 0.2, 3.0], np.float32))
+        [(_, cg)] = clip([(p, g)])
+        np.testing.assert_allclose(cg.numpy(), [-0.5, 0.2, 0.5])
+
+    def test_clip_by_norm(self):
+        clip = nn.ClipGradByNorm(clip_norm=1.0)
+        g = np.array([3.0, 4.0], np.float32)  # norm 5
+        [(_, cg)] = clip([(paddle.to_tensor(np.zeros(2, np.float32)),
+                           paddle.to_tensor(g))])
+        np.testing.assert_allclose(cg.numpy(), g / 5.0, rtol=1e-6)
+
+    def test_clip_by_global_norm(self):
+        clip = nn.ClipGradByGlobalNorm(clip_norm=1.0)
+        g1 = np.array([3.0], np.float32)
+        g2 = np.array([4.0], np.float32)  # global norm 5
+        out = clip([(paddle.to_tensor(np.zeros(1, np.float32)), paddle.to_tensor(g1)),
+                    (paddle.to_tensor(np.zeros(1, np.float32)), paddle.to_tensor(g2))])
+        np.testing.assert_allclose(out[0][1].numpy(), [0.6], rtol=1e-6)
+        np.testing.assert_allclose(out[1][1].numpy(), [0.8], rtol=1e-6)
+        # under the threshold: untouched
+        small = clip([(paddle.to_tensor(np.zeros(1, np.float32)),
+                       paddle.to_tensor(np.array([0.1], np.float32)))])
+        np.testing.assert_allclose(small[0][1].numpy(), [0.1], rtol=1e-6)
+
+    def test_eager_optimizer_applies_clip(self):
+        paddle.seed(0)
+        net = nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(parameters=net.parameters(),
+                                   learning_rate=1.0,
+                                   grad_clip=nn.ClipGradByGlobalNorm(1e-6))
+        before = net.weight.numpy().copy()
+        out = net(paddle.to_tensor(np.ones((2, 4), np.float32)))
+        paddle.sum(out * out).backward()
+        opt.step()
+        # clip to ~0 norm => essentially no movement despite lr=1
+        assert np.abs(net.weight.numpy() - before).max() < 1e-5
+
+
+class TestEngineClipParity:
+    def _losses(self, dp, mp, sh, stage, clip_norm):
+        set_hybrid_communicate_group(None)
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+        strat = DistributedStrategy(
+            hybrid_configs=HybridConfig(dp_degree=dp, mp_degree=mp,
+                                        sharding_degree=sh),
+            sharding=ShardingConfig(stage=stage))
+        opt = paddle.optimizer.AdamW(
+            parameters=net.parameters(), learning_rate=5e-2,
+            grad_clip=nn.ClipGradByGlobalNorm(0.1))
+        eng = DistributedEngine(net, loss_fn=paddle.nn.CrossEntropyLoss(),
+                                optimizer=opt, strategy=strat)
+        rng = np.random.RandomState(0)
+        out = []
+        for s in range(3):
+            x = rng.rand(16, 16).astype(np.float32)
+            y = rng.randint(0, 4, (16,)).astype(np.int64)
+            out.append(float(np.asarray(eng.step([x], [y]))))
+        set_hybrid_communicate_group(None)
+        return out
+
+    def test_global_norm_spans_mesh_axes(self):
+        """Clipped training on dp2 x mp2 x zero2 must equal the single-axis
+        run — the global-norm reduction crosses every parallel axis (the
+        HybridParallelClipGrad guarantee)."""
+        ref = self._losses(8, 1, 1, 1, 0.1)
+        hyb = self._losses(2, 2, 2, 2, 0.1)
+        np.testing.assert_allclose(ref, hyb, rtol=2e-4, atol=2e-5)
+
+    def test_clip_changes_trajectory(self):
+        clipped = self._losses(8, 1, 1, 1, 0.1)
+        set_hybrid_communicate_group(None)
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+        strat = DistributedStrategy(
+            hybrid_configs=HybridConfig(dp_degree=8))
+        opt = paddle.optimizer.AdamW(parameters=net.parameters(),
+                                     learning_rate=5e-2)
+        eng = DistributedEngine(net, loss_fn=paddle.nn.CrossEntropyLoss(),
+                                optimizer=opt, strategy=strat)
+        rng = np.random.RandomState(0)
+        unclipped = []
+        for s in range(3):
+            x = rng.rand(16, 16).astype(np.float32)
+            y = rng.randint(0, 4, (16,)).astype(np.int64)
+            unclipped.append(float(np.asarray(eng.step([x], [y]))))
+        set_hybrid_communicate_group(None)
+        assert not np.allclose(clipped[1:], unclipped[1:], rtol=1e-4)
+
+
+class TestDispatchOverhead:
+    def test_eager_op_overhead_budget(self):
+        """Eager per-op dispatch stays within a host-overhead budget
+        (reference budget ~µs/op, SURVEY §3.1; CPU CI bound is looser)."""
+        x = paddle.to_tensor(np.ones((4, 4), np.float32))
+        y = paddle.to_tensor(np.ones((4, 4), np.float32))
+        for _ in range(20):  # warm caches
+            _ = paddle.add(x, y)
+        n = 200
+        t0 = time.perf_counter()
+        for _ in range(n):
+            _ = paddle.add(x, y)
+        per_op = (time.perf_counter() - t0) / n
+        # generous CI bound: dispatch + tiny kernel < 2 ms on CPU
+        assert per_op < 2e-3, f"eager dispatch too slow: {per_op*1e6:.0f}us/op"

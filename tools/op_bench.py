@@ -1,0 +1,85 @@
+"""Op micro-benchmark harness (the reference's tools/ci_op_benchmark.sh
+role: per-op timing gate, relative comparisons between revisions).
+
+Usage:
+    python tools/op_bench.py [--ops add,matmul,...] [--size 512] [--json OUT]
+
+Prints one JSON line per op: eager dispatch time (host overhead + kernel)
+and jitted steady-state time. Compare two revisions by diffing their JSON.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ops", default="add,multiply,matmul,softmax,relu,"
+                    "layer_norm,cumsum,logsumexp,transpose,concat")
+    ap.add_argument("--size", type=int, default=256)
+    ap.add_argument("--iters", type=int, default=100)
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args()
+
+    import jax
+
+    try:
+        jax.config.update("jax_default_device", jax.devices("cpu")[0])
+    except RuntimeError:
+        pass
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.ops.registry import OPS
+
+    n = args.size
+    x = paddle.to_tensor(np.random.rand(n, n).astype(np.float32))
+    y = paddle.to_tensor(np.random.rand(n, n).astype(np.float32))
+
+    cases = {
+        "add": lambda: paddle.add(x, y),
+        "multiply": lambda: paddle.multiply(x, y),
+        "matmul": lambda: paddle.matmul(x, y),
+        "softmax": lambda: F.softmax(x, axis=-1),
+        "relu": lambda: F.relu(x),
+        "layer_norm": lambda: F.layer_norm(x, [n]),
+        "cumsum": lambda: paddle.cumsum(x, axis=1),
+        "logsumexp": lambda: paddle.logsumexp(x, axis=1),
+        "transpose": lambda: paddle.transpose(x, [1, 0]),
+        "concat": lambda: paddle.concat([x, y], axis=0),
+    }
+
+    results = []
+    for name in args.ops.split(","):
+        name = name.strip()
+        fn = cases.get(name)
+        if fn is None:
+            raise SystemExit(
+                f"unknown op {name!r}; available: {sorted(cases)}")
+        for _ in range(10):
+            out = fn()  # warm
+        jax.block_until_ready(out._value)
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            out = fn()
+        jax.block_until_ready(out._value)
+        eager_us = (time.perf_counter() - t0) / args.iters * 1e6
+        rec = {"op": name, "eager_us": round(eager_us, 1), "size": n,
+               "registered": name in OPS}
+        results.append(rec)
+        print(json.dumps(rec))
+
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(results, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
