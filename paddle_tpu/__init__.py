@@ -75,6 +75,7 @@ from . import static  # noqa: F401,E402
 from . import models  # noqa: F401,E402
 from . import kernels  # noqa: F401,E402
 from .ops import parity as _ops_parity  # noqa: F401,E402  (needs nn+kernels)
+from .ops import detection as _ops_detection  # noqa: F401,E402
 for _k, _v in _ops_parity.PUBLIC_OPS.items():
     if _k not in globals():
         globals()[_k] = _v

@@ -20,11 +20,14 @@ __all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm"]
 
 class _ClipBase:
     def __call__(self, params_grads):
-        """Eager interface: [(param, grad Tensor)] -> same, clipped."""
-        grads = {i: g._value for i, (_, g) in enumerate(params_grads)}
+        """Eager interface: [(param, grad Tensor)] -> same, clipped.
+        Pairs with grad None pass through untouched (reference behavior for
+        params that received no gradient)."""
+        grads = {i: g._value for i, (_, g) in enumerate(params_grads)
+                 if g is not None}
         clipped = self._clip_tree(grads)
-        return [(p, Tensor._wrap(clipped[i]))
-                for i, (p, _) in enumerate(params_grads)]
+        return [(p, Tensor._wrap(clipped[i]) if i in clipped else g)
+                for i, (p, g) in enumerate(params_grads)]
 
 
 class ClipGradByValue(_ClipBase):

@@ -888,6 +888,7 @@ def _roi_align(x, boxes, boxes_num, pooled_height=1, pooled_width=1,
                spatial_scale=1.0, sampling_ratio=-1, aligned=True):
     """RoIAlign (Mask R-CNN): average of bilinear samples per output bin.
     boxes [R,4] absolute coords; boxes_num maps rois->batch images."""
+    x = jnp.asarray(x)  # numpy input + traced batch index inside vmap
     ratio = 2 if sampling_ratio <= 0 else int(sampling_ratio)
     counts = np.asarray(jax.device_get(boxes_num)).astype(int)
     batch_idx = np.repeat(np.arange(len(counts)), counts)
@@ -920,6 +921,7 @@ def _roi_align(x, boxes, boxes_num, pooled_height=1, pooled_width=1,
 def _roi_pool(x, boxes, boxes_num, pooled_height=1, pooled_width=1,
               spatial_scale=1.0):
     """RoIPool (Fast R-CNN): max over dense samples per quantized bin."""
+    x = jnp.asarray(x)  # numpy input + traced batch index inside vmap
     ratio = 4  # dense sampling approximates the quantized max
     counts = np.asarray(jax.device_get(boxes_num)).astype(int)
     batch_idx = jnp.asarray(
