@@ -87,6 +87,16 @@ from . import quantization  # noqa: F401,E402
 from . import audio  # noqa: F401,E402
 from . import text  # noqa: F401,E402
 from . import onnx  # noqa: F401,E402
+from . import incubate  # noqa: F401,E402
+from . import utils  # noqa: F401,E402
+from .core.containers import (  # noqa: F401,E402
+    SelectedRows,
+    TensorArray,
+    array_length,
+    array_read,
+    array_write,
+    create_array,
+)
 from . import inference  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 

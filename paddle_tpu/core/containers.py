@@ -1,0 +1,116 @@
+"""Tensor container types beyond DenseTensor (reference phi:
+TensorArray — framework/lod_tensor_array + python/paddle/tensor/array.py;
+SelectedRows — phi/core/selected_rows.h, sparse row-gradient container).
+
+TPU-native: a TensorArray is a host-side list feeding lax.scan stacking (the
+dynamic-loop role the reference gives it in while_loop); SelectedRows is the
+(rows, values) pair our embedding-style sparse grads use before a
+segment-sum scatter into the dense table.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .tensor import Tensor
+
+__all__ = ["TensorArray", "SelectedRows",
+           "create_array", "array_write", "array_read", "array_length"]
+
+
+class TensorArray:
+    """Append-only list of same-rank Tensors with stack/concat views."""
+
+    def __init__(self, dtype="float32"):
+        self.dtype = dtype
+        self._items: list[Tensor] = []
+
+    def append(self, t):
+        self._items.append(t if isinstance(t, Tensor) else Tensor(t))
+        return self
+
+    def write(self, index, t):
+        index = int(index)
+        if index > len(self._items):
+            # reference array_write only permits i <= len (append position);
+            # gap-filling with placeholders would poison stack()/read()
+            raise IndexError(
+                f"array_write index {index} > length {len(self._items)}")
+        if index == len(self._items):
+            self._items.append(None)
+        self._items[index] = t if isinstance(t, Tensor) else Tensor(t)
+        return self
+
+    def read(self, index):
+        return self._items[int(index)]
+
+    def __len__(self):
+        return len(self._items)
+
+    def __getitem__(self, i):
+        return self._items[i]
+
+    def stack(self, axis=0):
+        from .. import ops as P
+
+        return P.stack(self._items, axis=axis)
+
+    def concat(self, axis=0):
+        from .. import ops as P
+
+        return P.concat(self._items, axis=axis)
+
+    def pop(self, index=-1):
+        return self._items.pop(index)
+
+
+class SelectedRows:
+    """Sparse row container: `rows[i]` indexes the dense height dim,
+    `values[i]` is that row's data (reference selected_rows.h)."""
+
+    def __init__(self, rows, values, height):
+        self.rows = jnp.asarray(
+            rows.numpy() if isinstance(rows, Tensor) else rows, jnp.int32)
+        self.values = values._value if isinstance(values, Tensor) \
+            else jnp.asarray(values)
+        self.height = int(height)
+
+    def to_dense(self):
+        """Duplicate rows accumulate (the merge_selected_rows semantic)."""
+        out = jax.ops.segment_sum(self.values, self.rows.astype(jnp.int32),
+                                  self.height)
+        return Tensor._wrap(out)
+
+    def merge(self):
+        """Coalesce duplicate rows (reference merge_selected_rows op)."""
+        uniq = np.unique(np.asarray(jax.device_get(self.rows)))
+        dense = self.to_dense()._value
+        return SelectedRows(uniq, dense[jnp.asarray(uniq)], self.height)
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self.height}, "
+                f"nrows={self.rows.shape[0]})")
+
+
+# -- paddle.tensor.array functional surface --------------------------------
+def create_array(dtype="float32", initialized_list=None):
+    arr = TensorArray(dtype)
+    for t in initialized_list or []:
+        arr.append(t)
+    return arr
+
+
+def array_write(x, i, array=None):
+    if array is None:
+        array = TensorArray()
+    array.write(int(i.numpy()) if isinstance(i, Tensor) else int(i), x)
+    return array
+
+
+def array_read(array, i):
+    return array.read(int(i.numpy()) if isinstance(i, Tensor) else int(i))
+
+
+def array_length(array):
+    return Tensor(np.asarray(len(array), np.int64))

@@ -1,5 +1,5 @@
 """paddle.distributed parity surface, TPU-native (SURVEY §2.3, §5.8)."""
-from . import collective, fleet  # noqa: F401
+from . import collective, fleet, rpc  # noqa: F401
 from .collective import (  # noqa: F401
     ReduceOp,
     all_gather,

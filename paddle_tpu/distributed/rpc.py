@@ -1,0 +1,195 @@
+"""paddle.distributed.rpc parity (reference
+/root/reference/paddle/fluid/distributed/rpc/ + python/paddle/distributed/
+rpc/rpc.py — brpc-based tensor/callable RPC between named workers).
+
+TPU-native: training-path communication is XLA collectives; RPC remains the
+control-plane tool (dataset coordination, metrics aggregation, PS-style
+lookups). Implementation: a python socket server per worker, rendezvous of
+worker addresses through the native TCPStore, cloudpickle-free pickled
+callables (functions must be importable on the callee, same rule as the
+reference).
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from concurrent.futures import Future
+from dataclasses import dataclass
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_worker_info", "get_all_worker_infos", "WorkerInfo"]
+
+
+@dataclass
+class WorkerInfo:
+    name: str
+    rank: int
+    ip: str
+    port: int
+
+
+_state = {"server": None, "store": None, "workers": {}, "me": None}
+
+
+def _send_msg(sock, payload: bytes):
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_msg(sock) -> bytes:
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        hdr += chunk
+    (n,) = struct.unpack("<Q", hdr)
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _serve(listener):
+    while True:
+        try:
+            conn, _ = listener.accept()
+        except OSError:
+            return  # shutdown
+        threading.Thread(target=_handle, args=(conn,), daemon=True).start()
+
+
+def _handle(conn):
+    with conn:
+        try:
+            while True:
+                req = pickle.loads(_recv_msg(conn))
+                if req.get("op") == "stop":
+                    _send_msg(conn, pickle.dumps({"ok": True}))
+                    return
+                fn, args, kwargs = req["fn"], req["args"], req["kwargs"]
+                try:
+                    out = {"ok": True, "value": fn(*args, **kwargs)}
+                except Exception as e:  # deliver remote exceptions to caller
+                    out = {"ok": False, "error": e}
+                try:
+                    payload = pickle.dumps(out)
+                except Exception as e:  # unpicklable result/exception: the
+                    # caller must still get a real error, not a dead socket
+                    payload = pickle.dumps(
+                        {"ok": False,
+                         "error": RuntimeError(
+                             f"rpc result not picklable: {e!r}")})
+                _send_msg(conn, payload)
+        except (ConnectionError, EOFError):
+            return
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """Start this worker's RPC server and rendezvous all worker addresses
+    (reference rpc.init_rpc; TCPStore replaces the brpc master)."""
+    from .tcp_store import TCPStore
+
+    host, port = (master_endpoint.split(":") if master_endpoint
+                  else ("127.0.0.1", "0"))
+    is_master = rank == 0
+    store = TCPStore(host=host, port=int(port), is_master=is_master,
+                     timeout=60.0)
+    listener = socket.socket()
+    listener.bind(("0.0.0.0", 0))
+    listener.listen(64)
+    my_port = listener.getsockname()[1]
+    my_ip = "127.0.0.1" if host in ("127.0.0.1", "localhost") else \
+        socket.gethostbyname(socket.gethostname())
+    info = WorkerInfo(name, rank, my_ip, my_port)
+    store.set(f"rpc/worker/{rank}", f"{name},{my_ip},{my_port}")
+    store.add("rpc/registered", 1)
+    # wait until everyone registered, then read the full table
+    deadline_key = "rpc/all_registered"
+    if store.add("rpc/registered", 0) == world_size:
+        store.set(deadline_key, b"1")
+    store.wait(deadline_key, timeout=120.0)
+    workers = {}
+    for r in range(world_size):
+        raw = store.get(f"rpc/worker/{r}")
+        nm, ip, p = raw.decode().split(",")
+        workers[nm] = WorkerInfo(nm, r, ip, int(p))
+    _state.update(store=store, me=info, workers=workers)
+    _state["server"] = listener
+    threading.Thread(target=_serve, args=(listener,), daemon=True).start()
+    return info
+
+
+def get_worker_info(name=None):
+    if name is None:
+        return _state["me"]
+    return _state["workers"][name]
+
+
+def get_all_worker_infos():
+    return list(_state["workers"].values())
+
+
+def _call(to, fn, args, kwargs, timeout):
+    w = _state["workers"][to]
+    with socket.create_connection((w.ip, w.port), timeout=timeout) as s:
+        s.settimeout(timeout)
+        _send_msg(s, pickle.dumps(
+            {"fn": fn, "args": args or (), "kwargs": kwargs or {}}))
+        resp = pickle.loads(_recv_msg(s))
+    if not resp["ok"]:
+        raise resp["error"]
+    return resp["value"]
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=60.0):
+    """Run fn(*args, **kwargs) on worker `to`; block for the result."""
+    return _call(to, fn, args, kwargs, timeout)
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=60.0):
+    """Like rpc_sync but returns a concurrent.futures.Future (reference
+    returns a FutureWrapper with .wait())."""
+    fut = Future()
+
+    def run():
+        try:
+            fut.set_result(_call(to, fn, args, kwargs, timeout))
+        except Exception as e:
+            fut.set_exception(e)
+
+    threading.Thread(target=run, daemon=True).start()
+    fut.wait = fut.result  # reference API spells it .wait()
+    return fut
+
+
+def shutdown():
+    """Barrier with every worker, then stop serving (reference
+    rpc.shutdown's graceful drain). The store HOST must linger until every
+    worker acknowledges passing the barrier — closing earlier would yank the
+    rendezvous out from under peers still blocked in their wait."""
+    import time
+
+    store = _state["store"]
+    if store is None:
+        return
+    n = len(_state["workers"])
+    me = _state["me"]
+    try:
+        store.barrier("rpc/shutdown", n, timeout=60.0)
+        acks = store.add("rpc/shutdown_acks", 1)
+        if me is not None and me.rank == 0:
+            deadline = time.time() + 30.0
+            while acks < n and time.time() < deadline:
+                time.sleep(0.05)
+                acks = store.add("rpc/shutdown_acks", 0)
+    finally:
+        if _state["server"] is not None:
+            _state["server"].close()
+            _state["server"] = None
+        store.close()
+        _state.update(store=None, workers={}, me=None)

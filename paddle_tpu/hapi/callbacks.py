@@ -10,7 +10,7 @@ import numpy as np
 
 __all__ = [
     "Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
-    "EarlyStopping", "History", "CallbackList",
+    "EarlyStopping", "History", "CallbackList", "VisualDL",
 ]
 
 
@@ -193,3 +193,45 @@ class EarlyStopping(Callback):
             self.wait += 1
             if self.wait >= self.patience:
                 self.model.stop_training = True
+
+
+class VisualDL(Callback):
+    """Log train/eval scalars per step+epoch (reference hapi VisualDL
+    callback, callbacks.py:883), backed by paddle_tpu.utils.LogWriter."""
+
+    def __init__(self, log_dir):
+        super().__init__()
+        self.log_dir = log_dir
+        self._writer = None
+        self._train_step = 0
+
+    def _w(self):
+        if self._writer is None:
+            from ..utils import LogWriter
+
+            self._writer = LogWriter(self.log_dir)
+        return self._writer
+
+    @staticmethod
+    def _scalarize(v):
+        return float(np.atleast_1d(np.asarray(v)).ravel()[0])
+
+    def on_train_batch_end(self, step, logs=None):
+        self._train_step += 1
+        for k, v in (logs or {}).items():
+            self._w().add_scalar(f"train/{k}", self._scalarize(v),
+                                 self._train_step)
+
+    def on_epoch_end(self, epoch, logs=None):
+        for k, v in (logs or {}).items():
+            self._w().add_scalar(f"epoch/{k}", self._scalarize(v), epoch)
+
+    def on_eval_end(self, logs=None):
+        for k, v in (logs or {}).items():
+            self._w().add_scalar(f"eval/{k}", self._scalarize(v),
+                                 self._train_step)
+
+    def on_train_end(self, logs=None):
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None  # a later fit/evaluate reopens cleanly

@@ -1,0 +1,24 @@
+"""paddle.incubate parity (reference /root/reference/python/paddle/incubate/
+— fused nn ops, extra optimizers, ASP 2:4 sparsity, autotune config).
+
+On TPU "fused" ops are XLA fusions: the incubate names bind to the same
+bodies the kernel policy already fuses, so the namespace is API parity, not
+a second implementation.
+"""
+from . import asp  # noqa: F401
+from . import nn  # noqa: F401
+from .optimizer import LookAhead, ModelAverage  # noqa: F401
+
+__all__ = ["nn", "asp", "LookAhead", "ModelAverage", "autotune"]
+
+
+def autotune(config=None):
+    """reference incubate.autotune: kernel/dataloader/amp autotuning toggles.
+    XLA autotunes its own GEMM/conv algorithms during compilation; accepted
+    for API parity and recorded on the kernel-policy module."""
+    from .. import kernels
+
+    config = config or {}
+    if "kernel" in config and "enable" in config["kernel"]:
+        kernels.set_use_pallas(bool(config["kernel"]["enable"]) or None)
+    return config

@@ -1,0 +1,66 @@
+"""incubate.asp: 2:4 structured sparsity (reference incubate/asp/ —
+Automatic SParsity: prune masks so every 4 consecutive weights keep the 2
+largest; sparse tensor cores accelerate this on GPU, the capability here is
+the pruning workflow + mask maintenance)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["calculate_density", "create_mask", "check_mask_2d4",
+           "prune_model", "decorate"]
+
+
+def create_mask(weight, n=2, m=4):
+    """Keep the n largest magnitudes of every m consecutive elements along
+    the last axis."""
+    w = np.asarray(weight.numpy() if hasattr(weight, "numpy") else weight)
+    flat = w.reshape(-1, m) if w.size % m == 0 else None
+    if flat is None:
+        raise ValueError(f"weight size {w.size} not divisible by m={m}")
+    idx = np.argsort(-np.abs(flat), axis=1)[:, :n]
+    mask = np.zeros_like(flat, dtype=bool)
+    np.put_along_axis(mask, idx, True, axis=1)
+    return mask.reshape(w.shape)
+
+
+def check_mask_2d4(mask, n=2, m=4):
+    ms = np.asarray(mask).reshape(-1, m)
+    return bool(np.all(ms.sum(axis=1) == n))
+
+
+def calculate_density(weight):
+    w = np.asarray(weight.numpy() if hasattr(weight, "numpy") else weight)
+    return float(np.count_nonzero(w) / w.size)
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d"):
+    """Apply 2:4 masks to every Linear weight in place; masks are recorded on
+    the layer so `decorate`d optimizers can re-apply after updates."""
+    from ..nn.layers.common import Linear
+
+    masks = {}
+    for name, layer in model.named_sublayers(include_self=True):
+        if isinstance(layer, Linear):
+            mask = create_mask(layer.weight, n, m)
+            layer.weight._value = layer.weight._value * jnp.asarray(mask)
+            layer._asp_mask = jnp.asarray(mask)
+            masks[name] = mask
+    return masks
+
+
+def decorate(optimizer, model=None):
+    """Wrap optimizer.step to re-apply recorded masks after every update
+    (reference asp.decorate keeps pruned weights at zero during training)."""
+    inner_step = optimizer.step
+    layers = ([l for _, l in model.named_sublayers(include_self=True)
+               if hasattr(l, "_asp_mask")] if model is not None else [])
+
+    def masked_step(*a, **k):
+        out = inner_step(*a, **k)
+        for l in layers:
+            l.weight._value = l.weight._value * l._asp_mask
+        return out
+
+    optimizer.step = masked_step
+    return optimizer

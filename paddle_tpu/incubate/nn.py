@@ -1,0 +1,92 @@
+"""incubate.nn fused layers/functional (reference incubate/nn/: fused
+attention/feedforward/transformer, memory_efficient_attention). The bodies
+are the existing attention/FFN compositions — XLA produces the fusion the
+reference hand-writes in CUDA."""
+from __future__ import annotations
+
+from ..nn import functional as F
+from ..nn.layer import Layer
+from ..nn.layers.transformer import TransformerEncoderLayer
+
+__all__ = [
+    "FusedMultiHeadAttention", "FusedFeedForward", "FusedTransformerEncoderLayer",
+    "fused_multi_head_attention", "fused_feedforward",
+    "memory_efficient_attention",
+]
+
+
+def memory_efficient_attention(query, key, value, attn_bias=None, p=0.0,
+                               scale=None, training=True):
+    return F.scaled_dot_product_attention(
+        query, key, value, attn_mask=attn_bias, dropout_p=p, scale=scale,
+        training=training)
+
+
+def fused_multi_head_attention(x, qkv_weight=None, out_weight=None, **kwargs):
+    raise NotImplementedError(
+        "use incubate.nn.FusedMultiHeadAttention (layer form); the raw-weight "
+        "functional form is CUDA-kernel-specific plumbing")
+
+
+def fused_feedforward(x, w1, b1, w2, b2, activation="relu"):
+    h = F.linear(x, w1, b1)
+    h = getattr(F, activation)(h)
+    return F.linear(h, w2, b2)
+
+
+class FusedMultiHeadAttention(Layer):
+    """API-parity wrapper over MultiHeadAttention: same math, XLA fuses the
+    projections+attention (flash kernel on chip)."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.0,
+                 attn_dropout_rate=0.0, normalize_before=False, **kwargs):
+        super().__init__()
+        from ..nn.layers.norm import LayerNorm
+        from ..nn.layers.transformer import MultiHeadAttention
+
+        self.normalize_before = normalize_before
+        self.attn = MultiHeadAttention(embed_dim, num_heads,
+                                       dropout=attn_dropout_rate)
+        self.norm = LayerNorm(embed_dim)
+        from ..nn.layers.common import Dropout
+
+        self.dropout = Dropout(dropout_rate)
+
+    def forward(self, x, attn_mask=None):
+        residual = x
+        if self.normalize_before:
+            x = self.norm(x)
+        out = self.attn(x, x, x, attn_mask)
+        out = residual + self.dropout(out)
+        if not self.normalize_before:
+            out = self.norm(out)
+        return out
+
+
+class FusedFeedForward(Layer):
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", normalize_before=False, **kwargs):
+        super().__init__()
+        from ..nn.layers.common import Dropout, Linear
+        from ..nn.layers.norm import LayerNorm
+
+        self.normalize_before = normalize_before
+        self.fc1 = Linear(d_model, dim_feedforward)
+        self.fc2 = Linear(dim_feedforward, d_model)
+        self.norm = LayerNorm(d_model)
+        self.dropout = Dropout(dropout_rate)
+        self.activation = activation
+
+    def forward(self, x):
+        residual = x
+        if self.normalize_before:
+            x = self.norm(x)
+        h = getattr(F, self.activation)(self.fc1(x))
+        out = residual + self.dropout(self.fc2(h))
+        if not self.normalize_before:
+            out = self.norm(out)
+        return out
+
+
+class FusedTransformerEncoderLayer(TransformerEncoderLayer):
+    """Same block as TransformerEncoderLayer — the fusion is XLA's job."""

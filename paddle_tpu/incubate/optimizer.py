@@ -1,0 +1,99 @@
+"""incubate optimizers (reference incubate/optimizer/: LookAhead
+(Zhang 2019) and ModelAverage) as wrappers over any inner optimizer."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead:
+    """k fast steps with the inner optimizer, then slow weights interpolate:
+    slow += alpha * (fast - slow); fast = slow."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._slow = None
+        self._steps = 0
+
+    def _params(self):
+        return self.inner_optimizer._parameter_list
+
+    def step(self):
+        if self._slow is None:
+            self._slow = [np.asarray(p._value).copy() for p in self._params()]
+        self.inner_optimizer.step()
+        self._steps += 1
+        if self._steps % self.k == 0:
+            for p, s in zip(self._params(), self._slow):
+                new_slow = s + self.alpha * (np.asarray(p._value) - s)
+                p._value = jnp.asarray(new_slow)
+                s[...] = new_slow
+
+    def clear_grad(self, *a, **k):
+        self.inner_optimizer.clear_grad(*a, **k)
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    def state_dict(self):
+        out = self.inner_optimizer.state_dict()
+        out["lookahead"] = {"steps": self._steps,
+                            "slow": None if self._slow is None
+                            else [s.copy() for s in self._slow]}
+        return out
+
+    def set_state_dict(self, state):
+        la = state.pop("lookahead", None)
+        self.inner_optimizer.set_state_dict(state)
+        if la:
+            self._steps = la["steps"]
+            self._slow = la["slow"]
+
+
+class ModelAverage:
+    """Maintain a running average of parameters; apply()/restore() swap the
+    averaged weights in for evaluation (reference incubate ModelAverage)."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self._params = list(parameters or [])
+        self._sum = [np.zeros_like(np.asarray(p._value)) for p in self._params]
+        self._count = 0
+        self._backup = None
+
+    def accumulate(self):
+        for s, p in zip(self._sum, self._params):
+            s += np.asarray(p._value)
+        self._count += 1
+
+    # the reference hooks accumulate into step(); standalone usage calls
+    # accumulate() after each optimizer.step()
+    def step(self):
+        self.accumulate()
+
+    def apply(self, executor=None, need_restore=True):
+        if self._count == 0:
+            return
+        self._backup = [np.asarray(p._value).copy() for p in self._params]
+        for p, s in zip(self._params, self._sum):
+            p._value = jnp.asarray(s / self._count)
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p, b in zip(self._params, self._backup):
+            p._value = jnp.asarray(b)
+        self._backup = None

@@ -1,0 +1,5 @@
+"""Utility surface: scalar/trace logging (the VisualDL role) and misc
+helpers."""
+from .log_writer import LogWriter  # noqa: F401
+
+__all__ = ["LogWriter"]

@@ -1,0 +1,53 @@
+"""LogWriter: scalar/histogram/text experiment logging (the role of the
+external VisualDL LogWriter the reference's hapi VisualDL callback wraps,
+/root/reference/python/paddle/hapi/callbacks.py:883).
+
+Format: JSONL events (one file per run) — directly loadable by pandas or
+TensorBoard-converter tooling; no external dependency in this image.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+__all__ = ["LogWriter"]
+
+
+class LogWriter:
+    def __init__(self, logdir="vdl_log", file_name=None, display_name=None,
+                 **kwargs):
+        os.makedirs(logdir, exist_ok=True)
+        name = file_name or f"vdlrecords.{int(time.time())}.jsonl"
+        self.logdir = logdir
+        self.path = os.path.join(logdir, name)
+        self._f = open(self.path, "a")
+
+    def _write(self, kind, tag, step, payload):
+        rec = {"kind": kind, "tag": tag, "step": int(step),
+               "wall_time": time.time(), **payload}
+        self._f.write(json.dumps(rec) + "\n")
+        self._f.flush()
+
+    def add_scalar(self, tag, value, step=0, walltime=None):
+        self._write("scalar", tag, step, {"value": float(value)})
+
+    def add_histogram(self, tag, values, step=0, buckets=10):
+        import numpy as np
+
+        hist, edges = np.histogram(np.asarray(values).ravel(), bins=buckets)
+        self._write("histogram", tag, step,
+                    {"hist": hist.tolist(), "edges": edges.tolist()})
+
+    def add_text(self, tag, text_string, step=0):
+        self._write("text", tag, step, {"text": str(text_string)})
+
+    def close(self):
+        if not self._f.closed:
+            self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

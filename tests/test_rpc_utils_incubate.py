@@ -1,0 +1,207 @@
+"""RPC, LogWriter/VisualDL callback, incubate (LookAhead/ModelAverage/asp/
+fused nn), TensorArray/SelectedRows — the last partial/absent rows of the
+round-1 component table."""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.core import native
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# module-level so it pickles for rpc
+def _add(a, b):
+    return a + b
+
+
+def _boom():
+    raise ValueError("remote failure")
+
+
+@pytest.mark.skipif(not native.available(), reason="no C++ toolchain")
+class TestRPC:
+    def test_two_worker_rpc(self, tmp_path):
+        """rank0 (this test) + a subprocess worker; both call each other."""
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        ep = f"127.0.0.1:{port}"
+        child = textwrap.dedent(f"""
+            import sys
+            sys.path.insert(0, {REPO!r})
+            sys.path.insert(0, {os.path.join(REPO, 'tests')!r})
+            # same module NAME as pytest's top-level import, so pickled
+            # function references resolve identically on both workers
+            import test_rpc_utils_incubate as m
+            from paddle_tpu.distributed import rpc
+            rpc.init_rpc("worker1", rank=1, world_size=2,
+                         master_endpoint={ep!r})
+            # worker1 calls back into worker0
+            assert rpc.rpc_sync("worker0", m._add, args=(1, 2)) == 3
+            rpc.shutdown()
+        """)
+        proc = subprocess.Popen([sys.executable, "-c", child],
+                                cwd=REPO, env=dict(os.environ, PYTHONPATH=REPO))
+        from paddle_tpu.distributed import rpc
+
+        me = rpc.init_rpc("worker0", rank=0, world_size=2,
+                          master_endpoint=ep)
+        assert me.name == "worker0"
+        assert {w.name for w in rpc.get_all_worker_infos()} == \
+            {"worker0", "worker1"}
+        assert rpc.rpc_sync("worker1", _add, args=(20, 22)) == 42
+        fut = rpc.rpc_async("worker1", _add, args=(1, 1))
+        assert fut.wait() == 2
+        with pytest.raises(ValueError, match="remote failure"):
+            rpc.rpc_sync("worker1", _boom)
+        rpc.shutdown()
+        assert proc.wait(timeout=60) == 0
+
+
+class TestLogWriterVisualDL:
+    def test_scalars_written_as_jsonl(self, tmp_path):
+        from paddle_tpu.utils import LogWriter
+
+        with LogWriter(str(tmp_path)) as w:
+            w.add_scalar("loss", 1.5, 1)
+            w.add_scalar("loss", 1.2, 2)
+            w.add_histogram("w", np.random.rand(100), 1)
+            w.add_text("note", "hello", 1)
+        files = os.listdir(tmp_path)
+        assert len(files) == 1
+        recs = [json.loads(l) for l in open(tmp_path / files[0])]
+        assert [r["kind"] for r in recs] == ["scalar", "scalar",
+                                             "histogram", "text"]
+        assert recs[1]["value"] == 1.2
+
+    def test_visualdl_callback_in_fit(self, tmp_path):
+        from paddle_tpu.io import Dataset
+
+        class D(Dataset):
+            def __getitem__(self, i):
+                rng = np.random.RandomState(i)
+                return rng.rand(4).astype(np.float32), \
+                    np.int64(rng.randint(0, 2))
+
+            def __len__(self):
+                return 32
+
+        paddle.seed(0)
+        net = nn.Linear(4, 2)
+        model = paddle.Model(net)
+        model.prepare(
+            optimizer=paddle.optimizer.SGD(parameters=net.parameters(),
+                                           learning_rate=0.1),
+            loss=paddle.nn.CrossEntropyLoss())
+        cb = paddle.hapi.callbacks.VisualDL(str(tmp_path / "vdl"))
+        model.fit(D(), batch_size=8, epochs=2, verbose=0, callbacks=[cb])
+        files = os.listdir(tmp_path / "vdl")
+        recs = [json.loads(l) for l in open(tmp_path / "vdl" / files[0])]
+        tags = {r["tag"] for r in recs}
+        assert "train/loss" in tags and "epoch/loss" in tags
+        assert sum(r["tag"] == "train/loss" for r in recs) == 8  # 4 steps x 2
+
+
+class TestIncubate:
+    def test_lookahead_interpolates(self):
+        def train(use_lookahead):
+            paddle.seed(0)
+            net = nn.Linear(4, 4)
+            inner = paddle.optimizer.SGD(parameters=net.parameters(),
+                                         learning_rate=0.01)
+            opt = (paddle.incubate.LookAhead(inner, alpha=0.5, k=2)
+                   if use_lookahead else inner)
+            x = paddle.to_tensor(np.ones((2, 4), np.float32))
+            w0 = net.weight.numpy().copy()
+            for i in range(2):
+                loss = paddle.sum(net(x) ** 2)
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+            return w0, net.weight.numpy()
+
+        w0, fast = train(False)
+        _, la = train(True)
+        # LookAhead after exactly k fast steps: slow + alpha*(fast - slow)
+        np.testing.assert_allclose(la, w0 + 0.5 * (fast - w0),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_model_average_apply_restore(self):
+        paddle.seed(0)
+        net = nn.Linear(2, 2)
+        ma = paddle.incubate.ModelAverage(parameters=net.parameters())
+        vals = []
+        for v in [1.0, 3.0]:
+            for p in net.parameters():
+                p._value = np.full_like(np.asarray(p._value), v)
+            ma.accumulate()
+            vals.append(v)
+        ma.apply()
+        np.testing.assert_allclose(net.weight.numpy(), 2.0)  # mean(1, 3)
+        ma.restore()
+        np.testing.assert_allclose(net.weight.numpy(), 3.0)  # last value
+
+    def test_asp_2to4_pruning(self):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 8))
+        masks = paddle.incubate.asp.prune_model(net)
+        w = net[0].weight.numpy() if hasattr(net, "__getitem__") else None
+        w = net.sublayers()[0].weight.numpy()
+        flat = np.abs(w).reshape(-1, 4)
+        assert np.all((flat > 0).sum(axis=1) <= 2)
+        assert paddle.incubate.asp.calculate_density(
+            net.sublayers()[0].weight) <= 0.5 + 1e-6
+        # decorate keeps masks applied after optimizer updates
+        opt = paddle.incubate.asp.decorate(
+            paddle.optimizer.SGD(parameters=net.parameters(),
+                                 learning_rate=0.1), net)
+        x = paddle.to_tensor(np.ones((2, 8), np.float32))
+        loss = paddle.sum(net(x) ** 2)
+        loss.backward()
+        opt.step()
+        flat2 = np.abs(net.sublayers()[0].weight.numpy()).reshape(-1, 4)
+        assert np.all((flat2 > 0).sum(axis=1) <= 2)
+
+    def test_fused_nn_runs(self):
+        paddle.seed(0)
+        x = paddle.to_tensor(np.random.rand(2, 5, 16).astype(np.float32))
+        att = paddle.incubate.nn.FusedMultiHeadAttention(16, 4)
+        ff = paddle.incubate.nn.FusedFeedForward(16, 32)
+        out = ff(att(x))
+        assert out.shape == [2, 5, 16]
+        mea = paddle.incubate.nn.memory_efficient_attention(
+            x.reshape([2, 5, 4, 4]), x.reshape([2, 5, 4, 4]),
+            x.reshape([2, 5, 4, 4]))
+        assert mea.shape == [2, 5, 4, 4]
+
+
+class TestContainers:
+    def test_tensor_array(self):
+        arr = paddle.create_array()
+        for i in range(3):
+            paddle.array_write(paddle.to_tensor(
+                np.full((2,), i, np.float32)), i, arr)
+        assert int(paddle.array_length(arr).numpy()) == 3
+        np.testing.assert_allclose(paddle.array_read(arr, 1).numpy(), 1.0)
+        stacked = arr.stack()
+        assert stacked.shape == [3, 2]
+
+    def test_selected_rows_merge(self):
+        sr = paddle.SelectedRows(rows=[1, 3, 1], height=5,
+                                 values=np.array([[1., 1.], [2., 2.], [3., 3.]],
+                                                 np.float32))
+        dense = sr.to_dense().numpy()
+        np.testing.assert_allclose(dense[1], [4., 4.])  # duplicate row summed
+        np.testing.assert_allclose(dense[3], [2., 2.])
+        merged = sr.merge()
+        assert merged.rows.shape[0] == 2
