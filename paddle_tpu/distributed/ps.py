@@ -1,0 +1,199 @@
+"""Parameter-server mode, lite (reference
+/root/reference/paddle/fluid/distributed/ps/ — brpc PS services with dense +
+sparse tables, async GeoSGD push/pull; python surface
+python/paddle/distributed/ps/ + fleet PS runtime).
+
+TPU-native stance: collective (SPMD) training is the first-class path; PS
+mode remains the capability for huge-vocabulary sparse embedding workloads
+where the table cannot live on-device. This implementation keeps the
+reference's observable surface — dense/sparse tables, pull/push with
+server-side optimizer application, barrier — over the same socket transport
+as paddle_tpu.distributed.rpc.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import threading
+
+import numpy as np
+
+from .rpc import _recv_msg, _send_msg
+
+__all__ = ["ParameterServer", "PSClient"]
+
+
+class _DenseTable:
+    def __init__(self, value, lr):
+        self.value = np.asarray(value, np.float32)
+        self.lr = float(lr)
+
+    def pull(self, _):
+        return self.value
+
+    def push(self, grad):
+        self.value -= self.lr * np.asarray(grad, np.float32)
+
+
+class _SparseTable:
+    """Lazily-initialized embedding rows (reference's sparse table creates
+    rows on first touch)."""
+
+    def __init__(self, dim, lr, init_std=0.01, seed=0):
+        self.dim = int(dim)
+        self.lr = float(lr)
+        self.rows: dict[int, np.ndarray] = {}
+        self._rng = np.random.RandomState(seed)
+        self.init_std = init_std
+
+    def _row(self, i):
+        i = int(i)
+        if i not in self.rows:
+            self.rows[i] = self._rng.randn(self.dim).astype(np.float32) \
+                * self.init_std
+        return self.rows[i]
+
+    def pull(self, ids):
+        return np.stack([self._row(i) for i in np.asarray(ids).ravel()])
+
+    def push(self, ids, grads):
+        grads = np.asarray(grads, np.float32)
+        for i, g in zip(np.asarray(ids).ravel(), grads):
+            self._row(i)  # materialize
+            self.rows[int(i)] = self.rows[int(i)] - self.lr * g
+
+
+class ParameterServer:
+    """Hosts tables; serves pull/push/barrier over TCP."""
+
+    def __init__(self, port=0):
+        self._tables = {}
+        self._lock = threading.Lock()
+        self._barrier_count = 0
+        self._barrier_gen = 0
+        self._cv = threading.Condition(self._lock)
+        self._listener = socket.socket()
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("0.0.0.0", int(port)))
+        self._listener.listen(64)
+        self.port = self._listener.getsockname()[1]
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    # -- table management (server-side API) ------------------------------
+    def create_dense_table(self, name, value, lr=0.01):
+        with self._lock:
+            self._tables[name] = _DenseTable(value, lr)
+
+    def create_sparse_table(self, name, dim, lr=0.01, init_std=0.01):
+        with self._lock:
+            self._tables[name] = _SparseTable(dim, lr, init_std)
+
+    # -- rpc plumbing -----------------------------------------------------
+    def _serve(self):
+        while True:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn):
+        with conn:
+            try:
+                while True:
+                    req = pickle.loads(_recv_msg(conn))
+                    _send_msg(conn, pickle.dumps(self._dispatch(req)))
+            except (ConnectionError, EOFError):
+                return
+
+    def _dispatch(self, req):
+        op = req["op"]
+        try:
+            if op == "pull_dense":
+                with self._lock:
+                    return {"ok": True,
+                            "value": self._tables[req["table"]].pull(None)}
+            if op == "push_dense":
+                with self._lock:
+                    self._tables[req["table"]].push(req["grad"])
+                return {"ok": True}
+            if op == "pull_sparse":
+                with self._lock:
+                    return {"ok": True, "value":
+                            self._tables[req["table"]].pull(req["ids"])}
+            if op == "push_sparse":
+                with self._lock:
+                    self._tables[req["table"]].push(req["ids"], req["grad"])
+                return {"ok": True}
+            if op == "create_dense":
+                self.create_dense_table(req["table"], req["value"], req["lr"])
+                return {"ok": True}
+            if op == "create_sparse":
+                self.create_sparse_table(req["table"], req["dim"], req["lr"])
+                return {"ok": True}
+            if op == "barrier":
+                with self._cv:
+                    gen = self._barrier_gen
+                    self._barrier_count += 1
+                    if self._barrier_count >= req["world"]:
+                        self._barrier_count = 0
+                        self._barrier_gen += 1
+                        self._cv.notify_all()
+                    else:
+                        self._cv.wait_for(
+                            lambda: self._barrier_gen > gen, timeout=60)
+                return {"ok": True}
+            return {"ok": False, "error": ValueError(f"unknown op {op!r}")}
+        except Exception as e:
+            return {"ok": False, "error": e}
+
+    def stop(self):
+        self._listener.close()
+
+
+class PSClient:
+    """Trainer-side handle (reference fleet PS worker role)."""
+
+    def __init__(self, host, port, timeout=30.0):
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=timeout)
+        self._lock = threading.Lock()
+
+    def _call(self, **req):
+        with self._lock:
+            _send_msg(self._sock, pickle.dumps(req))
+            resp = pickle.loads(_recv_msg(self._sock))
+        if not resp.get("ok"):
+            raise resp.get("error", RuntimeError("ps call failed"))
+        return resp.get("value")
+
+    def create_dense_table(self, table, value, lr=0.01):
+        return self._call(op="create_dense", table=table,
+                          value=np.asarray(value, np.float32), lr=lr)
+
+    def create_sparse_table(self, table, dim, lr=0.01):
+        return self._call(op="create_sparse", table=table, dim=dim, lr=lr)
+
+    def pull_dense(self, table):
+        return self._call(op="pull_dense", table=table)
+
+    def push_dense(self, table, grad):
+        return self._call(op="push_dense", table=table,
+                          grad=np.asarray(grad, np.float32))
+
+    def pull_sparse(self, table, ids):
+        return self._call(op="pull_sparse", table=table,
+                          ids=np.asarray(ids, np.int64))
+
+    def push_sparse(self, table, ids, grad):
+        return self._call(op="push_sparse", table=table,
+                          ids=np.asarray(ids, np.int64),
+                          grad=np.asarray(grad, np.float32))
+
+    def barrier(self, world_size):
+        return self._call(op="barrier", world=int(world_size))
+
+    def close(self):
+        self._sock.close()

@@ -30,7 +30,9 @@ class WorkerInfo:
     port: int
 
 
-_state = {"server": None, "store": None, "workers": {}, "me": None}
+_state = {"server": None, "store": None, "workers": {}, "me": None,
+          "conns": {}}  # name -> (socket, lock): persistent per-peer channel
+_conns_lock = threading.Lock()
 
 
 def _send_msg(sock, payload: bytes):
@@ -134,13 +136,36 @@ def get_all_worker_infos():
     return list(_state["workers"].values())
 
 
+def _peer_conn(to, timeout):
+    """One persistent connection per peer (the server keeps per-connection
+    handler loops alive for exactly this); serialized by a per-peer lock."""
+    with _conns_lock:
+        entry = _state["conns"].get(to)
+        if entry is None:
+            w = _state["workers"][to]
+            s = socket.create_connection((w.ip, w.port), timeout=timeout)
+            entry = (s, threading.Lock())
+            _state["conns"][to] = entry
+    return entry
+
+
 def _call(to, fn, args, kwargs, timeout):
-    w = _state["workers"][to]
-    with socket.create_connection((w.ip, w.port), timeout=timeout) as s:
+    s, lock = _peer_conn(to, timeout)
+    with lock:
         s.settimeout(timeout)
-        _send_msg(s, pickle.dumps(
-            {"fn": fn, "args": args or (), "kwargs": kwargs or {}}))
-        resp = pickle.loads(_recv_msg(s))
+        try:
+            _send_msg(s, pickle.dumps(
+                {"fn": fn, "args": args or (), "kwargs": kwargs or {}}))
+            resp = pickle.loads(_recv_msg(s))
+        except (ConnectionError, OSError):
+            # stale channel (peer restarted): reconnect once
+            with _conns_lock:
+                _state["conns"].pop(to, None)
+            s2, lock2 = _peer_conn(to, timeout)
+            with lock2:
+                _send_msg(s2, pickle.dumps(
+                    {"fn": fn, "args": args or (), "kwargs": kwargs or {}}))
+                resp = pickle.loads(_recv_msg(s2))
     if not resp["ok"]:
         raise resp["error"]
     return resp["value"]
@@ -188,6 +213,16 @@ def shutdown():
                 time.sleep(0.05)
                 acks = store.add("rpc/shutdown_acks", 0)
     finally:
+        with _conns_lock:
+            for s, lock in _state["conns"].values():
+                try:
+                    with lock:
+                        _send_msg(s, pickle.dumps({"op": "stop"}))
+                        _recv_msg(s)  # drain the ack
+                except (ConnectionError, OSError):
+                    pass
+                s.close()
+            _state["conns"] = {}
         if _state["server"] is not None:
             _state["server"].close()
             _state["server"] = None

@@ -20,5 +20,6 @@ def autotune(config=None):
 
     config = config or {}
     if "kernel" in config and "enable" in config["kernel"]:
-        kernels.set_use_pallas(bool(config["kernel"]["enable"]) or None)
+        # explicit True/False is an override either way (None = no override)
+        kernels.set_use_pallas(bool(config["kernel"]["enable"]))
     return config

@@ -13,13 +13,15 @@ __all__ = ["calculate_density", "create_mask", "check_mask_2d4",
 
 def create_mask(weight, n=2, m=4):
     """Keep the n largest magnitudes of every m consecutive elements along
-    the last axis."""
+    the LAST axis (groups never cross rows — the 2:4 hardware layout)."""
     w = np.asarray(weight.numpy() if hasattr(weight, "numpy") else weight)
-    flat = w.reshape(-1, m) if w.size % m == 0 else None
-    if flat is None:
-        raise ValueError(f"weight size {w.size} not divisible by m={m}")
-    idx = np.argsort(-np.abs(flat), axis=1)[:, :n]
-    mask = np.zeros_like(flat, dtype=bool)
+    if w.shape[-1] % m != 0:
+        raise ValueError(
+            f"last dim {w.shape[-1]} not divisible by m={m}; 2:{m} groups "
+            "must lie within a row")
+    grouped = w.reshape(-1, m)  # row-major: groups stay inside the last axis
+    idx = np.argsort(-np.abs(grouped), axis=1)[:, :n]
+    mask = np.zeros_like(grouped, dtype=bool)
     np.put_along_axis(mask, idx, True, axis=1)
     return mask.reshape(w.shape)
 

@@ -70,13 +70,19 @@ class ModelAverage:
                  min_average_window=10000, max_average_window=10000,
                  name=None):
         self._params = list(parameters or [])
-        self._sum = [np.zeros_like(np.asarray(p._value)) for p in self._params]
+        self._sum = [jnp.zeros_like(p._value) for p in self._params]
         self._count = 0
         self._backup = None
+        self.max_average_window = int(max_average_window)
 
     def accumulate(self):
-        for s, p in zip(self._sum, self._params):
-            s += np.asarray(p._value)
+        # on-device accumulation (no per-step host transfer); window restart
+        # bounds the history like the reference's cascading sum windows
+        if self._count >= self.max_average_window:
+            self._sum = [jnp.array(p._value) for p in self._params]
+            self._count = 1
+            return
+        self._sum = [s + p._value for s, p in zip(self._sum, self._params)]
         self._count += 1
 
     # the reference hooks accumulate into step(); standalone usage calls
@@ -87,13 +93,13 @@ class ModelAverage:
     def apply(self, executor=None, need_restore=True):
         if self._count == 0:
             return
-        self._backup = [np.asarray(p._value).copy() for p in self._params]
+        self._backup = [jnp.array(p._value) for p in self._params]
         for p, s in zip(self._params, self._sum):
-            p._value = jnp.asarray(s / self._count)
+            p._value = s / self._count
 
     def restore(self, executor=None):
         if self._backup is None:
             return
         for p, b in zip(self._params, self._backup):
-            p._value = jnp.asarray(b)
+            p._value = b
         self._backup = None

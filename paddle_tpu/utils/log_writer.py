@@ -15,10 +15,15 @@ __all__ = ["LogWriter"]
 
 
 class LogWriter:
+    _seq = 0
+
     def __init__(self, logdir="vdl_log", file_name=None, display_name=None,
                  **kwargs):
         os.makedirs(logdir, exist_ok=True)
-        name = file_name or f"vdlrecords.{int(time.time())}.jsonl"
+        LogWriter._seq += 1  # pid+seq: no collision for same-second writers
+        name = file_name or (
+            f"vdlrecords.{int(time.time())}.{os.getpid()}"
+            f".{LogWriter._seq}.jsonl")
         self.logdir = logdir
         self.path = os.path.join(logdir, name)
         self._f = open(self.path, "a")
