@@ -104,7 +104,15 @@ class ParameterServer:
             try:
                 while True:
                     req = pickle.loads(_recv_msg(conn))
-                    _send_msg(conn, pickle.dumps(self._dispatch(req)))
+                    out = self._dispatch(req)
+                    try:
+                        payload = pickle.dumps(out)
+                    except Exception as e:  # unpicklable error object: the
+                        # client must still get a response on this channel
+                        payload = pickle.dumps(
+                            {"ok": False, "error": RuntimeError(
+                                f"ps response not picklable: {e!r}")})
+                    _send_msg(conn, payload)
             except (ConnectionError, EOFError):
                 return
 
@@ -142,8 +150,15 @@ class ParameterServer:
                         self._barrier_gen += 1
                         self._cv.notify_all()
                     else:
-                        self._cv.wait_for(
+                        ok = self._cv.wait_for(
                             lambda: self._barrier_gen > gen, timeout=60)
+                        if not ok:
+                            # roll back so a later barrier round doesn't
+                            # release early on this stale arrival
+                            if self._barrier_gen == gen:
+                                self._barrier_count -= 1
+                            return {"ok": False, "error": TimeoutError(
+                                "ps barrier timed out (a trainer died?)")}
                 return {"ok": True}
             return {"ok": False, "error": ValueError(f"unknown op {op!r}")}
         except Exception as e:

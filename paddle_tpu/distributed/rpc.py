@@ -150,25 +150,41 @@ def _peer_conn(to, timeout):
 
 
 def _call(to, fn, args, kwargs, timeout):
+    payload = pickle.dumps(
+        {"fn": fn, "args": args or (), "kwargs": kwargs or {}})
     s, lock = _peer_conn(to, timeout)
+    retry = False
     with lock:
         s.settimeout(timeout)
         try:
-            _send_msg(s, pickle.dumps(
-                {"fn": fn, "args": args or (), "kwargs": kwargs or {}}))
+            _send_msg(s, payload)
             resp = pickle.loads(_recv_msg(s))
-        except (ConnectionError, OSError):
-            # stale channel (peer restarted): reconnect once
-            with _conns_lock:
-                _state["conns"].pop(to, None)
-            s2, lock2 = _peer_conn(to, timeout)
-            with lock2:
-                _send_msg(s2, pickle.dumps(
-                    {"fn": fn, "args": args or (), "kwargs": kwargs or {}}))
-                resp = pickle.loads(_recv_msg(s2))
+        except (ConnectionResetError, BrokenPipeError):
+            # stale channel (peer restarted) — the request never executed,
+            # so a single retry is safe. Timeouts are NOT retried: the
+            # server may be mid-execution and a re-send would run the fn
+            # twice (non-idempotent pushes!).
+            retry = True
+        except Exception:
+            _drop_conn(to)
+            raise
+    if retry:
+        _drop_conn(to)
+        s2, lock2 = _peer_conn(to, timeout)
+        with lock2:
+            s2.settimeout(timeout)
+            _send_msg(s2, payload)
+            resp = pickle.loads(_recv_msg(s2))
     if not resp["ok"]:
         raise resp["error"]
     return resp["value"]
+
+
+def _drop_conn(to):
+    """Forget a dead channel. Never called while holding its per-conn lock
+    at the same time as _conns_lock in the opposite order of shutdown()."""
+    with _conns_lock:
+        _state["conns"].pop(to, None)
 
 
 def rpc_sync(to, fn, args=None, kwargs=None, timeout=60.0):
@@ -213,16 +229,21 @@ def shutdown():
                 time.sleep(0.05)
                 acks = store.add("rpc/shutdown_acks", 0)
     finally:
+        # snapshot-and-clear under _conns_lock, then close WITHOUT holding it
+        # (holding both here while _call's error path takes them in the other
+        # order would deadlock)
         with _conns_lock:
-            for s, lock in _state["conns"].values():
-                try:
-                    with lock:
-                        _send_msg(s, pickle.dumps({"op": "stop"}))
-                        _recv_msg(s)  # drain the ack
-                except (ConnectionError, OSError):
-                    pass
-                s.close()
+            conns = list(_state["conns"].values())
             _state["conns"] = {}
+        for s, lock in conns:
+            try:
+                with lock:
+                    s.settimeout(5.0)
+                    _send_msg(s, pickle.dumps({"op": "stop"}))
+                    _recv_msg(s)  # drain the ack
+            except (ConnectionError, OSError):
+                pass
+            s.close()
         if _state["server"] is not None:
             _state["server"].close()
             _state["server"] = None

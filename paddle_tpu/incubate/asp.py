@@ -44,6 +44,9 @@ def prune_model(model, n=2, m=4, mask_algo="mask_1d"):
     masks = {}
     for name, layer in model.named_sublayers(include_self=True):
         if isinstance(layer, Linear):
+            if layer.weight.shape[-1] % m != 0:
+                continue  # ragged head (e.g. 10-class classifier): the
+                # reference likewise skips non-conforming layers
             mask = create_mask(layer.weight, n, m)
             layer.weight._value = layer.weight._value * jnp.asarray(mask)
             layer._asp_mask = jnp.asarray(mask)

@@ -5,10 +5,20 @@ from .conformer import (  # noqa: F401
     ConformerForRNNT,
     conformer_tiny,
 )
+from .ernie import (  # noqa: F401
+    ErnieConfig,
+    ErnieForMaskedLM,
+    ErnieForSequenceClassification,
+    ErnieModel,
+    ernie_base,
+    ernie_tiny,
+)
 from .llama import LlamaConfig, LlamaDecoderLayer, LlamaForCausalLM, llama_7b, llama_tiny  # noqa: F401
 
 __all__ = [
     "LlamaConfig", "LlamaForCausalLM", "LlamaDecoderLayer", "llama_7b", "llama_tiny",
     "ConformerConfig", "ConformerEncoder", "ConformerForCTC", "ConformerForRNNT",
     "conformer_tiny",
+    "ErnieConfig", "ErnieModel", "ErnieForMaskedLM",
+    "ErnieForSequenceClassification", "ernie_base", "ernie_tiny",
 ]

@@ -1,0 +1,118 @@
+"""ERNIE model family (BASELINE config #3 — ERNIE-3.0-Base DP training; the
+reference ecosystem's BERT-style bidirectional encoder with word/position/
+token-type embeddings, a pooler, and task heads).
+
+TPU-first: the whole encoder is nn.TransformerEncoder (flash-attention
+kernel path); one jitted step per batch shape. Sizes follow the published
+ERNIE-3.0-Base config (12L, 768H, 12 heads).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .. import nn
+from ..nn import functional as F
+
+
+@dataclass
+class ErnieConfig:
+    vocab_size: int = 40000
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 2048
+    type_vocab_size: int = 4
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+
+
+def ernie_base():
+    return ErnieConfig()
+
+
+def ernie_tiny(vocab=512, hidden=64, layers=2, heads=4, inter=128, seq=128):
+    return ErnieConfig(vocab_size=vocab, hidden_size=hidden,
+                       num_hidden_layers=layers, num_attention_heads=heads,
+                       intermediate_size=inter, max_position_embeddings=seq,
+                       hidden_dropout_prob=0.0,
+                       attention_probs_dropout_prob=0.0)
+
+
+class ErnieEmbeddings(nn.Layer):
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.position_embeddings = nn.Embedding(cfg.max_position_embeddings,
+                                                cfg.hidden_size)
+        self.token_type_embeddings = nn.Embedding(cfg.type_vocab_size,
+                                                  cfg.hidden_size)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        from .. import ops as P
+
+        b, t = input_ids.shape
+        if position_ids is None:
+            position_ids = P.arange(t, dtype="int64").unsqueeze(0)
+        if token_type_ids is None:
+            token_type_ids = P.zeros([b, t], "int64")
+        h = (self.word_embeddings(input_ids)
+             + self.position_embeddings(position_ids)
+             + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(h))
+
+
+class ErnieModel(nn.Layer):
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = ErnieEmbeddings(cfg)
+        enc_layer = nn.TransformerEncoderLayer(
+            cfg.hidden_size, cfg.num_attention_heads, cfg.intermediate_size,
+            dropout=cfg.hidden_dropout_prob, activation="gelu",
+            attn_dropout=cfg.attention_probs_dropout_prob)
+        self.encoder = nn.TransformerEncoder(enc_layer, cfg.num_hidden_layers)
+        self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                position_ids=None):
+        from .. import ops as P
+
+        h = self.embeddings(input_ids, token_type_ids, position_ids)
+        if attention_mask is not None:
+            # [B, T] 1/0 mask -> additive [B, 1, 1, T] bias
+            bias = (1.0 - attention_mask.astype("float32")) * -1e9
+            attention_mask = bias.unsqueeze(1).unsqueeze(1)
+        seq = self.encoder(h, attention_mask)
+        pooled = F.tanh(self.pooler(seq[:, 0]))
+        return seq, pooled
+
+
+class ErnieForMaskedLM(nn.Layer):
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.ernie = ErnieModel(cfg)
+        self.transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size)
+        self.decoder = nn.Linear(cfg.hidden_size, cfg.vocab_size)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq, _ = self.ernie(input_ids, token_type_ids, attention_mask)
+        h = self.layer_norm(F.gelu(self.transform(seq)))
+        return self.decoder(h)  # [B, T, V]
+
+
+class ErnieForSequenceClassification(nn.Layer):
+    def __init__(self, cfg: ErnieConfig, num_classes=2):
+        super().__init__()
+        self.ernie = ErnieModel(cfg)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+        self.classifier = nn.Linear(cfg.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        _, pooled = self.ernie(input_ids, token_type_ids, attention_mask)
+        return self.classifier(self.dropout(pooled))

@@ -1,0 +1,84 @@
+"""ERNIE model family (BASELINE config #3): shapes, masking semantics, MLM
+learnability, and DP training through the DistributedEngine."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.models import (
+    ErnieForMaskedLM, ErnieForSequenceClassification, ErnieModel, ernie_tiny,
+)
+
+
+class TestErnie:
+    def test_forward_shapes(self):
+        paddle.seed(0)
+        cfg = ernie_tiny()
+        model = ErnieModel(cfg)
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 16))
+            .astype(np.int64))
+        seq, pooled = model(ids)
+        assert seq.shape == [2, 16, cfg.hidden_size]
+        assert pooled.shape == [2, cfg.hidden_size]
+
+    def test_attention_mask_blocks_padding(self):
+        paddle.seed(1)
+        cfg = ernie_tiny()
+        model = ErnieModel(cfg)
+        model.eval()
+        rng = np.random.RandomState(1)
+        ids = rng.randint(0, cfg.vocab_size, (1, 8)).astype(np.int64)
+        mask = np.ones((1, 8), np.float32)
+        mask[0, 6:] = 0  # last two tokens are padding
+        seq1, _ = model(paddle.to_tensor(ids), attention_mask=paddle.to_tensor(mask))
+        ids2 = ids.copy()
+        ids2[0, 6:] = rng.randint(0, cfg.vocab_size, 2)  # change padding
+        seq2, _ = model(paddle.to_tensor(ids2), attention_mask=paddle.to_tensor(mask))
+        # non-padded positions must not see the padded tokens
+        np.testing.assert_allclose(seq1.numpy()[0, :6], seq2.numpy()[0, :6],
+                                   atol=1e-5)
+
+    def test_mlm_learns_copy_task(self):
+        paddle.seed(2)
+        cfg = ernie_tiny(vocab=32, hidden=32, layers=1, heads=2, inter=64)
+        model = ErnieForMaskedLM(cfg)
+        opt = paddle.optimizer.Adam(parameters=model.parameters(),
+                                    learning_rate=3e-3)
+        loss_fn = paddle.nn.CrossEntropyLoss()
+        rng = np.random.RandomState(0)
+        ids = rng.randint(1, 32, (8, 12)).astype(np.int64)
+        x = paddle.to_tensor(ids)
+        y = paddle.to_tensor(ids)
+        losses = []
+        for _ in range(25):
+            logits = model(x)
+            loss = loss_fn(logits.reshape([-1, 32]), y.reshape([-1]))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+    def test_classification_head_and_dp_engine(self):
+        from paddle_tpu.distributed import DistributedEngine, DistributedStrategy
+        from paddle_tpu.distributed.mesh import set_hybrid_communicate_group
+        from paddle_tpu.distributed.strategy import HybridConfig
+
+        set_hybrid_communicate_group(None)
+        paddle.seed(3)
+        cfg = ernie_tiny(vocab=64, hidden=32, layers=1, heads=2, inter=64)
+        model = ErnieForSequenceClassification(cfg, num_classes=2)
+        strat = DistributedStrategy(hybrid_configs=HybridConfig(dp_degree=8))
+        opt = paddle.optimizer.AdamW(parameters=model.parameters(),
+                                     learning_rate=1e-3)
+        eng = DistributedEngine(model, loss_fn=paddle.nn.CrossEntropyLoss(),
+                                optimizer=opt, strategy=strat)
+        rng = np.random.RandomState(0)
+        x = rng.randint(0, 64, (16, 12)).astype(np.int64)
+        y = rng.randint(0, 2, (16,)).astype(np.int64)
+        l0 = float(np.asarray(eng.step([x], [y])))
+        for _ in range(4):
+            l = float(np.asarray(eng.step([x], [y])))
+        assert np.isfinite(l) and l < l0  # overfits the fixed batch under DP
+        set_hybrid_communicate_group(None)
