@@ -81,7 +81,11 @@ def apply(fn, *args, op_name="op", **kwargs):
     if not record:
         vals = [l._value if isinstance(l, Tensor) else l for l in leaves]
         a, k = tree_util.tree_unflatten(treedef, vals)
-        out = fn(*a, **k)
+        try:
+            out = fn(*a, **k)
+        except Exception as e:
+            _enrich_error(e, op_name, leaves)
+            raise
         result = _wrap_outputs(out, node=None)
         _maybe_attach_recompute(fn, leaves, treedef, result)
         _debug_hooks(op_name, result)
@@ -106,7 +110,11 @@ def apply(fn, *args, op_name="op", **kwargs):
         a, k = tree_util.tree_unflatten(treedef, vals)
         return fn(*a, **k)
 
-    out, vjp_fn = jax.vjp(pure, *(t._value for t in diff_tensors))
+    try:
+        out, vjp_fn = jax.vjp(pure, *(t._value for t in diff_tensors))
+    except Exception as e:
+        _enrich_error(e, op_name, leaves)
+        raise
     out_list = list(out) if isinstance(out, (tuple, list)) else [out]
     node = GradNode(
         op_name,
@@ -118,6 +126,22 @@ def apply(fn, *args, op_name="op", **kwargs):
     _maybe_attach_recompute(fn, leaves, treedef, result)
     _debug_hooks(op_name, result)
     return result
+
+
+def _enrich_error(e, op_name, leaves):
+    """Attach the op name + tensor signatures to a failing op's exception —
+    the role of the reference's enriched PADDLE_ENFORCE errors with attached
+    op callstack (paddle/fluid/framework/op_call_stack.cc)."""
+    sigs = []
+    for l in leaves:
+        if isinstance(l, Tensor):
+            v = l._value
+            sigs.append(f"Tensor{tuple(v.shape)}:{v.dtype}")
+    try:
+        e.add_note(f"[paddle_tpu] in op '{op_name}' "
+                   f"(tensor inputs: {', '.join(sigs) or 'none'})")
+    except AttributeError:
+        pass  # pre-3.11 python: original exception unchanged
 
 
 def _debug_hooks(op_name, result):

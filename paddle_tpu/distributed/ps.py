@@ -150,8 +150,11 @@ class ParameterServer:
                         self._barrier_gen += 1
                         self._cv.notify_all()
                     else:
+                        # must stay under the CLIENT's socket timeout or the
+                        # late reply desyncs its request/response stream
                         ok = self._cv.wait_for(
-                            lambda: self._barrier_gen > gen, timeout=60)
+                            lambda: self._barrier_gen > gen,
+                            timeout=float(req.get("timeout", 25.0)))
                         if not ok:
                             # roll back so a later barrier round doesn't
                             # release early on this stale arrival
@@ -172,14 +175,23 @@ class PSClient:
     """Trainer-side handle (reference fleet PS worker role)."""
 
     def __init__(self, host, port, timeout=30.0):
-        self._sock = socket.create_connection((host, int(port)),
-                                              timeout=timeout)
+        self._addr = (host, int(port))
+        self._timeout = timeout
+        self._sock = socket.create_connection(self._addr, timeout=timeout)
         self._lock = threading.Lock()
 
     def _call(self, **req):
         with self._lock:
-            _send_msg(self._sock, pickle.dumps(req))
-            resp = pickle.loads(_recv_msg(self._sock))
+            try:
+                _send_msg(self._sock, pickle.dumps(req))
+                resp = pickle.loads(_recv_msg(self._sock))
+            except socket.timeout:
+                # a late server reply would desync this channel's
+                # request/response pairing — reconnect before re-raising
+                self._sock.close()
+                self._sock = socket.create_connection(
+                    self._addr, timeout=self._timeout)
+                raise TimeoutError(f"ps call {req.get('op')!r} timed out")
         if not resp.get("ok"):
             raise resp.get("error", RuntimeError("ps call failed"))
         return resp.get("value")
@@ -207,8 +219,11 @@ class PSClient:
                           ids=np.asarray(ids, np.int64),
                           grad=np.asarray(grad, np.float32))
 
-    def barrier(self, world_size):
-        return self._call(op="barrier", world=int(world_size))
+    def barrier(self, world_size, timeout=None):
+        # server-side wait must finish before the client socket gives up
+        t = min(timeout or self._timeout - 5.0, self._timeout - 5.0)
+        return self._call(op="barrier", world=int(world_size),
+                          timeout=max(t, 1.0))
 
     def close(self):
         self._sock.close()

@@ -166,10 +166,10 @@ def _call(to, fn, args, kwargs, timeout):
             # twice (non-idempotent pushes!).
             retry = True
         except Exception:
-            _drop_conn(to)
+            _drop_conn(to, (s, lock))
             raise
     if retry:
-        _drop_conn(to)
+        _drop_conn(to, (s, lock))
         s2, lock2 = _peer_conn(to, timeout)
         with lock2:
             s2.settimeout(timeout)
@@ -180,11 +180,17 @@ def _call(to, fn, args, kwargs, timeout):
     return resp["value"]
 
 
-def _drop_conn(to):
-    """Forget a dead channel. Never called while holding its per-conn lock
-    at the same time as _conns_lock in the opposite order of shutdown()."""
+def _drop_conn(to, entry):
+    """Forget a dead channel — only if the cache still holds THAT channel
+    (a concurrent retry may already have installed a fresh one, which must
+    not be evicted/leaked)."""
     with _conns_lock:
-        _state["conns"].pop(to, None)
+        if _state["conns"].get(to) is entry:
+            _state["conns"].pop(to, None)
+    try:
+        entry[0].close()
+    except OSError:
+        pass
 
 
 def rpc_sync(to, fn, args=None, kwargs=None, timeout=60.0):

@@ -62,16 +62,44 @@ class StaticFunction:
         arrays = [a._value if isinstance(a, Tensor) else np.asarray(a) for a in args]
         key = self._guard_key(arrays)
         entry = self._cache.get(key)
+        if entry == "eager":
+            return self._eager_call(*args, **kwargs)
         if entry is None:
             entry = self._build(key, kwargs)
             self._cache[key] = entry
         jitted, buffers_box = entry
-        if self._layer is not None:
-            params, buffers = functional_state(self._layer)
-            out = jitted(params, buffers, *arrays)
-        else:
-            out = jitted(*arrays)
+        try:
+            if self._layer is not None:
+                params, buffers = functional_state(self._layer)
+                out = jitted(params, buffers, *arrays)
+            else:
+                out = jitted(*arrays)
+        except (jax.errors.TracerBoolConversionError,
+                jax.errors.TracerArrayConversionError,
+                jax.errors.TracerIntegerConversionError,
+                jax.errors.ConcretizationTypeError) as e:
+            # data-dependent python control flow: the reference's dy2static
+            # AST transforms rewrite it into cond/while ops; here the
+            # function stays CORRECT by falling back to eager execution for
+            # this guard key (once, with a pointer to the jit-able idioms)
+            import warnings
+
+            warnings.warn(
+                f"to_static: '{getattr(self._target, '__name__', self._target)}'"
+                " branches on traced values; running eagerly for this input "
+                "signature (use paddle.where / lax.cond-style ops to keep it "
+                f"compiled). Tracer error: {str(e).splitlines()[0]}",
+                stacklevel=2)
+            self._cache[key] = "eager"
+            return self._eager_call(*args, **kwargs)
         return _wrap_out(out)
+
+    def _eager_call(self, *args, **kwargs):
+        if self._layer is not None:
+            orig = getattr(self._layer, "_orig_forward", None)
+            if orig is not None:
+                return orig(*args, **kwargs)
+        return self._target(*args, **kwargs)
 
     def _build(self, key, kwargs):
         if self._layer is not None:

@@ -1,0 +1,87 @@
+"""MobileNetV2 (reference python/paddle/vision/models/mobilenetv2.py;
+Sandler 2018 inverted residuals + linear bottlenecks)."""
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["MobileNetV2", "mobilenet_v2"]
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    min_value = min_value or divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class ConvBNReLU(nn.Sequential):
+    def __init__(self, c_in, c_out, kernel=3, stride=1, groups=1):
+        super().__init__(
+            nn.Conv2D(c_in, c_out, kernel, stride=stride,
+                      padding=(kernel - 1) // 2, groups=groups,
+                      bias_attr=False),
+            nn.BatchNorm2D(c_out),
+            nn.ReLU6(),
+        )
+
+
+class InvertedResidual(nn.Layer):
+    def __init__(self, c_in, c_out, stride, expand_ratio):
+        super().__init__()
+        hidden = int(round(c_in * expand_ratio))
+        self.use_res = stride == 1 and c_in == c_out
+        layers = []
+        if expand_ratio != 1:
+            layers.append(ConvBNReLU(c_in, hidden, kernel=1))
+        layers += [
+            ConvBNReLU(hidden, hidden, stride=stride, groups=hidden),  # dw
+            nn.Conv2D(hidden, c_out, 1, bias_attr=False),  # linear pw
+            nn.BatchNorm2D(c_out),
+        ]
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        cfg = [  # t, c, n, s
+            (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+            (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1),
+        ]
+        c_in = _make_divisible(32 * scale)
+        last = _make_divisible(1280 * max(1.0, scale))
+        feats = [ConvBNReLU(3, c_in, stride=2)]
+        for t, c, n, s in cfg:
+            c_out = _make_divisible(c * scale)
+            for i in range(n):
+                feats.append(InvertedResidual(
+                    c_in, c_out, s if i == 0 else 1, t))
+                c_in = c_out
+        feats.append(ConvBNReLU(c_in, last, kernel=1))
+        self.features = nn.Sequential(*feats)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(nn.Dropout(0.2),
+                                            nn.Linear(last, num_classes))
+
+    def forward(self, x):
+        from ... import ops as P
+
+        h = self.features(x)
+        if self.with_pool:
+            h = self.pool(h)
+        if self.num_classes > 0:
+            h = self.classifier(P.flatten(h, start_axis=1))
+        return h
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV2(scale=scale, **kwargs)

@@ -1,0 +1,99 @@
+"""Out-of-tree custom op story (docs/CUSTOM_OPS.md; reference PD_BUILD_OP /
+custom-kernel registration, VERDICT §2.1 'Custom kernel C-API' row)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.registry import OPS, defop, register_variant
+
+
+class TestCustomOp:
+    def test_defop_user_op_with_autograd_and_flags(self):
+        @defop("test_swiglu")
+        def my_swiglu(x, gate):
+            return x * jax.nn.silu(gate)
+
+        a = paddle.to_tensor(np.random.RandomState(0).rand(4).astype(np.float32))
+        g = paddle.to_tensor(np.random.RandomState(1).rand(4).astype(np.float32))
+        a.stop_gradient = False
+        out = my_swiglu(a, g)
+        silu = g.numpy() / (1 + np.exp(-g.numpy()))
+        np.testing.assert_allclose(out.numpy(), a.numpy() * silu, rtol=1e-6)
+        paddle.sum(out).backward()
+        np.testing.assert_allclose(a.grad.numpy(), silu, rtol=1e-6)  # d/da
+        assert "test_swiglu" in OPS
+        # debug flags apply to custom ops too
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            with pytest.raises(RuntimeError, match="test_swiglu"):
+                my_swiglu(paddle.to_tensor(np.array([np.inf], np.float32)),
+                          paddle.to_tensor(np.array([1.0], np.float32)))
+        finally:
+            paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+    def test_custom_vjp_respected(self):
+        @jax.custom_vjp
+        def body(x):
+            return x * x
+
+        def fwd(x):
+            return x * x, x
+
+        def bwd(res, g):
+            return (g * 7.0,)  # deliberately NOT the analytic grad
+
+        body.defvjp(fwd, bwd)
+        op = defop("test_fake_grad")(body)
+        x = paddle.to_tensor(np.array([3.0], np.float32))
+        x.stop_gradient = False
+        op(x).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [7.0])  # custom vjp won
+
+    def test_register_variant_and_selection(self):
+        calls = []
+
+        @defop("test_variant_op")
+        def base(x):
+            calls.append("xla")
+            return x + 1
+
+        @register_variant("test_variant_op", "pallas")
+        def fast(x):
+            calls.append("pallas")
+            return x + 1
+
+        entry = OPS["test_variant_op"]
+        assert "pallas" in entry.variants
+        # policy-style selection, as kernels/attention_impl does
+        from paddle_tpu import kernels
+
+        impl = entry.variants["pallas"] if kernels.use_pallas() else entry.impl
+        impl(jnp.ones(2))
+        assert calls[-1] == ("pallas" if kernels.use_pallas() else "xla")
+
+    def test_enriched_errors_name_the_op(self):
+        """dispatch attaches op name + tensor signatures to failures
+        (reference op-callstack-enriched errors)."""
+        with pytest.raises(TypeError) as ei:
+            paddle.matmul(paddle.to_tensor(np.ones((2, 3), np.float32)),
+                          paddle.to_tensor(np.ones((4, 5), np.float32)))
+        notes = getattr(ei.value, "__notes__", [])
+        assert any("op 'matmul'" in n and "Tensor(2, 3)" in n for n in notes)
+
+    def test_to_static_eager_fallback_on_data_dependent_branch(self):
+        """Data-dependent python `if` can't trace; to_static must fall back
+        to eager (correct result + warning) rather than crash."""
+        @paddle.jit.to_static
+        def f(x):
+            if x.sum() > 0:  # bool() on a traced value
+                return x * 2
+            return x - 1
+
+        with pytest.warns(UserWarning, match="running eagerly"):
+            out = f(paddle.to_tensor(np.ones(3, np.float32)))
+        np.testing.assert_allclose(out.numpy(), 2.0)
+        # second call on the same signature: cached eager path, no re-trace
+        out2 = f(paddle.to_tensor(np.full(3, -1.0, np.float32)))
+        np.testing.assert_allclose(out2.numpy(), -2.0)

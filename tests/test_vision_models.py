@@ -1,0 +1,58 @@
+"""Vision model families beyond ResNet/LeNet (reference
+python/paddle/vision/models): forward shapes + parameter counts vs the
+published architectures + a gradient step."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision.models import (
+    AlexNet, MobileNetV2, alexnet, mobilenet_v2, vgg11, vgg16,
+)
+
+
+def _param_count(net):
+    return sum(int(np.prod(p.shape)) for p in net.parameters())
+
+
+class TestVisionModels:
+    def test_alexnet_shapes_and_params(self):
+        paddle.seed(0)
+        net = alexnet(num_classes=10)
+        x = paddle.to_tensor(np.random.rand(2, 3, 64, 64).astype(np.float32))
+        out = net(x)
+        assert out.shape == [2, 10]
+        # canonical 1000-class AlexNet has ~61.1M params
+        assert abs(_param_count(AlexNet()) - 61_100_840) < 2e5
+
+    def test_vgg_shapes_and_params(self):
+        paddle.seed(0)
+        net = vgg11(num_classes=7)
+        x = paddle.to_tensor(np.random.rand(1, 3, 64, 64).astype(np.float32))
+        assert net(x).shape == [1, 7]
+        # canonical VGG16 has ~138.36M params
+        assert abs(_param_count(vgg16()) - 138_357_544) < 2e5
+
+    def test_mobilenetv2_params_and_width_scale(self):
+        paddle.seed(0)
+        # canonical MobileNetV2 1.0x has ~3.50M params
+        assert abs(_param_count(MobileNetV2()) - 3_504_872) < 5e4
+        wide = MobileNetV2(scale=1.4)
+        assert _param_count(wide) > _param_count(MobileNetV2())
+
+    def test_mobilenetv2_trains_a_step(self):
+        paddle.seed(1)
+        net = mobilenet_v2(scale=0.35, num_classes=4)
+        net.train()
+        opt = paddle.optimizer.SGD(parameters=net.parameters(),
+                                   learning_rate=0.1)
+        x = paddle.to_tensor(np.random.rand(2, 3, 32, 32).astype(np.float32))
+        y = paddle.to_tensor(np.array([1, 3], np.int64))
+        loss_fn = paddle.nn.CrossEntropyLoss()
+        out = net(x)
+        assert out.shape == [2, 4]
+        loss = loss_fn(out, y)
+        loss.backward()
+        grads = [p for p in net.parameters() if p.grad is not None]
+        assert len(grads) > 50  # depthwise + pointwise stacks all got grads
+        opt.step()
+        assert np.isfinite(float(loss.numpy()))
