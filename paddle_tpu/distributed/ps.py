@@ -180,18 +180,26 @@ class PSClient:
         self._sock = socket.create_connection(self._addr, timeout=timeout)
         self._lock = threading.Lock()
 
-    def _call(self, **req):
+    def _call(self, _sock_timeout=None, **req):
         with self._lock:
+            if self._sock is None:  # lazy reconnect after a failed one
+                self._sock = socket.create_connection(
+                    self._addr, timeout=self._timeout)
             try:
+                self._sock.settimeout(_sock_timeout or self._timeout)
                 _send_msg(self._sock, pickle.dumps(req))
                 resp = pickle.loads(_recv_msg(self._sock))
             except socket.timeout:
                 # a late server reply would desync this channel's
                 # request/response pairing — reconnect before re-raising
                 self._sock.close()
-                self._sock = socket.create_connection(
-                    self._addr, timeout=self._timeout)
-                raise TimeoutError(f"ps call {req.get('op')!r} timed out")
+                try:
+                    self._sock = socket.create_connection(
+                        self._addr, timeout=self._timeout)
+                except OSError:
+                    self._sock = None  # retried lazily on the next call
+                raise TimeoutError(
+                    f"ps call {req.get('op')!r} timed out") from None
         if not resp.get("ok"):
             raise resp.get("error", RuntimeError("ps call failed"))
         return resp.get("value")
@@ -220,10 +228,11 @@ class PSClient:
                           grad=np.asarray(grad, np.float32))
 
     def barrier(self, world_size, timeout=None):
-        # server-side wait must finish before the client socket gives up
-        t = min(timeout or self._timeout - 5.0, self._timeout - 5.0)
-        return self._call(op="barrier", world=int(world_size),
-                          timeout=max(t, 1.0))
+        # honor the caller's wait; the SOCKET deadline extends past the
+        # server-side wait so the reply always lands inside it
+        t = max(float(timeout if timeout is not None else self._timeout), 1.0)
+        return self._call(op="barrier", world=int(world_size), timeout=t,
+                          _sock_timeout=t + 10.0)
 
     def close(self):
         self._sock.close()

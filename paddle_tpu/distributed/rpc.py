@@ -152,7 +152,8 @@ def _peer_conn(to, timeout):
 def _call(to, fn, args, kwargs, timeout):
     payload = pickle.dumps(
         {"fn": fn, "args": args or (), "kwargs": kwargs or {}})
-    s, lock = _peer_conn(to, timeout)
+    entry = _peer_conn(to, timeout)
+    s, lock = entry
     retry = False
     with lock:
         s.settimeout(timeout)
@@ -166,11 +167,12 @@ def _call(to, fn, args, kwargs, timeout):
             # twice (non-idempotent pushes!).
             retry = True
         except Exception:
-            _drop_conn(to, (s, lock))
+            _drop_conn(to, entry)
             raise
     if retry:
-        _drop_conn(to, (s, lock))
-        s2, lock2 = _peer_conn(to, timeout)
+        _drop_conn(to, entry)
+        entry2 = _peer_conn(to, timeout)
+        s2, lock2 = entry2
         with lock2:
             s2.settimeout(timeout)
             _send_msg(s2, payload)

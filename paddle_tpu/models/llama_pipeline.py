@@ -34,7 +34,7 @@ class LlamaPipelineTrainer:
 
     def __init__(self, config: LlamaConfig, mesh, optimizer, n_micro=None,
                  zero_stage=2, compute_dtype="auto", seed=0,
-                 pp_schedule="1f1b"):
+                 pp_schedule="1f1b", vpp=2):
         from .. import nn
         from ..distributed.mp_layers import ColumnParallelLinear, VocabParallelEmbedding
         from ..framework import random as frandom
@@ -57,11 +57,14 @@ class LlamaPipelineTrainer:
         self.zdeg = shape.get("sharding", 1)
         self.zero_stage = zero_stage
         # "1f1b" (reference pipeline_parallel.py:372, the default schedule
-        # there too) or "fthenb" (GPipe fill-drain, autodiff backward)
+        # there too), "fthenb" (GPipe fill-drain, autodiff backward), or
+        # "interleaved" (virtual stages: vpp non-adjacent chunks per device,
+        # reference PipelineParallelWithInterleave:807)
         self.pp_schedule = pp_schedule
+        self.vpp = vpp if pp_schedule == "interleaved" else 1
         self.n_micro = n_micro or max(2 * self.n_stages, 2)
-        assert config.num_hidden_layers % self.n_stages == 0, \
-            "layers must divide evenly over pipeline stages"
+        assert config.num_hidden_layers % (self.n_stages * self.vpp) == 0, \
+            "layers must divide evenly over pipeline stages (x vpp chunks)"
 
         frandom.seed(seed)
         # template block: ONE set of python layers reused functionally per block
@@ -243,7 +246,24 @@ class LlamaPipelineTrainer:
                 return ploss(bparams, {"norm": nparams, "head": hparams},
                              h_micro, y_micro)
 
-            if S > 1:
+            if S > 1 and self.pp_schedule == "interleaved":
+                from ..distributed.pipeline import (
+                    interleave_stage_params, spmd_pipeline_interleaved)
+
+                vpp = self.vpp
+
+                def to_chunks(a):
+                    # [S, L/S, ...] -> [L, ...] -> [S*vpp, L/(S*vpp), ...]
+                    L_total = a.shape[0] * a.shape[1]
+                    lpc = L_total // (S * vpp)
+                    return a.reshape((L_total,) + a.shape[2:]) \
+                        .reshape((S * vpp, lpc) + a.shape[2:])
+
+                chunked = jax.tree_util.tree_map(to_chunks, bparams)
+                inter = interleave_stage_params(chunked, S)
+                h_micro = spmd_pipeline_interleaved(
+                    stage_fn, inter, h_micro, mesh, S, vpp)
+            elif S > 1:
                 h_micro = spmd_pipeline(stage_fn, bparams, h_micro, mesh, S)
             else:
                 squeezed = jax.tree_util.tree_map(lambda a: a.reshape((-1,) + a.shape[2:]), bparams)

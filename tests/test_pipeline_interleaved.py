@@ -100,6 +100,31 @@ class TestInterleaved:
                                        np.asarray(g_plain[k]),
                                        rtol=1e-4, atol=1e-6)
 
+    def test_llama_trainer_interleaved_matches_fthenb(self):
+        """pp_schedule='interleaved' on the Llama trainer is the same math as
+        fill-drain, re-laid-out over virtual chunks — losses must match."""
+        from paddle_tpu.models import llama_tiny
+        from paddle_tpu.models.llama_pipeline import LlamaPipelineTrainer
+        from paddle_tpu.optimizer import AdamW
+
+        def losses(schedule):
+            mesh = build_mesh(degrees={"pp": 2, "dp": 2, "mp": 2})
+            cfg = llama_tiny(vocab=64, hidden=32, layers=4, heads=4,
+                             kv_heads=2, inter=64, seq=32)
+            trainer = LlamaPipelineTrainer(
+                cfg, mesh, AdamW(learning_rate=1e-2), n_micro=4,
+                zero_stage=2, seed=0, pp_schedule=schedule, vpp=2)
+            rng = np.random.RandomState(0)
+            out = []
+            for _ in range(2):
+                x = rng.randint(0, 64, (8, 16)).astype(np.int64)
+                y = rng.randint(0, 64, (8, 16)).astype(np.int64)
+                out.append(float(np.asarray(trainer.step(x, y))))
+            return out
+
+        np.testing.assert_allclose(losses("interleaved"), losses("fthenb"),
+                                   rtol=2e-4, atol=2e-5)
+
     def test_deeper_ring_pp4_vpp2(self):
         per_stage, stacked, x = _setup(n_stages=4, vpp=2, M=6)
         mesh = build_mesh(degrees={"pp": 4, "dp": 2})
