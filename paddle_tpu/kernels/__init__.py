@@ -81,3 +81,17 @@ def attention_impl():
         except Exception:
             return sdpa_ref
     return sdpa_ref
+
+
+def layer_norm_impl():
+    """Selector for the fused-layernorm path (mirrors attention_impl):
+    returns the Pallas kernel when the policy picks Pallas, else None
+    (caller uses its jnp composition)."""
+    if use_pallas():
+        try:
+            from .layernorm import layer_norm_pallas
+
+            return layer_norm_pallas
+        except Exception:
+            return None
+    return None

@@ -59,6 +59,16 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=N
         normalized_shape = [normalized_shape]
     n_axes = len(tuple(normalized_shape))
 
+    # Pallas fused kernel variant (kernel-policy selected, like flash
+    # attention): last-dim normalization with both affine params
+    if n_axes == 1 and weight is not None and bias is not None:
+        from ...kernels import layer_norm_impl
+
+        fused = layer_norm_impl()
+        if fused is not None:
+            return apply(lambda v, w, b: fused(v, w, b, epsilon),
+                         x, weight, bias, op_name="layer_norm")
+
     def body(v, w=None, b=None):
         axes = tuple(range(v.ndim - n_axes, v.ndim))
         mean = jnp.mean(v, axis=axes, keepdims=True)
