@@ -20,7 +20,7 @@ import jax
 
 __all__ = [
     "use_pallas", "set_use_pallas", "attention_impl",
-    "set_platform", "active_platform",
+    "set_platform", "active_platform", "layer_norm_impl",
 ]
 
 _FORCE = os.environ.get("PADDLE_TPU_USE_PALLAS")  # "1" | "0" | None

@@ -14,6 +14,12 @@ from .ernie import (  # noqa: F401
     ernie_tiny,
 )
 from .llama import LlamaConfig, LlamaDecoderLayer, LlamaForCausalLM, llama_7b, llama_tiny  # noqa: F401
+from .whisper import (  # noqa: F401
+    WhisperConfig,
+    WhisperEncoder,
+    WhisperForConditionalGeneration,
+    whisper_tiny,
+)
 
 __all__ = [
     "LlamaConfig", "LlamaForCausalLM", "LlamaDecoderLayer", "llama_7b", "llama_tiny",
@@ -21,4 +27,6 @@ __all__ = [
     "conformer_tiny",
     "ErnieConfig", "ErnieModel", "ErnieForMaskedLM",
     "ErnieForSequenceClassification", "ernie_base", "ernie_tiny",
+    "WhisperConfig", "WhisperEncoder", "WhisperForConditionalGeneration",
+    "whisper_tiny",
 ]

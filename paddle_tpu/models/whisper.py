@@ -1,0 +1,148 @@
+"""Whisper-style encoder-decoder ASR model (BASELINE config #5's other
+named family; Radford 2022 architecture: log-mel frontend -> conv subsample
+-> transformer encoder; token decoder with cross attention).
+
+TPU-first: both stacks are nn.Transformer components (flash-attention kernel
+path), greedy decode rides MultiHeadAttention's Cache/StaticCache API so the
+per-step cost is one token's compute.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .. import nn
+from ..nn import functional as F
+
+
+@dataclass
+class WhisperConfig:
+    n_mels: int = 80
+    vocab_size: int = 51865
+    d_model: int = 512
+    encoder_layers: int = 6
+    decoder_layers: int = 6
+    num_heads: int = 8
+    ffn_dim: int = 2048
+    max_source_positions: int = 1500
+    max_target_positions: int = 448
+    dropout: float = 0.0
+    sot_token: int = 1
+    eot_token: int = 2
+
+
+def whisper_tiny(vocab=128, d_model=64, layers=2, heads=4, n_mels=16,
+                 max_src=64, max_tgt=32):
+    return WhisperConfig(n_mels=n_mels, vocab_size=vocab, d_model=d_model,
+                         encoder_layers=layers, decoder_layers=layers,
+                         num_heads=heads, ffn_dim=d_model * 2,
+                         max_source_positions=max_src,
+                         max_target_positions=max_tgt)
+
+
+def _sinusoids(length, channels):
+    """Whisper's fixed sinusoidal positional table."""
+    log_timescale = np.log(10000.0) / (channels // 2 - 1)
+    inv = np.exp(-log_timescale * np.arange(channels // 2))
+    scaled = np.arange(length)[:, None] * inv[None, :]
+    return np.concatenate([np.sin(scaled), np.cos(scaled)],
+                          axis=1).astype(np.float32)
+
+
+class WhisperEncoder(nn.Layer):
+    def __init__(self, cfg: WhisperConfig):
+        super().__init__()
+        self.conv1 = nn.Conv1D(cfg.n_mels, cfg.d_model, 3, padding=1)
+        self.conv2 = nn.Conv1D(cfg.d_model, cfg.d_model, 3, stride=2, padding=1)
+        enc_layer = nn.TransformerEncoderLayer(
+            cfg.d_model, cfg.num_heads, cfg.ffn_dim, dropout=cfg.dropout,
+            activation="gelu", normalize_before=True)
+        self.layers = nn.TransformerEncoder(enc_layer, cfg.encoder_layers,
+                                            norm=nn.LayerNorm(cfg.d_model))
+        from ..core.tensor import to_tensor
+
+        self.register_buffer(
+            "_pos", to_tensor(_sinusoids(cfg.max_source_positions, cfg.d_model)),
+            persistable=False)
+
+    def forward(self, mel):
+        """mel [B, n_mels, T] -> [B, T//2, d_model]"""
+        h = F.gelu(self.conv1(mel))
+        h = F.gelu(self.conv2(h))  # stride-2 subsample
+        h = h.transpose([0, 2, 1])
+        if h.shape[1] > self._pos.shape[0]:
+            raise ValueError(
+                f"audio yields {h.shape[1]} frames but max_source_positions "
+                f"is {self._pos.shape[0]} — trim/chunk the input")
+        h = h + self._pos[: h.shape[1]]
+        return self.layers(h)
+
+
+class WhisperDecoder(nn.Layer):
+    def __init__(self, cfg: WhisperConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embed_tokens = nn.Embedding(cfg.vocab_size, cfg.d_model)
+        self.embed_positions = nn.Embedding(cfg.max_target_positions,
+                                            cfg.d_model)
+        dec_layer = nn.TransformerDecoderLayer(
+            cfg.d_model, cfg.num_heads, cfg.ffn_dim, dropout=cfg.dropout,
+            activation="gelu", normalize_before=True)
+        self.layers = nn.TransformerDecoder(dec_layer, cfg.decoder_layers,
+                                            norm=nn.LayerNorm(cfg.d_model))
+
+    def forward(self, tokens, memory, cache=None, pos_offset=0):
+        from .. import ops as P
+
+        t = tokens.shape[1]
+        pos = P.arange(pos_offset, pos_offset + t, dtype="int64")
+        h = self.embed_tokens(tokens) + self.embed_positions(pos)
+        tgt_mask = None
+        if t > 1:
+            tgt_mask = nn.Transformer.generate_square_subsequent_mask(t)
+        if cache is None:
+            return self.layers(h, memory, tgt_mask)
+        return self.layers(h, memory, tgt_mask, None, cache)
+
+
+class WhisperForConditionalGeneration(nn.Layer):
+    def __init__(self, cfg: WhisperConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.encoder = WhisperEncoder(cfg)
+        self.decoder = WhisperDecoder(cfg)
+        self.proj = nn.Linear(cfg.d_model, cfg.vocab_size, bias_attr=False)
+
+    def forward(self, mel, tokens):
+        """Teacher-forced logits [B, T_tok, V]."""
+        memory = self.encoder(mel)
+        h = self.decoder(tokens, memory)
+        return self.proj(h)
+
+    def generate(self, mel, max_new_tokens=16):
+        """Greedy decode with per-layer K/V caches (reference generation
+        loop; one token of decoder compute per step)."""
+        import paddle_tpu as paddle
+        from .. import ops as P
+
+        memory = self.encoder(mel)
+        b = mel.shape[0]
+        tokens = paddle.to_tensor(
+            np.full((b, 1), self.cfg.sot_token, np.int64))
+        cache = self.decoder.layers.gen_cache(memory)
+        out = [tokens]
+        cur = tokens
+        finished = np.zeros(b, bool)
+        for step in range(max_new_tokens):
+            h, cache = self.decoder(cur, memory, cache=cache,
+                                    pos_offset=step)
+            logits = self.proj(h[:, -1])
+            nxt = np.asarray(P.argmax(logits, axis=-1).numpy()).astype(np.int64)
+            nxt = np.where(finished, self.cfg.eot_token, nxt)  # pad after eot
+            finished |= nxt == self.cfg.eot_token
+            cur = paddle.to_tensor(nxt[:, None])
+            out.append(cur)
+            if finished.all():
+                break
+        return P.concat(out, axis=1)
