@@ -1,0 +1,137 @@
+"""Seq2seq decoding (reference python/paddle/nn/decode.py:
+BeamSearchDecoder + dynamic_decode over an RNN cell).
+
+TPU note: the step loop runs in python (host-driven decode, like the
+reference's dynamic_decode); each step's compute is dispatched ops, and the
+final sequence reconstruction is the registered ``gather_tree`` op.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+from ..ops.registry import OPS
+
+__all__ = ["BeamSearchDecoder", "dynamic_decode"]
+
+
+def _np(x):
+    return np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+
+
+class BeamSearchDecoder:
+    """Beam search over a cell: state carries (cell states per beam,
+    cumulative log-probs, finished flags)."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- reference API ----------------------------------------------------
+    def initialize(self, initial_cell_states):
+        """Tile cell states across beams; beam 0 starts live, others -inf."""
+        k = self.beam_size
+
+        def tile(s):
+            a = _np(s)
+            return np.repeat(a, k, axis=0)  # [b*k, ...] beam-major per batch
+
+        states = _tree_map(tile, initial_cell_states)
+        batch = _tree_first(initial_cell_states).shape[0]
+        log_probs = np.full((batch, k), -1e9, np.float32)
+        log_probs[:, 0] = 0.0
+        finished = np.zeros((batch, k), bool)
+        tokens = np.full((batch, k), self.start_token, np.int64)
+        return tokens, (states, log_probs, finished)
+
+    def step(self, time, tokens, beam_state):
+        states, log_probs, finished = beam_state
+        batch, k = tokens.shape
+        inp = to_tensor(tokens.reshape(-1))
+        if self.embedding_fn is not None:
+            inp = self.embedding_fn(inp)
+        cell_out, new_states = self.cell(inp, _tree_map(to_tensor, states))
+        logits = self.output_fn(cell_out) if self.output_fn else cell_out
+        logp = _np(logits).astype(np.float32)
+        logp = logp - _logsumexp(logp)  # log-softmax, [b*k, V]
+        V = logp.shape[-1]
+        logp = logp.reshape(batch, k, V)
+        # finished beams only extend with end_token at no cost
+        fin_mask = np.full((V,), -1e9, np.float32)
+        fin_mask[self.end_token] = 0.0
+        logp = np.where(finished[:, :, None], fin_mask[None, None], logp)
+        total = log_probs[:, :, None] + logp  # [b, k, V]
+        flat = total.reshape(batch, k * V)
+        top = np.argsort(-flat, axis=1)[:, :k]  # [b, k]
+        new_log_probs = np.take_along_axis(flat, top, axis=1)
+        parent = (top // V).astype(np.int64)
+        token = (top % V).astype(np.int64)
+        new_finished = np.take_along_axis(finished, parent, axis=1) | (
+            token == self.end_token)
+
+        def regather(s):
+            a = _np(s).reshape((batch, k) + _np(s).shape[1:])
+            idx = parent
+            for _ in range(a.ndim - 2):
+                idx = idx[..., None]
+            out = np.take_along_axis(a, np.broadcast_to(idx, a.shape), axis=1)
+            return out.reshape((batch * k,) + a.shape[2:])
+
+        new_states = _tree_map(regather, _tree_map(_np, new_states))
+        return (token, parent), (new_states, new_log_probs, new_finished)
+
+
+_ACCEPTED_NOOP_KWARGS = {"output_time_major", "impute_finished",
+                         "is_test", "return_length"}
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=32, **kwargs):
+    """Run the decoder to completion; returns (sequences, final log-probs
+    [b, beam]). Sequences are TIME-MAJOR [T, b, beam] (matching the
+    reference's default output_time_major layout), reconstructed through the
+    ``gather_tree`` op (reference dynamic_decode + gather_tree)."""
+    for k in kwargs:
+        if k not in _ACCEPTED_NOOP_KWARGS:
+            raise TypeError(f"dynamic_decode got unexpected argument {k!r}")
+        if kwargs[k] not in (None, False, True):
+            raise NotImplementedError(f"{k}={kwargs[k]!r} is not supported")
+    if kwargs.get("output_time_major") is False:
+        raise NotImplementedError(
+            "output_time_major=False: transpose the [T, b, beam] result")
+    if max_step_num < 1:
+        raise ValueError("max_step_num must be >= 1")
+    tokens, state = decoder.initialize(inits)
+    step_tokens, step_parents = [], []
+    for t in range(max_step_num):
+        (tok, parent), state = decoder.step(t, tokens, state)
+        step_tokens.append(tok)
+        step_parents.append(parent)
+        tokens = tok
+        if state[2].all():
+            break
+    ids = np.stack(step_tokens)      # [T, b, k]
+    parents = np.stack(step_parents)
+    seqs = OPS["gather_tree"].fn(to_tensor(ids), to_tensor(parents))
+    return seqs, to_tensor(state[1])
+
+
+def _logsumexp(a):
+    m = a.max(axis=-1, keepdims=True)
+    return m + np.log(np.exp(a - m).sum(axis=-1, keepdims=True))
+
+
+def _tree_map(fn, tree):
+    if isinstance(tree, (list, tuple)):
+        return type(tree)(_tree_map(fn, t) for t in tree)
+    return fn(tree)
+
+
+def _tree_first(tree):
+    if isinstance(tree, (list, tuple)):
+        return _tree_first(tree[0])
+    return tree

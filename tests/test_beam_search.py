@@ -1,0 +1,93 @@
+"""BeamSearchDecoder + dynamic_decode (reference python/paddle/nn/decode.py).
+Oracle: exhaustive path enumeration over a tiny deterministic cell."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+class TableCell(nn.Layer):
+    """Deterministic 'language model': logits depend only on the previous
+    token via a fixed table — beam search over it has a computable optimum."""
+
+    def __init__(self, table):
+        super().__init__()
+        self._table = np.asarray(table, np.float32)  # [V, V] logits
+
+    def forward(self, tokens, states):
+        idx = np.asarray(tokens.numpy()).astype(int)
+        return paddle.to_tensor(self._table[idx]), states
+
+
+def _brute_force_best(table, start, end, steps, beam_is_exact=True):
+    """Exhaustive search for the max-log-prob sequence of `steps` tokens."""
+    from itertools import product
+
+    def logsoftmax(row):
+        m = row.max()
+        return row - (m + np.log(np.exp(row - m).sum()))
+
+    V = table.shape[0]
+    best, arg = -1e18, None
+    for seq in product(range(V), repeat=steps):
+        lp, prev, alive = 0.0, start, True
+        for tok in seq:
+            if not alive:
+                if tok != end:
+                    lp = -1e18
+                    break
+                continue
+            lp += logsoftmax(table[prev])[tok]
+            prev = tok
+            if tok == end:
+                alive = False
+        if lp > best:
+            best, arg = lp, seq
+    return best, arg
+
+
+class TestBeamSearch:
+    def test_beam_finds_global_optimum(self):
+        rng = np.random.RandomState(0)
+        V, steps = 5, 3
+        table = rng.randn(V, V).astype(np.float32) * 2
+        cell = TableCell(table)
+        dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=V - 1,
+                                   beam_size=V * V)  # wide beam == exhaustive
+        init = np.zeros((1, 1), np.float32)  # dummy cell state, batch 1
+        seqs, scores = nn.dynamic_decode(dec, init, max_step_num=steps)
+        got = np.asarray(seqs.numpy())[:, 0, 0]  # [T] best beam of batch 0
+        best_lp, best_seq = _brute_force_best(table, 0, V - 1, steps)
+        np.testing.assert_array_equal(got, best_seq)
+        np.testing.assert_allclose(float(scores.numpy()[0, 0]), best_lp,
+                                   rtol=1e-5)
+
+    def test_finished_beams_freeze(self):
+        # table that strongly prefers end_token immediately
+        V = 4
+        table = np.full((V, V), -5.0, np.float32)
+        table[:, V - 1] = 5.0
+        cell = TableCell(table)
+        dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=V - 1,
+                                   beam_size=2)
+        seqs, scores = nn.dynamic_decode(dec, np.zeros((2, 1), np.float32),
+                                         max_step_num=6)
+        out = np.asarray(seqs.numpy())
+        # loop stopped early once every beam emitted end_token
+        assert out.shape[0] <= 3
+        assert (out[0, :, 0] == V - 1).all()  # first step: eot everywhere
+
+    def test_batch_independence(self):
+        rng = np.random.RandomState(1)
+        V = 6
+        table = rng.randn(V, V).astype(np.float32)
+        cell = TableCell(table)
+        dec = nn.BeamSearchDecoder(cell, start_token=1, end_token=0,
+                                   beam_size=3)
+        one, _ = nn.dynamic_decode(dec, np.zeros((1, 1), np.float32),
+                                   max_step_num=4)
+        two, _ = nn.dynamic_decode(dec, np.zeros((3, 1), np.float32),
+                                   max_step_num=4)
+        np.testing.assert_array_equal(np.asarray(one.numpy())[:, 0],
+                                      np.asarray(two.numpy())[:, 1])
