@@ -9,6 +9,8 @@ from __future__ import annotations
 
 import numpy as np
 
+import jax
+
 from ..core.tensor import Tensor, to_tensor
 from ..ops.registry import OPS
 
@@ -41,8 +43,8 @@ class BeamSearchDecoder:
             a = _np(s)
             return np.repeat(a, k, axis=0)  # [b*k, ...] beam-major per batch
 
-        states = _tree_map(tile, initial_cell_states)
-        batch = _tree_first(initial_cell_states).shape[0]
+        states = jax.tree_util.tree_map(tile, initial_cell_states)
+        batch = jax.tree_util.tree_leaves(initial_cell_states)[0].shape[0]
         log_probs = np.full((batch, k), -1e9, np.float32)
         log_probs[:, 0] = 0.0
         finished = np.zeros((batch, k), bool)
@@ -55,7 +57,8 @@ class BeamSearchDecoder:
         inp = to_tensor(tokens.reshape(-1))
         if self.embedding_fn is not None:
             inp = self.embedding_fn(inp)
-        cell_out, new_states = self.cell(inp, _tree_map(to_tensor, states))
+        cell_out, new_states = self.cell(
+            inp, jax.tree_util.tree_map(to_tensor, states))
         logits = self.output_fn(cell_out) if self.output_fn else cell_out
         logp = _np(logits).astype(np.float32)
         logp = logp - _logsumexp(logp)  # log-softmax, [b*k, V]
@@ -82,27 +85,30 @@ class BeamSearchDecoder:
             out = np.take_along_axis(a, np.broadcast_to(idx, a.shape), axis=1)
             return out.reshape((batch * k,) + a.shape[2:])
 
-        new_states = _tree_map(regather, _tree_map(_np, new_states))
+        new_states = jax.tree_util.tree_map(
+            regather, jax.tree_util.tree_map(_np, new_states))
         return (token, parent), (new_states, new_log_probs, new_finished)
 
 
-_ACCEPTED_NOOP_KWARGS = {"output_time_major", "impute_finished",
-                         "is_test", "return_length"}
+_ACCEPTED_NOOP_KWARGS = {"impute_finished", "is_test"}
 
 
-def dynamic_decode(decoder, inits=None, max_step_num=32, **kwargs):
-    """Run the decoder to completion; returns (sequences, final log-probs
-    [b, beam]). Sequences are TIME-MAJOR [T, b, beam] (matching the
-    reference's default output_time_major layout), reconstructed through the
-    ``gather_tree`` op (reference dynamic_decode + gather_tree)."""
+def dynamic_decode(decoder, inits=None, max_step_num=32,
+                   output_time_major=False, return_length=False, **kwargs):
+    """Run the decoder to completion (reference dynamic_decode).
+
+    Returns (sequences, final log-probs [b, beam]); sequences are
+    batch-major [b, T, beam] by default (the reference's
+    output_time_major=False), time-major with output_time_major=True.
+    With return_length=True a third [b, beam] int array gives each
+    sequence's length including its end token. Reconstruction goes through
+    the ``gather_tree`` op."""
     for k in kwargs:
         if k not in _ACCEPTED_NOOP_KWARGS:
             raise TypeError(f"dynamic_decode got unexpected argument {k!r}")
-        if kwargs[k] not in (None, False, True):
-            raise NotImplementedError(f"{k}={kwargs[k]!r} is not supported")
-    if kwargs.get("output_time_major") is False:
-        raise NotImplementedError(
-            "output_time_major=False: transpose the [T, b, beam] result")
+    if inits is None:
+        raise ValueError(
+            "dynamic_decode needs initial cell states (inits=...)")
     if max_step_num < 1:
         raise ValueError("max_step_num must be >= 1")
     tokens, state = decoder.initialize(inits)
@@ -117,21 +123,25 @@ def dynamic_decode(decoder, inits=None, max_step_num=32, **kwargs):
     ids = np.stack(step_tokens)      # [T, b, k]
     parents = np.stack(step_parents)
     seqs = OPS["gather_tree"].fn(to_tensor(ids), to_tensor(parents))
-    return seqs, to_tensor(state[1])
+    seq_np = _np(seqs)
+    if not output_time_major:
+        seqs = to_tensor(np.transpose(seq_np, (1, 0, 2)))  # [b, T, k]
+    out = (seqs, to_tensor(state[1]))
+    if return_length:
+        end = getattr(decoder, "end_token", None)
+        T = seq_np.shape[0]
+        if end is None:
+            lengths = np.full(seq_np.shape[1:], T, np.int64)
+        else:
+            is_end = seq_np == end  # [T, b, k]
+            any_end = is_end.any(axis=0)
+            first = is_end.argmax(axis=0) + 1
+            lengths = np.where(any_end, first, T).astype(np.int64)
+        out = out + (to_tensor(lengths),)
+    return out
 
 
 def _logsumexp(a):
     m = a.max(axis=-1, keepdims=True)
     return m + np.log(np.exp(a - m).sum(axis=-1, keepdims=True))
 
-
-def _tree_map(fn, tree):
-    if isinstance(tree, (list, tuple)):
-        return type(tree)(_tree_map(fn, t) for t in tree)
-    return fn(tree)
-
-
-def _tree_first(tree):
-    if isinstance(tree, (list, tuple)):
-        return _tree_first(tree[0])
-    return tree

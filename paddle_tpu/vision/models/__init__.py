@@ -1,11 +1,17 @@
 from .alexnet import AlexNet, alexnet  # noqa: F401
+from .densenet import (  # noqa: F401
+    DenseNet, densenet121, densenet161, densenet169, densenet201,
+)
 from .lenet import LeNet  # noqa: F401
 from .mobilenetv2 import MobileNetV2, mobilenet_v2  # noqa: F401
 from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, resnet152  # noqa: F401
+from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1  # noqa: F401
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
 
 __all__ = [
     "LeNet", "ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
     "resnet152", "AlexNet", "alexnet", "VGG", "vgg11", "vgg13", "vgg16",
     "vgg19", "MobileNetV2", "mobilenet_v2",
+    "DenseNet", "densenet121", "densenet161", "densenet169", "densenet201",
+    "SqueezeNet", "squeezenet1_0", "squeezenet1_1",
 ]

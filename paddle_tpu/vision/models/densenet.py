@@ -1,0 +1,102 @@
+"""DenseNet (reference python/paddle/vision/models/densenet.py; Huang 2017
+dense connectivity: each layer consumes every earlier feature map)."""
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201"]
+
+_CFGS = {
+    121: (64, 32, (6, 12, 24, 16)),
+    161: (96, 48, (6, 12, 36, 24)),
+    169: (64, 32, (6, 12, 32, 32)),
+    201: (64, 32, (6, 12, 48, 32)),
+}
+
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, c_in, growth, bn_size=4, dropout=0.0):
+        super().__init__()
+        self.norm1 = nn.BatchNorm2D(c_in)
+        self.conv1 = nn.Conv2D(c_in, bn_size * growth, 1, bias_attr=False)
+        self.norm2 = nn.BatchNorm2D(bn_size * growth)
+        self.conv2 = nn.Conv2D(bn_size * growth, growth, 3, padding=1,
+                               bias_attr=False)
+        self.relu = nn.ReLU()
+        self.dropout = nn.Dropout(dropout) if dropout > 0 else None
+
+    def forward(self, x):
+        from ... import ops as P
+
+        h = self.conv1(self.relu(self.norm1(x)))
+        h = self.conv2(self.relu(self.norm2(h)))
+        if self.dropout is not None:
+            h = self.dropout(h)
+        return P.concat([x, h], axis=1)
+
+
+class _Transition(nn.Layer):
+    def __init__(self, c_in, c_out):
+        super().__init__()
+        self.norm = nn.BatchNorm2D(c_in)
+        self.conv = nn.Conv2D(c_in, c_out, 1, bias_attr=False)
+        self.pool = nn.AvgPool2D(2, stride=2)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.norm(x))))
+
+
+class DenseNet(nn.Layer):
+    def __init__(self, layers=121, bn_size=4, dropout=0.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        init_c, growth, blocks = _CFGS[layers]
+        feats = [
+            nn.Conv2D(3, init_c, 7, stride=2, padding=3, bias_attr=False),
+            nn.BatchNorm2D(init_c), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1),
+        ]
+        c = init_c
+        for bi, n in enumerate(blocks):
+            for _ in range(n):
+                feats.append(_DenseLayer(c, growth, bn_size, dropout))
+                c += growth
+            if bi != len(blocks) - 1:
+                feats.append(_Transition(c, c // 2))
+                c //= 2
+        feats += [nn.BatchNorm2D(c), nn.ReLU()]
+        self.features = nn.Sequential(*feats)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Linear(c, num_classes)
+
+    def forward(self, x):
+        from ... import ops as P
+
+        h = self.features(x)
+        if self.with_pool:
+            h = self.pool(h)
+        if self.num_classes > 0:
+            h = self.classifier(P.flatten(h, start_axis=1))
+        return h
+
+
+def densenet121(pretrained=False, **kwargs):
+    return DenseNet(121, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return DenseNet(161, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return DenseNet(169, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return DenseNet(201, **kwargs)

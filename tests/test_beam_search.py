@@ -57,7 +57,7 @@ class TestBeamSearch:
                                    beam_size=V * V)  # wide beam == exhaustive
         init = np.zeros((1, 1), np.float32)  # dummy cell state, batch 1
         seqs, scores = nn.dynamic_decode(dec, init, max_step_num=steps)
-        got = np.asarray(seqs.numpy())[:, 0, 0]  # [T] best beam of batch 0
+        got = np.asarray(seqs.numpy())[0, :, 0]  # batch-major: [b, T, beam]
         best_lp, best_seq = _brute_force_best(table, 0, V - 1, steps)
         np.testing.assert_array_equal(got, best_seq)
         np.testing.assert_allclose(float(scores.numpy()[0, 0]), best_lp,
@@ -71,12 +71,14 @@ class TestBeamSearch:
         cell = TableCell(table)
         dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=V - 1,
                                    beam_size=2)
-        seqs, scores = nn.dynamic_decode(dec, np.zeros((2, 1), np.float32),
-                                         max_step_num=6)
-        out = np.asarray(seqs.numpy())
+        seqs, scores, lengths = nn.dynamic_decode(
+            dec, np.zeros((2, 1), np.float32), max_step_num=6,
+            return_length=True)
+        out = np.asarray(seqs.numpy())  # [b, T, beam]
         # loop stopped early once every beam emitted end_token
-        assert out.shape[0] <= 3
-        assert (out[0, :, 0] == V - 1).all()  # first step: eot everywhere
+        assert out.shape[1] <= 3
+        assert (out[:, 0, 0] == V - 1).all()  # first step: eot everywhere
+        np.testing.assert_array_equal(np.asarray(lengths.numpy())[:, 0], 1)
 
     def test_batch_independence(self):
         rng = np.random.RandomState(1)
@@ -89,5 +91,11 @@ class TestBeamSearch:
                                    max_step_num=4)
         two, _ = nn.dynamic_decode(dec, np.zeros((3, 1), np.float32),
                                    max_step_num=4)
-        np.testing.assert_array_equal(np.asarray(one.numpy())[:, 0],
-                                      np.asarray(two.numpy())[:, 1])
+        np.testing.assert_array_equal(np.asarray(one.numpy())[0],
+                                      np.asarray(two.numpy())[1])
+        # time-major option preserves the reference's other layout
+        tm, _ = nn.dynamic_decode(dec, np.zeros((1, 1), np.float32),
+                                  max_step_num=4, output_time_major=True)
+        np.testing.assert_array_equal(
+            np.asarray(tm.numpy()).transpose(1, 0, 2),
+            np.asarray(one.numpy()))

@@ -56,3 +56,26 @@ class TestVisionModels:
         assert len(grads) > 50  # depthwise + pointwise stacks all got grads
         opt.step()
         assert np.isfinite(float(loss.numpy()))
+
+
+class TestDenseSqueeze:
+    def test_densenet121_params_and_forward(self):
+        from paddle_tpu.vision.models import densenet121
+
+        paddle.seed(0)
+        # canonical DenseNet-121 has ~7.98M params
+        net = densenet121()
+        assert abs(_param_count(net) - 7_978_856) < 1e5
+        small = densenet121(num_classes=5)
+        x = paddle.to_tensor(np.random.rand(1, 3, 64, 64).astype(np.float32))
+        assert small(x).shape == [1, 5]
+
+    def test_squeezenet_params_and_forward(self):
+        from paddle_tpu.vision.models import squeezenet1_0, squeezenet1_1
+
+        paddle.seed(0)
+        # canonical SqueezeNet 1.0 has ~1.25M params; 1.1 has ~1.24M
+        assert abs(_param_count(squeezenet1_0()) - 1_248_424) < 2e4
+        net = squeezenet1_1(num_classes=7)
+        x = paddle.to_tensor(np.random.rand(2, 3, 64, 64).astype(np.float32))
+        assert net(x).shape == [2, 7]
