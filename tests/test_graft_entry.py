@@ -1,0 +1,42 @@
+"""Driver-gate regression tests (VERDICT r2 weak #1).
+
+The multichip dryrun is a CPU-mesh correctness check, so it must be
+hermetic: it has to pass even when the injected TPU plugin's tunnel is
+broken. `dryrun_multichip` guarantees this by always re-exec'ing into a
+child whose environment has the plugin stripped from PYTHONPATH and the
+default device pinned to the virtual CPU pool. Analogue of the reference's
+fake custom_cpu plugin CI device (SURVEY §4, test/custom_runtime/).
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ENTRY = os.path.join(REPO, "__graft_entry__.py")
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_hermetic_with_broken_tunnel():
+    env = dict(os.environ)
+    # Deliberately break the plugin's tunnel endpoints. The hermetic
+    # re-exec must strip the plugin entirely, so these are never consulted.
+    env["PALLAS_AXON_POOL_IPS"] = "10.255.255.1"
+    env["AXON_LOOPBACK_RELAY"] = "0"
+    out = subprocess.run(
+        [sys.executable, ENTRY, "dryrun", "8"],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, (out.stdout + out.stderr)[-2000:]
+    assert "dryrun_multichip(8)" in out.stdout
+
+
+def test_hermetic_env_strips_plugin_and_forces_cpu():
+    import __graft_entry__ as g
+
+    env = g._hermetic_cpu_env(8)
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert "axon" not in env["PYTHONPATH"]
+    assert REPO in env["PYTHONPATH"].split(os.pathsep)
+    assert "--xla_force_host_platform_device_count=8" in env["XLA_FLAGS"]
+    assert env[g._HERMETIC_MARKER] == "1"
