@@ -88,6 +88,10 @@ register_flag("FLAGS_use_pallas", "",
 register_flag("FLAGS_benchmark", False,
               "block on every op result (like the reference's stream-sync "
               "benchmark mode) — makes per-op timing honest")
+register_flag("FLAGS_dy2static_eager_fallback", False,
+              "explicit opt-in: let to_static fall back to eager execution "
+              "(with a warning) when control flow can't be compiled; default "
+              "raises — silent eager dispatch is a 10-100x TPU perf cliff")
 register_flag("FLAGS_cudnn_deterministic", False,
               "determinism request; XLA:TPU is deterministic by default so "
               "this only pins rng-behind-dropout choices")

@@ -17,11 +17,13 @@ import contextlib
 import threading
 
 import jax
+import numpy as np
 
 _state = threading.local()
 # key is materialized lazily: building it at import would initialize JAX
 # backends before a launcher can call jax.distributed.initialize
 _global = {"seed": 0, "key": None}
+_np_state = {"rng": None}
 
 
 def _global_key():
@@ -34,7 +36,16 @@ def seed(s: int):
     """paddle.seed: reset the global generator."""
     _global["seed"] = int(s)
     _global["key"] = jax.random.PRNGKey(int(s))
+    _np_state["rng"] = np.random.RandomState(int(s))
     return _global["key"]
+
+
+def np_rng() -> np.random.RandomState:
+    """Host-side numpy generator tied to paddle.seed — parameter
+    initialization runs on host, not through the traced PRNG streams."""
+    if _np_state["rng"] is None:
+        _np_state["rng"] = np.random.RandomState(_global["seed"])
+    return _np_state["rng"]
 
 
 def get_cuda_rng_state():  # parity shim

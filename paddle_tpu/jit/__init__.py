@@ -37,14 +37,33 @@ def not_to_static(fn):
     return fn
 
 
+_TRACER_ERRORS = (
+    jax.errors.TracerBoolConversionError,
+    jax.errors.TracerArrayConversionError,
+    jax.errors.TracerIntegerConversionError,
+    jax.errors.ConcretizationTypeError,
+)
+
+
 class StaticFunction:
     """The reference's per-function program cache: one compiled program per
-    (input shapes/dtypes, training flag) guard key."""
+    (input shapes/dtypes, training flag) guard key (program_translator.py:1337).
 
-    def __init__(self, fn_or_layer, input_spec=None, build_strategy=None, full_graph=True):
+    Data-dependent python control flow is AST-transformed into
+    ``lax.cond``/``lax.while_loop`` via the dy2static package (the reference's
+    *_transformer.py role) so it still compiles to ONE program; eager
+    fallback only happens behind an explicit opt-in
+    (``to_static(..., fallback=True)`` or FLAGS_dy2static_eager_fallback)
+    and always WARNS — on TPU a silent fallback is a 10-100x perf cliff."""
+
+    def __init__(self, fn_or_layer, input_spec=None, build_strategy=None,
+                 full_graph=True, fallback=False):
         self._target = fn_or_layer
         self._input_spec = input_spec
         self._cache = {}
+        self._fallback = fallback
+        self._transformed_fn = None
+        self._needs_transform = False
         if isinstance(fn_or_layer, Layer):
             self._layer = fn_or_layer
         else:
@@ -56,6 +75,13 @@ class StaticFunction:
         training = self._layer.training if self._layer is not None else False
         return tuple((a.shape, str(a.dtype)) for a in arrays) + (training,)
 
+    def _allow_fallback(self):
+        if self._fallback:
+            return True
+        from ..framework.flags import flag_value
+
+        return bool(flag_value("FLAGS_dy2static_eager_fallback"))
+
     def __call__(self, *args, **kwargs):
         if not _to_static_enabled:
             return self._target(*args, **kwargs)
@@ -65,34 +91,58 @@ class StaticFunction:
         if entry == "eager":
             return self._eager_call(*args, **kwargs)
         if entry is None:
-            entry = self._build(key, kwargs)
+            # a function known to need the transform skips the doomed
+            # direct trace on every new input signature
+            entry = self._build(key, kwargs, transform=self._needs_transform)
             self._cache[key] = entry
-        jitted, buffers_box = entry
         try:
-            if self._layer is not None:
-                params, buffers = functional_state(self._layer)
-                out = jitted(params, buffers, *arrays)
-            else:
-                out = jitted(*arrays)
-        except (jax.errors.TracerBoolConversionError,
-                jax.errors.TracerArrayConversionError,
-                jax.errors.TracerIntegerConversionError,
-                jax.errors.ConcretizationTypeError) as e:
-            # data-dependent python control flow: the reference's dy2static
-            # AST transforms rewrite it into cond/while ops; here the
-            # function stays CORRECT by falling back to eager execution for
-            # this guard key (once, with a pointer to the jit-able idioms)
+            return _wrap_out(self._invoke(entry, arrays))
+        except _TRACER_ERRORS as e:
+            tracer_exc = e
+
+        # Direct tracing hit data-dependent python control flow: rewrite the
+        # function through the dy2static AST transformers and re-jit.
+        from . import dy2static
+
+        try:
+            entry = self._build(key, kwargs, transform=True)
+            out = _wrap_out(self._invoke(entry, arrays))
+            self._cache[key] = entry
+            self._needs_transform = True
+            return out
+        except (dy2static.UnsupportedSyntax, NameError, TypeError,
+                *_TRACER_ERRORS) as e2:
+            # NameError/TypeError cover the conversion runtime's own
+            # diagnostics (one-branch assignment, carry shape changes, ...)
+            reason = e2
+        name = getattr(self._target, "__name__", str(self._target))
+        if self._allow_fallback():
             import warnings
 
             warnings.warn(
-                f"to_static: '{getattr(self._target, '__name__', self._target)}'"
-                " branches on traced values; running eagerly for this input "
-                "signature (use paddle.where / lax.cond-style ops to keep it "
-                f"compiled). Tracer error: {str(e).splitlines()[0]}",
+                f"to_static: '{name}' uses control flow the dy2static "
+                "transform could not compile; running eagerly for this input "
+                "signature (10-100x slower on TPU). Reason: "
+                f"{str(reason).splitlines()[0]}",
                 stacklevel=2)
             self._cache[key] = "eager"
             return self._eager_call(*args, **kwargs)
-        return _wrap_out(out)
+        raise RuntimeError(
+            f"to_static: '{name}' uses data-dependent python control flow "
+            f"that could not be compiled ({str(reason).splitlines()[0]}). "
+            "Rewrite with tensor ops (paddle.where / supported if-while-for "
+            "patterns), or explicitly opt into eager execution with "
+            "to_static(..., fallback=True) or "
+            "paddle.set_flags({'FLAGS_dy2static_eager_fallback': True}) — "
+            "note that eager fallback is a severe perf cliff on TPU."
+        ) from (reason if isinstance(reason, Exception) else tracer_exc)
+
+    def _invoke(self, entry, arrays):
+        jitted, _ = entry
+        if self._layer is not None:
+            params, buffers = functional_state(self._layer)
+            return jitted(params, buffers, *arrays)
+        return jitted(*arrays)
 
     def _eager_call(self, *args, **kwargs):
         if self._layer is not None:
@@ -101,19 +151,36 @@ class StaticFunction:
                 return orig(*args, **kwargs)
         return self._target(*args, **kwargs)
 
-    def _build(self, key, kwargs):
+    def _transformed(self):
+        """AST-transform the target (cached) — layer forwards transform the
+        underlying unbound function and rebind to the layer instance."""
+        if self._transformed_fn is None:
+            import types
+
+            from . import dy2static
+
+            if self._layer is not None:
+                base = getattr(self._layer, "_orig_forward", None) or self._layer.forward
+                new_fn = dy2static.transform_function(base)
+                self._transformed_fn = types.MethodType(new_fn, self._layer)
+            else:
+                self._transformed_fn = dy2static.transform_function(self._target)
+        return self._transformed_fn
+
+    def _build(self, key, kwargs, transform=False):
         if self._layer is not None:
             layer = self._layer
             training = layer.training
-            orig_forward = getattr(layer, "_orig_forward", None)
+            use_forward = (self._transformed() if transform
+                           else getattr(layer, "_orig_forward", None))
 
             @jax.jit
             def jitted(params, buffers, *arrays):
                 # un-patch forward during tracing so the static wrapper
                 # doesn't recurse into itself
                 patched = layer.__dict__.get("forward")
-                if orig_forward is not None:
-                    layer.forward = orig_forward
+                if use_forward is not None:
+                    layer.forward = use_forward
                 try:
                     out, _ = functional_call(
                         layer, params, buffers, *arrays, training=training, **kwargs)
@@ -123,7 +190,7 @@ class StaticFunction:
                 return out
 
             return jitted, None
-        fn = self._target
+        fn = self._transformed() if transform else self._target
 
         @jax.jit
         def jitted(*arrays):
@@ -160,19 +227,23 @@ def _wrap_out(out):
     return out
 
 
-def to_static(function=None, input_spec=None, build_strategy=None, backend=None, **kwargs):
-    """@paddle.jit.to_static decorator / wrapper."""
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, fallback=False, **kwargs):
+    """@paddle.jit.to_static decorator / wrapper. ``fallback=True`` is the
+    explicit opt-in for eager execution when control flow can't compile
+    (always warns); the default raises instead of silently hitting the
+    eager perf cliff."""
 
     def deco(fn):
         if isinstance(fn, Layer):
-            sf = StaticFunction(fn, input_spec)
+            sf = StaticFunction(fn, input_spec, fallback=fallback)
             fn.forward_static = sf
             orig_forward = fn.forward
             fn._orig_forward = orig_forward
             # route __call__ through the static function
             fn.forward = lambda *a, **k: sf(*a, **k)
             return fn
-        return StaticFunction(fn, input_spec)
+        return StaticFunction(fn, input_spec, fallback=fallback)
 
     if function is not None:
         return deco(function)

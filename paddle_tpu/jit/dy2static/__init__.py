@@ -1,0 +1,418 @@
+"""dy2static: AST transformation of data-dependent python control flow.
+
+The reference converts python ``if``/``while``/``for`` over tensors into
+static-graph control-flow ops through one AST transformer per construct
+(/root/reference/python/paddle/jit/dy2static/ifelse_transformer.py,
+loop_transformer.py, logical_transformer.py, program_translator.py:1337).
+TPU-native: the rewritten code calls the converters in ``runtime.py`` which
+lower traced conditions to ``lax.cond`` / ``lax.while_loop``, so a function
+with data-dependent control flow compiles to ONE XLA program instead of
+falling off the jit cliff into per-op eager dispatch.
+
+Supported rewrites:
+- ``if``/``elif``/``else`` over traced predicates (assignment merging, and
+  the early-return pattern via return-normalization);
+- ``while`` with traced conditions (assigned names become the loop carry);
+- ``for .. in range(..)`` with traced bounds (lowered to while);
+- ``and``/``or``/``not`` over tensors; ternary ``a if c else b``; ``assert``.
+
+Unsupported syntax raises :class:`UnsupportedSyntax`; ``to_static`` then
+either raises (default) or, with the explicit eager-fallback opt-in, warns
+and runs the function eagerly.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+
+__all__ = ["transform_function", "UnsupportedSyntax"]
+
+
+class UnsupportedSyntax(Exception):
+    """Control flow the transformer cannot lower to lax combinators."""
+
+
+_CTRL = (ast.Return, ast.Break, ast.Continue)
+_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+
+
+def _walk_shallow(stmts, *, into_loops=True):
+    """Yield nodes in ``stmts`` without descending into nested function/class
+    scopes (their statements belong to a different frame); optionally skip
+    loop bodies (break/continue inside them are legal)."""
+    stack = list(stmts)
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, _SCOPES):
+            continue
+        if not into_loops and isinstance(n, (ast.For, ast.While)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _assigned_names(stmts):
+    """Names stored at this scope level inside ``stmts`` (the branch/loop
+    outputs), excluding nested function/class scopes."""
+    names = set()
+    for n in _walk_shallow(stmts):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+            names.add(n.id)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            names.add(n.name)
+    # generated transform internals are scoped to their own branch/body
+    return {n for n in names if not n.startswith("_pd_")}
+
+
+def _has_side_store(stmts):
+    """Attribute/Subscript stores (object mutation) can't be replayed in both
+    lax.cond branches safely."""
+    for n in _walk_shallow(stmts):
+        if isinstance(n, (ast.Attribute, ast.Subscript)) and isinstance(
+                n.ctx, (ast.Store, ast.Del)):
+            return True
+    return False
+
+
+def _contains(stmts, kinds, *, into_loops=True):
+    for n in _walk_shallow(stmts, into_loops=into_loops):
+        if isinstance(n, kinds):
+            return True
+    return False
+
+
+def _ends_in_return(stmts):
+    """All control paths through ``stmts`` end in return (recursing into a
+    trailing if/else)."""
+    if not stmts:
+        return False
+    last = stmts[-1]
+    if isinstance(last, ast.Return):
+        return True
+    if isinstance(last, ast.If):
+        return _ends_in_return(last.body) and _ends_in_return(last.orelse)
+    return False
+
+
+def _normalize_returns(stmts):
+    """Early-return normalization: ``if c: return a`` followed by S becomes
+    ``if c: return a  else: S`` so both branches end in return and the If can
+    lower to one convert_ifelse (the reference's return_transformer role)."""
+    out = []
+    for idx, s in enumerate(stmts):
+        if isinstance(s, ast.If):
+            s.body = _normalize_returns(s.body)
+            s.orelse = _normalize_returns(s.orelse)
+            rest = stmts[idx + 1:]
+            body_ret = _ends_in_return(s.body)
+            else_ret = _ends_in_return(s.orelse)
+            if body_ret and not else_ret:
+                merged = list(s.orelse) + rest
+                s.orelse = (_normalize_returns(merged) if merged
+                            else [ast.Return(value=ast.Constant(value=None))])
+                out.append(s)
+                return out
+            if else_ret and not body_ret and rest:
+                s.body = _normalize_returns(list(s.body) + rest)
+                out.append(s)
+                return out
+            if body_ret and else_ret:
+                out.append(s)
+                return out  # anything after is dead code
+            out.append(s)
+        elif isinstance(s, (ast.While, ast.For)):
+            s.body = _normalize_returns(s.body)
+            out.append(s)
+        else:
+            out.append(s)
+    return out
+
+
+def _name(id_, ctx=None):
+    return ast.Name(id=id_, ctx=ctx or ast.Load())
+
+
+def _jst_call(fn_name, args):
+    return ast.Call(
+        func=ast.Attribute(value=_name("_jst"), attr=fn_name, ctx=ast.Load()),
+        args=args, keywords=[])
+
+
+def _guard_init(names):
+    """``try: x``/``except: x = _jst.UNDEFINED`` per name — robust
+    definite-assignment handling without whole-function dataflow analysis."""
+    out = []
+    for n in sorted(names):
+        out.append(ast.Try(
+            body=[ast.Expr(value=_name(n))],
+            handlers=[ast.ExceptHandler(
+                type=ast.Tuple(elts=[_name("NameError"),
+                                     _name("UnboundLocalError")],
+                               ctx=ast.Load()),
+                name=None,
+                body=[ast.Assign(
+                    targets=[_name(n, ast.Store())],
+                    value=ast.Attribute(value=_name("_jst"), attr="UNDEFINED",
+                                        ctx=ast.Load()))])],
+            orelse=[], finalbody=[]))
+    return out
+
+
+def _names_tuple(names, ctx=None):
+    return ast.Tuple(elts=[_name(n, ctx or ast.Load()) for n in names],
+                     ctx=ctx or ast.Load())
+
+
+def _str_tuple(names):
+    return ast.Tuple(elts=[ast.Constant(value=n) for n in names],
+                     ctx=ast.Load())
+
+
+class Dy2StaticTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self._n = 0
+
+    def _uid(self):
+        self._n += 1
+        return self._n
+
+    # -- function entry ------------------------------------------------------
+    def visit_FunctionDef(self, node):
+        if not _ends_in_return(node.body):
+            # make the implicit fall-off-the-end return explicit so
+            # early-return normalization always has a tail to merge
+            node.body = list(node.body) + [
+                ast.Return(value=ast.Constant(value=None))]
+        node.body = _normalize_returns(node.body)
+        self.generic_visit(node)
+        return node
+
+    # -- boolean operators ---------------------------------------------------
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        op = "and" if isinstance(node.op, ast.And) else "or"
+        thunks = [ast.Lambda(
+            args=ast.arguments(posonlyargs=[], args=[], vararg=None,
+                               kwonlyargs=[], kw_defaults=[], kwarg=None,
+                               defaults=[]),
+            body=v) for v in node.values]
+        return _jst_call("convert_bool_op", [ast.Constant(value=op), *thunks])
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return _jst_call("convert_not", [node.operand])
+        return node
+
+    def visit_IfExp(self, node):
+        self.generic_visit(node)
+        mk = lambda b: ast.Lambda(
+            args=ast.arguments(posonlyargs=[], args=[], vararg=None,
+                               kwonlyargs=[], kw_defaults=[], kwarg=None,
+                               defaults=[]),
+            body=b)
+        return _jst_call("convert_ifelse",
+                         [node.test, mk(node.body), mk(node.orelse)])
+
+    def visit_Assert(self, node):
+        self.generic_visit(node)
+        return ast.Expr(value=_jst_call(
+            "convert_assert",
+            [node.test] + ([node.msg] if node.msg else [])))
+
+    # -- if / else -----------------------------------------------------------
+    def visit_If(self, node):
+        self.generic_visit(node)
+        body_ret = _ends_in_return(node.body)
+        else_ret = _ends_in_return(node.orelse)
+
+        # branch helpers take the assigned names as PARAMETERS (called with
+        # the current outer values) so read-then-write patterns like
+        # ``y = y * 2`` don't trip UnboundLocalError — the reference's
+        # ifelse transformer passes input vars the same way
+        def _branch(name, stmts, params):
+            return ast.FunctionDef(
+                name=name,
+                args=ast.arguments(
+                    posonlyargs=[], args=[ast.arg(arg=n) for n in params],
+                    vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+                    defaults=[]),
+                body=stmts, decorator_list=[], returns=None)
+
+        def _thunk(fn_name, params):
+            return ast.Lambda(
+                args=_noargs(),
+                body=ast.Call(func=_name(fn_name),
+                              args=[_name(n) for n in params], keywords=[]))
+
+        if body_ret and else_ret:
+            if _has_side_store(node.body + node.orelse):
+                raise UnsupportedSyntax(
+                    "attribute/subscript assignment inside a data-dependent "
+                    "if branch (object mutation can't run in both lax.cond "
+                    "branches)")
+            names = sorted(_assigned_names(node.body)
+                           | _assigned_names(node.orelse))
+            uid = self._uid()
+            t_def = _branch(f"_pd_ret_true_{uid}", list(node.body), names)
+            f_def = _branch(f"_pd_ret_false_{uid}", list(node.orelse), names)
+            ret = ast.Return(value=_jst_call(
+                "convert_ifelse",
+                [node.test, _thunk(t_def.name, names),
+                 _thunk(f_def.name, names)]))
+            return [*_guard_init(names), t_def, f_def, ret]
+
+        if _contains(node.body + node.orelse, _CTRL):
+            raise UnsupportedSyntax(
+                "return/break/continue inside a data-dependent if branch "
+                "(only the early-return pattern is supported)")
+        if _has_side_store(node.body + node.orelse):
+            raise UnsupportedSyntax(
+                "attribute/subscript assignment inside a data-dependent "
+                "if branch (object mutation can't run in both lax.cond "
+                "branches)")
+        names = sorted(_assigned_names(node.body) | _assigned_names(node.orelse))
+        uid = self._uid()
+        ret_tuple = ast.Return(value=_names_tuple(names))
+        t_def = _branch(f"_pd_true_{uid}",
+                        list(node.body) + [ret_tuple], names)
+        f_def = _branch(f"_pd_false_{uid}",
+                        (list(node.orelse) or [ast.Pass()]) + [ret_tuple],
+                        names)
+        call = _jst_call("convert_ifelse",
+                         [node.test, _thunk(t_def.name, names),
+                          _thunk(f_def.name, names), _str_tuple(names)])
+        if names:
+            assign = ast.Assign(
+                targets=[_names_tuple(names, ast.Store())], value=call)
+        else:
+            assign = ast.Expr(value=call)
+        return [*_guard_init(names), t_def, f_def, assign]
+
+    # -- while ---------------------------------------------------------------
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse:
+            raise UnsupportedSyntax("while/else")
+        if _contains(node.body, (ast.Return,)):
+            raise UnsupportedSyntax("return inside a data-dependent while")
+        if _contains(node.body, (ast.Break, ast.Continue), into_loops=False):
+            raise UnsupportedSyntax(
+                "break/continue inside a data-dependent while")
+        if _has_side_store(node.body):
+            raise UnsupportedSyntax(
+                "attribute/subscript assignment inside a data-dependent "
+                "while body")
+        names = sorted(_assigned_names(node.body))
+        uid = self._uid()
+        args = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=n) for n in names], vararg=None,
+            kwonlyargs=[], kw_defaults=[], kwarg=None, defaults=[])
+        cond_def = ast.FunctionDef(
+            name=f"_pd_while_cond_{uid}", args=args,
+            body=[ast.Return(value=node.test)], decorator_list=[], returns=None)
+        body_def = ast.FunctionDef(
+            name=f"_pd_while_body_{uid}",
+            args=ast.arguments(
+                posonlyargs=[], args=[ast.arg(arg=n) for n in names],
+                vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+                defaults=[]),
+            body=list(node.body) + [ast.Return(value=_names_tuple(names))],
+            decorator_list=[], returns=None)
+        call = _jst_call("convert_while",
+                         [_name(cond_def.name), _name(body_def.name),
+                          _names_tuple(names), _str_tuple(names)])
+        if names:
+            assign = ast.Assign(
+                targets=[_names_tuple(names, ast.Store())], value=call)
+        else:
+            assign = ast.Expr(value=call)
+        return [*_guard_init(names), cond_def, body_def, assign]
+
+    # -- for over range ------------------------------------------------------
+    def visit_For(self, node):
+        if (isinstance(node.iter, ast.Call)
+                and isinstance(node.iter.func, ast.Name)
+                and node.iter.func.id == "range"
+                and isinstance(node.target, ast.Name)
+                and not node.orelse
+                and not node.iter.keywords):
+            uid = self._uid()
+            i = node.target.id
+            ra = node.iter.args
+            if len(ra) == 1:
+                start, stop, step = ast.Constant(value=0), ra[0], ast.Constant(value=1)
+            elif len(ra) == 2:
+                start, stop, step = ra[0], ra[1], ast.Constant(value=1)
+            else:
+                start, stop, step = ra[0], ra[1], ra[2]
+            sv, ev, tv = (f"_pd_start_{uid}", f"_pd_stop_{uid}", f"_pd_step_{uid}")
+            setup = [
+                ast.Assign(targets=[_names_tuple([sv, ev, tv], ast.Store())],
+                           value=ast.Tuple(elts=[
+                               _jst_call("to_index", [start]),
+                               _jst_call("to_index", [stop]),
+                               _jst_call("to_index", [step])], ctx=ast.Load())),
+                ast.Assign(targets=[_name(i, ast.Store())], value=_name(sv)),
+            ]
+            loop = ast.While(
+                test=_jst_call("range_cond", [_name(i), _name(ev), _name(tv)]),
+                body=list(node.body) + [ast.Assign(
+                    targets=[_name(i, ast.Store())],
+                    value=ast.BinOp(left=_name(i), op=ast.Add(),
+                                    right=_name(tv)))],
+                orelse=[])
+            result = self.visit_While(loop)
+            return setup + (result if isinstance(result, list) else [result])
+        self.generic_visit(node)
+        return node
+
+
+def _noargs():
+    return ast.arguments(posonlyargs=[], args=[], vararg=None, kwonlyargs=[],
+                         kw_defaults=[], kwarg=None, defaults=[])
+
+
+def transform_function(fn):
+    """Rewrite ``fn``'s control flow through the conversion runtime; returns
+    a new function object closing over the same globals (closure cells are
+    snapshot into the namespace — the reference does the same in its
+    ast-to-func utility, python/paddle/jit/dy2static/utils.py ast_to_func)."""
+    inner = inspect.unwrap(fn)
+    inner = getattr(inner, "__func__", inner)  # bound method -> function
+    try:
+        src = textwrap.dedent(inspect.getsource(inner))
+    except (OSError, TypeError) as e:
+        raise UnsupportedSyntax(f"source unavailable: {e}") from e
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        raise UnsupportedSyntax(f"could not re-parse source: {e}") from e
+    if not tree.body or not isinstance(tree.body[0], ast.FunctionDef):
+        raise UnsupportedSyntax("not a plain function definition")
+    fdef = tree.body[0]
+    fdef.decorator_list = []
+    Dy2StaticTransformer().visit(fdef)
+    ast.fix_missing_locations(tree)
+    code = compile(tree, filename=f"<dy2static:{inner.__qualname__}>",
+                   mode="exec")
+
+    from . import runtime as _jst
+
+    glb = dict(inner.__globals__)
+    glb["_jst"] = _jst
+    if inner.__closure__:
+        for name, cell in zip(inner.__code__.co_freevars, inner.__closure__):
+            try:
+                glb[name] = cell.cell_contents
+            except ValueError as e:
+                raise UnsupportedSyntax(
+                    f"unresolvable closure cell {name!r}") from e
+    ns: dict = {}
+    exec(code, glb, ns)
+    new_fn = ns[fdef.name]
+    functools.update_wrapper(new_fn, inner)
+    new_fn.__dy2static_original__ = fn
+    return new_fn

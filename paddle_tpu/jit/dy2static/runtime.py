@@ -1,0 +1,269 @@
+"""dy2static conversion runtime (the ``_jst`` namespace in transformed code).
+
+The AST transformers (paddle_tpu/jit/dy2static/__init__.py) rewrite python
+control flow over possibly-traced values into calls here; each converter
+dispatches at RUN time: concrete values keep exact python semantics, traced
+values lower to ``lax.cond`` / ``lax.while_loop`` so the whole function
+compiles to ONE XLA program — the role of the reference's
+convert_ifelse/convert_while_loop runtime
+(/root/reference/python/paddle/jit/dy2static/convert_operators.py).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax, tree_util
+
+from ...core.tensor import Tensor
+
+
+class _Undefined:
+    """Marker for a name with no binding yet (the reference's UndefinedVar,
+    python/paddle/jit/dy2static/utils.py). Using it raises clearly."""
+
+    _msg = ("dy2static: variable used before assignment inside transformed "
+            "control flow")
+
+    def __repr__(self):
+        return "<undefined>"
+
+    def _raise(self, *a, **k):
+        raise NameError(self._msg)
+
+    __add__ = __radd__ = __sub__ = __mul__ = __call__ = _raise
+    __bool__ = __iter__ = __len__ = _raise
+
+
+UNDEFINED = _Undefined()
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def _unwrap(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _is_traced(x):
+    return isinstance(_unwrap(x), jax.core.Tracer)
+
+
+def _flatten(tree):
+    leaves, treedef = tree_util.tree_flatten(tree, is_leaf=_is_tensor)
+    return leaves, treedef
+
+
+def _unwrap_leaves(leaves):
+    return [_unwrap(l) for l in leaves]
+
+
+def _rewrap(vals, like_leaves):
+    out = []
+    for v, l in zip(vals, like_leaves):
+        out.append(Tensor._wrap(v) if isinstance(l, Tensor) else v)
+    return out
+
+
+def convert_ifelse(pred, true_fn, false_fn, names=()):
+    """if/else over a possibly-traced predicate.
+
+    Concrete: exact python semantics (only the taken branch runs).
+    Traced: both branches trace under ``lax.cond``; their outputs must match
+    in structure/shape/dtype (same contract as the reference's cond op)."""
+    p = _unwrap(pred)
+    if not isinstance(p, jax.core.Tracer):
+        return true_fn() if p else false_fn()
+
+    t_out = true_fn()
+    f_out = false_fn()
+    t_leaves, t_def = _flatten(t_out)
+    f_leaves, f_def = _flatten(f_out)
+    if t_def != f_def:
+        raise TypeError(
+            f"dy2static: if/else branches assign mismatched structures for "
+            f"{names or 'outputs'}: {t_def} vs {f_def}")
+    for n, tl, fl in zip(names or [""] * len(t_leaves), t_leaves, f_leaves):
+        if isinstance(tl, _Undefined) or isinstance(fl, _Undefined):
+            raise NameError(
+                f"dy2static: variable '{n}' is assigned in only one branch "
+                "of a compiled if/else; assign it in both (or before)")
+    tv, fv = _unwrap_leaves(t_leaves), _unwrap_leaves(f_leaves)
+    # non-array python leaves (ints, None, strings) must agree between
+    # branches — they are baked into the compiled program
+    sel = []
+    for i, (a, b) in enumerate(zip(tv, fv)):
+        arr_a = hasattr(a, "dtype") or isinstance(a, (int, float, bool, complex))
+        if not arr_a:
+            if a is not b and a != b:
+                raise TypeError(
+                    "dy2static: non-tensor branch outputs differ "
+                    f"({a!r} vs {b!r}); they would be baked into the program")
+            sel.append(None)
+        else:
+            sel.append(i)
+    picked = lax.cond(
+        jnp.asarray(p).astype(bool).reshape(()),
+        lambda: tuple(jnp.asarray(tv[i]) for i in sel if i is not None),
+        lambda: tuple(jnp.asarray(fv[i]) for i in sel if i is not None),
+    )
+    it = iter(picked)
+    merged = [next(it) if i is not None else tv[k]
+              for k, i in enumerate(sel)]
+    out_leaves = _rewrap(merged, t_leaves)
+    return tree_util.tree_unflatten(t_def, out_leaves)
+
+
+def _probe_undefined(cond_fn, body_fn, vars_in, names):
+    """Resolve UNDEFINED loop vars: variables assigned in the body before any
+    read get zero-initialized with the body's output shape/dtype (fixed-point
+    via eval_shape) — semantically equivalent whenever the eager code would
+    not hit UnboundLocalError."""
+    vars_list = list(vars_in)
+    undef = [i for i, v in enumerate(vars_list) if isinstance(v, _Undefined)]
+    if not undef:
+        return vars_list
+    for i in undef:
+        vars_list[i] = Tensor._wrap(jnp.zeros(()))
+    for _ in range(3):
+        # per-var leaf grouping keeps indices aligned even when other loop
+        # vars are nested structures (tuples/lists of tensors)
+        out_spec = jax.eval_shape(
+            lambda: tuple(
+                tuple(jnp.asarray(x) for x in _unwrap_leaves(_flatten(v)[0]))
+                for v in body_fn(*vars_list)))
+        changed = False
+        for i in undef:
+            var_spec = out_spec[i]
+            if len(var_spec) != 1:
+                raise TypeError(
+                    f"dy2static: loop variable '{names[i] if i < len(names) else i}' "
+                    "is first assigned a nested structure inside a compiled "
+                    "while; initialize it before the loop")
+            spec = var_spec[0]
+            cur = jnp.asarray(_unwrap(vars_list[i]))
+            if tuple(cur.shape) != tuple(spec.shape) or cur.dtype != spec.dtype:
+                vars_list[i] = Tensor._wrap(jnp.zeros(spec.shape, spec.dtype))
+                changed = True
+        if not changed:
+            return vars_list
+    raise TypeError(
+        f"dy2static: could not infer a stable shape for loop variable(s) "
+        f"{[names[i] for i in undef]} first assigned inside a compiled loop")
+
+
+def convert_while(cond_fn, body_fn, init_vars, names=()):
+    """while over a possibly-traced condition.
+
+    Concrete: plain python while. Traced: ``lax.while_loop`` with the
+    assigned-in-body variables as the carry; carries must keep stable
+    shapes/dtypes across iterations."""
+    vars_t = tuple(init_vars)
+    probe = cond_fn(*vars_t)
+    p = _unwrap(probe)
+    if not isinstance(p, jax.core.Tracer):
+        while p:
+            vars_t = tuple(body_fn(*vars_t))
+            p = _unwrap(cond_fn(*vars_t))
+        return vars_t
+
+    vars_list = _probe_undefined(cond_fn, body_fn, vars_t, names)
+    leaves, treedef = _flatten(tuple(vars_list))
+    init = [jnp.asarray(v) for v in _unwrap_leaves(leaves)]
+    # align names to leaves (a loop var may flatten to several leaves)
+    leaf_names = []
+    if len(names) == len(vars_list):
+        for n, v in zip(names, vars_list):
+            leaf_names.extend([n] * len(_flatten(v)[0]))
+    else:
+        leaf_names = [""] * len(init)
+
+    def c(flat):
+        vs = tree_util.tree_unflatten(treedef, _rewrap(flat, leaves))
+        return jnp.asarray(_unwrap(cond_fn(*vs))).astype(bool).reshape(())
+
+    def b(flat):
+        vs = tree_util.tree_unflatten(treedef, _rewrap(flat, leaves))
+        out = body_fn(*vs)
+        out_leaves, out_def = _flatten(tuple(out))
+        if out_def != treedef:
+            raise TypeError(
+                f"dy2static: while body changed the structure of loop "
+                f"variables {names}: {out_def} vs {treedef}")
+        vals = [jnp.asarray(v) for v in _unwrap_leaves(out_leaves)]
+        for n, a, o in zip(leaf_names, init, vals):
+            if tuple(a.shape) != tuple(o.shape):
+                raise TypeError(
+                    f"dy2static: loop variable '{n}' changes shape "
+                    f"{tuple(a.shape)} -> {tuple(o.shape)} inside a compiled "
+                    "while; shapes must be loop-invariant on TPU")
+        # keep carry dtypes stable (python-int inits become weak i32/i64)
+        return [v.astype(a.dtype) if v.dtype != a.dtype else v
+                for a, v in zip(init, vals)]
+
+    out_flat = lax.while_loop(c, b, init)
+    return tuple(tree_util.tree_unflatten(treedef, _rewrap(out_flat, leaves)))
+
+
+def convert_bool_op(op, *thunks):
+    """``and``/``or`` chains: python short-circuit semantics for concrete
+    values, ``logical_and/or`` once any operand is traced."""
+    val = thunks[0]()
+    for t in thunks[1:]:
+        v = _unwrap(val)
+        if isinstance(v, jax.core.Tracer):
+            nxt = _unwrap(t())
+            fn = jnp.logical_and if op == "and" else jnp.logical_or
+            val = Tensor._wrap(fn(jnp.asarray(v).astype(bool),
+                                  jnp.asarray(nxt).astype(bool)))
+            continue
+        truthy = bool(v)
+        if op == "and":
+            if not truthy:
+                return val
+            val = t()
+        else:
+            if truthy:
+                return val
+            val = t()
+    return val
+
+
+def convert_not(x):
+    v = _unwrap(x)
+    if isinstance(v, jax.core.Tracer):
+        return Tensor._wrap(jnp.logical_not(jnp.asarray(v).astype(bool)))
+    return not x
+
+
+def to_index(x):
+    """range() bound that may be a Tensor."""
+    v = _unwrap(x)
+    if hasattr(v, "dtype"):
+        return v if isinstance(v, jax.core.Tracer) else int(v)
+    return v
+
+
+def range_cond(i, stop, step):
+    """Continuation test for a for-range lowered to while (sign-aware)."""
+    iv, sv, tv = _unwrap(i), _unwrap(stop), _unwrap(step)
+    if isinstance(tv, jax.core.Tracer):
+        return Tensor._wrap(jnp.where(jnp.asarray(tv) > 0,
+                                      jnp.asarray(iv) < jnp.asarray(sv),
+                                      jnp.asarray(iv) > jnp.asarray(sv)))
+    if any(isinstance(v, jax.core.Tracer) for v in (iv, sv)):
+        cmp = (jnp.asarray(iv) < jnp.asarray(sv) if tv > 0
+               else jnp.asarray(iv) > jnp.asarray(sv))
+        return Tensor._wrap(cmp)
+    return (iv < sv) if tv > 0 else (iv > sv)
+
+
+def convert_assert(test, msg=None):
+    """Concrete asserts keep python semantics; traced asserts are dropped
+    (XLA has no cheap device-side assert — mirrors the reference's Assert op
+    being a no-op in inference programs)."""
+    t = _unwrap(test)
+    if isinstance(t, jax.core.Tracer):
+        return
+    if not t:
+        raise AssertionError(msg if msg is not None else "")

@@ -1,18 +1,36 @@
-"""paddle.static parity shim.
+"""paddle.static parity: a real compiled static-graph path.
 
-The reference's static graph path — Program/ProgramDesc, program_guard,
-Executor over StandaloneExecutor/InterpreterCore
-(/root/reference/python/paddle/static/, python/paddle/fluid/executor.py:843,
-paddle/fluid/framework/new_executor/ SURVEY §3.4) — maps onto jax tracing:
-a Program records a traced callable; Executor.run compiles+runs it with the
-feed/fetch dict surface. This keeps static-style user code and tests running
-while the real compilation engine is jax.jit (no instruction-list
-interpreter to re-implement: XLA owns scheduling, memory planning and
-garbage collection of intermediates).
+The reference's static mode is a compiled, serializable program: user code
+builds a ProgramDesc under ``program_guard``
+(/root/reference/python/paddle/static/), ``Executor.run`` compiles it once
+per (program, feed-signature) through an executor cache
+(python/paddle/fluid/executor.py:843 ``Executor.run`` -> ``_ExecutorCache``
+:666) and executes via the C++ StandaloneExecutor/InterpreterCore
+(paddle/fluid/framework/new_executor/standalone_executor.h:34); programs and
+parameters serialize to *.pdmodel/*.pdiparams
+(paddle/fluid/framework/program_desc.h:32, framework.proto).
+
+TPU-native mapping:
+
+- Graph capture: ops applied to ``static.data`` placeholders record replay
+  closures (core/dispatch.py:_maybe_attach_recompute) — the ProgramDesc role.
+- ``Executor.run`` traces the replay ONCE per (program, feed names, feed
+  shapes/dtypes, fetch set) into a pure function and ``jax.jit``-compiles it;
+  subsequent runs hit the compiled cache with zero re-tracing (the
+  _ExecutorCache + InterpreterCore role — XLA owns instruction scheduling,
+  memory planning and garbage collection of intermediates).
+- ``Scope``/``Variable`` hold named parameter state outside the graph
+  (paddle/fluid/framework/scope.h:49); parameters enter the compiled program
+  as traced inputs so ``static.load`` updates take effect without retracing.
+- ``save_inference_model``/``load_inference_model`` serialize the
+  feed->fetch slice as a jax.export (StableHLO) archive + weights, loadable
+  in a fresh process WITHOUT the builder's python
+  (paddle/fluid/inference/io.cc save_inference_model).
 """
 from __future__ import annotations
 
 import contextlib
+import pickle
 
 import numpy as np
 
@@ -23,6 +41,8 @@ __all__ = [
     "Program", "program_guard", "default_main_program", "default_startup_program",
     "data", "Executor", "InputSpec", "name_scope", "gradients", "save", "load",
     "save_inference_model", "load_inference_model", "cpu_places", "device_guard",
+    "Scope", "Variable", "global_scope", "scope_guard", "create_parameter",
+    "InferenceProgram",
 ]
 
 
@@ -41,25 +61,104 @@ class InputSpec:
 
 
 class _Var(Tensor):
-    """Placeholder variable created by static.data."""
+    """Placeholder variable created by static.data / create_parameter."""
+
+
+class Variable:
+    """Named value slot in a Scope (reference paddle/fluid/framework/variable.h)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._value = None
+
+    def get_tensor(self):
+        return self._value
+
+    def set(self, value, place=None):
+        self._value = np.asarray(value)
+
+
+class Scope:
+    """Name->Variable tree with parent lookup (reference scope.h:49):
+    ``var`` finds-or-creates locally, ``find_var`` walks to the root,
+    ``new_scope`` opens a child whose lookups fall through to this scope."""
+
+    def __init__(self, parent=None):
+        self._vars: dict[str, Variable] = {}
+        self._parent = parent
+        self._kids: list[Scope] = []
+
+    def var(self, name) -> Variable:
+        v = self._vars.get(name)
+        if v is None:
+            v = Variable(name)
+            self._vars[name] = v
+        return v
+
+    def find_var(self, name):
+        s = self
+        while s is not None:
+            if name in s._vars:
+                return s._vars[name]
+            s = s._parent
+        return None
+
+    def new_scope(self) -> "Scope":
+        k = Scope(self)
+        self._kids.append(k)
+        return k
+
+    def local_var_names(self):
+        return list(self._vars)
+
+    def drop_kids(self):
+        self._kids.clear()
+
+
+_global_scope = Scope()
+_param_uid = 0
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    global _global_scope
+    prev = _global_scope
+    _global_scope = scope
+    try:
+        yield
+    finally:
+        _global_scope = prev
 
 
 class Program:
-    """Recorded computation: feed names -> python builder -> fetch targets."""
+    """Recorded computation: feed placeholders + parameters -> replay graph.
+
+    The ProgramDesc analogue (program_desc.h:32): holds the named inputs and
+    parameters whose replay closures (recorded by op dispatch during the
+    build under ``program_guard``) constitute the op graph. Serialization of
+    a feed->fetch slice is ``save_inference_model`` (jax.export archive)."""
 
     def __init__(self):
         self._inputs: dict[str, _Var] = {}
-        self._builders = []  # (fn, inputs, outputs) traces added under guard
+        self._params: dict[str, _Var] = {}
         self.random_seed = 0
 
     def global_block(self):
         return self
 
+    def all_parameters(self):
+        return list(self._params.values())
+
     def clone(self, for_test=False):
         return self
 
     def __repr__(self):
-        return f"Program(inputs={list(self._inputs)})"
+        return (f"Program(inputs={list(self._inputs)}, "
+                f"params={list(self._params)})")
 
 
 _main_program = Program()
@@ -106,75 +205,366 @@ def cpu_places(device_count=None):
 def data(name, shape, dtype="float32", lod_level=0):
     """static.data: a named placeholder registered with the current Program.
 
-    Eager-tracing model: the returned Tensor holds zeros of the given shape
-    (dims of -1/None become 1 until fed); ops applied to it run eagerly,
-    building values that Executor.run recomputes with real feeds by replaying
-    the user's python (captured via closures at run call sites)."""
+    Build-time tracing model: the returned Tensor holds zeros of the given
+    shape (dims of -1/None become 1 until fed) so ops applied to it execute
+    eagerly while recording replay closures; ``Executor.run`` traces those
+    closures with real feeds into a compiled program. The declared shape
+    (with None preserved) drives shape-polymorphic export."""
+    declared = tuple(shape)
     concrete = [1 if (s is None or s == -1) else int(s) for s in shape]
     v = _Var(np.zeros(concrete, convert_dtype(dtype)))
     v.name = name
     v._recompute = "placeholder"  # ops downstream record replay closures
+    v._declared_shape = declared
     _main_program._inputs[name] = v
     return v
 
 
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """static.create_parameter: a trainable variable registered with the
+    current Program and living in the global Scope (reference
+    python/paddle/static/nn/common.py). It enters compiled programs as a
+    traced input, so updating the Scope (e.g. ``static.load``) changes what
+    subsequent ``Executor.run`` calls compute without retracing."""
+    if name is None:
+        # process-global counter: default-named params from different
+        # Programs share the global Scope and must not collide
+        global _param_uid
+        name = f"param_{_param_uid}"
+        _param_uid += 1
+    shape = tuple(int(s) for s in shape)
+    np_dtype = convert_dtype(dtype)
+    if default_initializer is not None:
+        init = np.asarray(default_initializer(shape), np_dtype)
+    elif is_bias or not np.issubdtype(np.dtype(np_dtype), np.floating):
+        init = np.zeros(shape, np_dtype)
+    else:
+        from ..framework.random import np_rng
+
+        fan_in = shape[0] if shape else 1
+        fan_out = shape[-1] if len(shape) > 1 else 1
+        limit = float(np.sqrt(6.0 / max(fan_in + fan_out, 1)))
+        init = np_rng().uniform(-limit, limit, shape).astype(np_dtype)
+    v = _Var(init)
+    v.name = name
+    v.stop_gradient = False
+    v._recompute = "placeholder"
+    v._declared_shape = shape
+    _main_program._params[name] = v
+    global_scope().var(name).set(init)
+    return v
+
+
+class _FetchTarget:
+    """Opaque fetch token returned by load_inference_model (the reference's
+    fetch_targets variables)."""
+
+    def __init__(self, name, index):
+        self.name = name
+        self.index = index
+
+    def __repr__(self):
+        return f"FetchTarget({self.name})"
+
+
+class InferenceProgram:
+    """A deserialized feed->fetch program: executes the jax.export artifact
+    with saved weights — the AnalysisPredictor's loaded-program role. Run it
+    through ``Executor.run`` exactly like a built Program."""
+
+    def __init__(self, exported, params, feed_names, fetch_names):
+        self._exported = exported
+        self._params = params
+        self.feed_names = list(feed_names)
+        self.fetch_names = list(fetch_names)
+
+    def program_text(self):
+        return self._exported.mlir_module()
+
+    def _run(self, feed, fetch_list, return_numpy):
+        args = [np.asarray(feed[n]) for n in self.feed_names]
+        outs = self._exported.call(self._params, *args)
+        if not isinstance(outs, (tuple, list)):
+            outs = (outs,)
+        by_name = dict(zip(self.fetch_names, outs))
+        sel = []
+        for f in fetch_list or [_FetchTarget(n, i) for i, n in enumerate(self.fetch_names)]:
+            name = f.name if isinstance(f, _FetchTarget) else f
+            val = by_name[name]
+            sel.append(np.asarray(val) if return_numpy else Tensor._wrap(val))
+        return sel
+
+
 class Executor:
-    """paddle.static.Executor shim: jit-compiles a callable per (program,
-    fetch_list) and runs with the feed dict."""
+    """paddle.static.Executor: compiles the program's replay graph once per
+    (program, feed names, feed signature, fetch set) and caches the compiled
+    callable — the reference's ``Executor.run`` -> ``_ExecutorCache`` ->
+    StandaloneExecutor pipeline (executor.py:843,666). ``_trace_count``
+    increments only when a cache entry traces, so tests can prove the second
+    run executes the compiled program without re-tracing."""
 
     def __init__(self, place=None):
         self.place = place
+        self._cache: dict = {}
+        self._trace_count = 0
+
+    def close(self):
+        self._cache.clear()
 
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
             return_numpy=True, use_program_cache=True):
         import jax.numpy as jnp
 
-        from ..core.dispatch import recompute_value
-
-        feed = feed or {}
-        fetch_list = fetch_list or []
         program = program or _main_program
-        for name, value in feed.items():
-            if name in program._inputs:
-                var = program._inputs[name]
-                v = value._value if isinstance(value, Tensor) else jnp.asarray(np.asarray(value))
-                var._value = v
-        cache: dict = {}
+        feed = feed or {}
+        fetch_list = list(fetch_list) if fetch_list is not None else []
+        if isinstance(program, InferenceProgram):
+            return program._run(feed, fetch_list, return_numpy)
+        scope = scope or global_scope()
+
+        feed_names = [n for n in sorted(feed) if n in program._inputs]
+        arrays = {}
+        for n in feed_names:
+            v = feed[n]
+            a = v._value if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+            arrays[n] = a
+            program._inputs[n]._value = a  # keep build-time vars inspectable
+
+        param_names = sorted(program._params)
+        param_vals = []
+        for n in param_names:
+            var = scope.find_var(n)
+            if var is not None and var._value is not None:
+                param_vals.append(jnp.asarray(var._value))
+            else:
+                param_vals.append(program._params[n]._value)
+
+        fetch_ts = [f for f in fetch_list if isinstance(f, Tensor)]
+        key = (
+            id(program),
+            tuple(feed_names),
+            tuple((tuple(arrays[n].shape), str(arrays[n].dtype)) for n in feed_names),
+            tuple(id(f) for f in fetch_ts),
+        )
+        entry = self._cache.get(key) if use_program_cache else None
+        if entry is None:
+            entry = self._compile(program, feed_names, param_names, fetch_ts)
+            if use_program_cache:
+                self._cache[key] = entry
+
+        out_vals = entry(
+            tuple(arrays[n] for n in feed_names), tuple(param_vals))
+        out_map = {id(t): v for t, v in zip(fetch_ts, out_vals)}
         outs = []
         for f in fetch_list:
             if isinstance(f, Tensor):
-                val = recompute_value(f, cache)
+                val = out_map[id(f)]
                 outs.append(np.asarray(val) if return_numpy else Tensor._wrap(val))
             else:
                 outs.append(f)
         return outs
 
+    def _compile(self, program, feed_names, param_names, fetch_ts):
+        import jax
+
+        from ..core.dispatch import recompute_value
+
+        placeholders = [program._inputs[n] for n in feed_names]
+        params = [program._params[n] for n in param_names]
+        exe = self
+
+        def pure(feed_vals, param_vals):
+            exe._trace_count += 1  # side effect fires only while tracing
+            cache = {id(p): v for p, v in zip(placeholders, feed_vals)}
+            cache.update({id(p): v for p, v in zip(params, param_vals)})
+            # gradients() replays need to distinguish graph seeds from
+            # memoized intermediates (which must NOT leak into jax.grad)
+            cache["__seed_ids__"] = frozenset(cache)
+            return tuple(recompute_value(f, cache) for f in fetch_ts)
+
+        return jax.jit(pure)
+
 
 def gradients(targets, inputs, target_gradients=None):
-    from ..core.autograd import grad as _grad
+    """static.gradients: symbolic gradients recorded INTO the program's
+    replay graph (the reference's append_backward role,
+    python/paddle/fluid/backward.py) — fetching them through ``Executor.run``
+    differentiates the compiled program at the fed values, not the
+    build-time constants."""
+    import jax
+    import jax.numpy as jnp
 
-    return _grad(targets, inputs, grad_outputs=target_gradients, allow_unused=True)
+    from ..core.dispatch import recompute_value
+
+    tlist = list(targets) if isinstance(targets, (list, tuple)) else [targets]
+    ilist = list(inputs) if isinstance(inputs, (list, tuple)) else [inputs]
+    if target_gradients is None:
+        glist = [None] * len(tlist)
+    else:
+        glist = (list(target_gradients)
+                 if isinstance(target_gradients, (list, tuple))
+                 else [target_gradients])
+
+    outs: list[Tensor] = []
+
+    def replay(cache):
+        if outs and id(outs[0]) in cache:
+            return [cache[id(o)] for o in outs]
+        in_vals = [recompute_value(i, cache) for i in ilist]
+
+        def f(ivals):
+            # rebuild from graph seeds only: memoized intermediates in the
+            # outer cache were computed from the ORIGINAL input values and
+            # would make the differentiated targets constants
+            seed_ids = cache.get("__seed_ids__", frozenset())
+            c2 = {k: cache[k] for k in seed_ids}
+            c2["__seed_ids__"] = seed_ids
+            for i, v in zip(ilist, ivals):
+                c2[id(i)] = v
+            total = None
+            for t, g in zip(tlist, glist):
+                tv = recompute_value(t, c2)
+                if g is not None:
+                    # graph tensors replay with fed values; raw arrays are
+                    # genuine constants
+                    gv = (recompute_value(g, c2) if isinstance(g, Tensor)
+                          else jnp.asarray(np.asarray(g)))
+                    term = jnp.sum(tv * gv)
+                else:
+                    term = jnp.sum(tv)
+                total = term if total is None else total + term
+            return total
+
+        gvals = list(jax.grad(f)(in_vals))
+        for o, g in zip(outs, gvals):
+            cache[id(o)] = g
+        return gvals
+
+    build_vals = replay({})
+    for idx, v in enumerate(build_vals):
+        gt = Tensor._wrap(v)
+        gt._recompute = (replay, idx)
+        outs.append(gt)
+    return outs
 
 
 def save(program, model_path, protocol=4):
-    from ..framework.io import save as _save
-
-    _save({"program_inputs": list(program._inputs)}, model_path + ".pdmodel.meta")
+    """static.save: persist the program's parameters from the Scope —
+    the reference's paddle.static.save -> <path>.pdparams
+    (python/paddle/static/io.py save)."""
+    state = {}
+    scope = global_scope()
+    for n, p in program._params.items():
+        var = scope.find_var(n)
+        val = var._value if var is not None and var._value is not None else p._value
+        state[n] = np.asarray(val)
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(state, f, protocol=protocol)
 
 
 def load(program, model_path, executor=None, var_list=None):
-    return None
+    """static.load: restore parameters into the Scope (and the program's
+    build-time values). Compiled executor cache entries stay valid: params
+    are traced inputs, so the next run just sees the new values."""
+    import jax.numpy as jnp
+
+    with open(model_path + ".pdparams", "rb") as f:
+        state = pickle.load(f)
+    keep = None
+    if var_list is not None:
+        keep = {getattr(v, "name", v) for v in var_list}
+    scope = global_scope()
+    for n, v in state.items():
+        if keep is not None and n not in keep:
+            continue
+        scope.var(n).set(v)
+        if n in program._params:
+            program._params[n]._value = jnp.asarray(v)
+    return state
 
 
-def save_inference_model(path_prefix, feed_vars, fetch_vars, executor, **kwargs):
-    from ..framework.io import save as _save
+def _feed_struct(var, sym_count):
+    """Declared placeholder shape -> ShapeDtypeStruct; None/-1 dims export as
+    symbolic dimensions so the artifact is shape-polymorphic."""
+    import jax
+    from jax import export as jexport
 
-    _save({"feed": [v.name for v in feed_vars]}, path_prefix + ".pdmodel.meta")
+    declared = getattr(var, "_declared_shape", None) or tuple(var.shape)
+    dims = []
+    for s in declared:
+        if s in (None, -1):
+            (d,) = jexport.symbolic_shape(f"_pd_s{next(sym_count)}")
+            dims.append(d)
+        else:
+            dims.append(int(s))
+    return jax.ShapeDtypeStruct(tuple(dims), np.dtype(var._value.dtype))
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
+                         program=None, **kwargs):
+    """Serialize the feed->fetch slice of the program as a jax.export
+    (StableHLO) archive + weights: the reference's *.pdmodel ProgramDesc +
+    *.pdiparams pair (paddle/fluid/inference/io.cc, python/paddle/static/io.py
+    save_inference_model). Loads in a fresh process without builder python."""
+    import itertools
+    import os
+
+    import jax
+    from jax import export as jexport
+
+    from ..core.dispatch import recompute_value
+
+    program = program or _main_program
+    feed_vars = list(feed_vars) if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    fetch_vars = list(fetch_vars) if isinstance(fetch_vars, (list, tuple)) else [fetch_vars]
+    scope = global_scope()
+
+    param_names = sorted(program._params)
+    param_vals = {}
+    for n in param_names:
+        var = scope.find_var(n)
+        val = var._value if var is not None and var._value is not None else program._params[n]._value
+        param_vals[n] = np.asarray(val)
+
+    def pure(params, *feed_vals):
+        cache = {id(p): v for p, v in zip(feed_vars, feed_vals)}
+        cache.update({id(program._params[n]): params[n] for n in param_names})
+        cache["__seed_ids__"] = frozenset(cache)
+        return tuple(recompute_value(f, cache) for f in fetch_vars)
+
+    sym_count = itertools.count()
+    structs = [_feed_struct(v, sym_count) for v in feed_vars]
+    p_structs = {n: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                 for n, v in param_vals.items()}
+    exported = jexport.export(jax.jit(pure), platforms=("cpu", "tpu"))(
+        p_structs, *structs)
+
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    feed_names = [getattr(v, "name", f"feed_{i}") for i, v in enumerate(feed_vars)]
+    fetch_names = [f"fetch_{i}" for i in range(len(fetch_vars))]
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    with open(path_prefix + ".pdiparams", "wb") as f:
+        pickle.dump({"params": param_vals, "feed_names": feed_names,
+                     "fetch_names": fetch_names}, f)
 
 
 def load_inference_model(path_prefix, executor, **kwargs):
-    raise NotImplementedError(
-        "static inference load: use paddle_tpu.jit.load / StableHLO deployment")
+    """Deserialize a save_inference_model artifact; returns
+    ``[InferenceProgram, feed_names, fetch_targets]`` runnable through
+    ``Executor.run`` (reference python/paddle/static/io.py
+    load_inference_model)."""
+    from jax import export as jexport
+
+    with open(path_prefix + ".pdiparams", "rb") as f:
+        blob = pickle.load(f)
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        exported = jexport.deserialize(f.read())
+    prog = InferenceProgram(exported, blob["params"], blob["feed_names"],
+                            blob["fetch_names"])
+    fetch_targets = [_FetchTarget(n, i) for i, n in enumerate(blob["fetch_names"])]
+    return [prog, list(blob["feed_names"]), fetch_targets]
 
 
 class amp:  # namespace shim: paddle.static.amp

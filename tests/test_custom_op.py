@@ -82,18 +82,18 @@ class TestCustomOp:
         notes = getattr(ei.value, "__notes__", [])
         assert any("op 'matmul'" in n and "Tensor(2, 3)" in n for n in notes)
 
-    def test_to_static_eager_fallback_on_data_dependent_branch(self):
-        """Data-dependent python `if` can't trace; to_static must fall back
-        to eager (correct result + warning) rather than crash."""
+    def test_to_static_compiles_data_dependent_branch(self):
+        """Data-dependent python `if` is AST-transformed to lax.cond and
+        COMPILES (dy2static transform — no eager fallback, no warning)."""
         @paddle.jit.to_static
         def f(x):
             if x.sum() > 0:  # bool() on a traced value
                 return x * 2
             return x - 1
 
-        with pytest.warns(UserWarning, match="running eagerly"):
-            out = f(paddle.to_tensor(np.ones(3, np.float32)))
+        out = f(paddle.to_tensor(np.ones(3, np.float32)))
         np.testing.assert_allclose(out.numpy(), 2.0)
-        # second call on the same signature: cached eager path, no re-trace
+        # same compiled program takes the other branch
         out2 = f(paddle.to_tensor(np.full(3, -1.0, np.float32)))
         np.testing.assert_allclose(out2.numpy(), -2.0)
+        assert "eager" not in f._cache.values()

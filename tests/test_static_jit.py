@@ -89,6 +89,185 @@ def test_static_multiple_fetches_share_cache():
     np.testing.assert_allclose(b_out, [3, 5])
 
 
+def test_executor_compiles_once_and_caches():
+    """Second run with the same (program, feed signature, fetch set) must hit
+    the compiled cache — zero re-tracing (reference _ExecutorCache role)."""
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [None, 3], "float32")
+        y = paddle.nn.functional.relu(x * 2.0 + 1.0)
+    exe = paddle.static.Executor()
+    f = np.random.rand(2, 3).astype(np.float32)
+    (o1,) = exe.run(main, feed={"x": f}, fetch_list=[y])
+    assert exe._trace_count == 1
+    (o2,) = exe.run(main, feed={"x": f + 1}, fetch_list=[y])
+    assert exe._trace_count == 1  # cache hit: no retrace
+    np.testing.assert_allclose(o2, np.maximum((f + 1) * 2 + 1, 0), rtol=1e-6)
+    # new feed shape -> new signature -> exactly one more trace
+    (o3,) = exe.run(main, feed={"x": np.random.rand(5, 3).astype(np.float32)},
+                    fetch_list=[y])
+    assert exe._trace_count == 2
+    assert o3.shape == (5, 3)
+
+
+def test_scope_and_create_parameter():
+    scope = paddle.static.Scope()
+    with paddle.static.scope_guard(scope):
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            x = paddle.static.data("x", [None, 4], "float32")
+            w = paddle.static.create_parameter([4, 2], "float32", name="w")
+            b = paddle.static.create_parameter([2], "float32", name="b",
+                                               is_bias=True)
+            y = paddle.matmul(x, w) + b
+        exe = paddle.static.Executor()
+        f = np.random.rand(3, 4).astype(np.float32)
+        (out,) = exe.run(main, feed={"x": f}, fetch_list=[y])
+        w_np = np.asarray(scope.find_var("w")._value)
+        np.testing.assert_allclose(out, f @ w_np, rtol=1e-5)
+        # scope update takes effect WITHOUT retracing (params are traced inputs)
+        scope.var("w").set(np.ones((4, 2), np.float32))
+        traces = exe._trace_count
+        (out2,) = exe.run(main, feed={"x": f}, fetch_list=[y])
+        assert exe._trace_count == traces
+        np.testing.assert_allclose(out2, f @ np.ones((4, 2), np.float32), rtol=1e-5)
+    # scope tree lookup falls through to parent
+    child = scope.new_scope()
+    assert child.find_var("w") is scope.find_var("w")
+
+
+def test_static_gradients_compile_with_feeds():
+    """static.gradients records symbolic grads into the replay graph: fetched
+    grads differentiate at the FED values (reference append_backward)."""
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [None, 2], "float32")
+        loss = paddle.sum(x * x)
+        (gx,) = paddle.static.gradients([loss], [x])
+    exe = paddle.static.Executor()
+    f = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    (g,) = exe.run(main, feed={"x": f}, fetch_list=[gx])
+    np.testing.assert_allclose(g, 2 * f, rtol=1e-6)
+    (g2,) = exe.run(main, feed={"x": f * 10}, fetch_list=[gx])
+    np.testing.assert_allclose(g2, 20 * f, rtol=1e-6)
+    assert exe._trace_count == 1
+    # regression: fetching the target TOGETHER with its grad must not turn
+    # the grad into a constant (memoized-intermediate leak into jax.grad)
+    l_out, g3 = exe.run(main, feed={"x": f}, fetch_list=[loss, gx])
+    np.testing.assert_allclose(g3, 2 * f, rtol=1e-6)
+    np.testing.assert_allclose(l_out, (f * f).sum(), rtol=1e-6)
+
+
+def test_target_gradients_replay_with_feeds():
+    """target_gradients given as a graph tensor must replay with fed values,
+    not bake the build-time constant into the compiled program."""
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [2], "float32")
+        g = paddle.static.data("g", [], "float32")
+        y = x * x
+        (gx,) = paddle.static.gradients([y], [x], target_gradients=[g])
+    exe = paddle.static.Executor()
+    f = np.array([1.0, 2.0], np.float32)
+    (o1,) = exe.run(main, feed={"x": f, "g": np.float32(3.0)}, fetch_list=[gx])
+    np.testing.assert_allclose(o1, 6 * f, rtol=1e-6)
+    (o2,) = exe.run(main, feed={"x": f, "g": np.float32(10.0)}, fetch_list=[gx])
+    np.testing.assert_allclose(o2, 20 * f, rtol=1e-6)  # cached, new feed
+
+
+def test_default_param_names_unique_across_programs():
+    scope = paddle.static.Scope()
+    with paddle.static.scope_guard(scope):
+        a = paddle.static.Program()
+        with paddle.static.program_guard(a):
+            xa = paddle.static.data("x", [None, 4], "float32")
+            wa = paddle.static.create_parameter([4, 2])
+            ya = paddle.matmul(xa, wa)
+        b = paddle.static.Program()
+        with paddle.static.program_guard(b):
+            xb = paddle.static.data("x", [None, 8], "float32")
+            wb = paddle.static.create_parameter([8, 3])
+            yb = paddle.matmul(xb, wb)
+        assert wa.name != wb.name
+        exe = paddle.static.Executor()
+        (oa,) = exe.run(a, feed={"x": np.ones((2, 4), np.float32)}, fetch_list=[ya])
+        (ob,) = exe.run(b, feed={"x": np.ones((2, 8), np.float32)}, fetch_list=[yb])
+        assert oa.shape == (2, 2) and ob.shape == (2, 3)
+
+
+def test_static_save_load_params(tmp_path):
+    scope = paddle.static.Scope()
+    with paddle.static.scope_guard(scope):
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            x = paddle.static.data("x", [None, 2], "float32")
+            w = paddle.static.create_parameter([2, 2], name="w")
+            y = paddle.matmul(x, w)
+        path = str(tmp_path / "ckpt")
+        paddle.static.save(main, path)
+        scope.var("w").set(np.zeros((2, 2), np.float32))
+        paddle.static.load(main, path)
+        restored = np.asarray(scope.find_var("w")._value)
+        assert np.abs(restored).sum() > 0  # back to the saved (non-zero) init
+
+
+def test_save_load_inference_model_roundtrip(tmp_path):
+    scope = paddle.static.Scope()
+    with paddle.static.scope_guard(scope):
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            x = paddle.static.data("x", [None, 3], "float32")
+            w = paddle.static.create_parameter([3, 2], name="w")
+            y = paddle.nn.functional.relu(paddle.matmul(x, w))
+        exe = paddle.static.Executor()
+        path = str(tmp_path / "infer")
+        paddle.static.save_inference_model(path, [x], [y], exe)
+        f = np.random.rand(4, 3).astype(np.float32)
+        (expect,) = exe.run(main, feed={"x": f}, fetch_list=[y])
+    prog, feed_names, fetch_targets = paddle.static.load_inference_model(
+        path, paddle.static.Executor())
+    assert feed_names == ["x"]
+    (got,) = paddle.static.Executor().run(
+        prog, feed={"x": f}, fetch_list=fetch_targets)
+    np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+
+def test_load_inference_model_fresh_process(tmp_path):
+    """The exported artifact must execute WITHOUT the builder's python:
+    build+save here, load+run in a clean subprocess (reference
+    load_inference_model contract)."""
+    import subprocess
+    import sys
+
+    scope = paddle.static.Scope()
+    with paddle.static.scope_guard(scope):
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            x = paddle.static.data("x", [None, 3], "float32")
+            w = paddle.static.create_parameter([3, 2], name="w")
+            y = paddle.matmul(x, w) + 1.0
+        exe = paddle.static.Executor()
+        path = str(tmp_path / "fresh")
+        paddle.static.save_inference_model(path, [x], [y], exe)
+        f = np.random.rand(2, 3).astype(np.float32)
+        (expect,) = exe.run(main, feed={"x": f}, fetch_list=[y])
+    np.save(str(tmp_path / "feed.npy"), f)
+    np.save(str(tmp_path / "expect.npy"), expect)
+    code = (
+        "import numpy as np, paddle_tpu as paddle\n"
+        f"prog, feeds, fetches = paddle.static.load_inference_model({path!r}, paddle.static.Executor())\n"
+        f"f = np.load({str(tmp_path / 'feed.npy')!r})\n"
+        f"expect = np.load({str(tmp_path / 'expect.npy')!r})\n"
+        "(got,) = paddle.static.Executor().run(prog, feed={'x': f}, fetch_list=fetches)\n"
+        "np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-5)\n"
+        "print('FRESH-OK')\n"
+    )
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "FRESH-OK" in r.stdout
+
+
 def test_input_spec():
     spec = paddle.static.InputSpec([None, 8], "float32", name="x")
     assert spec.shape == (None, 8)
