@@ -264,10 +264,16 @@ class Dy2StaticTransformer(ast.NodeTransformer):
                  _thunk(f_def.name, names)]))
             return [*_guard_init(names), t_def, f_def, ret]
 
-        if _contains(node.body + node.orelse, _CTRL):
+        if _contains(node.body + node.orelse, (ast.Return,)):
             raise UnsupportedSyntax(
-                "return/break/continue inside a data-dependent if branch "
+                "return inside a data-dependent if branch "
                 "(only the early-return pattern is supported)")
+        # break/continue scoped to a nested concrete loop are legal python;
+        # only bare ones (targeting a loop outside this if) can't convert
+        if _contains(node.body + node.orelse, (ast.Break, ast.Continue),
+                     into_loops=False):
+            raise UnsupportedSyntax(
+                "break/continue inside a data-dependent if branch")
         if _has_side_store(node.body + node.orelse):
             raise UnsupportedSyntax(
                 "attribute/subscript assignment inside a data-dependent "

@@ -7,8 +7,9 @@ tensor_patch_methods.py) which grafts the op API onto the eager Tensor type.
 from __future__ import annotations
 
 from ..core.tensor import Tensor
-from . import creation, linalg, logic, manipulation, math, random, search, stat
+from . import creation, fused, linalg, logic, manipulation, math, random, search, stat
 from .creation import *  # noqa: F401,F403
+from .fused import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .logic import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
@@ -27,6 +28,7 @@ __all__ = (
     + linalg.__all__
     + random.__all__
     + stat.__all__
+    + fused.__all__
 )
 
 

@@ -1,0 +1,61 @@
+"""Fused ops (reference /root/reference/paddle/phi/api/yaml/fused_ops.yaml).
+
+Only two of the ten fused_ops.yaml entries are device-generic —
+``fused_dropout_add`` and ``fused_linear_param_grad_add``; the other eight
+are XPU-specific lowerings (N/A on this stack, see registry.NOT_APPLICABLE).
+On TPU the "fusion" itself is XLA's job: these functions express the fused
+semantics in one traced body so XLA emits a single fused kernel.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import defop
+
+__all__ = ["fused_dropout_add", "fused_linear_param_grad_add"]
+
+
+@defop("fused_dropout_add", category="fused")
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      seed=None):
+    """dropout(x) + y in one traced body (reference fused_dropout_add,
+    fused_ops.yaml:47): XLA fuses the mask/scale/add into one kernel —
+    the hand-written CUDA fusion is compiler output here."""
+    if not training:
+        # downscale_in_infer applies the keep-probability at inference;
+        # upscale_in_train already rescaled during training
+        if mode == "downscale_in_infer":
+            return x * (1.0 - p) + y
+        return x + y
+    if p == 0.0:
+        return x + y
+    from ..framework.random import next_key
+
+    key = next_key() if seed is None else jax.random.PRNGKey(int(seed))
+    keep = jax.random.bernoulli(key, 1.0 - p, jnp.shape(x))
+    if mode == "upscale_in_train":
+        return jnp.where(keep, x / (1.0 - p), 0.0) + y
+    return jnp.where(keep, x, 0.0) + y
+
+
+@defop("fused_linear_param_grad_add", category="fused")
+def fused_linear_param_grad_add(x, dout, dweight=None, dbias=None,
+                                multi_precision=True):
+    """Accumulate a linear layer's parameter grads in one fused body
+    (reference fused_linear_param_grad_add, fused_ops.yaml:60):
+    dweight += x^T @ dout, dbias += sum(dout). ``multi_precision``
+    accumulates in f32 when the activations are bf16/f16 — the TPU-correct
+    default for grad accumulation."""
+    x2 = x.reshape(-1, x.shape[-1])
+    d2 = dout.reshape(-1, dout.shape[-1])
+    acc_t = jnp.float32 if multi_precision else d2.dtype
+    dw = jnp.matmul(x2.T.astype(acc_t), d2.astype(acc_t))
+    db = jnp.sum(d2.astype(acc_t), axis=0)
+    if dweight is not None:
+        dw = dw + dweight.astype(acc_t)
+    if dbias is not None:
+        db = db + dbias.astype(acc_t)
+    if not multi_precision:
+        dw, db = dw.astype(d2.dtype), db.astype(d2.dtype)
+    return dw, db

@@ -21,9 +21,13 @@ from . import nn  # noqa: F401
 __all__ = [
     "sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
     "SparseCsrTensor", "is_same_shape",
-    "add", "subtract", "multiply", "divide", "matmul", "masked_matmul",
+    "add", "subtract", "multiply", "divide", "divide_scalar", "matmul",
+    "masked_matmul", "addmm", "mv",
     "relu", "tanh", "sigmoid", "sqrt", "square", "abs", "pow", "neg",
-    "cast", "transpose", "sum", "nn",
+    "sin", "sinh", "tan", "asin", "asinh", "atan", "atanh", "acos", "acosh",
+    "expm1", "log1p", "isnan", "relu6", "leaky_relu", "scale", "full_like",
+    "cast", "transpose", "sum", "reshape", "slice", "softmax", "coalesce",
+    "to_dense", "to_sparse_coo", "to_sparse_csr", "values", "nn",
 ]
 
 
@@ -222,10 +226,42 @@ sqrt = _unary(jnp.sqrt)
 square = _unary(jnp.square)
 abs = _unary(jnp.abs)
 neg = _unary(jnp.negative)
+# zero-preserving trig/exp family (reference sparse_ops.yaml unary block)
+sin = _unary(jnp.sin)
+sinh = _unary(jnp.sinh)
+tan = _unary(jnp.tan)
+asin = _unary(jnp.arcsin)
+asinh = _unary(jnp.arcsinh)
+atan = _unary(jnp.arctan)
+atanh = _unary(jnp.arctanh)
+acos = _unary(jnp.arccos)   # NOTE acos(0)!=0: applied on stored values only,
+acosh = _unary(jnp.arccosh)  # matching the reference's values-only kernels
+expm1 = _unary(jnp.expm1)
+log1p = _unary(jnp.log1p)
+isnan = _unary(jnp.isnan)
+relu6 = _unary(lambda v: jnp.clip(v, 0.0, 6.0))
+
+
+def leaky_relu(x, negative_slope=0.01):
+    return _unary(lambda v: jax.nn.leaky_relu(v, negative_slope))(x)
 
 
 def pow(x, factor):
     return _unary(lambda v: jnp.power(v, factor))(x)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True):
+    """Values-only affine (reference sparse scale kernel: bias applies to the
+    stored values, not the implicit zeros)."""
+    if bias_after_scale:
+        return _unary(lambda v: v * scale + bias)(x)
+    return _unary(lambda v: (v + bias) * scale)(x)
+
+
+def full_like(x, fill_value, dtype=None):
+    """Same sparsity pattern, every stored value = fill_value."""
+    return _unary(lambda v: jnp.full_like(
+        v, fill_value, dtype=dtype if dtype is not None else None))(x)
 
 
 def cast(x, index_dtype=None, value_dtype=None):
@@ -280,6 +316,11 @@ def divide(x, y):
     return _binary(jnp.divide, zero_out_nan=True)(x, y)
 
 
+def divide_scalar(x, scalar):
+    """Values / scalar (reference sparse divide_scalar kernel)."""
+    return _unary(lambda v: v / scalar)(x)
+
+
 def matmul(x, y):
     """sparse @ dense -> dense (the reference's spmm); XLA lowers the BCOO
     contraction to gather+segment-sum. Routed through dispatch so gradients
@@ -318,6 +359,19 @@ def masked_matmul(x, y, mask):
                            values_tensor=vals)
 
 
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    """beta * input + alpha * (sparse x @ dense y) -> dense
+    (reference sparse addmm kernel)."""
+    prod = matmul(x, y)
+    inp = input if isinstance(input, Tensor) else to_tensor(np.asarray(input))
+    return inp * beta + prod * alpha
+
+
+def mv(x, vec):
+    """sparse matrix @ dense vector -> dense vector (reference sparse mv)."""
+    return matmul(x, vec)
+
+
 def sum(x, axis=None, dtype=None, keepdim=False):
     x = _as_coo(x)
     out = x._bcoo.todense().sum(axis=axis, keepdims=keepdim)
@@ -329,3 +383,95 @@ def sum(x, axis=None, dtype=None, keepdim=False):
 def transpose(x, perm):
     x = _as_coo(x)
     return SparseCooTensor(x._bcoo.transpose(tuple(perm)))
+
+
+def reshape(x, shape):
+    """COO reshape via linearized-index remapping — no densification
+    (reference sparse reshape kernel)."""
+    was_csr = isinstance(x, SparseCsrTensor)
+    x = _as_coo(x).coalesce()
+    old_shape = tuple(x._bcoo.shape)
+    size = int(np.prod(old_shape))
+    shape = tuple(int(s) if s != -1 else -1 for s in shape)
+    if -1 in shape:
+        rest = int(np.prod([s for s in shape if s != -1]))
+        shape = tuple(size // rest if s == -1 else s for s in shape)
+    if int(np.prod(shape)) != size:
+        raise ValueError(
+            f"sparse.reshape: cannot reshape {old_shape} ({size} elements) "
+            f"into {shape} ({int(np.prod(shape))} elements)")
+    ind = x._bcoo.indices  # [nnz, ndim]
+    strides = np.cumprod((1,) + old_shape[::-1][:-1])[::-1].astype(np.int64)
+    linear = (ind * jnp.asarray(strides.copy())).sum(axis=1)
+    new_strides = np.cumprod((1,) + shape[::-1][:-1])[::-1].astype(np.int64)
+    new_ind = jnp.stack(
+        [(linear // int(s)) % int(d) for s, d in zip(new_strides, shape)],
+        axis=1).astype(ind.dtype)
+    out = SparseCooTensor(jsparse.BCOO((x._bcoo.data, new_ind), shape=shape))
+    return out.to_sparse_csr() if was_csr else out
+
+
+def slice(x, axes, starts, ends):
+    """Entries within [start, end) per sliced axis, indices rebased
+    (reference sparse slice kernel). Result nse is data-dependent, so this
+    is an eager (host-synced) op — same class as the reference's dynamic-nnz
+    CPU/GPU kernels."""
+    x = _as_coo(x).coalesce()
+    ind = np.asarray(jax.device_get(x._bcoo.indices))
+    vals = x._bcoo.data
+    shape = list(x._bcoo.shape)
+    keep = np.ones(ind.shape[0], bool)
+    offs = np.zeros(len(shape), np.int64)
+    for ax, s, e in zip(axes, starts, ends):
+        dim = shape[ax]
+        s = max(0, s + dim if s < 0 else s)
+        e = min(dim, e + dim if e < 0 else e)
+        keep &= (ind[:, ax] >= s) & (ind[:, ax] < e)
+        offs[ax] = s
+        shape[ax] = max(0, e - s)
+    sel = np.nonzero(keep)[0]
+    new_ind = (ind[sel] - offs).astype(ind.dtype)
+    return SparseCooTensor(jsparse.BCOO(
+        (vals[jnp.asarray(sel)], jnp.asarray(new_ind)), shape=tuple(shape)))
+
+
+def softmax(x, axis=-1):
+    """Row-wise softmax over stored values only (the reference's sparse
+    softmax semantics: implicit zeros are -inf, i.e. excluded). 2-D COO/CSR:
+    segment-softmax over row ids — stays jit-friendly (static nnz)."""
+    was_csr = isinstance(x, SparseCsrTensor)
+    x2 = _as_coo(x).coalesce()
+    if len(x2.shape) != 2 or axis not in (-1, 1):
+        raise ValueError("sparse softmax: 2-D tensors over the last axis "
+                         "(reference kernel contract)")
+    rows = x2._bcoo.indices[:, 0]
+    n_rows = x2.shape[0]
+    vals = x2._bcoo.data
+    row_max = jax.ops.segment_max(vals, rows, num_segments=n_rows)
+    shifted = jnp.exp(vals - row_max[rows])
+    denom = jax.ops.segment_sum(shifted, rows, num_segments=n_rows)
+    out_vals = shifted / denom[rows]
+    out = SparseCooTensor(jsparse.BCOO((out_vals, x2._bcoo.indices),
+                                       shape=x2._bcoo.shape))
+    return out.to_sparse_csr() if was_csr else out
+
+
+# -- module-level forms of the tensor methods (sparse_ops.yaml names) --------
+def coalesce(x):
+    return _as_coo(x).coalesce()
+
+
+def to_dense(x):
+    return x.to_dense()
+
+
+def to_sparse_coo(x, sparse_dim=2):
+    return x.to_sparse_coo(sparse_dim) if isinstance(x, SparseCsrTensor) else x
+
+
+def to_sparse_csr(x):
+    return x.to_sparse_csr() if isinstance(x, SparseCooTensor) else x
+
+
+def values(x):
+    return x.values()

@@ -10,7 +10,8 @@ from jax.experimental import sparse as jsparse
 
 from ..nn.layer import Layer
 
-__all__ = ["ReLU", "Softmax", "SubmConv3D"]
+__all__ = ["ReLU", "Softmax", "SubmConv3D", "Conv3D", "MaxPool3D",
+           "BatchNorm", "SyncBatchNorm", "functional"]
 
 
 class ReLU(Layer):
@@ -116,3 +117,230 @@ class SubmConv3D(Layer):
         return SparseCooTensor(
             jsparse.BCOO((out._value, x._bcoo.indices), shape=out_shape),
             values_tensor=out)
+
+
+def _resparsify(dense):
+    """Dense [N,D,H,W,C] -> COO with exact result nse (host-synced: nse is
+    data-dependent, same class as the reference's dynamic-nnz kernels)."""
+    from . import SparseCooTensor
+
+    site_mask = np.asarray(jax.device_get(
+        jnp.any(dense != 0, axis=-1)))          # [N,D,H,W]
+    sites = np.stack(np.nonzero(site_mask), 1)  # [nnz, 4]
+    vals = dense[tuple(jnp.asarray(sites[:, i]) for i in range(sites.shape[1]))]
+    return SparseCooTensor(jsparse.BCOO(
+        (vals, jnp.asarray(sites, jnp.int32)), shape=tuple(dense.shape)))
+
+
+class Conv3D(Layer):
+    """General (pattern-changing) sparse 3D conv, NDHWC COO voxels
+    (reference sparse/nn/layer/conv.py Conv3D). Dense-backed: the voxel grid
+    densifies, XLA convolves on the MXU, and the output re-sparsifies —
+    on TPU a dense conv over a mostly-empty grid beats per-site gathers for
+    the small grids this API targets; SubmConv3D is the gather path."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, bias_attr=None):
+        super().__init__()
+        k = (kernel_size if isinstance(kernel_size, (list, tuple))
+             else (kernel_size,) * 3)
+        self.kernel_size = tuple(int(v) for v in k)
+        self.stride = (tuple(stride) if isinstance(stride, (list, tuple))
+                       else (stride,) * 3)
+        self.padding = (tuple(padding) if isinstance(padding, (list, tuple))
+                        else (padding,) * 3)
+        self.in_channels, self.out_channels = in_channels, out_channels
+        self.weight = self.create_parameter(
+            [*self.kernel_size, in_channels, out_channels])
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter([out_channels], is_bias=True))
+
+    def forward(self, x):
+        return functional.conv3d(x, self.weight, self.bias,
+                                 stride=self.stride, padding=self.padding)
+
+
+class MaxPool3D(Layer):
+    """Sparse max pool on NDHWC voxels (reference sparse MaxPool3D);
+    dense-backed like Conv3D."""
+
+    def __init__(self, kernel_size, stride=None, padding=0):
+        super().__init__()
+        k = (kernel_size if isinstance(kernel_size, (list, tuple))
+             else (kernel_size,) * 3)
+        self.kernel_size = tuple(int(v) for v in k)
+        self.stride = (tuple(stride) if isinstance(stride, (list, tuple))
+                       else self.kernel_size if stride is None
+                       else (stride,) * 3)
+        self.padding = (tuple(padding) if isinstance(padding, (list, tuple))
+                        else (padding,) * 3)
+
+    def forward(self, x):
+        return functional.max_pool3d(x, self.kernel_size, self.stride,
+                                     self.padding)
+
+
+class BatchNorm(Layer):
+    """BatchNorm over the stored values, per channel (reference sparse
+    BatchNorm normalizes active sites only — implicit zeros excluded)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5):
+        super().__init__()
+        from ..nn import initializer as I
+
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.weight = self.create_parameter(
+            [num_features], default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter([num_features], is_bias=True)
+        self._mean = self.register_buffer(
+            "_mean", np.zeros(num_features, np.float32))
+        self._variance = self.register_buffer(
+            "_variance", np.ones(num_features, np.float32))
+
+    def forward(self, x):
+        from . import SparseCooTensor, _as_coo
+        from ..core.dispatch import apply
+        from ..core.tensor import Tensor
+
+        x = _as_coo(x)
+        vals = x._bcoo.data  # [nnz, C]
+        if self.training:
+            mean = jnp.mean(vals, axis=0)
+            var = jnp.var(vals, axis=0)
+            m = self.momentum
+            self._mean._value = m * self._mean._value + (1 - m) * mean
+            self._variance._value = m * self._variance._value + (1 - m) * var
+        else:
+            mean, var = self._mean._value, self._variance._value
+
+        def body(v, w, b):
+            return (v - mean) / jnp.sqrt(var + self.epsilon) * w + b
+
+        out = apply(body, Tensor._wrap(vals, stop_gradient=False),
+                    self.weight, self.bias, op_name="sparse_batch_norm")
+        return SparseCooTensor(
+            jsparse.BCOO((out._value, x._bcoo.indices), shape=x._bcoo.shape),
+            values_tensor=out)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-replica sparse BN: under pjit/shard_map the mean/var reductions
+    become psums automatically when values are sharded — same-class shim as
+    dense SyncBatchNorm (reference sync_batch_norm_ kernel)."""
+
+
+class functional:
+    """paddle.sparse.nn.functional parity surface."""
+
+    @staticmethod
+    def relu(x):
+        from . import relu as _relu
+
+        return _relu(x)
+
+    @staticmethod
+    def softmax(x, axis=-1):
+        from . import softmax as _softmax
+
+        return _softmax(x, axis)
+
+    @staticmethod
+    def subm_conv3d(x, weight, bias=None, stride=1, padding=0):
+        """Functional form of SubmConv3D (weight: [prod(k), Cin, Cout])."""
+        layer = SubmConv3D.__new__(SubmConv3D)
+        Layer.__init__(layer)
+        n_k = int(np.asarray(weight.shape)[0])
+        k = round(n_k ** (1 / 3))
+        layer.kernel_size = (k, k, k)
+        layer.weight = weight
+        layer.bias = bias
+        layer.in_channels = int(np.asarray(weight.shape)[1])
+        layer.out_channels = int(np.asarray(weight.shape)[2])
+        return layer.forward(x)
+
+    @staticmethod
+    def conv3d(x, weight, bias=None, stride=(1, 1, 1), padding=(0, 0, 0)):
+        """x: COO [N,D,H,W,C]; weight: [kD,kH,kW,Cin,Cout] (reference
+        layout); returns COO with the convolved pattern."""
+        from ..core.dispatch import apply
+        from ..core.tensor import Tensor
+
+        dense = x.to_dense()
+        stride = (tuple(stride) if isinstance(stride, (list, tuple))
+                  else (stride,) * 3)
+        padding = (tuple(padding) if isinstance(padding, (list, tuple))
+                   else (padding,) * 3)
+
+        def body(dv, w, b=None):
+            out = jax.lax.conv_general_dilated(
+                dv, w, window_strides=stride,
+                padding=[(p, p) for p in padding],
+                dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+            if b is not None:
+                out = out + b
+            return out
+
+        args = [dense, weight] + ([bias] if bias is not None else [])
+        out = apply(body, *args, op_name="sparse_conv3d")
+        return _resparsify(out._value if isinstance(out, Tensor) else out)
+
+    @staticmethod
+    def max_pool3d(x, kernel_size, stride=None, padding=(0, 0, 0)):
+        ks = (tuple(kernel_size) if isinstance(kernel_size, (list, tuple))
+              else (kernel_size,) * 3)
+        st = (tuple(stride) if isinstance(stride, (list, tuple))
+              else ks if stride is None else (stride,) * 3)
+        pd = (tuple(padding) if isinstance(padding, (list, tuple))
+              else (padding,) * 3)
+        from . import _as_coo
+
+        x = _as_coo(x).coalesce()
+        # densify with -inf at EMPTY sites so the max reduces over stored
+        # values only (the reference kernel's semantics): a window whose
+        # stored values are all negative must yield that negative value,
+        # not the implicit zero
+        base = jnp.full(tuple(x._bcoo.shape), -jnp.inf, x._bcoo.data.dtype)
+        ind = x._bcoo.indices
+        dense = base.at[tuple(ind[:, i] for i in range(ind.shape[1]))].set(
+            x._bcoo.data)
+        out = jax.lax.reduce_window(
+            dense, -jnp.inf, jax.lax.max,
+            window_dimensions=(1, *ks, 1), window_strides=(1, *st, 1),
+            padding=[(0, 0)] + [(p, p) for p in pd] + [(0, 0)])
+        out = jnp.where(jnp.isneginf(out), 0.0, out)
+        return _resparsify(out)
+
+    @staticmethod
+    def attention(query, key, value, sparse_mask, key_padding_mask=None,
+                  attn_mask=None):
+        """Sparse-pattern attention (reference sparse fused_attention):
+        softmax(QK^T / sqrt(d), restricted to sparse_mask's pattern) @ V.
+        q/k/v: dense [seqlen, d] Tensors; sparse_mask: 2-D COO.
+        ``key_padding_mask`` [seqlen] and ``attn_mask`` [seqlen, seqlen]:
+        entries <= 0 exclude the position (additive -inf before softmax)."""
+        from . import SparseCooTensor, masked_matmul, matmul as _spmm, \
+            softmax as _softmax
+        from ..core.dispatch import apply
+        from ..core.tensor import Tensor
+
+        d = int(np.asarray(query.shape)[-1])
+        kT = apply(lambda kv: kv.T, key, op_name="transpose")
+        scores = masked_matmul(query / float(np.sqrt(d)), kT, sparse_mask)
+        if key_padding_mask is not None or attn_mask is not None:
+            ind = scores._bcoo.indices
+            rows, cols = ind[:, 0], ind[:, 1]
+            bias = jnp.zeros(ind.shape[0], scores._bcoo.data.dtype)
+            if key_padding_mask is not None:
+                kpm = (key_padding_mask._value
+                       if isinstance(key_padding_mask, Tensor)
+                       else jnp.asarray(np.asarray(key_padding_mask)))
+                bias = bias + jnp.where(kpm[cols] > 0, 0.0, -jnp.inf)
+            if attn_mask is not None:
+                am = (attn_mask._value if isinstance(attn_mask, Tensor)
+                      else jnp.asarray(np.asarray(attn_mask)))
+                bias = bias + jnp.where(am[rows, cols] > 0, 0.0, -jnp.inf)
+            scores = SparseCooTensor(jsparse.BCOO(
+                (scores._bcoo.data + bias, ind), shape=scores._bcoo.shape))
+        probs = _softmax(scores, axis=-1)
+        return _spmm(probs, value)

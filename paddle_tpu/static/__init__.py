@@ -323,13 +323,26 @@ class Executor:
             return program._run(feed, fetch_list, return_numpy)
         scope = scope or global_scope()
 
-        feed_names = [n for n in sorted(feed) if n in program._inputs]
+        unknown = sorted(set(feed) - set(program._inputs))
+        if unknown:
+            raise ValueError(
+                f"Executor.run: feed name(s) {unknown} are not placeholders "
+                f"of this program (has: {sorted(program._inputs)}) — "
+                "the reference raises on unknown feed variables too")
+        # trace over ALL placeholders (fed ones with fed shapes, others with
+        # their build-time shapes) so nothing is ever baked in as a stale
+        # constant; after tracing, fetches that actually USE an unfed
+        # placeholder raise below
         arrays = {}
-        for n in feed_names:
-            v = feed[n]
-            a = v._value if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
-            arrays[n] = a
-            program._inputs[n]._value = a  # keep build-time vars inspectable
+        for n, var in program._inputs.items():
+            if n in feed:
+                v = feed[n]
+                a = v._value if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+                arrays[n] = a
+                var._value = a  # keep build-time vars inspectable
+            else:
+                arrays[n] = jnp.asarray(var._value)
+        feed_names = sorted(program._inputs)
 
         param_names = sorted(program._params)
         param_vals = []
@@ -349,11 +362,19 @@ class Executor:
         )
         entry = self._cache.get(key) if use_program_cache else None
         if entry is None:
-            entry = self._compile(program, feed_names, param_names, fetch_ts)
+            entry = self._compile(program, feed_names, param_names, fetch_ts,
+                                  tuple(arrays[n] for n in feed_names),
+                                  tuple(param_vals))
             if use_program_cache:
                 self._cache[key] = entry
 
-        out_vals = entry(
+        jitted, needed = entry
+        missing = sorted(n for n in needed if n not in feed)
+        if missing:
+            raise ValueError(
+                f"Executor.run: fetch targets depend on placeholder(s) "
+                f"{missing} which are not in the feed")
+        out_vals = jitted(
             tuple(arrays[n] for n in feed_names), tuple(param_vals))
         out_map = {id(t): v for t, v in zip(fetch_ts, out_vals)}
         outs = []
@@ -365,17 +386,19 @@ class Executor:
                 outs.append(f)
         return outs
 
-    def _compile(self, program, feed_names, param_names, fetch_ts):
+    def _compile(self, program, feed_names, param_names, fetch_ts,
+                 feed_vals, param_vals):
         import jax
 
         from ..core.dispatch import recompute_value
 
         placeholders = [program._inputs[n] for n in feed_names]
         params = [program._params[n] for n in param_names]
-        exe = self
+        # one increment per (program, signature, fetch-set) compile; cache
+        # hits in run() never reach here — the observable no-retrace proof
+        self._trace_count += 1
 
         def pure(feed_vals, param_vals):
-            exe._trace_count += 1  # side effect fires only while tracing
             cache = {id(p): v for p, v in zip(placeholders, feed_vals)}
             cache.update({id(p): v for p, v in zip(params, param_vals)})
             # gradients() replays need to distinguish graph seeds from
@@ -383,7 +406,22 @@ class Executor:
             cache["__seed_ids__"] = frozenset(cache)
             return tuple(recompute_value(f, cache) for f in fetch_ts)
 
-        return jax.jit(pure)
+        # which placeholders do the fetches actually consume? (the
+        # reference prunes the program to the fetch deps; unfed-but-needed
+        # variables raise rather than silently using stale constants)
+        from jax.extend.core import Var as _JVar
+
+        jaxpr = jax.make_jaxpr(pure)(feed_vals, param_vals)
+        used = set()
+        for eqn in jaxpr.jaxpr.eqns:
+            used.update(v for v in eqn.invars if isinstance(v, _JVar))
+        used.update(v for v in jaxpr.jaxpr.outvars
+                    if isinstance(v, _JVar))
+        n_feed = len(feed_names)
+        needed = {feed_names[i]
+                  for i, v in enumerate(jaxpr.jaxpr.invars[:n_feed])
+                  if v in used}
+        return jax.jit(pure), needed
 
 
 def gradients(targets, inputs, target_gradients=None):

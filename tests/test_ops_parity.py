@@ -20,11 +20,14 @@ def _run(name, *args, **kw):
 
 
 class TestOpCoverageGate:
-    def test_coverage_at_least_85_percent(self):
+    def test_coverage_full_inventory(self):
+        """Full 478-op inventory: ops.yaml + legacy_ops.yaml +
+        sparse/static/fused yaml (VERDICT r2 missing #3: >=90% gate)."""
         cov = op_coverage()
         print(f"\nop coverage: {cov['covered']}/{cov['total']} "
               f"= {cov['pct']:.1%}; missing: {cov['missing']}")
-        assert cov["pct"] >= 0.85
+        assert cov["total"] >= 460  # 485 lines minus N/A rows
+        assert cov["pct"] >= 0.95
 
 
 class TestMathParity:
@@ -242,3 +245,45 @@ class TestFFT:
         assert x.grad is not None
         # Parseval: d/dx sum|rfft(x)|^2 ~ 2*N*x (up to one-sided factors)
         assert float(np.abs(x.grad.numpy()).sum()) > 0
+
+
+class TestFusedOps:
+    """fused_ops.yaml device-generic rows (fused.py)."""
+
+    def test_fused_dropout_add_modes(self):
+        import paddle_tpu.ops as ops
+
+        x = paddle.ones([16, 8]) * 2.0
+        y = paddle.ones([16, 8])
+        # inference, upscale_in_train: identity + add
+        out = ops.fused_dropout_add(x, y, p=0.5, training=False)
+        np.testing.assert_allclose(out.numpy(), 3.0)
+        # inference, downscale_in_infer: x*(1-p) + y
+        out = ops.fused_dropout_add(x, y, p=0.5, training=False,
+                                    mode="downscale_in_infer")
+        np.testing.assert_allclose(out.numpy(), 2.0)
+        # training: kept entries upscaled, dropped entries equal y
+        paddle.seed(0)
+        out = ops.fused_dropout_add(x, y, p=0.5, training=True).numpy()
+        assert set(np.unique(out)).issubset({1.0, 5.0})
+        # p=0: no dropout at all
+        out = ops.fused_dropout_add(x, y, p=0.0, training=True)
+        np.testing.assert_allclose(out.numpy(), 3.0)
+
+    def test_fused_linear_param_grad_add(self):
+        import paddle_tpu.ops as ops
+
+        rng = np.random.RandomState(0)
+        x = rng.rand(6, 4).astype(np.float32)
+        dout = rng.rand(6, 3).astype(np.float32)
+        dw0 = rng.rand(4, 3).astype(np.float32)
+        db0 = rng.rand(3).astype(np.float32)
+        dw, db = ops.fused_linear_param_grad_add(
+            paddle.to_tensor(x), paddle.to_tensor(dout),
+            paddle.to_tensor(dw0), paddle.to_tensor(db0))
+        np.testing.assert_allclose(dw.numpy(), x.T @ dout + dw0, rtol=1e-5)
+        np.testing.assert_allclose(db.numpy(), dout.sum(0) + db0, rtol=1e-5)
+        # without accumulators
+        dw2, db2 = ops.fused_linear_param_grad_add(
+            paddle.to_tensor(x), paddle.to_tensor(dout))
+        np.testing.assert_allclose(dw2.numpy(), x.T @ dout, rtol=1e-5)

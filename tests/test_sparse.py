@@ -173,3 +173,140 @@ class TestSparseNN:
         t = sparse.sparse_coo_tensor(idx, np.ones(2, np.float32), [1, 2, 2])
         with pytest.raises(ValueError, match="2-D"):
             t.to_sparse_csr()
+
+
+class TestExtendedInventory:
+    """sparse_ops.yaml rows added in r3 (VERDICT missing #3)."""
+
+    def test_trig_family_values_only(self):
+        t, idx, vals = _coo()
+        for name, ref in [("sin", np.sin), ("tan", np.tan),
+                          ("asinh", np.arcsinh), ("atan", np.arctan),
+                          ("expm1", np.expm1)]:
+            out = getattr(sparse, name)(t)
+            np.testing.assert_allclose(np.asarray(out.values().numpy()),
+                                       ref(vals), rtol=1e-6)
+
+    def test_scale_full_like_isnan(self):
+        t, idx, vals = _coo()
+        s = sparse.scale(t, scale=2.0, bias=1.0)
+        np.testing.assert_allclose(np.asarray(s.values().numpy()),
+                                   vals * 2 + 1, rtol=1e-6)
+        f = sparse.full_like(t, 7.0)
+        np.testing.assert_allclose(np.asarray(f.values().numpy()), 7.0)
+        n = sparse.isnan(t)
+        assert not np.asarray(n.values().numpy()).any()
+
+    def test_reshape_preserves_entries(self):
+        t, idx, vals = _coo()
+        r = sparse.reshape(t, [4, 3])
+        np.testing.assert_allclose(np.asarray(r.to_dense().numpy()),
+                                   np.asarray(t.to_dense().numpy()).reshape(4, 3))
+        r2 = sparse.reshape(t, [2, -1])
+        assert r2.shape == [2, 6]
+
+    def test_slice(self):
+        t, idx, vals = _coo()
+        dense = np.asarray(t.to_dense().numpy())
+        s = sparse.slice(t, axes=[0, 1], starts=[0, 1], ends=[2, 4])
+        np.testing.assert_allclose(np.asarray(s.to_dense().numpy()),
+                                   dense[0:2, 1:4])
+
+    def test_softmax_rowwise_pattern_only(self):
+        t, idx, vals = _coo()
+        out = sparse.softmax(t)
+        dense = np.asarray(out.to_dense().numpy())
+        # row 0 has entries at cols 1,3: softmax over those two only
+        e = np.exp(np.array([1.0, 2.0]) - 2.0)
+        np.testing.assert_allclose(dense[0, [1, 3]], e / e.sum(), rtol=1e-6)
+        np.testing.assert_allclose(dense[1, 2], 1.0, rtol=1e-6)  # singleton row
+
+    def test_addmm_mv(self):
+        t, idx, vals = _coo()
+        y = np.random.RandomState(0).rand(4, 2).astype(np.float32)
+        inp = np.ones((3, 2), np.float32)
+        out = sparse.addmm(paddle.to_tensor(inp), t, paddle.to_tensor(y),
+                           beta=0.5, alpha=2.0)
+        dense = np.asarray(t.to_dense().numpy())
+        np.testing.assert_allclose(out.numpy(), 0.5 * inp + 2.0 * dense @ y,
+                                   rtol=1e-5)
+        v = np.random.RandomState(1).rand(4).astype(np.float32)
+        mv = sparse.mv(t, paddle.to_tensor(v))
+        np.testing.assert_allclose(mv.numpy(), dense @ v, rtol=1e-5)
+
+    def test_module_level_method_forms(self):
+        t, idx, vals = _coo()
+        assert sparse.to_sparse_csr(t).nnz() == 4
+        assert sparse.values(t).shape[0] == 4
+        assert np.asarray(sparse.to_dense(t).numpy()).shape == (3, 4)
+        c = sparse.coalesce(t)
+        assert c.nnz() == 4
+
+
+class TestSparseNNExtended:
+    def test_conv3d_matches_dense(self):
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(0)
+        dense = np.zeros((1, 4, 4, 4, 2), np.float32)
+        sites = [(0, 1, 1, 1), (0, 2, 2, 2), (0, 3, 0, 1)]
+        for s in sites:
+            dense[s] = rng.rand(2)
+        idx = np.array(sites).T
+        t = sparse.sparse_coo_tensor(
+            np.vstack([idx]), dense[tuple(idx)], dense.shape)
+        conv = sparse.nn.Conv3D(2, 3, kernel_size=3, padding=1)
+        out = conv(t)
+        import jax
+
+        w = conv.weight._value
+        b = conv.bias._value
+        expect = jax.lax.conv_general_dilated(
+            jnp.asarray(dense), w, (1, 1, 1), [(1, 1)] * 3,
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC")) + b
+        np.testing.assert_allclose(np.asarray(out.to_dense().numpy()),
+                                   np.asarray(expect), rtol=1e-4, atol=1e-5)
+
+    def test_max_pool3d(self):
+        dense = np.zeros((1, 4, 4, 4, 1), np.float32)
+        dense[0, 0, 0, 0, 0] = 5.0
+        dense[0, 3, 3, 3, 0] = 2.0
+        idx = np.array([[0, 0], [0, 3], [0, 3], [0, 3]])
+        t = sparse.sparse_coo_tensor(
+            idx, np.array([[5.0], [2.0]], np.float32), dense.shape)
+        out = sparse.nn.functional.max_pool3d(t, kernel_size=2)
+        od = np.asarray(out.to_dense().numpy())
+        assert od.shape == (1, 2, 2, 2, 1)
+        assert od[0, 0, 0, 0, 0] == 5.0 and od[0, 1, 1, 1, 0] == 2.0
+
+    def test_batch_norm_values_only(self):
+        t, idx, vals = _coo()
+        # values as [nnz, C]: build a [N, C] sparse-ish input
+        indices = np.array([[0, 1, 2]])
+        v = np.array([[1.0, 10.0], [2.0, 20.0], [3.0, 30.0]], np.float32)
+        coo = sparse.sparse_coo_tensor(indices, v, [3, 2])
+        bn = sparse.nn.BatchNorm(2)
+        bn.train()
+        out = bn(coo)
+        got = np.asarray(out.values().numpy())
+        expect = (v - v.mean(0)) / np.sqrt(v.var(0) + 1e-5)
+        np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-4)
+
+    def test_sparse_attention(self):
+        rng = np.random.RandomState(0)
+        q = paddle.to_tensor(rng.rand(4, 8).astype(np.float32))
+        k = paddle.to_tensor(rng.rand(4, 8).astype(np.float32))
+        v = paddle.to_tensor(rng.rand(4, 8).astype(np.float32))
+        # banded mask
+        idx = np.array([[0, 0, 1, 1, 2, 2, 3, 3],
+                        [0, 1, 0, 1, 2, 3, 2, 3]])
+        mask = sparse.sparse_coo_tensor(idx, np.ones(8, np.float32), [4, 4])
+        out = sparse.nn.functional.attention(q, k, v, mask)
+        qn, kn, vn = q.numpy(), k.numpy(), v.numpy()
+        scores = qn @ kn.T / np.sqrt(8)
+        dense_mask = np.asarray(mask.to_dense().numpy()) > 0
+        scores = np.where(dense_mask, scores, -np.inf)
+        probs = np.exp(scores - scores.max(1, keepdims=True))
+        probs = probs / probs.sum(1, keepdims=True)
+        np.testing.assert_allclose(out.numpy(), probs @ vn, rtol=1e-4,
+                                   atol=1e-5)

@@ -274,3 +274,24 @@ def test_input_spec():
     t = paddle.ones([2, 2])
     s2 = paddle.static.InputSpec.from_tensor(t)
     assert s2.shape == (2, 2)
+
+
+def test_executor_rejects_unknown_and_missing_feeds():
+    """Unknown feed names and unfed-but-needed placeholders raise (the
+    reference raises on unfed variables; no stale-constant baking)."""
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [2], "float32")
+        g = paddle.static.data("g", [], "float32")
+        y = x * g
+    exe = paddle.static.Executor()
+    f = np.ones(2, np.float32)
+    with pytest.raises(ValueError, match="not placeholders"):
+        exe.run(main, feed={"x": f, "typo": f}, fetch_list=[y])
+    with pytest.raises(ValueError, match="depend on placeholder"):
+        exe.run(main, feed={"x": f}, fetch_list=[y])
+    # feeding both works; fetching something that needs only x works
+    (o,) = exe.run(main, feed={"x": f, "g": np.float32(2.0)}, fetch_list=[y])
+    np.testing.assert_allclose(o, 2.0)
+    (o2,) = exe.run(main, feed={"x": f * 3}, fetch_list=[x])
+    np.testing.assert_allclose(o2, 3.0)
