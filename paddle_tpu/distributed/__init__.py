@@ -1,5 +1,8 @@
 """paddle.distributed parity surface, TPU-native (SURVEY §2.3, §5.8)."""
 from . import collective, fleet, rpc  # noqa: F401
+from .fleet_random import (  # noqa: F401
+    MODEL_PARALLEL_RNG, RNGStatesTracker, get_rng_state_tracker,
+    model_parallel_random_seed)
 from .collective import (  # noqa: F401
     ReduceOp,
     all_gather,
@@ -80,4 +83,5 @@ __all__ = [
     "shard_layer", "dtensor_from_fn", "AutoTuner", "TCPStore",
     "VocabParallelEmbedding", "ColumnParallelLinear", "RowParallelLinear",
     "ParallelCrossEntropy", "mark_sharding",
+    "RNGStatesTracker", "get_rng_state_tracker", "model_parallel_random_seed",
 ]

@@ -1,0 +1,110 @@
+"""TP RNG state tracker (reference
+/root/reference/python/paddle/distributed/fleet/layers/mpu/random.py
+``RNGStatesTracker``/``get_rng_state_tracker`` — the Megatron-style control
+of dropout randomness under tensor parallelism).
+
+The reference must juggle per-rank CUDA generator states because each mp
+rank owns a private RNG: dropout over a *partitioned* tensor needs distinct
+per-rank masks while *replicated* tensors need identical ones, so TP code
+swaps generator states around every dropout call.
+
+TPU-native mapping: under GSPMD (our mp layers are sharding-annotated, see
+mp_layers.py), a tracker-scoped dropout draws its mask for the FULL logical
+shape from one named PRNG stream; XLA partitions the mask with the tensor.
+That yields BOTH Megatron properties by construction — shards see
+decorrelated mask slices, replicated tensors see identical masks — plus a
+stronger one the reference cannot offer: the TP-N result is bit-identical
+to the single-device run (per-position masks are layout-independent).
+For per-rank SPMD code written with ``shard_map``, ``rng_state`` takes a
+``fold_axis`` to derive an explicit per-rank stream via ``axis_index``.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from ..framework import random as frandom
+
+__all__ = ["RNGStatesTracker", "get_rng_state_tracker",
+           "model_parallel_random_seed", "MODEL_PARALLEL_RNG"]
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    """Named deterministic PRNG streams (reference RNGStatesTracker,
+    mpu/random.py:34). States are JAX PRNG keys; entering ``rng_state``
+    installs the stream for everything that draws randomness inside
+    (dropout etc.), and advances it on exit so successive eager entries
+    see fresh randomness, exactly like the reference's save/restore of
+    generator states."""
+
+    def __init__(self):
+        self._states: dict = {}
+        self._seeds: set = set()
+
+    def reset(self):
+        self._states = {}
+        self._seeds = set()
+
+    def add(self, name, seed):
+        if seed in self._seeds:
+            raise ValueError(f"seed {seed} already exists")
+        self._seeds.add(seed)
+        if name in self._states:
+            raise ValueError(f"state {name} already exists")
+        self._states[name] = jax.random.PRNGKey(int(seed))
+
+    def get_states_tracker(self):
+        return dict(self._states)
+
+    def set_states_tracker(self, states):
+        self._states = dict(states)
+
+    @contextlib.contextmanager
+    def rng_state(self, name=MODEL_PARALLEL_RNG, fold_axis=None):
+        """Run the body under the named stream (reference rng_state
+        contextmanager, mpu/random.py:69). ``fold_axis``: inside a
+        ``shard_map`` region, derive a distinct per-rank stream by folding
+        in ``lax.axis_index(fold_axis)`` — the explicit-SPMD analogue of
+        the reference's per-rank generator states."""
+        if name not in self._states:
+            raise ValueError(f"state {name} does not exist")
+        base = self._states[name]
+        key = base
+        if fold_axis is not None:
+            key = jax.random.fold_in(base, jax.lax.axis_index(fold_axis))
+        with frandom.rng_scope(key):
+            yield
+        # advance the stored (per-process) state so the next eager entry
+        # draws fresh randomness; the folded per-rank keys derive from it
+        self._states[name] = jax.random.split(base)[0]
+
+
+_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _TRACKER
+
+
+def model_parallel_random_seed(seed=None):
+    """Initialize the tracker for a TP job (reference
+    model_parallel_random_seed): one global stream shared by every rank
+    (replicated-tensor dropout) plus the model-parallel stream. Under GSPMD
+    both are process-global; under multi-process launch the mp rank folds in
+    so ranks that own different shards draw different streams."""
+    base = int(seed) if seed is not None else frandom.default_seed() + 2718
+    _TRACKER.reset()
+    mp_rank = 0
+    try:
+        from .mesh import get_hybrid_communicate_group
+
+        hcg = get_hybrid_communicate_group()
+        if hcg is not None:
+            mp_rank = hcg.get_model_parallel_rank()
+    except Exception:
+        pass
+    _TRACKER.add(MODEL_PARALLEL_RNG, base + 1024 * mp_rank)
+    frandom.seed(base)

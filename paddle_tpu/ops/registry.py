@@ -104,7 +104,6 @@ _STATIC_ALIASES = {
     "pool2d": "max_pool2d",
     "pool3d": "max_pool3d",
     "unpool": "max_unpool2d",
-    "arange": "arange",
 }
 # collective/pipeline static ops: capability = the distributed verb set
 _STATIC_COLLECTIVES = {

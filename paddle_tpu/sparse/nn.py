@@ -119,17 +119,29 @@ class SubmConv3D(Layer):
             values_tensor=out)
 
 
-def _resparsify(dense):
-    """Dense [N,D,H,W,C] -> COO with exact result nse (host-synced: nse is
-    data-dependent, same class as the reference's dynamic-nnz kernels)."""
+def _resparsify(dense_t, site_mask=None):
+    """Dense Tensor [N,D,H,W,C] -> COO with exact result nse (host-synced:
+    nse is data-dependent, same class as the reference's dynamic-nnz
+    kernels). ``site_mask`` selects the active sites (defaults to any
+    nonzero channel); values gather through the tape so the sparse output
+    stays differentiable wrt upstream parameters."""
     from . import SparseCooTensor
+    from ..core.dispatch import apply
+    from ..core.tensor import Tensor
 
-    site_mask = np.asarray(jax.device_get(
-        jnp.any(dense != 0, axis=-1)))          # [N,D,H,W]
-    sites = np.stack(np.nonzero(site_mask), 1)  # [nnz, 4]
-    vals = dense[tuple(jnp.asarray(sites[:, i]) for i in range(sites.shape[1]))]
+    dense_v = dense_t._value if isinstance(dense_t, Tensor) else dense_t
+    if site_mask is None:
+        site_mask = jnp.any(dense_v != 0, axis=-1)  # [N,D,H,W]
+    sites = np.stack(np.nonzero(np.asarray(jax.device_get(site_mask))), 1)
+    idx = tuple(jnp.asarray(sites[:, i]) for i in range(sites.shape[1]))
+    if isinstance(dense_t, Tensor):
+        vals = apply(lambda dv: dv[idx], dense_t, op_name="sparse_gather")
+        return SparseCooTensor(jsparse.BCOO(
+            (vals._value, jnp.asarray(sites, jnp.int32)),
+            shape=tuple(dense_v.shape)), values_tensor=vals)
     return SparseCooTensor(jsparse.BCOO(
-        (vals, jnp.asarray(sites, jnp.int32)), shape=tuple(dense.shape)))
+        (dense_v[idx], jnp.asarray(sites, jnp.int32)),
+        shape=tuple(dense_v.shape)))
 
 
 class Conv3D(Layer):
@@ -247,11 +259,24 @@ class functional:
 
     @staticmethod
     def subm_conv3d(x, weight, bias=None, stride=1, padding=0):
-        """Functional form of SubmConv3D (weight: [prod(k), Cin, Cout])."""
+        """Functional form of SubmConv3D (weight: [prod(k), Cin, Cout],
+        cubic kernel; pattern-preserving, so stride must be 1)."""
+        if stride not in (1, (1, 1, 1), [1, 1, 1]):
+            raise NotImplementedError(
+                "subm_conv3d is pattern-preserving: stride=1 only "
+                "(use conv3d for strided sparse conv)")
+        if padding not in (0, (0, 0, 0), [0, 0, 0]):
+            raise NotImplementedError(
+                "subm_conv3d: padding is implicit (same pattern); got "
+                f"padding={padding!r}")
         layer = SubmConv3D.__new__(SubmConv3D)
         Layer.__init__(layer)
         n_k = int(np.asarray(weight.shape)[0])
         k = round(n_k ** (1 / 3))
+        if k ** 3 != n_k:
+            raise ValueError(
+                f"subm_conv3d expects a cubic kernel; weight dim 0 = {n_k} "
+                "is not a perfect cube")
         layer.kernel_size = (k, k, k)
         layer.weight = weight
         layer.bias = bias
@@ -262,15 +287,29 @@ class functional:
     @staticmethod
     def conv3d(x, weight, bias=None, stride=(1, 1, 1), padding=(0, 0, 0)):
         """x: COO [N,D,H,W,C]; weight: [kD,kH,kW,Cin,Cout] (reference
-        layout); returns COO with the convolved pattern."""
+        layout). Output entries exist only where the kernel footprint
+        covers at least one active input site (reference sparse Conv3D
+        semantics) — bias applies at covered sites, not the whole grid."""
+        from . import _as_coo
         from ..core.dispatch import apply
-        from ..core.tensor import Tensor
 
+        x = _as_coo(x)
         dense = x.to_dense()
         stride = (tuple(stride) if isinstance(stride, (list, tuple))
                   else (stride,) * 3)
         padding = (tuple(padding) if isinstance(padding, (list, tuple))
                    else (padding,) * 3)
+        kshape = tuple(int(s) for s in np.asarray(weight.shape)[:3])
+
+        # coverage: convolve site occupancy with a ones kernel
+        ind = x._bcoo.indices
+        occ = jnp.zeros(tuple(x._bcoo.shape[:-1]) + (1,), jnp.float32)
+        occ = occ.at[tuple(ind[:, i] for i in range(ind.shape[1]))].set(1.0)
+        ones_k = jnp.ones(kshape + (1, 1), jnp.float32)
+        coverage = jax.lax.conv_general_dilated(
+            occ, ones_k, window_strides=stride,
+            padding=[(p, p) for p in padding],
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))[..., 0] > 0
 
         def body(dv, w, b=None):
             out = jax.lax.conv_general_dilated(
@@ -279,11 +318,11 @@ class functional:
                 dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
             if b is not None:
                 out = out + b
-            return out
+            return jnp.where(coverage[..., None], out, 0.0)
 
         args = [dense, weight] + ([bias] if bias is not None else [])
         out = apply(body, *args, op_name="sparse_conv3d")
-        return _resparsify(out._value if isinstance(out, Tensor) else out)
+        return _resparsify(out, site_mask=coverage)
 
     @staticmethod
     def max_pool3d(x, kernel_size, stride=None, padding=(0, 0, 0)):
@@ -294,21 +333,28 @@ class functional:
         pd = (tuple(padding) if isinstance(padding, (list, tuple))
               else (padding,) * 3)
         from . import _as_coo
+        from ..core.dispatch import apply
 
         x = _as_coo(x).coalesce()
-        # densify with -inf at EMPTY sites so the max reduces over stored
-        # values only (the reference kernel's semantics): a window whose
-        # stored values are all negative must yield that negative value,
-        # not the implicit zero
-        base = jnp.full(tuple(x._bcoo.shape), -jnp.inf, x._bcoo.data.dtype)
         ind = x._bcoo.indices
-        dense = base.at[tuple(ind[:, i] for i in range(ind.shape[1]))].set(
-            x._bcoo.data)
-        out = jax.lax.reduce_window(
-            dense, -jnp.inf, jax.lax.max,
-            window_dimensions=(1, *ks, 1), window_strides=(1, *st, 1),
-            padding=[(0, 0)] + [(p, p) for p in pd] + [(0, 0)])
-        out = jnp.where(jnp.isneginf(out), 0.0, out)
+        shape = tuple(x._bcoo.shape)
+
+        def body(vals):
+            # densify with -inf at EMPTY sites so the max reduces over
+            # stored values only (the reference kernel's semantics): a
+            # window whose stored values are all negative must yield that
+            # negative value, not the implicit zero
+            base = jnp.full(shape, -jnp.inf, vals.dtype)
+            dv = base.at[tuple(ind[:, i] for i in range(ind.shape[1]))].set(vals)
+            pooled = jax.lax.reduce_window(
+                dv, -jnp.inf, jax.lax.max,
+                window_dimensions=(1, *ks, 1), window_strides=(1, *st, 1),
+                padding=[(0, 0)] + [(p, p) for p in pd] + [(0, 0)])
+            return jnp.where(jnp.isneginf(pooled), 0.0, pooled)
+
+        # x.values() keeps the producer's tape link, so pooled outputs stay
+        # differentiable wrt upstream sparse producers
+        out = apply(body, x.values(), op_name="sparse_max_pool3d")
         return _resparsify(out)
 
     @staticmethod

@@ -1,0 +1,105 @@
+"""TP RNG state tracker (reference fleet/layers/mpu/random.py
+get_rng_state_tracker): dropout under TP matches the single-device run and
+named streams are deterministic/independent."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed import (get_rng_state_tracker,
+                                    model_parallel_random_seed)
+from paddle_tpu.distributed.mesh import build_mesh
+
+
+def _fresh_tracker(seed=123):
+    tr = get_rng_state_tracker()
+    tr.reset()
+    tr._seeds.clear()
+    tr.add("model_parallel_rng", seed)
+    return tr
+
+
+class TestTrackerAPI:
+    def test_duplicate_seed_and_name_raise(self):
+        tr = _fresh_tracker()
+        with pytest.raises(ValueError, match="seed"):
+            tr.add("other", 123)
+        with pytest.raises(ValueError, match="state"):
+            tr.add("model_parallel_rng", 7)
+
+    def test_states_roundtrip_deterministic(self):
+        tr = _fresh_tracker()
+        saved = tr.get_states_tracker()
+        x = paddle.ones([64])
+        with tr.rng_state():
+            a = F.dropout(x, 0.5, training=True).numpy()
+        with tr.rng_state():
+            b = F.dropout(x, 0.5, training=True).numpy()
+        assert not np.array_equal(a, b)  # state advanced between entries
+        tr.set_states_tracker(saved)
+        with tr.rng_state():
+            a2 = F.dropout(x, 0.5, training=True).numpy()
+        np.testing.assert_array_equal(a, a2)  # restored => same stream
+
+    def test_missing_state_raises(self):
+        tr = _fresh_tracker()
+        with pytest.raises(ValueError, match="does not exist"):
+            with tr.rng_state("nope"):
+                pass
+
+
+class TestTPDropoutParity:
+    def test_tp2_dropout_equals_single_device(self):
+        """VERDICT r2 #6 done-criterion: TP-2 dropout output equals the
+        single-device reference run (per-position masks are layout-
+        independent under GSPMD)."""
+        mesh = build_mesh(degrees={"mp": 2, "dp": 1, "pp": 1, "sharding": 1})
+        x = np.arange(8 * 16, dtype=np.float32).reshape(8, 16) + 1.0
+
+        def step(xv, key):
+            from paddle_tpu.framework.random import rng_scope
+            from paddle_tpu.core.tensor import Tensor
+
+            with rng_scope(key):
+                return F.dropout(Tensor._wrap(xv), 0.5, training=True)._value
+
+        tr = _fresh_tracker()
+        key = tr.get_states_tracker()["model_parallel_rng"]
+
+        # single device
+        single = jax.jit(step)(jnp.asarray(x), key)
+
+        # TP-2: hidden dim sharded over mp
+        jmesh = mesh
+        sharded_x = jax.device_put(
+            jnp.asarray(x), NamedSharding(jmesh, P(None, "mp")))
+        tp = jax.jit(step)(sharded_x, key)
+        np.testing.assert_array_equal(np.asarray(single), np.asarray(tp))
+        # and the two shard-halves decorrelate (not identical masks)
+        half = np.asarray(tp)
+        assert not np.array_equal(half[:, :8] != 0, half[:, 8:] != 0)
+
+    def test_replicated_streams_match_across_entries_same_base(self):
+        """Two processes initialized with the same seed draw the SAME
+        replicated-stream masks (reference: global generator equality)."""
+        tr = _fresh_tracker(7)
+        x = paddle.ones([32])
+        with tr.rng_state():
+            a = F.dropout(x, 0.5, training=True).numpy()
+        tr2 = _fresh_tracker(7)
+        with tr2.rng_state():
+            b = F.dropout(x, 0.5, training=True).numpy()
+        np.testing.assert_array_equal(a, b)
+
+    def test_model_parallel_random_seed_sets_up_streams(self):
+        model_parallel_random_seed(99)
+        tr = get_rng_state_tracker()
+        assert "model_parallel_rng" in tr.get_states_tracker()
+        x = paddle.ones([16])
+        with tr.rng_state():
+            out = F.dropout(x, 0.5, training=True)
+        assert out.shape == [16]

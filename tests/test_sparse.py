@@ -310,3 +310,55 @@ class TestSparseNNExtended:
         probs = probs / probs.sum(1, keepdims=True)
         np.testing.assert_allclose(out.numpy(), probs @ vn, rtol=1e-4,
                                    atol=1e-5)
+
+
+class TestSparseConvSemantics:
+    def test_conv3d_bias_only_at_covered_sites(self):
+        """Output entries exist only where the kernel footprint covers an
+        active input site; bias must not densify the whole grid."""
+        rng = np.random.RandomState(0)
+        shape = (1, 8, 8, 8, 2)
+        idx = np.array([[0], [4], [4], [4]])  # one active voxel
+        t = sparse.sparse_coo_tensor(idx, rng.rand(1, 2).astype(np.float32),
+                                     shape)
+        conv = sparse.nn.Conv3D(2, 3, kernel_size=3, padding=1,
+                                bias_attr=None)
+        # force a nonzero bias
+        conv.bias.set_value(np.full(3, 0.7, np.float32))
+        out = conv(t)
+        # coverage of a 3^3 kernel around one site = at most 27 sites
+        assert out.nnz() <= 27
+        dense = np.asarray(out.to_dense().numpy())
+        assert dense[0, 0, 0, 0].sum() == 0.0  # far corner stays empty
+
+    def test_conv3d_gradients_reach_weight_and_bias(self):
+        rng = np.random.RandomState(1)
+        shape = (1, 4, 4, 4, 2)
+        idx = np.array([[0, 0], [1, 2], [1, 2], [1, 2]])
+        t = sparse.sparse_coo_tensor(idx, rng.rand(2, 2).astype(np.float32),
+                                     shape)
+        conv = sparse.nn.Conv3D(2, 3, kernel_size=3, padding=1)
+        out = conv(t)
+        loss = paddle.sum(out.values())
+        loss.backward()
+        assert conv.weight.grad is not None
+        assert float(np.abs(conv.weight.grad.numpy()).sum()) > 0
+        assert conv.bias.grad is not None
+
+    def test_subm_conv3d_functional_validates(self):
+        t, idx, vals = _coo()
+        w = paddle.ones([27, 1, 1])
+        with pytest.raises(NotImplementedError, match="stride"):
+            sparse.nn.functional.subm_conv3d(t, w, stride=2)
+        with pytest.raises(ValueError, match="cube"):
+            sparse.nn.functional.subm_conv3d(t, paddle.ones([18, 1, 1]))
+
+    def test_max_pool3d_negative_values_survive(self):
+        shape = (1, 2, 2, 2, 1)
+        idx = np.array([[0], [0], [0], [0]])
+        t = sparse.sparse_coo_tensor(
+            idx, np.array([[-3.0]], np.float32), shape)
+        out = sparse.nn.functional.max_pool3d(t, kernel_size=2)
+        # stored -3.0 must win over implicit zeros in its window
+        np.testing.assert_allclose(
+            np.asarray(out.to_dense().numpy()).ravel(), [-3.0])
