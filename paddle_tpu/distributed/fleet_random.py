@@ -97,14 +97,20 @@ def model_parallel_random_seed(seed=None):
     so ranks that own different shards draw different streams."""
     base = int(seed) if seed is not None else frandom.default_seed() + 2718
     _TRACKER.reset()
-    mp_rank = 0
+    mp_rank, pp_rank, pp_size = 0, 0, 1
     try:
         from .mesh import get_hybrid_communicate_group
 
         hcg = get_hybrid_communicate_group()
         if hcg is not None:
             mp_rank = hcg.get_model_parallel_rank()
+            pp_rank = hcg.get_stage_id()
+            pp_size = hcg.get_pipe_parallel_world_size()
     except Exception:
         pass
-    _TRACKER.add(MODEL_PARALLEL_RNG, base + 1024 * mp_rank)
+    # reference offset formula (mpu/random.py model_parallel_random_seed):
+    # the +1 keeps the mp stream distinct from the global stream even at
+    # rank 0, and pp stages get their own streams
+    local_seed = base + 1 + mp_rank * pp_size + pp_rank
+    _TRACKER.add(MODEL_PARALLEL_RNG, local_seed)
     frandom.seed(base)

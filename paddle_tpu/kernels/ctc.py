@@ -1,0 +1,246 @@
+"""Fused Pallas CTC loss (warpctc parity — the reference vendors
+third_party/warpctc and registers warpctc_kernel.cu; this is the TPU
+lattice kernel, SURVEY §7 M5).
+
+The lax.scan lattice in nn/functional/loss.py is correct but materializes
+T sequential [B, S] HLO ops. Here the whole alpha (forward) / beta
+(backward) recursion runs over VMEM-resident state in one kernel launch per
+direction. The class-scatter of the gradient (ext-state posteriors ->
+vocabulary) stays outside as a one-hot einsum: a dense [S, C] contraction
+the MXU eats directly.
+
+Layout (Mosaic):
+- lattice state is [8, Sp]: batch rows on SUBLANES, extended-label states on
+  LANES (Sp = S padded to 128) — each vector op advances 8 batch rows;
+- grid tiles the batch in groups of 8; padded rows/states carry -1e30
+  log-prob so shifted contributions vanish;
+- lane shifts use pltpu.roll + iota masks;
+- ragged input lengths are handled branch-free: the beta recursion runs the
+  full static T and merges the per-row terminal initialization with a
+  ``t == in_len-1`` mask (no dynamic trip counts);
+- x64 traps: index-map constants, loop bounds and float literals must be
+  explicit i32/f32 or Mosaic sees i64/f64 and refuses to lower.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import active_platform
+
+__all__ = ["ctc_loss_pallas"]
+
+_NEG = -1.0e30
+_BT = 8  # batch rows per grid program (one sublane tile)
+
+
+def _neg32():
+    return jnp.float32(_NEG)
+
+
+def _i0():
+    # index-map constants must be i32: under jax_enable_x64 a python literal
+    # traces as i64 and Mosaic rejects the mixed index tuple
+    return jnp.int32(0)
+
+
+def _interpret_mode() -> bool:
+    return active_platform() not in ("tpu",)
+
+
+def _lanes(s: int) -> int:
+    return max(128, ((s + 127) // 128) * 128)
+
+
+def _lse3(a, b, c):
+    m = jnp.maximum(a, jnp.maximum(b, c))
+    safe_m = jnp.where(m <= _neg32() / 2, jnp.float32(0.0), m)
+    out = safe_m + jnp.log(
+        jnp.exp(a - safe_m) + jnp.exp(b - safe_m) + jnp.exp(c - safe_m))
+    return jnp.where(m <= _neg32() / 2, _neg32(), out)
+
+
+def _shift_right(a, k, lane):
+    return jnp.where(lane < k, _neg32(), pltpu.roll(a, jnp.int32(k), axis=1))
+
+
+def _shift_left(a, k, lane, size):
+    # pltpu.roll is circular with non-negative shift: left-by-k == size-k
+    return jnp.where(lane >= size - k,
+                     _neg32(), pltpu.roll(a, jnp.int32(size - k), axis=1))
+
+
+def _alpha_kernel(logp_ref, same_ref, alpha_ref, *, T):
+    """logp_ref: [T, 8, Sp]; same_ref: [8, Sp]; alpha_ref out: [T, 8, Sp]."""
+    Sp = logp_ref.shape[-1]
+    lane = jax.lax.broadcasted_iota(jnp.int32, (_BT, Sp), 1)
+    same = same_ref[...]
+
+    alpha0 = jnp.where(lane < 2, logp_ref[0], _neg32())
+    alpha_ref[pl.ds(0, 1), :, :] = alpha0[None]
+
+    def step(t, alpha):
+        lp_t = logp_ref[pl.ds(t, 1), :, :].reshape(_BT, Sp)
+        a2 = _shift_right(alpha, 1, lane)
+        a3 = jnp.where(same > 0, _neg32(), _shift_right(alpha, 2, lane))
+        new = _lse3(alpha, a2, a3) + lp_t
+        alpha_ref[pl.ds(t, 1), :, :] = new[None]
+        return new
+
+    jax.lax.fori_loop(jnp.int32(1), jnp.int32(T), step, alpha0)
+
+
+def _beta_kernel(logp_ref, same_ref, inlen_ref, slast_ref, beta_ref, *, T):
+    """Branch-free ragged beta: full static T loop; at each t the per-row
+    terminal init (t == in_len-1) merges in by mask. logp_ref: [T, 8, Sp];
+    inlen/slast: [8, 1] i32; beta_ref out: [T, 8, Sp]."""
+    Sp = logp_ref.shape[-1]
+    lane = jax.lax.broadcasted_iota(jnp.int32, (_BT, Sp), 1)
+    same = same_ref[...]
+    in_len = inlen_ref[...]  # [8, 1] i32
+    s_last = slast_ref[...]
+    same_l2 = _shift_left(same.astype(jnp.float32), 2, lane, Sp)
+
+    init = jnp.where(
+        (lane == s_last) | ((lane == s_last - 1) & (s_last > 0)),
+        jnp.float32(0.0), _neg32())  # [8, Sp]
+
+    beta_T = jnp.full((_BT, Sp), _NEG, jnp.float32)
+
+    def step(i, beta_next):
+        t = jnp.int32(T - 1) - i
+        lp_next = logp_ref[pl.ds(jnp.minimum(t + 1, jnp.int32(T - 1)), 1),
+                           :, :].reshape(_BT, Sp)
+        tmp = lp_next + beta_next
+        b2 = _shift_left(tmp, 1, lane, Sp)
+        b3 = jnp.where(same_l2 > 0, _neg32(), _shift_left(tmp, 2, lane, Sp))
+        rec = _lse3(tmp, b2, b3)
+        # rows where t is the terminal step take the init; rows with
+        # t >= in_len keep -inf (beta_next is -inf so rec stays -inf)
+        new = jnp.where(t == in_len - 1, init, rec)
+        beta_ref[pl.ds(t, 1), :, :] = new[None]
+        return new
+
+    jax.lax.fori_loop(jnp.int32(0), jnp.int32(T), step, beta_T)
+
+
+def _prep(log_probs, labels, blank):
+    """ext labels, gathered ext log-probs [T, B, Sp], same-mask [B, Sp] —
+    batch padded to a multiple of 8 sublane rows."""
+    T, B, C = log_probs.shape
+    L = labels.shape[1]
+    S = 2 * L + 1
+    Sp = _lanes(S)
+    Bp = ((B + _BT - 1) // _BT) * _BT
+    lbl = labels.astype(jnp.int32)
+    ext = jnp.full((B, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(lbl)
+    logp_ext = jnp.take_along_axis(
+        log_probs.astype(jnp.float32),
+        jnp.broadcast_to(ext[None], (T, B, S)), axis=2)  # [T, B, S]
+    same = jnp.concatenate(
+        [jnp.ones((B, 2), jnp.int32),
+         (ext[:, 2:] == ext[:, :-2]).astype(jnp.int32)], axis=1)
+    logp_ext = jnp.pad(logp_ext, ((0, 0), (0, Bp - B), (0, Sp - S)),
+                       constant_values=_NEG)
+    same = jnp.pad(same, ((0, Bp - B), (0, Sp - S)), constant_values=1)
+    return ext, logp_ext, same, S, Sp, Bp
+
+
+def _alphas(logp_ext, same, T, Sp):
+    Bp = logp_ext.shape[1]
+    return pl.pallas_call(
+        functools.partial(_alpha_kernel, T=T),
+        grid=(Bp // _BT,),
+        in_specs=[
+            pl.BlockSpec((T, _BT, Sp), lambda b: (_i0(), b, _i0())),
+            pl.BlockSpec((_BT, Sp), lambda b: (b, _i0())),
+        ],
+        out_specs=pl.BlockSpec((T, _BT, Sp), lambda b: (_i0(), b, _i0())),
+        out_shape=jax.ShapeDtypeStruct((T, Bp, Sp), jnp.float32),
+        interpret=_interpret_mode(),
+    )(logp_ext, same)
+
+
+def _betas(logp_ext, same, in_len, s_last, T, Sp):
+    Bp = logp_ext.shape[1]
+    B = in_len.shape[0]
+    inlen2 = jnp.pad(in_len.astype(jnp.int32), (0, Bp - B),
+                     constant_values=-1)[:, None]  # [Bp, 1]
+    slast2 = jnp.pad(s_last.astype(jnp.int32), (0, Bp - B),
+                     constant_values=-1)[:, None]
+    return pl.pallas_call(
+        functools.partial(_beta_kernel, T=T),
+        grid=(Bp // _BT,),
+        in_specs=[
+            pl.BlockSpec((T, _BT, Sp), lambda b: (_i0(), b, _i0())),
+            pl.BlockSpec((_BT, Sp), lambda b: (b, _i0())),
+            pl.BlockSpec((_BT, 1), lambda b: (b, _i0())),
+            pl.BlockSpec((_BT, 1), lambda b: (b, _i0())),
+        ],
+        out_specs=pl.BlockSpec((T, _BT, Sp), lambda b: (_i0(), b, _i0())),
+        out_shape=jax.ShapeDtypeStruct((T, Bp, Sp), jnp.float32),
+        interpret=_interpret_mode(),
+    )(logp_ext, same, inlen2, slast2)
+
+
+def _loglik(alphas, in_len, lbl_len, S):
+    """Final log-likelihood from saved alphas [T, Bp, Sp]: states 2*L and
+    2*L-1 at t = in_len-1."""
+    B = in_len.shape[0]
+    T = alphas.shape[0]
+    t_idx = jnp.clip(in_len.astype(jnp.int32) - 1, 0, T - 1)
+    last = alphas[t_idx, jnp.arange(B)]  # [B, Sp]
+    s_last = 2 * lbl_len.astype(jnp.int32)
+    a_end = jnp.take_along_axis(last, s_last[:, None], axis=1)[:, 0]
+    a_pre = jnp.take_along_axis(
+        last, jnp.clip(s_last - 1, 0, S - 1)[:, None], axis=1)[:, 0]
+    # empty label (s_last == 0): only the all-blank state ends the path —
+    # clipping s_last-1 to 0 would double-count it (a ln2 bias)
+    a_pre = jnp.where(s_last > 0, a_pre, _NEG)
+    return jnp.logaddexp(a_end, a_pre), s_last
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def ctc_loss_pallas(log_probs, labels, input_lengths, label_lengths,
+                    blank=0):
+    """Per-sample negative log-likelihood [B] (reduction applied by the
+    caller, like phi::WarpctcKernel). Differentiable wrt log_probs."""
+    loss, _ = _fwd(log_probs, labels, input_lengths, label_lengths, blank)
+    return loss
+
+
+def _fwd(log_probs, labels, input_lengths, label_lengths, blank):
+    T = log_probs.shape[0]
+    ext, logp_ext, same, S, Sp, Bp = _prep(log_probs, labels, blank)
+    alphas = _alphas(logp_ext, same, T, Sp)
+    ll, s_last = _loglik(alphas, input_lengths, label_lengths, S)
+    res = (log_probs, labels, input_lengths, label_lengths,
+           ext, logp_ext, same, alphas, ll, s_last, S, Sp)
+    return -ll, res
+
+
+def _bwd(blank, res, g):
+    (log_probs, labels, in_len, lbl_len,
+     ext, logp_ext, same, alphas, ll, s_last, S, Sp) = res
+    T, B, C = log_probs.shape
+    betas = _betas(logp_ext, same, in_len, s_last, T, Sp)
+    # posterior over ext states; rows t >= in_len carry -inf betas -> 0
+    post = jnp.exp(alphas[:, :B] + betas[:, :B]
+                   - ll[None, :, None])  # [T, B, Sp]
+    g_ext = -post * g[None, :, None]  # d(-ll)/dlogp_ext * upstream
+    # scatter ext states back to classes on the MXU: one-hot [B,S,C] einsum
+    onehot = jax.nn.one_hot(ext, C, dtype=g_ext.dtype)  # [B, S, C]
+    g_logp = jnp.einsum("tbs,bsc->tbc", g_ext[:, :, :S],
+                        onehot).astype(log_probs.dtype)
+    f0 = lambda x: np.zeros(x.shape, jax.dtypes.float0)
+    return (g_logp, f0(labels), f0(in_len), f0(lbl_len))
+
+
+ctc_loss_pallas.defvjp(_fwd, _bwd)

@@ -246,12 +246,27 @@ def dice_loss(input, label, epsilon=1e-5, name=None):
 
 
 def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction="mean", norm_by_times=False):
-    """CTC loss, dynamic-programming over lax.scan (warpctc parity,
-    /root/reference/paddle/phi/kernels/gpu/warpctc_kernel.cu). Pallas-fused
-    variant lands in paddle_tpu.kernels.
+    """CTC loss (warpctc parity,
+    /root/reference/paddle/phi/kernels/gpu/warpctc_kernel.cu).
 
     log_probs: [T, B, C] (paddle layout), labels: [B, L] padded with blank.
+    Two kernels under the policy surface (kernels/__init__.py): the Pallas
+    lattice (kernels/ctc.py — VMEM-resident alpha/beta recursions, default
+    on chip) and the lax.scan lattice below (default off-chip / oracle).
     """
+    from ...kernels import use_pallas
+
+    if use_pallas():
+        from ...kernels.ctc import ctc_loss_pallas
+
+        def body_pallas(lp, lbl, in_len, lbl_len):
+            loss = ctc_loss_pallas(lp, lbl, in_len, lbl_len, blank)
+            if norm_by_times:
+                loss = loss / jnp.maximum(in_len.astype(loss.dtype), 1.0)
+            return _reduce(loss, reduction)
+
+        return apply(body_pallas, log_probs, labels, input_lengths,
+                     label_lengths, op_name="ctc_loss_pallas")
     def body(lp, lbl, in_len, lbl_len):
         T, B, C = lp.shape
         L = lbl.shape[1]
@@ -288,12 +303,13 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction
         t_idx = jnp.clip(in_len.astype(jnp.int32) - 1, 0, T - 1)
         last = alphas[t_idx, jnp.arange(B)]  # [B, S]
         s_last = 2 * lbl_len.astype(jnp.int32)
-        ll = jnp.logaddexp(
-            jnp.take_along_axis(last, s_last[:, None], axis=1).squeeze(1),
-            jnp.take_along_axis(
-                last, jnp.clip(s_last - 1, 0, S - 1)[:, None], axis=1
-            ).squeeze(1),
-        )
+        a_end = jnp.take_along_axis(last, s_last[:, None], axis=1).squeeze(1)
+        a_pre = jnp.take_along_axis(
+            last, jnp.clip(s_last - 1, 0, S - 1)[:, None], axis=1).squeeze(1)
+        # empty label (s_last == 0): only the all-blank state is terminal;
+        # clipping s_last-1 to 0 would double-count it (a ln2 bias)
+        a_pre = jnp.where(s_last > 0, a_pre, neg_inf)
+        ll = jnp.logaddexp(a_end, a_pre)
         loss = -ll
         if norm_by_times:
             loss = loss / jnp.maximum(in_len.astype(loss.dtype), 1.0)

@@ -301,10 +301,15 @@ class functional:
                    else (padding,) * 3)
         kshape = tuple(int(s) for s in np.asarray(weight.shape)[:3])
 
-        # coverage: convolve site occupancy with a ones kernel
+        # coverage: convolve site occupancy with a ones kernel. COO inputs
+        # may be site-level (4 index columns, values [nnz, C]) or fully
+        # sparse (5 columns incl. channel): occupancy keys on the SITE, so
+        # drop a trailing channel column
         ind = x._bcoo.indices
+        n_site = len(x._bcoo.shape) - 1
+        site_ind = ind[:, :n_site]
         occ = jnp.zeros(tuple(x._bcoo.shape[:-1]) + (1,), jnp.float32)
-        occ = occ.at[tuple(ind[:, i] for i in range(ind.shape[1]))].set(1.0)
+        occ = occ.at[tuple(site_ind[:, i] for i in range(n_site))].set(1.0)
         ones_k = jnp.ones(kshape + (1, 1), jnp.float32)
         coverage = jax.lax.conv_general_dilated(
             occ, ones_k, window_strides=stride,
@@ -335,7 +340,10 @@ class functional:
         from . import _as_coo
         from ..core.dispatch import apply
 
-        x = _as_coo(x).coalesce()
+        # no coalesce: it would sever the producer's tape link, and the
+        # -inf-base scatter below resolves duplicate indices with .max,
+        # which is exactly max-pool semantics
+        x = _as_coo(x)
         ind = x._bcoo.indices
         shape = tuple(x._bcoo.shape)
 
@@ -345,7 +353,7 @@ class functional:
             # window whose stored values are all negative must yield that
             # negative value, not the implicit zero
             base = jnp.full(shape, -jnp.inf, vals.dtype)
-            dv = base.at[tuple(ind[:, i] for i in range(ind.shape[1]))].set(vals)
+            dv = base.at[tuple(ind[:, i] for i in range(ind.shape[1]))].max(vals)
             pooled = jax.lax.reduce_window(
                 dv, -jnp.inf, jax.lax.max,
                 window_dimensions=(1, *ks, 1), window_strides=(1, *st, 1),

@@ -411,9 +411,9 @@ class Executor:
         # variables raise rather than silently using stale constants)
         from jax.extend.core import Var as _JVar
 
-        # NOTE: this does not double-trace — jax.jit shares the tracing
-        # cache with make_jaxpr for the same function object + avals, so
-        # the jit call below reuses this trace
+        # NOTE: this does not double-trace — measured on this jax version
+        # (a side-effect counter in `pure` fires once across make_jaxpr +
+        # the first jit call), the jit below reuses the cached trace
         jaxpr = jax.make_jaxpr(pure)(feed_vals, param_vals)
         used = set()
         for eqn in jaxpr.jaxpr.eqns:

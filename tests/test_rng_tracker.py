@@ -103,3 +103,18 @@ class TestTPDropoutParity:
         with tr.rng_state():
             out = F.dropout(x, 0.5, training=True)
         assert out.shape == [16]
+
+
+def test_mp_stream_distinct_from_global_at_rank0():
+    """Reference offset formula: the model-parallel stream differs from the
+    global stream even on (mp_rank=0, pp_rank=0)."""
+    import jax
+
+    from paddle_tpu.framework import random as frandom
+
+    model_parallel_random_seed(99)
+    tr = get_rng_state_tracker()
+    mp_key = tr.get_states_tracker()["model_parallel_rng"]
+    global_key = jax.random.PRNGKey(99)
+    assert not np.array_equal(np.asarray(jax.random.key_data(mp_key)),
+                              np.asarray(jax.random.key_data(global_key)))

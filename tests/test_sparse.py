@@ -362,3 +362,33 @@ class TestSparseConvSemantics:
         # stored -3.0 must win over implicit zeros in its window
         np.testing.assert_allclose(
             np.asarray(out.to_dense().numpy()).ravel(), [-3.0])
+
+
+class TestSparseConvReviewRegressions:
+    def test_conv3d_fully_sparse_5col_indices(self):
+        """COO with a channel index column (BCOO.fromdense layout) must
+        produce the same coverage as site-level indices."""
+        dense = np.zeros((1, 4, 4, 4, 2), np.float32)
+        dense[0, 2, 2, 2, 1] = 3.0  # active only in channel 1
+        import jax.numpy as jnp
+        from jax.experimental import sparse as jsparse
+
+        coo5 = sparse.SparseCooTensor(jsparse.BCOO.fromdense(jnp.asarray(dense)))
+        assert coo5._bcoo.indices.shape[1] == 5
+        paddle.seed(0)
+        conv = sparse.nn.Conv3D(2, 2, kernel_size=3, padding=1)
+        out = conv(coo5)
+        assert out.nnz() > 0  # previously zeroed out by OOB occupancy scatter
+
+    def test_max_pool3d_grads_reach_producer(self):
+        rng = np.random.RandomState(0)
+        shape = (1, 4, 4, 4, 2)
+        idx = np.array([[0, 0], [1, 2], [1, 2], [1, 2]])
+        t = sparse.sparse_coo_tensor(idx, rng.rand(2, 2).astype(np.float32),
+                                     shape)
+        conv = sparse.nn.SubmConv3D(2, 3, kernel_size=3)
+        pooled = sparse.nn.functional.max_pool3d(conv(t), kernel_size=2)
+        loss = paddle.sum(pooled.values())
+        loss.backward()
+        assert conv.weight.grad is not None
+        assert float(np.abs(conv.weight.grad.numpy()).sum()) > 0

@@ -63,12 +63,13 @@ class TestDenseSqueeze:
         from paddle_tpu.vision.models import densenet121
 
         paddle.seed(0)
-        # canonical DenseNet-121 has ~7.98M params
+        # canonical DenseNet-121 has ~7.98M params; one build serves both
+        # the param-count and the forward check (a second build + larger
+        # input dominated the suite runtime)
         net = densenet121()
         assert abs(_param_count(net) - 7_978_856) < 1e5
-        small = densenet121(num_classes=5)
-        x = paddle.to_tensor(np.random.rand(1, 3, 64, 64).astype(np.float32))
-        assert small(x).shape == [1, 5]
+        x = paddle.to_tensor(np.random.rand(1, 3, 32, 32).astype(np.float32))
+        assert net(x).shape == [1, 1000]
 
     def test_squeezenet_params_and_forward(self):
         from paddle_tpu.vision.models import squeezenet1_0, squeezenet1_1
