@@ -85,20 +85,30 @@ def main():
                           "vs_baseline": 0.0}))
         return 1
 
-    # stage inputs on device once (a real input pipeline prefetches to
-    # device — reader cost is measured separately by Benchmark)
+    # stage a SMALL ROTATION of distinct batches on device (fresh data per
+    # step without paying host->device transfers inside the window; a real
+    # input pipeline prefetches the same way — reader cost is measured
+    # separately by Benchmark). One fixed batch would memorize (r2's
+    # loss=0.05) and hide any data-dependent effects.
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     data_sharding = NamedSharding(mesh, P(("dp", "sharding"), None))
-    x = jax.device_put(x, data_sharding)
-    y = jax.device_put(y, data_sharding)
+    n_bufs = 4
+    rng = np.random.RandomState(1)
+    bufs = []
+    for _ in range(n_bufs):
+        bx = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
+        by = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
+        bufs.append((jax.device_put(bx, data_sharding),
+                     jax.device_put(by, data_sharding)))
 
     # one measured window, sync at the edges only: per-step syncs would
     # forbid the host-ahead dispatch every real training loop relies on
     bench = prof.Benchmark()
     bench.begin()
-    for _ in range(steps):
-        loss = trainer.step(x, y)
+    for i in range(steps):
+        bx, by = bufs[i % n_bufs]
+        loss = trainer.step(bx, by)
     jax.block_until_ready(loss)
     bench.step(num_samples=batch * seq * steps)
     bench.end()
@@ -106,8 +116,11 @@ def main():
     report = bench.report()
     report["batch_cost"] = report["batch_cost"] / steps
     tok_per_sec = report["ips"]
-    flops_per_token = trainer.flops_per_token(seq)
-    mfu = prof.mfu(tok_per_sec, flops_per_token, platform)
+    # headline MFU counts true matmul FLOPs (input-embedding gather
+    # excluded); the raw 6N convention is reported alongside for
+    # cross-paper comparability (VERDICT r2 weak #3)
+    mfu = prof.mfu(tok_per_sec, trainer.matmul_flops_per_token(seq), platform)
+    mfu_6n = prof.mfu(tok_per_sec, trainer.flops_per_token(seq), platform)
 
     # north star: >=45% MFU (BASELINE.md config #4)
     result = {
@@ -117,13 +130,22 @@ def main():
         "vs_baseline": round(mfu / 0.45, 4),
         "extra": {
             "mfu": round(mfu, 4),
+            "mfu_6n_convention": round(mfu_6n, 4),
             "platform": platform,
             "params": trainer.num_params(),
+            "layers": cfg.num_hidden_layers,
             "batch": batch,
             "seq": seq,
             "steps": steps,
+            "fresh_batches": n_bufs,
             "batch_cost": round(report["batch_cost"], 5),
             "loss": float(np.asarray(loss)),
+            "config_note": (
+                "7B layer shapes (hidden 4096, heads 32, inter 11008, vocab "
+                "32000) at HBM-limited depth; headline mfu excludes the "
+                "input-embedding gather (r1/r2 reported the 6N convention "
+                "on different configs - r1: 13-layer hidden-2048 model - so "
+                "tokens/s across rounds are not directly comparable)"),
         },
     }
     print(json.dumps(result))

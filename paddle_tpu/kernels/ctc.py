@@ -221,15 +221,18 @@ def _fwd(log_probs, labels, input_lengths, label_lengths, blank):
     ext, logp_ext, same, S, Sp, Bp = _prep(log_probs, labels, blank)
     alphas = _alphas(logp_ext, same, T, Sp)
     ll, s_last = _loglik(alphas, input_lengths, label_lengths, S)
+    # logp_ext is NOT saved: it is one cheap gather away from log_probs
+    # (recomputed in _bwd) and would otherwise pin T*Bp*Sp floats in HBM
+    # across forward->backward
     res = (log_probs, labels, input_lengths, label_lengths,
-           ext, logp_ext, same, alphas, ll, s_last, S, Sp)
+           alphas, ll, s_last)
     return -ll, res
 
 
 def _bwd(blank, res, g):
-    (log_probs, labels, in_len, lbl_len,
-     ext, logp_ext, same, alphas, ll, s_last, S, Sp) = res
+    (log_probs, labels, in_len, lbl_len, alphas, ll, s_last) = res
     T, B, C = log_probs.shape
+    ext, logp_ext, same, S, Sp, Bp = _prep(log_probs, labels, blank)
     betas = _betas(logp_ext, same, in_len, s_last, T, Sp)
     # posterior over ext states; rows t >= in_len carry -inf betas -> 0
     post = jnp.exp(alphas[:, :B] + betas[:, :B]
@@ -241,6 +244,14 @@ def _bwd(blank, res, g):
                         onehot).astype(log_probs.dtype)
     f0 = lambda x: np.zeros(x.shape, jax.dtypes.float0)
     return (g_logp, f0(labels), f0(in_len), f0(lbl_len))
+
+
+def fits_vmem(T, L, budget_bytes=6 * 1024 * 1024):
+    """Whether the untiled [T, 8, Sp] blocks fit VMEM (double-buffered in +
+    out). Long utterances fall back to the scan lattice until the kernel
+    grows T-tiling."""
+    Sp = _lanes(2 * L + 1)
+    return 2 * (T * _BT * Sp * 4) <= budget_bytes
 
 
 ctc_loss_pallas.defvjp(_fwd, _bwd)

@@ -329,6 +329,19 @@ class LlamaPipelineTrainer:
         return sum(int(np.prod(v.shape)) for v in self._state[0].values())
 
     def flops_per_token(self, seq_len):
+        """6N + attention FLOPs with N = ALL params (the common reporting
+        convention; overcounts because the input-embedding forward is a
+        gather, not a matmul — see matmul_flops_per_token)."""
         c = self.config
         n = self.num_params()
+        return 6 * n + 12 * c.num_hidden_layers * c.hidden_size * seq_len
+
+    def matmul_flops_per_token(self, seq_len):
+        """True matmul FLOPs per token: excludes the input embedding table
+        (forward = gather, ~0 matmul FLOPs; its grad is a scatter-add) but
+        keeps the LM head. At real 32-layer depth the two differ by ~4%;
+        at shallow benchmark depths the difference is large, so MFU is
+        reported from THIS number (VERDICT r2 weak #3)."""
+        c = self.config
+        n = self.num_params() - c.vocab_size * c.hidden_size
         return 6 * n + 12 * c.num_hidden_layers * c.hidden_size * seq_len

@@ -256,7 +256,11 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction
     """
     from ...kernels import use_pallas
 
-    if use_pallas():
+    _T = (log_probs.shape[0] if hasattr(log_probs, "shape") else 0)
+    _L = (labels.shape[-1] if hasattr(labels, "shape") else 0)
+    from ...kernels.ctc import fits_vmem
+
+    if use_pallas() and fits_vmem(int(_T), int(_L)):
         from ...kernels.ctc import ctc_loss_pallas
 
         def body_pallas(lp, lbl, in_len, lbl_len):

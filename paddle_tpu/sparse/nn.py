@@ -340,20 +340,22 @@ class functional:
         from . import _as_coo
         from ..core.dispatch import apply
 
-        # no coalesce: it would sever the producer's tape link, and the
-        # -inf-base scatter below resolves duplicate indices with .max,
-        # which is exactly max-pool semantics
+        # no coalesce (it would sever the producer's tape link): duplicate
+        # indices SUM during densification — matching to_dense()/coalesce
+        # semantics — via an add-scatter plus an occupancy mask
         x = _as_coo(x)
         ind = x._bcoo.indices
         shape = tuple(x._bcoo.shape)
+        idx = tuple(ind[:, i] for i in range(ind.shape[1]))
+        occupied = jnp.zeros(shape, jnp.float32).at[idx].add(1.0) > 0
 
         def body(vals):
-            # densify with -inf at EMPTY sites so the max reduces over
-            # stored values only (the reference kernel's semantics): a
-            # window whose stored values are all negative must yield that
-            # negative value, not the implicit zero
-            base = jnp.full(shape, -jnp.inf, vals.dtype)
-            dv = base.at[tuple(ind[:, i] for i in range(ind.shape[1]))].max(vals)
+            # empty sites are -inf so the max reduces over stored values
+            # only (the reference kernel's semantics): a window whose
+            # stored values are all negative must yield that negative
+            # value, not the implicit zero
+            sums = jnp.zeros(shape, vals.dtype).at[idx].add(vals)
+            dv = jnp.where(occupied, sums, -jnp.inf)
             pooled = jax.lax.reduce_window(
                 dv, -jnp.inf, jax.lax.max,
                 window_dimensions=(1, *ks, 1), window_strides=(1, *st, 1),
