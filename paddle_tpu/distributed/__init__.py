@@ -22,6 +22,7 @@ from .collective import (  # noqa: F401
     unshard,
 )
 from .auto_parallel import (  # noqa: F401
+    Engine,
     Partial,
     ProcessMesh,
     Replicate,
@@ -37,6 +38,7 @@ from .checkpoint import (  # noqa: F401
     load_distributed_checkpoint,
     save_distributed_checkpoint,
 )
+from .cost_model import ClusterSpec, CostModel, ModelSpec  # noqa: F401
 from .engine import DistributedEngine  # noqa: F401
 from .mesh import (  # noqa: F401
     HybridCommunicateGroup,
@@ -81,6 +83,7 @@ __all__ = [
     "DistributedSaver", "save_distributed_checkpoint", "load_distributed_checkpoint",
     "ProcessMesh", "Shard", "Replicate", "Partial", "shard_tensor", "reshard",
     "shard_layer", "dtensor_from_fn", "AutoTuner", "TCPStore",
+    "Engine", "CostModel", "ModelSpec", "ClusterSpec",
     "VocabParallelEmbedding", "ColumnParallelLinear", "RowParallelLinear",
     "ParallelCrossEntropy", "mark_sharding",
     "RNGStatesTracker", "get_rng_state_tracker", "model_parallel_random_seed",

@@ -108,9 +108,54 @@ class AutoTuner:
         np.asarray(loss)  # block
         return (time.perf_counter() - t0) / steps
 
+    def can_rank(self) -> bool:
+        """Whether model_cfg carries the shape facts the cost model needs."""
+        model = self.cfg.get("model_cfg", {})
+        needed = ("n_params", "num_layers", "hidden_size", "seq_len",
+                  "global_batch_size")
+        return all(k in model for k in needed)
+
+    def plan(self, world_size):
+        """Cost-model ranking of the pruned candidates (reference
+        static/cost cost_model + planner role): returns candidates ordered
+        by predicted step time, HBM-infeasible ones last. Requires
+        model_cfg to carry enough shape facts; falls back to the unranked
+        list otherwise."""
+        cands = self.candidates(world_size)
+        if not self.can_rank():
+            return cands
+        model = self.cfg.get("model_cfg", {})
+        from .cost_model import ClusterSpec, CostModel, ModelSpec
+
+        spec = ModelSpec(
+            n_params=int(model["n_params"]),
+            n_layers=int(model["num_layers"]),
+            hidden=int(model["hidden_size"]),
+            seq_len=int(model["seq_len"]),
+            global_batch=int(model["global_batch_size"]),
+            heads=int(model.get("num_heads", 0)),
+            vocab=int(model.get("vocab_size", 0)),
+        )
+        cm = CostModel(spec, ClusterSpec.detect(),
+                       remat=self.cfg.get("remat", "dots"))
+        ranked = cm.rank(cands)
+        for c in ranked:
+            pred = cm.predict(c)
+            # "error" tags keep predictions out of recorder.best(), which
+            # must only ever return a LIVE trial result
+            self.recorder.add(
+                {**c, "predicted": True},
+                pred["step_time"],
+                error="prediction" if cm.feasible(c) else "predicted-oom")
+        return ranked
+
     def tune(self, model_fn, data_fn, world_size=None):
         """model_fn() -> (layer, loss_fn); data_fn() -> (inputs, labels).
-        Returns the best config; full history in self.recorder."""
+        Returns the best config; full history in self.recorder.
+
+        With enough model_cfg shape facts the cost model ranks candidates
+        first and only the top ``max_trials`` (default 3) run live —
+        the reference's planner-then-trials flow."""
         import jax
 
         from .mesh import _device_pool
@@ -118,9 +163,15 @@ class AutoTuner:
         if world_size is None:
             world_size = len(_device_pool(2))
         steps = int(self.cfg.get("steps_per_trial", 3))
-        cands = self.candidates(world_size)
+        cands = self.plan(world_size)
         if not cands:
             raise ValueError("no valid candidate configs after pruning")
+        # only a RANKED list may be truncated — cutting an unranked list
+        # would drop most of the search space in arbitrary itertools order
+        if self.can_rank():
+            max_trials = int(self.cfg.get("max_trials", 3))
+            if len(cands) > max_trials:
+                cands = cands[:max_trials]
         from .mesh import (get_hybrid_communicate_group,
                            set_hybrid_communicate_group)
 

@@ -1,0 +1,208 @@
+"""Cost-model planner + auto-parallel Engine (reference
+auto_parallel/static/cost/cost_model.py + static/engine.py Engine.fit)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import AutoTuner, ClusterSpec, CostModel, ModelSpec
+
+
+def _7b_spec(batch=64, seq=2048):
+    return ModelSpec(n_params=7_000_000_000, n_layers=32, hidden=4096,
+                     seq_len=seq, global_batch=batch, heads=32, vocab=32000)
+
+
+class TestCostModel:
+    def test_hbm_accounting_orders_zero_stages(self):
+        cm = CostModel(_7b_spec(), ClusterSpec())
+        base = {"dp_degree": 1, "mp_degree": 1, "sharding_degree": 8}
+        h1 = cm.hbm_bytes({**base, "sharding_stage": 1})
+        h2 = cm.hbm_bytes({**base, "sharding_stage": 2})
+        h3 = cm.hbm_bytes({**base, "sharding_stage": 3})
+        assert h1 > h2 > h3  # each stage shards more state
+
+    def test_7b_infeasible_unsharded_feasible_sharded(self):
+        """7B + Adam f32 master state = ~98GB: impossible on one 16GB chip
+        unsharded, feasible spread over 8 with stage 3."""
+        cm = CostModel(_7b_spec(), ClusterSpec(), remat="full")
+        assert not cm.feasible({"dp_degree": 8, "mp_degree": 1,
+                                "sharding_degree": 1, "sharding_stage": 1})
+        # flash attention keeps activations linear in s; a 32-chip
+        # sharding group holds the f32 Adam state comfortably
+        assert cm.feasible({"dp_degree": 1, "mp_degree": 1,
+                            "sharding_degree": 32, "sharding_stage": 3})
+
+    def test_tp_overhead_ranks_dp_first_for_small_models(self):
+        """A model that fits everywhere: pure dp should out-rank tp (no
+        activation allreduces on the critical path)."""
+        small = ModelSpec(n_params=100_000_000, n_layers=12, hidden=768,
+                          seq_len=512, global_batch=64, heads=12)
+        cm = CostModel(small, ClusterSpec())
+        dp = {"dp_degree": 8, "mp_degree": 1, "sharding_degree": 1,
+              "sharding_stage": 1}
+        tp = {"dp_degree": 1, "mp_degree": 8, "sharding_degree": 1,
+              "sharding_stage": 1}
+        assert cm.step_time(dp) < cm.step_time(tp)
+
+    def test_pipeline_bubble_penalty(self):
+        cm = CostModel(_7b_spec(), ClusterSpec())
+        nopp = {"dp_degree": 8, "mp_degree": 1, "sharding_degree": 1,
+                "sharding_stage": 1, "pp_degree": 1}
+        pp = {"dp_degree": 4, "mp_degree": 1, "sharding_degree": 1,
+              "sharding_stage": 1, "pp_degree": 2, "n_micro": 2}
+        assert cm.step_time(pp) > cm.step_time(nopp)
+
+    def test_rank_puts_infeasible_last(self):
+        cm = CostModel(_7b_spec(), ClusterSpec(), remat="full")
+        cands = [
+            {"dp_degree": 32, "mp_degree": 1, "sharding_degree": 1,
+             "sharding_stage": 1},  # infeasible: full state per chip
+            {"dp_degree": 1, "mp_degree": 1, "sharding_degree": 32,
+             "sharding_stage": 3},
+        ]
+        ranked = cm.rank(cands)
+        assert ranked[0]["sharding_degree"] == 32
+        assert ranked[-1]["sharding_degree"] == 1
+
+
+class TestPlannedTuner:
+    def test_tuner_prunes_to_max_trials(self):
+        """VERDICT r2 #8 done-criterion: the tuner lands on the known-best
+        config for the tiny fixture within <=3 live trials."""
+        from paddle_tpu.distributed.mesh import set_hybrid_communicate_group
+
+        def model_fn():
+            paddle.seed(0)
+            net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+            return net, paddle.nn.CrossEntropyLoss()
+
+        def data_fn():
+            rng = np.random.RandomState(0)
+            return ([rng.rand(16, 16).astype(np.float32)],
+                    [rng.randint(0, 4, (16,)).astype(np.int64)])
+
+        tuner = AutoTuner({
+            "model_cfg": {"hidden_size": 32, "global_batch_size": 16,
+                          "n_params": 16 * 32 + 32 * 4 + 36,
+                          "num_layers": 2, "seq_len": 1, "num_heads": 1},
+            "mp_degree": [1],
+            "sharding_stage": [1],
+            "steps_per_trial": 2,
+            "max_trials": 3,
+        })
+        best = tuner.tune(model_fn, data_fn, world_size=8)
+        set_hybrid_communicate_group(None)
+        live = [h for h in tuner.recorder.history
+                if h["error"] is None or
+                (h["error"] and "prediction" not in str(h["error"])
+                 and "predicted" not in str(h["error"]))]
+        assert len(live) <= 3
+        # a tiny MLP is bandwidth-bound: the planner must keep a pure-dp
+        # or lightly-sharded layout, never an mp-heavy one
+        assert best["mp_degree"] == 1
+        assert best["dp_degree"] * best["sharding_degree"] == 8
+
+    def test_plan_records_predictions_without_polluting_best(self):
+        tuner = AutoTuner({
+            "model_cfg": {"hidden_size": 4096, "global_batch_size": 64,
+                          "n_params": 7_000_000_000, "num_layers": 32,
+                          "seq_len": 2048, "num_heads": 32},
+        })
+        ranked = tuner.plan(8)
+        assert ranked
+        assert tuner.recorder.best() is None  # predictions are not trials
+
+
+class TestAutoParallelEngine:
+    def test_engine_fit_plans_and_trains(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(8, 16)
+                self.fc2 = nn.Linear(16, 2)
+
+            def forward(self, x):
+                return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+        from paddle_tpu.distributed import Engine
+        from paddle_tpu.distributed.mesh import set_hybrid_communicate_group
+
+        paddle.seed(0)
+        net = Net()
+        eng = Engine(model=net, loss=paddle.nn.CrossEntropyLoss(),
+                     optimizer=paddle.optimizer.Adam(
+                         parameters=net.parameters(), learning_rate=1e-2))
+        rng = np.random.RandomState(0)
+        x = rng.rand(64, 8).astype(np.float32)
+        y = (x.sum(1) > 4).astype(np.int64)
+        hist = eng.fit((x, y), epochs=3, batch_size=32)
+        assert hist["loss"][-1] < hist["loss"][0]
+        ev = eng.evaluate((x, y), batch_size=32)
+        assert ev["eval_loss"] is not None
+        preds = eng.predict((x, None), batch_size=32)
+        assert preds[0].shape == (32, 2)
+        # the engine planned a full-device layout automatically
+        st = eng._engine.strategy.hybrid_configs
+        assert st.dp_degree * st.mp_degree * st.sharding_degree == 8
+        set_hybrid_communicate_group(None)
+
+
+class TestReviewRegressions:
+    def test_unranked_candidates_not_truncated(self):
+        """Without cost-model shape facts, tune() must trial every
+        candidate (no arbitrary itertools-order truncation)."""
+        tuner = AutoTuner({"model_cfg": {"hidden_size": 32,
+                                         "global_batch_size": 16}})
+        assert not tuner.can_rank()
+        assert len(tuner.plan(8)) == len(tuner.candidates(8))
+
+    def test_plan_empty_fallback_is_single_device(self):
+        from paddle_tpu.distributed import Engine
+
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(3, 2)
+
+            def forward(self, x):
+                return self.fc(x)
+
+        eng = Engine(model=M())
+        # batch 6 on 8 devices: every full-device layout is pruned
+        cand = eng.plan(6, 1, world_size=8)
+        assert cand["dp_degree"] * cand["mp_degree"] * cand["sharding_degree"] == 1
+
+    def test_predict_bare_array_batches(self):
+        from paddle_tpu.distributed import Engine
+        from paddle_tpu.distributed.mesh import set_hybrid_communicate_group
+
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 2)
+
+            def forward(self, x):
+                return self.fc(x)
+
+        paddle.seed(0)
+        eng = Engine(model=M(), loss=paddle.nn.CrossEntropyLoss(),
+                     optimizer=None)
+        x = np.random.RandomState(0).rand(16, 4).astype(np.float32)
+        outs = eng.predict(x, batch_size=8)
+        assert len(outs) == 2 and outs[0].shape == (8, 2)
+        set_hybrid_communicate_group(None)
+
+    def test_engine_save_before_fit(self, tmp_path):
+        from paddle_tpu.distributed import Engine
+
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(3, 2)
+
+            def forward(self, x):
+                return self.fc(x)
+
+        eng = Engine(model=M())
+        eng.save(str(tmp_path / "m"))  # must not crash pre-fit
