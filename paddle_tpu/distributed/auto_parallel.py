@@ -239,9 +239,9 @@ class Engine:
                          global_batch=sample_batch, heads=heads)
 
     def plan(self, global_batch, seq_len=1, world_size=None):
-        """Choose hybrid degrees by predicted step time (no trials).
-        Returns the chosen candidate dict and records all predictions."""
-        from .cost_model import ClusterSpec, CostModel
+        """Choose hybrid degrees by predicted step time (no trials) —
+        delegates the ranking to AutoTuner.plan so Engine and tuner share
+        ONE cost-model code path."""
         from .auto_tuner import AutoTuner
 
         if world_size is None:
@@ -250,14 +250,13 @@ class Engine:
             # platform the same way build_mesh does
             world_size = len(_device_pool(2))
         spec = self._model_spec(global_batch, seq_len)
-        cm = CostModel(spec, self._cluster or ClusterSpec.detect())
         tuner = AutoTuner({"model_cfg": {
             "hidden_size": spec.hidden, "num_heads": spec.heads,
-            "global_batch_size": global_batch}})
-        cands = tuner.candidates(world_size)
-        ranked = cm.rank(cands)
-        self.history.append(
-            [{**c, **cm.predict(c)} for c in ranked[:8]])
+            "global_batch_size": global_batch, "n_params": spec.n_params,
+            "num_layers": spec.n_layers, "seq_len": seq_len}})
+        ranked = tuner.plan(world_size)
+        self.history.append([h for h in tuner.recorder.history
+                             if h["config"].get("predicted")][:8])
         if ranked:
             return ranked[0]
         # every candidate was pruned (e.g. indivisible batch): run
@@ -269,22 +268,25 @@ class Engine:
         if self._engine is not None:
             return self._engine
         from .engine import DistributedEngine
-        from .strategy import DistributedStrategy, HybridConfig, ShardingConfig
+        from .strategy import DistributedStrategy
 
-        strat = self.strategy
-        if strat is None or (
-                strat.hybrid_configs.dp_degree
-                * strat.hybrid_configs.mp_degree
-                * strat.hybrid_configs.sharding_degree == 1):
+        strat = self.strategy if self.strategy is not None else DistributedStrategy()
+        h = strat.hybrid_configs
+        if h.dp_degree * h.mp_degree * h.sharding_degree * h.pp_degree == 1:
+            # no degrees pinned: plan a layout, filling ONLY the hybrid
+            # degrees into a copy so every other strategy field the user
+            # configured (amp, recompute, pinned pp, ...) survives
+            import copy
+
             batch = int(np.asarray(sample_inputs).shape[0])
             seq = (int(np.asarray(sample_inputs).shape[1])
                    if np.asarray(sample_inputs).ndim > 1 else 1)
             cand = self.plan(batch, seq)
-            strat = DistributedStrategy(
-                hybrid_configs=HybridConfig(
-                    dp_degree=cand["dp_degree"], mp_degree=cand["mp_degree"],
-                    sharding_degree=cand["sharding_degree"]),
-                sharding=ShardingConfig(stage=cand["sharding_stage"]))
+            strat = copy.deepcopy(strat)
+            strat.hybrid_configs.dp_degree = cand["dp_degree"]
+            strat.hybrid_configs.mp_degree = cand["mp_degree"]
+            strat.hybrid_configs.sharding_degree = cand["sharding_degree"]
+            strat.sharding.stage = cand["sharding_stage"]
         self._engine = DistributedEngine(
             self.model, loss_fn=self.loss, optimizer=self.optimizer,
             strategy=strat)
@@ -294,7 +296,7 @@ class Engine:
     def fit(self, train_data, epochs=1, batch_size=None, steps_per_epoch=None,
             log_freq=0, valid_data=None):
         """train_data: (inputs, labels) arrays or an iterable of batches."""
-        logs = []
+        logs, eval_logs = [], []
         for _ in range(epochs):
             for step_i, (bx, by) in enumerate(
                     _iter_batches(train_data, batch_size)):
@@ -304,8 +306,12 @@ class Engine:
                 loss = eng.step(bx, by)
                 logs.append(float(np.asarray(loss)))
             if valid_data is not None:
-                self.evaluate(valid_data, batch_size)
-        return {"loss": logs}
+                eval_logs.append(
+                    self.evaluate(valid_data, batch_size)["eval_loss"])
+        out = {"loss": logs}
+        if eval_logs:
+            out["eval_loss"] = eval_logs
+        return out
 
     def evaluate(self, eval_data, batch_size=None):
         losses = []

@@ -166,21 +166,26 @@ class AutoTuner:
         cands = self.plan(world_size)
         if not cands:
             raise ValueError("no valid candidate configs after pruning")
-        # only a RANKED list may be truncated — cutting an unranked list
-        # would drop most of the search space in arbitrary itertools order
-        if self.can_rank():
-            max_trials = int(self.cfg.get("max_trials", 3))
-            if len(cands) > max_trials:
-                cands = cands[:max_trials]
+        # only a RANKED list bounds its trial budget — an unranked search
+        # must trial everything; and if every budgeted trial fails, keep
+        # going down the ranking rather than aborting with viable
+        # candidates untried
+        max_trials = (int(self.cfg.get("max_trials", 3))
+                      if self.can_rank() else len(cands))
         from .mesh import (get_hybrid_communicate_group,
                            set_hybrid_communicate_group)
 
         prev_hcg = get_hybrid_communicate_group()
         try:
+            n_trials = n_ok = 0
             for cand in cands:
+                if n_trials >= max_trials and n_ok:
+                    break  # budget spent and a live result exists
+                n_trials += 1
                 try:
                     dt = self._run_trial(cand, model_fn, data_fn, steps)
                     self.recorder.add(cand, dt)
+                    n_ok += 1
                 except Exception as e:  # OOM/invalid-shape trials recorded
                     self.recorder.add(cand, float("inf"), error=repr(e))
         finally:

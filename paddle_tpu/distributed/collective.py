@@ -252,7 +252,14 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
 
 
 def barrier(group=None):
-    jax.block_until_ready(jnp.zeros(()))
+    """Block until every process reaches the barrier (reference
+    paddle.distributed.barrier). Single-process: device-queue drain only."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("paddle_tpu_barrier")
+    else:
+        jax.block_until_ready(jnp.zeros(()))
     return None
 
 

@@ -1,20 +1,29 @@
-"""Elastic-lite: heartbeat-based failure detection + restart hooks
+"""Elastic: heartbeat-based failure detection + scale planning
 (reference /root/reference/python/paddle/distributed/fleet/elastic/
 manager.py:124 — etcd3 registration, TTL lease heartbeat, watch callbacks,
-ElasticLevel 1 fault-tolerant restarts).
+ElasticLevel 1 fault-tolerant restart / ElasticLevel 2 scale within
+[min, max], manager.py:219-256).
 
-TPU-native stance (SURVEY §5.3): no per-rank elasticity over ICI — recovery
-is pod-restart + checkpoint-resume. This manager provides the detection half
-over the native TCPStore (etcd's role) and the launch CLI provides the
-restart half (--max_restarts); ElasticLevel 2 scale-up/down does not apply
-to a fixed TPU slice.
+TPU-native stance (SURVEY §5.3): within one ICI slice there is no per-rank
+elasticity — recovery is pod-restart + checkpoint-resume (level 1). Level 2
+applies across DCN-connected pods (and the CPU backend): on membership
+loss the job relaunches at the surviving world size within [min, max] and
+resumes from the sharded checkpoint — DistributedEngine checkpoints
+reshard on load, so a smaller world picks up the same state. This module
+provides detection + the scale plan over the native TCPStore (etcd's
+role); the launch CLI executes the plan.
 """
 from __future__ import annotations
 
 import threading
 import time
 
-__all__ = ["ElasticManager", "Heartbeat"]
+__all__ = ["ElasticLevel", "ElasticManager", "Heartbeat"]
+
+
+class ElasticLevel:
+    FAULT_TOLERANT = 1  # restart at the same world size
+    ELASTIC = 2         # scale within [min, max] on membership change
 
 
 class Heartbeat:
@@ -51,17 +60,35 @@ class ElasticManager:
     fire a callback (launcher restarts the pod — elastic level 1)."""
 
     def __init__(self, store, world_size, timeout=6.0, poll=1.0,
-                 on_failure=None):
+                 on_failure=None, level=ElasticLevel.FAULT_TOLERANT,
+                 min_world=1, max_world=None):
         self.store = store
         self.world_size = int(world_size)
         self.timeout = timeout
         self.poll = poll
         self.on_failure = on_failure
+        self.level = level
+        self.min_world = int(min_world)
+        self.max_world = int(max_world or world_size)
         self._stop = threading.Event()
         self._thread = None
         self.dead: list[int] = []
         # rank -> (last seen sequence, master-local time it changed)
         self._seen: dict[int, tuple[int, float]] = {}
+
+    def scale_plan(self, dead) -> int | None:
+        """Next world size after losing ``dead`` ranks (reference
+        manager.py:219-256 membership-change handling).
+
+        Level 1: same world (every rank must come back). Level 2: the
+        surviving count clamped to [min_world, max_world]; ``None`` means
+        the job cannot continue (below min_world)."""
+        if self.level == ElasticLevel.FAULT_TOLERANT:
+            return self.world_size
+        alive = self.world_size - len(set(dead))
+        if alive < self.min_world:
+            return None
+        return max(self.min_world, min(alive, self.max_world))
 
     def wait_for_all(self, timeout=60.0):
         """Block until every rank has registered a first heartbeat."""

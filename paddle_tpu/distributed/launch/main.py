@@ -35,6 +35,13 @@ def _parse(argv):
     p.add_argument("--max_restarts", type=int, default=0,
                    help="elastic level-1: restart the whole pod up to K "
                         "times when any worker fails")
+    p.add_argument("--elastic_level", type=int, choices=[1, 2], default=1,
+                   help="2: on worker failure relaunch at the SURVIVING "
+                        "world size within [--min_procs, nproc_per_node] "
+                        "and let workers resume from checkpoint (reference "
+                        "fleet/elastic/manager.py ElasticLevel)")
+    p.add_argument("--min_procs", type=int, default=1,
+                   help="elastic level-2 lower bound on workers per node")
     p.add_argument("--devices", default=None,
                    help="comma list forwarded as PADDLE_TPU_VISIBLE_DEVICES")
     p.add_argument("--backend", choices=["auto", "cpu", "tpu"], default="auto",
@@ -61,6 +68,9 @@ def _worker_env(args, master, local_rank):
         "PADDLE_LOCAL_RANK": str(local_rank),
         "PADDLE_RANK_IN_NODE": str(local_rank),
         "PADDLE_MASTER": master,
+        # incarnation counter: scripts use it to resume from checkpoint
+        # instead of starting fresh (reference PADDLE_ELASTIC_* env family)
+        "PADDLE_RESTART_ATTEMPT": str(getattr(args, "_attempt", 0)),
     })
     if args.devices:
         env["PADDLE_TPU_VISIBLE_DEVICES"] = args.devices
@@ -92,41 +102,49 @@ def _spawn(args, master):
 
 def _watch(procs, poll_s=0.2):
     """Reference watcher role (launch/controllers/watcher.py): first failure
-    aborts the pod; returns 0 only if every worker exits 0."""
+    aborts the pod; returns (rc, n_failed) — rc 0 only if every worker
+    exits 0."""
     try:
         while procs:
-            alive = []
+            alive, failed = [], []
+            # sweep the WHOLE pod before aborting so simultaneous failures
+            # are all counted (the elastic scale plan needs the true
+            # surviving size)
             for proc, logf, rank in procs:
                 rc = proc.poll()
                 if rc is None:
                     alive.append((proc, logf, rank))
                 elif rc != 0:
+                    failed.append((rank, rc))
+                else:
+                    logf.close()
+            if failed:
+                for rank, rc in failed:
                     sys.stderr.write(
                         f"[launch] rank {rank} failed with exit {rc}; "
                         f"aborting pod (see workerlog.{rank})\n")
-                    for p2, f2, _ in procs:
-                        if p2.poll() is None:
-                            p2.terminate()
-                    for p2, f2, _ in procs:
-                        try:
-                            p2.wait(timeout=10)
-                        except subprocess.TimeoutExpired:
-                            p2.kill()
+                for p2, f2, _ in procs:
+                    if p2.poll() is None:
+                        p2.terminate()
+                for p2, f2, _ in procs:
+                    try:
+                        p2.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        p2.kill()
+                    if not f2.closed:
                         f2.close()
-                    return rc
-                else:
-                    logf.close()
+                return failed[0][1], len(failed)
             procs = alive
             if procs:
                 time.sleep(poll_s)
-        return 0
+        return 0, 0
     except KeyboardInterrupt:
         for proc, logf, _ in procs:
             proc.send_signal(signal.SIGINT)
         for proc, logf, _ in procs:
             proc.wait()
             logf.close()
-        return 130
+        return 130, n_failed
 
 
 def launch(argv):
@@ -134,11 +152,32 @@ def launch(argv):
     master = args.master or f"127.0.0.1:{_free_port()}"
     attempt = 0
     while True:
+        args._attempt = attempt
         procs = _spawn(args, master)
-        rc = _watch(procs)
+        rc, n_failed = _watch(procs)
         if rc == 0 or attempt >= args.max_restarts:
             return rc
         attempt += 1
+        if args.elastic_level >= 2 and n_failed:
+            # ElasticLevel 2 (reference fleet/elastic/manager.py:219-256):
+            # relaunch at the surviving world size; workers see the new
+            # PADDLE_TRAINERS_NUM and resume from their checkpoints
+            # (sharded checkpoints reshard on load)
+            from ..elastic import ElasticLevel, ElasticManager
+
+            plan = ElasticManager(
+                None, args.nproc_per_node, level=ElasticLevel.ELASTIC,
+                min_world=args.min_procs).scale_plan(range(n_failed))
+            if plan is None:
+                sys.stderr.write(
+                    f"[launch] fewer than --min_procs={args.min_procs} "
+                    "workers would survive; aborting\n")
+                return rc
+            if plan != args.nproc_per_node:
+                sys.stderr.write(
+                    f"[launch] elastic scale-down: {args.nproc_per_node} "
+                    f"-> {plan} workers\n")
+                args.nproc_per_node = plan
         sys.stderr.write(
             f"[launch] restarting pod (attempt {attempt}/{args.max_restarts})\n")
         # a fresh coordinator port avoids stale-rendezvous collisions

@@ -106,3 +106,70 @@ class TestPackaging:
             meta = tomllib.load(f)
         assert meta["project"]["name"] == "paddle-tpu"
         assert "jax" in meta["project"]["dependencies"]
+
+    def test_elastic_level2_scale_down_and_resume(self, tmp_path):
+        """VERDICT r2 #9 done-criterion: kill one worker -> the pod
+        relaunches at the smaller world size and resumes from checkpoint
+        (reference fleet/elastic/manager.py ElasticLevel 2)."""
+        script = tmp_path / "elastic_worker.py"
+        script.write_text(textwrap.dedent("""
+            import json, os, sys
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import paddle_tpu as paddle
+            import paddle_tpu.distributed as dist
+
+            dist.init_parallel_env()
+            world = int(os.environ["PADDLE_TRAINERS_NUM"])
+            rank = int(os.environ["PADDLE_TRAINER_ID"])
+            attempt = int(os.environ["PADDLE_RESTART_ATTEMPT"])
+            out = os.environ["TEST_OUT_DIR"]
+            ckpt = os.path.join(out, "ckpt.json")
+
+            # checkpoint-resume: restart continues the step counter
+            step = 0
+            if os.path.exists(ckpt):
+                with open(ckpt) as f:
+                    step = json.load(f)["step"]
+            # everyone reads the SAME resume step before rank 0 starts
+            # writing new checkpoints (keeps the per-step barriers aligned)
+            dist.barrier()
+
+            for i in range(step, 6):
+                step = i + 1
+                if rank == 0:
+                    with open(ckpt, "w") as f:
+                        json.dump({"step": step, "world": world,
+                                   "attempt": attempt}, f)
+                # first incarnation: rank 1 hard-crashes mid-training
+                # (os._exit: sys.exit would hang in jax.distributed's
+                # atexit shutdown while rank 0 holds the barrier)
+                if attempt == 0 and rank == 1 and step == 3:
+                    os._exit(1)
+                # lockstep: without this rank 0 could finish all steps
+                # before rank 1's crash aborts the pod
+                dist.barrier()
+            with open(os.path.join(out, f"done.{rank}.{attempt}"), "w") as f:
+                f.write(f"{world}")
+        """))
+        out_dir = tmp_path / "out"
+        out_dir.mkdir()
+        env = dict(os.environ, TEST_OUT_DIR=str(out_dir), JAX_PLATFORMS="cpu",
+                   PYTHONPATH=REPO)
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node", "2", "--backend", "cpu",
+             "--max_restarts", "2", "--elastic_level", "2",
+             "--min_procs", "1",
+             "--log_dir", str(tmp_path / "log"), str(script)],
+            cwd=REPO, env=env, timeout=300, capture_output=True, text=True)
+        assert r.returncode == 0, f"{r.stderr}"
+        assert "elastic scale-down: 2 -> 1 workers" in r.stderr, r.stderr
+        # the relaunched (attempt 1) world has ONE worker which finished
+        assert (out_dir / "done.0.1").exists()
+        assert not (out_dir / "done.1.1").exists()
+        import json as _json
+
+        final = _json.load(open(out_dir / "ckpt.json"))
+        assert final["world"] == 1 and final["attempt"] == 1
+        # resume happened: the restarted run continued past the crash step
+        assert final["step"] == 6
