@@ -19,7 +19,7 @@ import os
 import jax
 
 __all__ = [
-    "use_pallas", "set_use_pallas", "attention_impl",
+    "use_pallas", "use_pallas_explicit", "set_use_pallas", "attention_impl",
     "set_platform", "active_platform", "layer_norm_impl",
 ]
 
@@ -57,7 +57,9 @@ def active_platform() -> str:
         return "cpu"
 
 
-def use_pallas() -> bool:
+def _explicit_choice():
+    """The user's explicit Pallas on/off choice, or None when unset:
+    set_use_pallas override > PADDLE_TPU_USE_PALLAS env > FLAGS_use_pallas."""
     if _override is not None:
         return _override
     if _FORCE is not None:
@@ -67,6 +69,22 @@ def use_pallas() -> bool:
     fv = flag_value("FLAGS_use_pallas")
     if fv != "" and fv is not None:
         return str(fv).lower() in ("1", "true")
+    return None
+
+
+def use_pallas_explicit() -> bool:
+    """True only when the user EXPLICITLY forced Pallas on — never from the
+    platform default. For ops where the measured chip numbers show the XLA
+    composition matching or beating the kernel (e.g. the RNNT lattice), the
+    kernel stays available but opt-in."""
+    choice = _explicit_choice()
+    return bool(choice)
+
+
+def use_pallas() -> bool:
+    choice = _explicit_choice()
+    if choice is not None:
+        return choice
     return active_platform() == "tpu"
 
 

@@ -356,6 +356,29 @@ def rnnt_loss(logits, labels, logit_lengths, label_lengths, blank=0,
         u_valid = jnp.arange(U)[None, :] < u_lens[:, None]  # [B, U]
         emit_lp = jnp.where(u_valid[:, None, :], emit_lp, neg_inf)
 
+        # RNNT kernel policy: EXPLICIT opt-in only. Measured on chip
+        # (B16 T128 U48 V1024: 0.58x; B16 T256 U256 V128: 0.98x) XLA's
+        # scan-of-scan matches or beats the Pallas lattice at practical
+        # shapes — the kernel exists for parity/experimentation, not as
+        # the default (contrast CTC, where Pallas wins 1.76x).
+        from ...kernels import use_pallas_explicit
+        if use_pallas_explicit():
+            # import only when opted in: the scan path must keep working
+            # on jax builds without pallas.tpu
+            from ...kernels.rnnt import _lanes, fits_vmem as _rnnt_fits, \
+                rnnt_core_pallas
+        if use_pallas_explicit() and _rnnt_fits(T, U):
+
+            Up = _lanes(U + 1)
+            blank_tb = jnp.pad(
+                jnp.swapaxes(blank_lp, 0, 1), ((0, 0), (0, 0), (0, Up - U1)),
+                constant_values=neg_inf)  # [T, B, Up]
+            emit_tb = jnp.pad(
+                jnp.swapaxes(emit_lp, 0, 1), ((0, 0), (0, 0), (0, Up - U)),
+                constant_values=neg_inf)
+            loss = rnnt_core_pallas(blank_tb, emit_tb, t_lens, u_lens)
+            return _reduce(loss, reduction)
+
         # alpha[u] for the current t; init t=0: alpha[0]=0, alpha[u] = sum of
         # emits along u at t=0
         a0 = jnp.concatenate(

@@ -1,0 +1,100 @@
+"""Pallas RNNT lattice vs the scan path and the brute-force oracle
+(interpret mode on CPU). Reference capability: third_party/warprnnt."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.kernels import set_use_pallas
+from tests.test_asr import _brute_rnnt
+
+
+def _loss(logits, labels, tl, ul, pallas, reduction="none"):
+    set_use_pallas(pallas)
+    try:
+        return F.rnnt_loss(
+            paddle.to_tensor(logits), paddle.to_tensor(labels),
+            paddle.to_tensor(tl), paddle.to_tensor(ul),
+            reduction=reduction).numpy()
+    finally:
+        set_use_pallas(None)
+
+
+class TestRNNTPallas:
+    def test_matches_scan_and_brute(self):
+        rng = np.random.RandomState(0)
+        B, T, U, V = 3, 5, 3, 7
+        logits = rng.randn(B, T, U + 1, V).astype(np.float32)
+        labels = rng.randint(1, V, (B, U)).astype(np.int32)
+        tl = np.full(B, T, np.int32)
+        ul = np.full(B, U, np.int32)
+        got = _loss(logits, labels, tl, ul, pallas=True)
+        scan = _loss(logits, labels, tl, ul, pallas=False)
+        np.testing.assert_allclose(got, scan, rtol=1e-4, atol=1e-4)
+        lp = np.asarray(logits, np.float64)
+        lp = lp - np.log(np.exp(lp).sum(-1, keepdims=True))
+        want = [_brute_rnnt(lp[b], list(labels[b])) for b in range(B)]
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_ragged_lengths(self):
+        rng = np.random.RandomState(1)
+        B, T, U, V = 3, 6, 4, 5
+        logits = rng.randn(B, T, U + 1, V).astype(np.float32)
+        labels = rng.randint(1, V, (B, U)).astype(np.int32)
+        tl = np.array([4, 6, 2], np.int32)
+        ul = np.array([2, 4, 0], np.int32)
+        got = _loss(logits, labels, tl, ul, pallas=True)
+        for b in range(B):
+            lp = np.asarray(logits[b], np.float64)
+            lp = lp - np.log(np.exp(lp).sum(-1, keepdims=True))
+            want = _brute_rnnt(lp[:tl[b], :ul[b] + 1],
+                               list(labels[b][:ul[b]]))
+            np.testing.assert_allclose(got[b], want, rtol=1e-4)
+
+    def test_gradients_match_scan(self):
+        rng = np.random.RandomState(2)
+        B, T, U, V = 2, 5, 3, 6
+        logits = rng.randn(B, T, U + 1, V).astype(np.float32)
+        labels = rng.randint(1, V, (B, U)).astype(np.int32)
+        tl = np.array([5, 4], np.int32)
+        ul = np.array([3, 2], np.int32)
+        grads = {}
+        for flag in (True, False):
+            set_use_pallas(flag)
+            try:
+                t = paddle.to_tensor(logits.copy())
+                t.stop_gradient = False
+                loss = F.rnnt_loss(t, paddle.to_tensor(labels),
+                                   paddle.to_tensor(tl), paddle.to_tensor(ul),
+                                   reduction="sum")
+                loss.backward()
+                grads[flag] = t.grad.numpy()
+            finally:
+                set_use_pallas(None)
+        np.testing.assert_allclose(grads[True], grads[False],
+                                   rtol=1e-3, atol=1e-5)
+
+    def test_fastemit_and_mean_reduction(self):
+        rng = np.random.RandomState(3)
+        B, T, U, V = 2, 4, 2, 5
+        logits = rng.randn(B, T, U + 1, V).astype(np.float32)
+        labels = rng.randint(1, V, (B, U)).astype(np.int32)
+        tl = np.full(B, T, np.int32)
+        ul = np.full(B, U, np.int32)
+        for flag in (True, False):
+            set_use_pallas(flag)
+            try:
+                out = F.rnnt_loss(
+                    paddle.to_tensor(logits), paddle.to_tensor(labels),
+                    paddle.to_tensor(tl), paddle.to_tensor(ul),
+                    fastemit_lambda=0.01, reduction="mean")
+                if flag:
+                    pall = float(out.numpy())
+                else:
+                    np.testing.assert_allclose(float(out.numpy()), pall,
+                                               rtol=1e-4)
+            finally:
+                set_use_pallas(None)
