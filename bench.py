@@ -106,10 +106,15 @@ def main():
     # forbid the host-ahead dispatch every real training loop relies on
     bench = prof.Benchmark()
     bench.begin()
+    tot = None
     for i in range(steps):
         bx, by = bufs[i % n_bufs]
         loss = trainer.step(bx, by)
-    jax.block_until_ready(loss)
+        tot = loss if tot is None else tot + loss
+    # true completion sync: through a remote-chip tunnel,
+    # block_until_ready can return before the device finishes — a host
+    # readback of a value depending on EVERY step cannot
+    float(np.asarray(tot))
     bench.step(num_samples=batch * seq * steps)
     bench.end()
 

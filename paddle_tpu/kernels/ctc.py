@@ -32,30 +32,16 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from . import active_platform
+from ._lattice import (BT as _BT, NEG as _NEG, i0 as _i0,
+                       interpret_mode as _interpret_mode,
+                       lanes as _lanes, neg32 as _neg32)
 
 __all__ = ["ctc_loss_pallas"]
 
-_NEG = -1.0e30
-_BT = 8  # batch rows per grid program (one sublane tile)
 
 
-def _neg32():
-    return jnp.float32(_NEG)
 
 
-def _i0():
-    # index-map constants must be i32: under jax_enable_x64 a python literal
-    # traces as i64 and Mosaic rejects the mixed index tuple
-    return jnp.int32(0)
-
-
-def _interpret_mode() -> bool:
-    return active_platform() not in ("tpu",)
-
-
-def _lanes(s: int) -> int:
-    return max(128, ((s + 127) // 128) * 128)
 
 
 def _lse3(a, b, c):

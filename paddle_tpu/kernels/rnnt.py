@@ -32,28 +32,16 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from . import active_platform
+from ._lattice import (BT as _BT, NEG as _NEG, i0 as _i0,
+                       interpret_mode as _interpret_mode,
+                       lanes as _lanes, neg32 as _neg32)
 
 __all__ = ["rnnt_core_pallas", "fits_vmem"]
 
-_NEG = -1.0e30
-_BT = 8
 
 
-def _neg32():
-    return jnp.float32(_NEG)
 
 
-def _i0():
-    return jnp.int32(0)
-
-
-def _interpret_mode() -> bool:
-    return active_platform() not in ("tpu",)
-
-
-def _lanes(u: int) -> int:
-    return max(128, ((u + 127) // 128) * 128)
 
 
 def _lse2(a, b):

@@ -367,7 +367,9 @@ def rnnt_loss(logits, labels, logit_lengths, label_lengths, blank=0,
             # on jax builds without pallas.tpu
             from ...kernels.rnnt import _lanes, fits_vmem as _rnnt_fits, \
                 rnnt_core_pallas
-        if use_pallas_explicit() and _rnnt_fits(T, U):
+        else:
+            _rnnt_fits = None
+        if _rnnt_fits is not None and _rnnt_fits(T, U):
 
             Up = _lanes(U + 1)
             blank_tb = jnp.pad(
