@@ -34,7 +34,9 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ._lattice import (BT as _BT, NEG as _NEG, i0 as _i0,
                        interpret_mode as _interpret_mode,
-                       lanes as _lanes, neg32 as _neg32)
+                       lanes as _lanes, neg32 as _neg32,
+                       shift_left as _shift_left_f,
+                       shift_right as _shift_right_f)
 
 __all__ = ["ctc_loss_pallas"]
 
@@ -52,14 +54,8 @@ def _lse3(a, b, c):
     return jnp.where(m <= _neg32() / 2, _neg32(), out)
 
 
-def _shift_right(a, k, lane):
-    return jnp.where(lane < k, _neg32(), pltpu.roll(a, jnp.int32(k), axis=1))
-
-
-def _shift_left(a, k, lane, size):
-    # pltpu.roll is circular with non-negative shift: left-by-k == size-k
-    return jnp.where(lane >= size - k,
-                     _neg32(), pltpu.roll(a, jnp.int32(size - k), axis=1))
+_shift_right = _shift_right_f
+_shift_left = _shift_left_f
 
 
 def _alpha_kernel(logp_ref, same_ref, alpha_ref, *, T):

@@ -34,7 +34,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ._lattice import (BT as _BT, NEG as _NEG, i0 as _i0,
                        interpret_mode as _interpret_mode,
-                       lanes as _lanes, neg32 as _neg32)
+                       lanes as _lanes, neg32 as _neg32,
+                       shift_left as _shift_l, shift_right as _shift_r)
 
 __all__ = ["rnnt_core_pallas", "fits_vmem"]
 
@@ -51,13 +52,7 @@ def _lse2(a, b):
     return jnp.where(m <= _neg32() / 2, _neg32(), out)
 
 
-def _shift_r(a, k, lane, fill):
-    return jnp.where(lane < k, fill, pltpu.roll(a, jnp.int32(k), axis=1))
 
-
-def _shift_l(a, k, lane, size, fill):
-    return jnp.where(lane >= size - k, fill,
-                     pltpu.roll(a, jnp.int32(size - k), axis=1))
 
 
 def _cumsum_excl(x, lane, Up):

@@ -404,7 +404,19 @@ class Executor:
             # gradients() replays need to distinguish graph seeds from
             # memoized intermediates (which must NOT leak into jax.grad)
             cache["__seed_ids__"] = frozenset(cache)
-            return tuple(recompute_value(f, cache) for f in fetch_ts)
+            # control-flow replays (static.nn.cond/while_loop) re-invoke the
+            # user's builder closures, which read placeholder ._value —
+            # swap the traced values in for the duration of the trace
+            old = [(p, p._value) for p in placeholders + params]
+            for p, v in zip(placeholders, feed_vals):
+                p._value = v
+            for p, v in zip(params, param_vals):
+                p._value = v
+            try:
+                return tuple(recompute_value(f, cache) for f in fetch_ts)
+            finally:
+                for p, v in old:
+                    p._value = v
 
         # which placeholders do the fetches actually consume? (the
         # reference prunes the program to the fetch deps; unfed-but-needed
@@ -610,3 +622,6 @@ def load_inference_model(path_prefix, executor, **kwargs):
 
 class amp:  # namespace shim: paddle.static.amp
     from ..amp import auto_cast, decorate  # type: ignore
+
+
+from . import nn  # noqa: E402,F401  (static.nn builder + control-flow ops)

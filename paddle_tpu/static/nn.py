@@ -1,0 +1,295 @@
+"""paddle.static.nn — static-graph layer builders + control-flow ops
+(reference /root/reference/python/paddle/static/nn/__init__.py: fc, conv2d,
+batch_norm, embedding, ... and control_flow.py: cond/case/switch_case/
+while_loop).
+
+Builders create parameters with ``static.create_parameter`` (registered in
+the current Program + global Scope) and apply the SAME functional ops the
+dygraph layers use — the ops record replay closures on the placeholder
+graph, so ``Executor.run`` compiles them like any other static op.
+
+Control flow delegates to the dy2static conversion runtime: on concrete
+values python semantics hold; on traced values (inside a compiled program)
+``lax.cond``/``lax.while_loop`` are emitted — the role of the reference's
+ConditionalBlock/While ops.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from . import create_parameter
+
+__all__ = [
+    "fc", "embedding", "conv2d", "conv3d", "batch_norm", "layer_norm",
+    "group_norm", "instance_norm", "prelu", "cond", "case", "switch_case",
+    "while_loop",
+]
+
+
+def _F():
+    import paddle_tpu.nn.functional as F
+
+    return F
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """Fully-connected builder (reference static/nn/common.py fc)."""
+    shape = [int(s) for s in x.shape]
+    in_dim = int(np.prod(shape[num_flatten_dims:]))
+    w = create_parameter([in_dim, size], name=None if name is None else f"{name}.w")
+    out = None
+    F = _F()
+    flat = x.reshape(shape[:num_flatten_dims] + [in_dim]) \
+        if len(shape) != num_flatten_dims + 1 or shape[-1] != in_dim else x
+    from ..ops.linalg import matmul
+
+    out = matmul(flat, w)
+    if bias_attr is not False:
+        b = create_parameter([size], is_bias=True,
+                             name=None if name is None else f"{name}.b")
+        out = out + b
+    if activation:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32", name=None):
+    """Embedding lookup builder (reference static/nn/common.py embedding)."""
+    w = create_parameter(list(size), dtype=dtype,
+                         name=None if name is None else f"{name}.w")
+    F = _F()
+    return F.embedding(input, w, padding_idx=padding_idx)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCHW", name=None):
+    k = (filter_size if isinstance(filter_size, (list, tuple))
+         else (filter_size,) * 2)
+    in_ch = int(input.shape[1 if data_format == "NCHW" else -1])
+    w = create_parameter([num_filters, in_ch // groups, *k],
+                         name=None if name is None else f"{name}.w")
+    b = (None if bias_attr is False else
+         create_parameter([num_filters], is_bias=True,
+                          name=None if name is None else f"{name}.b"))
+    F = _F()
+    out = F.conv2d(input, w, bias=b, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups, data_format=data_format)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCDHW", name=None):
+    k = (filter_size if isinstance(filter_size, (list, tuple))
+         else (filter_size,) * 3)
+    in_ch = int(input.shape[1 if data_format == "NCDHW" else -1])
+    w = create_parameter([num_filters, in_ch // groups, *k],
+                         name=None if name is None else f"{name}.w")
+    b = (None if bias_attr is False else
+         create_parameter([num_filters], is_bias=True,
+                          name=None if name is None else f"{name}.b"))
+    F = _F()
+    out = F.conv3d(input, w, bias=b, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups, data_format=data_format)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5, param_attr=None,
+               bias_attr=None, data_layout="NCHW", is_test=False, name=None):
+    """Static batch_norm: batch statistics in the training graph (the
+    reference's training-mode path; serving graphs use the exported
+    inference program where statistics are frozen)."""
+    from ..core.tensor import to_tensor
+
+    C = int(input.shape[1 if data_layout == "NCHW" else -1])
+    one = np.ones(C, np.float32)
+    scale = create_parameter([C], default_initializer=lambda s: one,
+                             name=None if name is None else f"{name}.scale")
+    bias = create_parameter([C], is_bias=True,
+                            name=None if name is None else f"{name}.bias")
+    F = _F()
+    # training graph: batch statistics (is_test graphs would come from the
+    # exported inference program, where stats are constants)
+    rm = to_tensor(np.zeros(C, np.float32))
+    rv = to_tensor(np.ones(C, np.float32))
+    out = F.batch_norm(input, rm, rv, weight=scale, bias=bias,
+                       training=not is_test, momentum=momentum,
+                       epsilon=epsilon, data_format=data_layout)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    shape = [int(s) for s in input.shape[begin_norm_axis:]]
+    w = create_parameter(shape, default_initializer=lambda s: np.ones(s, np.float32)) if scale else None
+    b = create_parameter(shape, is_bias=True) if shift else None
+    F = _F()
+    out = F.layer_norm(input, normalized_shape=shape, weight=w, bias=b,
+                       epsilon=epsilon)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    C = int(input.shape[1 if data_layout == "NCHW" else -1])
+    w = create_parameter([C], default_initializer=lambda s: np.ones(s, np.float32))
+    b = create_parameter([C], is_bias=True)
+    F = _F()
+    out = F.group_norm(input, num_groups=groups, weight=w, bias=b,
+                       epsilon=epsilon, data_format=data_layout)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    C = int(input.shape[1])
+    w = create_parameter([C], default_initializer=lambda s: np.ones(s, np.float32))
+    b = create_parameter([C], is_bias=True)
+    F = _F()
+    return F.instance_norm(input, weight=w, bias=b, eps=epsilon)
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    if mode == "all":
+        shape = [1]
+    elif mode == "channel":
+        shape = [int(x.shape[1 if data_format == "NCHW" else -1])]
+    else:  # element
+        shape = [int(s) for s in x.shape[1:]]
+    a = create_parameter(
+        shape, default_initializer=lambda s: np.full(s, 0.25, np.float32))
+    F = _F()
+    return F.prelu(x, a)
+
+
+# -- control flow (reference static/nn/control_flow.py) ----------------------
+#
+# Build-time predicates are concrete (placeholders hold zeros), so the cond
+# must be RECORDED, not taken: each op returns tensors carrying a replay
+# closure that re-invokes the user's branch builders at compile time, when
+# placeholders hold traced values — the dy2static runtime then lowers to
+# lax.cond / lax.while_loop. Restriction (as in the reference): don't
+# create parameters inside a branch/body; build them outside.
+
+
+def _record_control_flow(build_outputs, replay_fn):
+    """Wrap build-time outputs with a replay closure (the pattern
+    static.gradients uses)."""
+    from ..jit.dy2static.runtime import _flatten
+
+    leaves, treedef = _flatten(build_outputs)
+    outs: list = []
+
+    def replay(cache):
+        if outs and id(outs[0]) in cache:
+            return [cache[id(o)] for o in outs]
+        vals = replay_fn(cache)
+        for o, v in zip(outs, vals):
+            cache[id(o)] = v
+        return vals
+
+    wrapped = []
+    for i, leaf in enumerate(leaves):
+        v = leaf._value if isinstance(leaf, Tensor) else leaf
+        t = Tensor._wrap(v)
+        t._recompute = (replay, i)
+        outs.append(t)
+        wrapped.append(t)
+    import jax.tree_util as jtu
+
+    return jtu.tree_unflatten(treedef, wrapped)
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """paddle.static.nn.cond: both branches trace under lax.cond in the
+    compiled program; concrete predicates keep python semantics
+    (ConditionalBlockOp role)."""
+    from ..core.autograd import no_grad, pure_mode
+    from ..core.dispatch import recompute_value
+    from ..jit.dy2static import runtime as _jst
+
+    t_fn = true_fn or (lambda: None)
+    f_fn = false_fn or (lambda: None)
+    from ..core.autograd import in_pure_mode
+
+    if in_pure_mode():
+        # invoked from inside another control-flow replay (e.g. nested
+        # case): an intermediate pred tensor's ._value is the stale
+        # build-time constant — re-replay it against the CURRENT
+        # (traced) placeholder values and convert directly
+        p = (recompute_value(pred, {}) if isinstance(pred, Tensor) else pred)
+        return _jst.convert_ifelse(Tensor._wrap(p), t_fn, f_fn)
+    # build-time value: concrete pred picks one branch eagerly
+    build_out = _jst.convert_ifelse(pred, t_fn, f_fn)
+    pred_t = pred
+
+    def replay_fn(cache):
+        p = recompute_value(pred_t, cache) if isinstance(pred_t, Tensor) else pred_t
+        with pure_mode(), no_grad():
+            out = _jst.convert_ifelse(Tensor._wrap(p), t_fn, f_fn)
+        leaves, _ = _jst._flatten(out)
+        return [l._value if isinstance(l, Tensor) else l for l in leaves]
+
+    return _record_control_flow(build_out, replay_fn)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """First predicate that holds wins (reference control_flow.py case)."""
+    if not pred_fn_pairs:
+        raise ValueError("case needs at least one (pred, fn) pair")
+    (pred, fn), rest = pred_fn_pairs[0], pred_fn_pairs[1:]
+    if not rest:
+        return cond(pred, fn, default if default is not None else fn)
+    return cond(pred, fn, lambda: case(rest, default))
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Integer dispatch (reference control_flow.py switch_case)."""
+    pairs = sorted(branch_fns.items() if isinstance(branch_fns, dict)
+                   else list(enumerate(branch_fns)))
+    pred_fn = [(branch_index == int(i), fn) for i, fn in pairs]
+    return case(pred_fn, default=default)
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """paddle.static.nn.while_loop -> lax.while_loop in the compiled program
+    (WhileOp role). loop_vars is a list; returns the final list."""
+    from ..core.autograd import no_grad, pure_mode
+    from ..core.dispatch import recompute_value
+    from ..jit.dy2static import runtime as _jst
+
+    body_t = lambda *vs: tuple(body_fn(*vs))
+    from ..core.autograd import in_pure_mode
+
+    if in_pure_mode():  # nested inside another control-flow replay
+        vals = [recompute_value(v, {}) if isinstance(v, Tensor) else v
+                for v in loop_vars]
+        return list(_jst.convert_while(
+            cond_fn, body_t, tuple(Tensor._wrap(v) for v in vals)))
+    build_out = list(_jst.convert_while(cond_fn, body_t, tuple(loop_vars)))
+    init_vars = list(loop_vars)
+
+    def replay_fn(cache):
+        vals = [recompute_value(v, cache) if isinstance(v, Tensor) else v
+                for v in init_vars]
+        with pure_mode(), no_grad():
+            out = _jst.convert_while(
+                cond_fn, body_t, tuple(Tensor._wrap(v) for v in vals))
+        return [o._value if isinstance(o, Tensor) else o for o in out]
+
+    return list(_record_control_flow(tuple(build_out), replay_fn))

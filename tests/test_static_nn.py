@@ -1,0 +1,105 @@
+"""paddle.static.nn builders + control-flow ops over the compiled executor
+(reference python/paddle/static/nn/ + control_flow.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _scoped():
+    scope = paddle.static.Scope()
+    return paddle.static.scope_guard(scope), scope
+
+
+class TestBuilders:
+    def test_fc_trains_through_executor(self):
+        guard, scope = _scoped()
+        with guard:
+            main = paddle.static.Program()
+            with paddle.static.program_guard(main):
+                x = paddle.static.data("x", [None, 6], "float32")
+                h = paddle.static.nn.fc(x, 8, activation="relu", name="fc1")
+                out = paddle.static.nn.fc(h, 2, name="fc2")
+                loss = paddle.mean(out * out)
+                w = main._params["fc1.w"]
+                (gw,) = paddle.static.gradients([loss], [w])
+            exe = paddle.static.Executor()
+            f = np.random.RandomState(0).rand(4, 6).astype(np.float32)
+            l1, g = exe.run(main, feed={"x": f}, fetch_list=[loss, gw])
+            assert g.shape == (6, 8)
+            # one SGD step via scope write-back reduces the loss
+            scope.var("fc1.w").set(np.asarray(scope.find_var("fc1.w")._value) - 0.5 * g)
+            (l2,) = exe.run(main, feed={"x": f}, fetch_list=[loss])
+            assert l2 < l1
+
+    def test_embedding_conv_and_norms_build(self):
+        guard, scope = _scoped()
+        with guard:
+            main = paddle.static.Program()
+            with paddle.static.program_guard(main):
+                ids = paddle.static.data("ids", [None, 5], "int64")
+                emb = paddle.static.nn.embedding(ids, (30, 8))
+                img = paddle.static.data("img", [None, 3, 8, 8], "float32")
+                c = paddle.static.nn.conv2d(img, 4, 3, padding=1, act="relu")
+                bn = paddle.static.nn.batch_norm(c)
+                ln = paddle.static.nn.layer_norm(emb, begin_norm_axis=2)
+                gn = paddle.static.nn.group_norm(c, groups=2)
+                pr = paddle.static.nn.prelu(c, mode="channel")
+            exe = paddle.static.Executor()
+            outs = exe.run(main, feed={
+                "ids": np.random.RandomState(0).randint(0, 30, (2, 5)),
+                "img": np.random.RandomState(1).rand(2, 3, 8, 8).astype(np.float32),
+            }, fetch_list=[emb, bn, ln, gn, pr])
+            assert outs[0].shape == (2, 5, 8)
+            assert outs[1].shape == (2, 4, 8, 8)
+            # batch_norm output is normalized per channel
+            np.testing.assert_allclose(outs[1].mean(axis=(0, 2, 3)), 0.0,
+                                       atol=1e-4)
+
+
+class TestControlFlow:
+    def test_cond_in_compiled_program(self):
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            x = paddle.static.data("x", [3], "float32")
+            out = paddle.static.nn.cond(
+                paddle.sum(x) > 0, lambda: x * 2, lambda: x - 1)
+        exe = paddle.static.Executor()
+        (a,) = exe.run(main, feed={"x": np.ones(3, np.float32)}, fetch_list=[out])
+        np.testing.assert_allclose(a, 2.0)
+        # SAME compiled program takes the other branch
+        (b,) = exe.run(main, feed={"x": -np.ones(3, np.float32)}, fetch_list=[out])
+        np.testing.assert_allclose(b, -2.0)
+        assert exe._trace_count == 1
+
+    def test_switch_case_and_case(self):
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            i = paddle.static.data("i", [], "int64")
+            x = paddle.static.data("x", [2], "float32")
+            out = paddle.static.nn.switch_case(
+                i, {0: lambda: x + 1, 1: lambda: x * 10},
+                default=lambda: x * 0)
+        exe = paddle.static.Executor()
+        f = np.array([1.0, 2.0], np.float32)
+        (o0,) = exe.run(main, feed={"i": np.int64(0), "x": f}, fetch_list=[out])
+        (o1,) = exe.run(main, feed={"i": np.int64(1), "x": f}, fetch_list=[out])
+        (o9,) = exe.run(main, feed={"i": np.int64(9), "x": f}, fetch_list=[out])
+        np.testing.assert_allclose(o0, f + 1)
+        np.testing.assert_allclose(o1, f * 10)
+        np.testing.assert_allclose(o9, 0.0)
+
+    def test_while_loop_compiled(self):
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            x = paddle.static.data("x", [2], "float32")
+            i0 = paddle.zeros([], "float32")
+            final_i, final_x = paddle.static.nn.while_loop(
+                lambda i, v: paddle.max(paddle.abs(v)) > 1.0,
+                lambda i, v: [i + 1, v / 2],
+                [i0, x])
+        exe = paddle.static.Executor()
+        (ni, nv) = exe.run(main, feed={"x": np.array([8.0, 4.0], np.float32)},
+                           fetch_list=[final_i, final_x])
+        assert float(ni) == 3.0
+        np.testing.assert_allclose(nv, [1.0, 0.5])

@@ -67,10 +67,10 @@ def _bench_config(name, build, steps):
     params, buffers = functional_state(net)
     init, update = _adamw()
     opt_state = init(params)
-    # NO buffer donation here: through the remote-chip tunnel, donated
-    # (identity-stable) buffers make every step look like a repeat of the
-    # previous execution and get memoized — measured 30x-inflated numbers.
-    # Fresh per-step batches + undonated state keep the measurement honest.
+    # Honest timing through the remote-chip tunnel requires (verified by
+    # experiment): distinct per-step batches (byte-identical repeat
+    # executions are memoized by the terminal) and a final host READBACK
+    # (block_until_ready can return before the device finishes).
     step = jax.jit(_train_step_fn(net, loss_fn, update))
     rng = jax.random.PRNGKey(0)
 
