@@ -20,7 +20,7 @@ import numpy as np
 
 from .rpc import _recv_msg, _send_msg
 
-__all__ = ["ParameterServer", "PSClient"]
+__all__ = ["ParameterServer", "PSClient", "GeoCommunicator"]
 
 
 class _DenseTable:
@@ -33,6 +33,11 @@ class _DenseTable:
 
     def push(self, grad):
         self.value -= self.lr * np.asarray(grad, np.float32)
+
+    def apply_delta(self, delta):
+        """GeoSGD: workers send parameter DELTAS (local_new - last_synced),
+        applied additively — no server-side learning rate."""
+        self.value += np.asarray(delta, np.float32)
 
 
 class _SparseTable:
@@ -61,6 +66,12 @@ class _SparseTable:
         for i, g in zip(np.asarray(ids).ravel(), grads):
             self._row(i)  # materialize
             self.rows[int(i)] = self.rows[int(i)] - self.lr * g
+
+    def apply_delta(self, ids, deltas):
+        deltas = np.asarray(deltas, np.float32)
+        for i, d in zip(np.asarray(ids).ravel(), deltas):
+            self._row(i)
+            self.rows[int(i)] = self.rows[int(i)] + d
 
 
 class ParameterServer:
@@ -134,6 +145,15 @@ class ParameterServer:
             if op == "push_sparse":
                 with self._lock:
                     self._tables[req["table"]].push(req["ids"], req["grad"])
+                return {"ok": True}
+            if op == "push_delta_dense":
+                with self._lock:
+                    self._tables[req["table"]].apply_delta(req["delta"])
+                return {"ok": True}
+            if op == "push_delta_sparse":
+                with self._lock:
+                    self._tables[req["table"]].apply_delta(req["ids"],
+                                                           req["delta"])
                 return {"ok": True}
             if op == "create_dense":
                 self.create_dense_table(req["table"], req["value"], req["lr"])
@@ -222,6 +242,14 @@ class PSClient:
         return self._call(op="pull_sparse", table=table,
                           ids=np.asarray(ids, np.int64))
 
+    def push_dense_delta(self, table, delta):
+        self._call(op="push_delta_dense", table=table,
+                   delta=np.asarray(delta, np.float32))
+
+    def push_sparse_delta(self, table, ids, delta):
+        self._call(op="push_delta_sparse", table=table,
+                   ids=np.asarray(ids), delta=np.asarray(delta, np.float32))
+
     def push_sparse(self, table, ids, grad):
         return self._call(op="push_sparse", table=table,
                           ids=np.asarray(ids, np.int64),
@@ -236,3 +264,75 @@ class PSClient:
 
     def close(self):
         self._sock.close()
+
+
+class GeoCommunicator:
+    """GeoSGD async communicator (reference
+    paddle/fluid/distributed/ps/service communicator GEO mode +
+    fleet runtime the_one_ps.py): workers run LOCAL optimizer steps and
+    every ``geo_steps`` push the parameter DELTA accumulated since the last
+    sync, then pull the fresh global value. Pushes drain on a background
+    thread (the async half); pulls are synchronous (the consistency point).
+    """
+
+    def __init__(self, client: PSClient, geo_steps=10):
+        import queue
+
+        self.client = client
+        self.geo_steps = int(geo_steps)
+        self._baseline: dict[str, np.ndarray] = {}
+        self._step = 0
+        self._q: "queue.Queue" = queue.Queue()
+        self._stop = threading.Event()
+        self._err = None
+        self._thread = threading.Thread(target=self._drain, daemon=True)
+        self._thread.start()
+
+    def _drain(self):
+        while not self._stop.is_set():
+            try:
+                item = self._q.get(timeout=0.1)
+            except Exception:
+                continue
+            try:
+                table, delta = item
+                self.client.push_dense_delta(table, delta)
+            except Exception as e:  # surfaced on the next sync
+                self._err = e
+            finally:
+                self._q.task_done()
+
+    def register(self, table, value):
+        """Start tracking a table; baseline = the current global value.
+        Returns a COPY — in-place updates of the returned array must not
+        mutate the baseline, or every delta would compute as zero."""
+        self._baseline[table] = np.array(value, np.float32, copy=True)
+        return self._baseline[table].copy()
+
+    def maybe_sync(self, params: dict) -> dict:
+        """Call once per local step with {table: local value}. On sync
+        steps: enqueue deltas, wait for the queue to drain, pull fresh
+        globals, rebase; returns the (possibly refreshed) params."""
+        self._step += 1
+        if self._step % self.geo_steps:
+            return params
+        for table, val in params.items():
+            delta = np.asarray(val, np.float32) - self._baseline[table]
+            self._q.put((table, delta))
+        self._q.join()  # deltas applied before the pull
+        # check AFTER the drain, BEFORE rebasing: a failed push must raise
+        # while the caller can still retry — rebasing onto a server value
+        # that is missing the delta would drop the local progress silently
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise err
+        fresh = {}
+        for table in params:
+            v = np.asarray(self.client.pull_dense(table), np.float32)
+            self._baseline[table] = v.copy()
+            fresh[table] = v
+        return fresh
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join()

@@ -39,10 +39,11 @@ def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
     shape = [int(s) for s in x.shape]
     in_dim = int(np.prod(shape[num_flatten_dims:]))
     w = create_parameter([in_dim, size], name=None if name is None else f"{name}.w")
-    out = None
     F = _F()
-    flat = x.reshape(shape[:num_flatten_dims] + [in_dim]) \
-        if len(shape) != num_flatten_dims + 1 or shape[-1] != in_dim else x
+    # -1 keeps the batch dims dynamic (the build-time placeholder shape has
+    # None dims concretized to 1 — never bake those in)
+    flat = x if len(shape) == num_flatten_dims + 1 and shape[-1] == in_dim \
+        else x.reshape([-1, in_dim])
     from ..ops.linalg import matmul
 
     out = matmul(flat, w)
@@ -115,13 +116,17 @@ def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5, param_attr=None,
                              name=None if name is None else f"{name}.scale")
     bias = create_parameter([C], is_bias=True,
                             name=None if name is None else f"{name}.bias")
+    if is_test:
+        raise NotImplementedError(
+            "static.nn.batch_norm(is_test=True) has no learned running "
+            "statistics in this builder — export the trained program with "
+            "save_inference_model and run THAT for eval/serving")
     F = _F()
-    # training graph: batch statistics (is_test graphs would come from the
-    # exported inference program, where stats are constants)
+    # training graph: batch statistics
     rm = to_tensor(np.zeros(C, np.float32))
     rv = to_tensor(np.ones(C, np.float32))
     out = F.batch_norm(input, rm, rv, weight=scale, bias=bias,
-                       training=not is_test, momentum=momentum,
+                       training=True, momentum=momentum,
                        epsilon=epsilon, data_format=data_layout)
     if act:
         out = getattr(F, act)(out)
@@ -169,12 +174,14 @@ def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
         shape = [1]
     elif mode == "channel":
         shape = [int(x.shape[1 if data_format == "NCHW" else -1])]
-    else:  # element
-        shape = [int(s) for s in x.shape[1:]]
+    else:
+        raise NotImplementedError(
+            "prelu mode='element' needs a per-element weight; the functional "
+            "prelu supports scalar/per-channel weights (as the common cases)")
     a = create_parameter(
         shape, default_initializer=lambda s: np.full(s, 0.25, np.float32))
     F = _F()
-    return F.prelu(x, a)
+    return F.prelu(x, a, data_format=data_format)
 
 
 # -- control flow (reference static/nn/control_flow.py) ----------------------
@@ -185,6 +192,48 @@ def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
 # placeholders hold traced values — the dy2static runtime then lowers to
 # lax.cond / lax.while_loop. Restriction (as in the reference): don't
 # create parameters inside a branch/body; build them outside.
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def _swap_captured(fns, cache):
+    """Branch/body closures may capture INTERMEDIATE tensors (h = x * 2)
+    whose ._value is the stale build-time constant at replay time —
+    resolve every captured Tensor through the replay cache and swap the
+    live value in for the duration of the re-invocation."""
+    from ..core.dispatch import recompute_value
+
+    seen: dict[int, Tensor] = {}
+
+    def collect(fn, depth=0):
+        if depth > 4 or not callable(fn):
+            return
+        for cell in (getattr(fn, "__closure__", None) or ()):
+            try:
+                v = cell.cell_contents
+            except ValueError:
+                continue
+            if isinstance(v, Tensor):
+                seen.setdefault(id(v), v)
+            elif isinstance(v, (list, tuple)):
+                for x in v:
+                    if isinstance(x, Tensor):
+                        seen.setdefault(id(x), x)
+            elif callable(v):
+                collect(v, depth + 1)
+
+    for f in fns:
+        collect(f)
+    old = {i: t._value for i, t in seen.items()}
+    for i, t in seen.items():
+        t._value = recompute_value(t, cache)
+    try:
+        yield
+    finally:
+        for i, t in seen.items():
+            t._value = old[i]
 
 
 def _record_control_flow(build_outputs, replay_fn):
@@ -240,7 +289,7 @@ def cond(pred, true_fn=None, false_fn=None, name=None):
 
     def replay_fn(cache):
         p = recompute_value(pred_t, cache) if isinstance(pred_t, Tensor) else pred_t
-        with pure_mode(), no_grad():
+        with pure_mode(), no_grad(), _swap_captured((t_fn, f_fn), cache):
             out = _jst.convert_ifelse(Tensor._wrap(p), t_fn, f_fn)
         leaves, _ = _jst._flatten(out)
         return [l._value if isinstance(l, Tensor) else l for l in leaves]
@@ -287,7 +336,7 @@ def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
     def replay_fn(cache):
         vals = [recompute_value(v, cache) if isinstance(v, Tensor) else v
                 for v in init_vars]
-        with pure_mode(), no_grad():
+        with pure_mode(), no_grad(), _swap_captured((cond_fn, body_fn), cache):
             out = _jst.convert_while(
                 cond_fn, body_t, tuple(Tensor._wrap(v) for v in vals))
         return [o._value if isinstance(o, Tensor) else o for o in out]
