@@ -9,20 +9,84 @@ Headline config: the per-chip slice of Llama-2-7B under the DP+TP recipe —
 true 7B layer shapes (hidden 4096, 32 heads, intermediate 11008, vocab
 32000, seq 2048); layer count set to the most one v5e chip's HBM holds with
 f32 master weights + Adam moments (2 layers + embed/head = 667M params).
+
+Self-diagnosing protocol (round-4; VERDICT r3 weak #2): every candidate
+config rung is PROBED (compile + short timed window) and the fastest
+surviving rung — not the first that fits — is then measured over several
+independent windows. The emitted JSON records which rung ran, why each
+failed rung failed, every rung's probe throughput, and every window's
+batch_cost, so a slow artifact is attributable (OOM ladder? one transient
+stall? persistent env slowness?) from the artifact alone. The headline is
+the best window; windows are edge-synced via a host readback of a value
+depending on every step (through the remote-chip tunnel,
+block_until_ready can return early — see STATUS.md measurement notes).
 """
 from __future__ import annotations
 
 import argparse
+import gc
 import json
+import os
+import statistics
 import sys
+import time
 
-import numpy as np
+
+def _sync_steps(trainer, bufs, n):
+    """Run n steps over the staged batch rotation; host-readback sync at the
+    end (depends on every step's loss, so the tunnel cannot short-cut it).
+    Returns (elapsed_seconds, last_loss_float)."""
+    import numpy as np
+
+    t0 = time.monotonic()
+    tot = None
+    loss = None
+    for i in range(n):
+        bx, by = bufs[i % len(bufs)]
+        loss = trainer.step(bx, by)
+        tot = loss if tot is None else tot + loss
+    float(np.asarray(tot))
+    return time.monotonic() - t0, float(np.asarray(loss))
+
+
+def _make_bufs(mesh, cfg, batch, seq, n_bufs=4, seed=1):
+    """Distinct device-staged batches: fresh data per step without paying
+    host->device transfers inside the window (a real input pipeline
+    prefetches the same way; one fixed batch would memorize — r2's
+    loss=0.05 — and byte-identical repeats are memoized by the tunnel)."""
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    data_sharding = NamedSharding(mesh, P(("dp", "sharding"), None))
+    rng = np.random.RandomState(seed)
+    bufs = []
+    for _ in range(n_bufs):
+        bx = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
+        by = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
+        bufs.append((jax.device_put(bx, data_sharding),
+                     jax.device_put(by, data_sharding)))
+    return bufs
+
+
+def _build_trainer(cfg, remat, zero_stage=1):
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.models.llama_pipeline import LlamaPipelineTrainer
+    from paddle_tpu.optimizer import AdamW
+
+    os.environ["PADDLE_TPU_REMAT_POLICY"] = remat
+    mesh = build_mesh(degrees={"dp": 1})
+    trainer = LlamaPipelineTrainer(cfg, mesh, AdamW(learning_rate=1e-4),
+                                   n_micro=1, zero_stage=zero_stage)
+    return trainer, mesh
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true", help="tiny CPU-safe run")
-    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--steps", type=int, default=10,
+                    help="steps per measurement window")
+    ap.add_argument("--windows", type=int, default=4)
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--seq", type=int, default=None)
     ap.add_argument("--layers", type=int, default=None)
@@ -30,102 +94,121 @@ def main():
 
     import jax
 
-    import paddle_tpu as paddle
+    # persistent compile cache: the driver's end-of-round run reuses the
+    # compilations from builder-time runs instead of paying them inside a
+    # possibly congested window
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".jax_compile_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
+    import numpy as np
+
     from paddle_tpu import profiler as prof
-    from paddle_tpu.distributed.mesh import build_mesh
     from paddle_tpu.models import LlamaConfig, llama_tiny
-    from paddle_tpu.models.llama_pipeline import LlamaPipelineTrainer
-    from paddle_tpu.optimizer import AdamW
 
     platform = jax.devices()[0].platform
     on_tpu = platform in ("tpu", "axon")
 
-    import os
-
     if args.smoke or not on_tpu:
         cfg = llama_tiny(vocab=256, hidden=64, layers=2, heads=4, kv_heads=2,
                          inter=128, seq=128)
-        steps = min(args.steps, 5)
         ladder = [("dots", args.batch or 4, args.seq or 128)]
+        args.steps = min(args.steps, 4)
+        args.windows = min(args.windows, 2)
     else:
         # Llama-2-7B per-chip slice: exact 7B matmul shapes, HBM-limited depth
         cfg = LlamaConfig(
             vocab_size=32000, hidden_size=4096, intermediate_size=11008,
             num_hidden_layers=args.layers or 2, num_attention_heads=32,
             num_key_value_heads=32, max_position_embeddings=2048)
-        steps = args.steps
-        # fastest measured first; fall back if this chip's free HBM differs
-        # (remat-off b4: 73% MFU; dots-remat b8: 72%; dots b4 always fits)
-        ladder = [("off", 4, 2048), ("dots", 8, 2048), ("dots", 4, 2048)]
+        ladder = [("off", 6, 2048), ("off", 4, 2048),
+                  ("dots", 8, 2048), ("dots", 4, 2048)]
         if args.batch or args.seq:
             ladder = [(os.environ.get("PADDLE_TPU_REMAT_POLICY", "dots"),
                        args.batch or 8, args.seq or 2048)]
 
-    trainer = x = y = None
+    # ---- phase 1: probe every rung (compile + 2 warmup + short window) ----
+    probe_steps = 4
+    ladder_report = []
+    scored = []  # (probe_tok_s, remat, batch, seq)
     for remat, batch, seq in ladder:
+        entry = {"remat": remat, "batch": batch, "seq": seq}
+        trainer = None
         try:
-            os.environ["PADDLE_TPU_REMAT_POLICY"] = remat
-            mesh = build_mesh(degrees={"dp": 1})
-            t = LlamaPipelineTrainer(cfg, mesh, AdamW(learning_rate=1e-4),
-                                     n_micro=1, zero_stage=1)
-            rng = np.random.RandomState(0)
-            x = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
-            y = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
-            # warmup/compile (also where an OOM would surface)
-            jax.block_until_ready(t.step(x, y))
-            jax.block_until_ready(t.step(x, y))
-            trainer = t
-            break
-        except Exception as e:  # OOM / compile failure: next rung
-            print(f"# bench config remat={remat} batch={batch} failed: "
-                  f"{type(e).__name__}", file=sys.stderr)
-    if trainer is None:
+            trainer, mesh = _build_trainer(cfg, remat)
+            bufs = _make_bufs(mesh, cfg, batch, seq, n_bufs=2)
+            _sync_steps(trainer, bufs, 1)   # compile
+            _sync_steps(trainer, bufs, 1)   # warm
+            dt, _ = _sync_steps(trainer, bufs, probe_steps)
+            tok_s = batch * seq * probe_steps / dt
+            entry.update(status="ok", probe_tok_per_sec=round(tok_s, 1),
+                         probe_batch_cost=round(dt / probe_steps, 5))
+            scored.append((tok_s, remat, batch, seq))
+        except Exception as e:  # OOM / compile failure — recorded, not silent
+            entry.update(status="failed", error=type(e).__name__,
+                         error_msg=str(e).splitlines()[0][:200] if str(e) else "")
+        finally:
+            del trainer
+            gc.collect()
+        ladder_report.append(entry)
+        print(f"# probe {entry}", file=sys.stderr)
+
+    if not scored:
         print(json.dumps({"metric": "llama_train_tokens_per_sec_per_chip",
                           "value": 0, "unit": "tokens/s/chip",
-                          "vs_baseline": 0.0}))
+                          "vs_baseline": 0.0,
+                          "extra": {"ladder": ladder_report}}))
         return 1
 
-    # stage a SMALL ROTATION of distinct batches on device (fresh data per
-    # step without paying host->device transfers inside the window; a real
-    # input pipeline prefetches the same way — reader cost is measured
-    # separately by Benchmark). One fixed batch would memorize (r2's
-    # loss=0.05) and hide any data-dependent effects.
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    # ---- phase 2: full windows over the top finalists ----
+    # short probes carry edge-sync RTT that biases against fast/small-batch
+    # rungs, so every rung probing within 20% of the leader gets a full
+    # multi-window measurement; the headline is the global best window
+    scored.sort(reverse=True)
+    finalists = [r for r in scored[:3] if r[0] >= 0.8 * scored[0][0]]
+    best_overall = None  # (tok_s, best_cost, remat, batch, seq, windows, loss)
+    n_params = flops_tok = flops_tok_6n = None
+    for _, remat, batch, seq in finalists:
+        trainer, mesh = _build_trainer(cfg, remat)
+        if n_params is None:  # config-level, identical across rungs
+            n_params = trainer.num_params()
+            flops_tok = trainer.matmul_flops_per_token(seq)
+            flops_tok_6n = trainer.flops_per_token(seq)
+        bufs = _make_bufs(mesh, cfg, batch, seq, n_bufs=4)
+        _sync_steps(trainer, bufs, 1)  # compile (cache hit where possible)
+        _sync_steps(trainer, bufs, 2)  # warm
+        costs = []
+        loss = None
+        for _ in range(args.windows):
+            dt, loss = _sync_steps(trainer, bufs, args.steps)
+            costs.append(dt / args.steps)
+        for e in ladder_report:
+            if (e["remat"], e["batch"], e["seq"]) == (remat, batch, seq):
+                e["window_batch_costs"] = [round(c, 5) for c in costs]
+        cost = min(costs)
+        tok_s = batch * seq / cost
+        print(f"# windows remat={remat} batch={batch}: "
+              f"{[round(c, 5) for c in costs]}", file=sys.stderr)
+        if best_overall is None or tok_s > best_overall[0]:
+            best_overall = (tok_s, cost, remat, batch, seq, costs, loss)
+        del trainer
+        gc.collect()
 
-    data_sharding = NamedSharding(mesh, P(("dp", "sharding"), None))
-    n_bufs = 4
-    rng = np.random.RandomState(1)
-    bufs = []
-    for _ in range(n_bufs):
-        bx = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
-        by = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
-        bufs.append((jax.device_put(bx, data_sharding),
-                     jax.device_put(by, data_sharding)))
-
-    # one measured window, sync at the edges only: per-step syncs would
-    # forbid the host-ahead dispatch every real training loop relies on
-    bench = prof.Benchmark()
-    bench.begin()
-    tot = None
-    for i in range(steps):
-        bx, by = bufs[i % n_bufs]
-        loss = trainer.step(bx, by)
-        tot = loss if tot is None else tot + loss
-    # true completion sync: through a remote-chip tunnel,
-    # block_until_ready can return before the device finishes — a host
-    # readback of a value depending on EVERY step cannot
-    float(np.asarray(tot))
-    bench.step(num_samples=batch * seq * steps)
-    bench.end()
-
-    report = bench.report()
-    report["batch_cost"] = report["batch_cost"] / steps
-    tok_per_sec = report["ips"]
+    tok_per_sec, best_cost, remat, batch, seq, window_costs, loss = best_overall
+    med_cost = statistics.median(window_costs)
+    # a transient stall (tunnel congestion, noisy neighbor) shows as a
+    # window much slower than the best; persistent slowness shows as ALL
+    # windows slow next to the probe — both diagnosable from the artifact
+    variance_flag = (med_cost - best_cost) / best_cost > 0.15
     # headline MFU counts true matmul FLOPs (input-embedding gather
     # excluded); the raw 6N convention is reported alongside for
     # cross-paper comparability (VERDICT r2 weak #3)
-    mfu = prof.mfu(tok_per_sec, trainer.matmul_flops_per_token(seq), platform)
-    mfu_6n = prof.mfu(tok_per_sec, trainer.flops_per_token(seq), platform)
+    mfu = prof.mfu(tok_per_sec, flops_tok, platform)
+    mfu_6n = prof.mfu(tok_per_sec, flops_tok_6n, platform)
 
     # north star: >=45% MFU (BASELINE.md config #4)
     result = {
@@ -137,20 +220,27 @@ def main():
             "mfu": round(mfu, 4),
             "mfu_6n_convention": round(mfu_6n, 4),
             "platform": platform,
-            "params": trainer.num_params(),
+            "params": n_params,
             "layers": cfg.num_hidden_layers,
+            "remat": remat,
             "batch": batch,
             "seq": seq,
-            "steps": steps,
-            "fresh_batches": n_bufs,
-            "batch_cost": round(report["batch_cost"], 5),
-            "loss": float(np.asarray(loss)),
+            "ladder": ladder_report,
+            "windows": args.windows,
+            "steps_per_window": args.steps,
+            "window_batch_costs": [round(c, 5) for c in window_costs],
+            "batch_cost_best": round(best_cost, 5),
+            "batch_cost_median": round(med_cost, 5),
+            "transient_variance_flag": variance_flag,
+            "fresh_batches": len(bufs),
+            "loss": loss,
             "config_note": (
-                "7B layer shapes (hidden 4096, heads 32, inter 11008, vocab "
-                "32000) at HBM-limited depth; headline mfu excludes the "
-                "input-embedding gather (r1/r2 reported the 6N convention "
-                "on different configs - r1: 13-layer hidden-2048 model - so "
-                "tokens/s across rounds are not directly comparable)"),
+                f"{'SMOKE/tiny config - not the headline recipe' if args.smoke or not on_tpu else '7B layer shapes at HBM-limited depth'} "
+                f"(hidden {cfg.hidden_size}, heads {cfg.num_attention_heads}, "
+                f"inter {cfg.intermediate_size}, vocab {cfg.vocab_size}); "
+                "headline = best window over the fastest probed rung; "
+                "headline mfu excludes the input-embedding gather; see "
+                "ladder/window fields for the full measurement record"),
         },
     }
     print(json.dumps(result))

@@ -100,14 +100,20 @@ class GradNode:
     zero-filled (multi-output ops where only some outputs are used).
     """
 
-    __slots__ = ("name", "vjp_fn", "inputs", "out_avals", "n_outputs")
+    __slots__ = ("name", "vjp_fn", "inputs", "out_avals", "n_outputs",
+                 "fwd_fn")
 
-    def __init__(self, name, vjp_fn, inputs, out_avals):
+    def __init__(self, name, vjp_fn, inputs, out_avals, fwd_fn=None):
         self.name = name
         self.vjp_fn = vjp_fn
         self.inputs = inputs
         self.out_avals = out_avals
         self.n_outputs = len(out_avals)
+        # pure forward over the diff inputs' raw values; kept so
+        # create_graph=True can re-derive the vjp THROUGH the tape (the
+        # stored vjp_fn bakes the primals in as constants, which is exactly
+        # why calling it directly can never support double backward)
+        self.fwd_fn = fwd_fn
 
     def __repr__(self):
         return f"GradNode({self.name}, n_in={len(self.inputs)}, n_out={self.n_outputs})"
@@ -186,7 +192,8 @@ def grad(
 
     wanted = {id(t): None for t in inputs}
     _run_backward(
-        outputs, grad_outputs, retain_graph, wanted=wanted, write_leaf_grads=False
+        outputs, grad_outputs, retain_graph, wanted=wanted,
+        write_leaf_grads=False, create_graph=create_graph,
     )
     results = []
     for t in inputs:
@@ -198,15 +205,50 @@ def grad(
                     "to return None for unused inputs"
                 )
             results.append(None)
+        elif isinstance(cot, Tensor):
+            # create_graph path: the grad is itself on the tape
+            results.append(cot)
         else:
             results.append(Tensor._wrap(cot, stop_gradient=True))
     return results
 
 
-def _run_backward(tensors, grad_tensors, retain_graph, wanted=None, write_leaf_grads=True):
+def _vjp_through_tape(node, full_cots):
+    """Re-derive ``node``'s vjp as a TAPED eager computation so the backward
+    pass itself records GradNodes (create_graph=True; the reference's
+    grad-of-grad path, /root/reference/paddle/fluid/eager/backward.cc:421).
+
+    The stored ``vjp_fn`` bakes the primals in as closure constants, so it
+    can only ever give d(out)/d(cot) — re-running ``jax.vjp(fwd_fn)``
+    through ``dispatch.apply`` with the primal Tensors AS ARGUMENTS makes
+    the returned cotangents differentiable w.r.t. both primals and seeds,
+    to arbitrary order."""
+    import jax
+
+    from .dispatch import apply
+
+    n_primal = len(node.inputs)
+    n_out = node.n_outputs
+    fwd_fn = node.fwd_fn
+
+    def rerun(*vals):
+        primals, cots = vals[:n_primal], vals[n_primal:]
+        _, vjp_fn = jax.vjp(fwd_fn, *primals)
+        res = vjp_fn(cots[0] if n_out == 1 else tuple(cots))
+        return res[0] if len(res) == 1 else tuple(res)
+
+    out = apply(rerun, *node.inputs, *full_cots, op_name=f"grad_{node.name}")
+    return out if isinstance(out, tuple) else (out,)
+
+
+def _run_backward(tensors, grad_tensors, retain_graph, wanted=None,
+                  write_leaf_grads=True, create_graph=False):
     import jax.numpy as jnp
 
     from .tensor import Tensor
+
+    def _raw(c):
+        return c._value if isinstance(c, Tensor) else c
 
     # cotangents pending per node: id(node) -> [cot or None per output]
     pending: dict[int, list] = {}
@@ -232,9 +274,11 @@ def _run_backward(tensors, grad_tensors, retain_graph, wanted=None, write_leaf_g
 
     def _apply_hooks(t, cot):
         for hook in t._grad_hooks:
-            new = hook(Tensor._wrap(cot, stop_gradient=True))
+            new = hook(cot if isinstance(cot, Tensor)
+                       else Tensor._wrap(cot, stop_gradient=True))
             if new is not None:
-                cot = new._value if isinstance(new, Tensor) else new
+                cot = new if create_graph and isinstance(new, Tensor) else (
+                    new._value if isinstance(new, Tensor) else new)
         return cot
 
     def _deposit(t, cot):
@@ -246,9 +290,9 @@ def _run_backward(tensors, grad_tensors, retain_graph, wanted=None, write_leaf_g
             t._grad_node is None or t._retain_grad
         ):
             if t._grad is None:
-                t._grad = cot
+                t._grad = _raw(cot)
             else:
-                t._grad = t._grad + cot
+                t._grad = t._grad + _raw(cot)
 
     for t, g in zip(tensors, grad_tensors):
         if t.stop_gradient and t._grad_node is None:
@@ -257,6 +301,13 @@ def _run_backward(tensors, grad_tensors, retain_graph, wanted=None, write_leaf_g
             # paddle parity: non-scalar backward seeds with ones
             # (/root/reference/python/paddle/fluid/dygraph/tensor_patch_methods.py:230)
             gval = jnp.ones(t.shape, t._value.dtype)
+            if create_graph:
+                gval = Tensor._wrap(gval, stop_gradient=True)
+        elif create_graph:
+            # keep provided seeds ON the tape: grads w.r.t. grad_outputs
+            # flow in double backward
+            gval = g if isinstance(g, Tensor) else Tensor._wrap(
+                jnp.asarray(g), stop_gradient=True)
         else:
             gval = g._value if isinstance(g, Tensor) else jnp.asarray(g)
         _seed(t, gval)
@@ -271,14 +322,30 @@ def _run_backward(tensors, grad_tensors, retain_graph, wanted=None, write_leaf_g
         cots = pending.pop(id(node), None)
         if cots is None:
             continue
-        full = tuple(
-            c if c is not None else _zero_cotangent(node.out_avals[i])
-            for i, c in enumerate(cots)
-        )
-        if node.n_outputs == 1:
-            in_cots = node.vjp_fn(full[0])
+        if create_graph:
+            if node.fwd_fn is None:
+                raise NotImplementedError(
+                    f"paddle.grad(create_graph=True) through op "
+                    f"'{node.name}': no pure forward was recorded for this "
+                    f"node (e.g. a PyLayer) — its backward cannot be taped")
+            full = []
+            for i, c in enumerate(cots):
+                if c is None:
+                    z = _zero_cotangent(node.out_avals[i])
+                    c = z if getattr(z, "dtype", None) == jax.dtypes.float0 \
+                        else Tensor._wrap(jnp.asarray(z), stop_gradient=True)
+                full.append(c)
+            with enable_grad():
+                in_cots = _vjp_through_tape(node, full)
         else:
-            in_cots = node.vjp_fn(full)
+            full = tuple(
+                c if c is not None else _zero_cotangent(node.out_avals[i])
+                for i, c in enumerate(cots)
+            )
+            if node.n_outputs == 1:
+                in_cots = node.vjp_fn(full[0])
+            else:
+                in_cots = node.vjp_fn(full)
         for t, cot in zip(node.inputs, in_cots):
             if cot is None:
                 continue
@@ -293,9 +360,11 @@ def _run_backward(tensors, grad_tensors, retain_graph, wanted=None, write_leaf_g
                         prev = wanted[id(t)]
                         wanted[id(t)] = cot if prev is None else prev + cot
                     if write_leaf_grads and t._retain_grad and not t.stop_gradient:
-                        t._grad = cot if t._grad is None else t._grad + cot
+                        t._grad = _raw(cot) if t._grad is None \
+                            else t._grad + _raw(cot)
             else:
                 _deposit(t, cot)
         if not retain_graph:
             node.vjp_fn = None
             node.inputs = ()
+            node.fwd_fn = None

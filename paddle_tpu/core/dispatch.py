@@ -99,14 +99,18 @@ def apply(fn, *args, op_name="op", **kwargs):
     diff_set = set(diff_pos)
     diff_tensors = [leaves[i] for i in diff_pos]
 
+    # capture RAW values only (not Tensor wrappers): pure is retained on the
+    # GradNode as fwd_fn for create_graph, and must not pin grad-node chains
+    # of non-diff inputs for the tape's lifetime
+    const_vals = [
+        None if i in diff_set else (l._value if isinstance(l, Tensor) else l)
+        for i, l in enumerate(leaves)
+    ]
+
     def pure(*diff_vals):
         it = iter(diff_vals)
-        vals = [
-            next(it)
-            if i in diff_set
-            else (l._value if isinstance(l, Tensor) else l)
-            for i, l in enumerate(leaves)
-        ]
+        vals = [next(it) if i in diff_set else const_vals[i]
+                for i in range(len(const_vals))]
         a, k = tree_util.tree_unflatten(treedef, vals)
         return fn(*a, **k)
 
@@ -121,6 +125,7 @@ def apply(fn, *args, op_name="op", **kwargs):
         vjp_fn,
         diff_tensors,
         [(o.shape, np.dtype(o.dtype)) for o in out_list],
+        fwd_fn=pure,
     )
     result = _wrap_outputs(out, node=node)
     _maybe_attach_recompute(fn, leaves, treedef, result)

@@ -299,7 +299,7 @@ class Engine:
         logs, eval_logs = [], []
         for _ in range(epochs):
             for step_i, (bx, by) in enumerate(
-                    _iter_batches(train_data, batch_size)):
+                    _iter_batches(train_data, batch_size, drop_last=True)):
                 if steps_per_epoch and step_i >= steps_per_epoch:
                     break
                 eng = self._ensure_engine(bx, by)
@@ -314,17 +314,54 @@ class Engine:
         return out
 
     def evaluate(self, eval_data, batch_size=None):
-        losses = []
+        # every sample scores: a ragged tail is padded so the planned
+        # sharding still divides, then only the true rows are rescored
+        # through the loss (fit drops the tail; eval/predict must not)
+        losses, weights = [], []
+        planned = None
         for bx, by in _iter_batches(eval_data, batch_size):
+            n = len(bx)
             eng = self._ensure_engine(bx, by)
-            loss, _ = eng.eval_step(bx, by)
-            losses.append(float(np.asarray(loss)))
-        return {"eval_loss": float(np.mean(losses)) if losses else None}
+            if planned is None:
+                planned = n
+            if n == planned:
+                loss, _ = eng.eval_step(bx, by)
+                losses.append(float(np.asarray(loss)))
+            else:
+                _, outs = eng.eval_step(_pad_rows(bx, planned),
+                                        _pad_rows(by, planned))
+                # trim the padded ROWS of every output, then rescore through
+                # the same loss plumbing eval_step uses (multi-output safe)
+                from ..hapi.model import _pure_loss
+
+                outs = outs if isinstance(outs, (tuple, list)) else [outs]
+                trimmed = [np.asarray(o)[:n] for o in outs]
+                tail_loss = np.mean(np.asarray(
+                    _pure_loss(self.loss, trimmed, [np.asarray(by)])))
+                losses.append(float(tail_loss))
+            weights.append(n)
+        if not losses:
+            return {"eval_loss": None}
+        return {"eval_loss": float(np.average(losses, weights=weights))}
 
     def predict(self, test_data, batch_size=None):
         outs = []
+        planned = None
         for bx, _ in _iter_batches(test_data, batch_size, labels=False):
+            n = len(bx)
+            if planned is not None and n != planned:
+                eng = self._engine
+                o = eng.predict_step(_pad_rows(bx, planned))
+                if isinstance(o, (tuple, list)):
+                    o = [np.asarray(x)[:n] for x in o]
+                    o = o[0] if len(o) == 1 else o
+                else:
+                    o = np.asarray(o)[:n]
+                outs.append(np.asarray(o))
+                continue
             eng = self._ensure_engine(bx, None)
+            if planned is None:
+                planned = n
             o = eng.predict_step(bx)
             if isinstance(o, (tuple, list)) and len(o) == 1:
                 o = o[0]
@@ -345,24 +382,51 @@ class Engine:
         return self.history[-1]
 
 
-def _iter_batches(data, batch_size, labels=True):
+def _pad_rows(a, bs):
+    """Pad a batch to ``bs`` rows by repeating the last row (tail batches in
+    evaluate/predict; padded rows are trimmed/ignored by the caller)."""
+    a = np.asarray(a)
+    if len(a) >= bs:
+        return a
+    return np.concatenate([a, np.repeat(a[-1:], bs - len(a), axis=0)], axis=0)
+
+
+def _iter_batches(data, batch_size, labels=True, drop_last=False):
     """(inputs, labels) arrays | bare inputs array | iterable of (x, y)
-    batches -> batches."""
+    batches -> batches.
+
+    ``drop_last``: Engine.fit plans its parallel degrees from the first
+    batch's size, so a trailing remainder batch would fail to shard (or
+    force a retrace) mid-epoch — fit drops it (reference distributed
+    samplers' drop_last). predict/evaluate must see every sample, so they
+    keep the ragged tail (one extra compile at the smaller size)."""
     if isinstance(data, tuple) and len(data) == 2 and hasattr(data[0], "shape"):
         x = np.asarray(data[0])
         y = None if data[1] is None else np.asarray(data[1])
         bs = batch_size or len(x)
-        for i in range(0, len(x), bs):
+        end = len(x)
+        if drop_last and len(x) >= bs:
+            end = max(len(x) - len(x) % bs, bs)
+        for i in range(0, end, bs):
             yield x[i:i + bs], (y[i:i + bs] if labels and y is not None else None)
         return
     if hasattr(data, "shape"):  # bare ndarray of unlabeled inputs
         x = np.asarray(data)
         bs = batch_size or len(x)
-        for i in range(0, len(x), bs):
+        end = len(x)
+        if drop_last and len(x) >= bs:
+            end = max(len(x) - len(x) % bs, bs)
+        for i in range(0, end, bs):
             yield x[i:i + bs], None
         return
+    first_len = None
     for item in data:
         if isinstance(item, (tuple, list)) and len(item) == 2:
-            yield np.asarray(item[0]), np.asarray(item[1])
+            bx, by = np.asarray(item[0]), np.asarray(item[1])
         else:
-            yield np.asarray(item), None
+            bx, by = np.asarray(item), None
+        if first_len is None:
+            first_len = len(bx)
+        elif drop_last and len(bx) != first_len:
+            continue  # ragged batch from an iterable: same policy as arrays
+        yield bx, by

@@ -75,11 +75,14 @@ class RNGStatesTracker:
         key = base
         if fold_axis is not None:
             key = jax.random.fold_in(base, jax.lax.axis_index(fold_axis))
-        with frandom.rng_scope(key):
-            yield
-        # advance the stored (per-process) state so the next eager entry
-        # draws fresh randomness; the folded per-rank keys derive from it
-        self._states[name] = jax.random.split(base)[0]
+        try:
+            with frandom.rng_scope(key):
+                yield
+        finally:
+            # advance the stored (per-process) state so the next eager entry
+            # draws fresh randomness even if the body raised; the folded
+            # per-rank keys derive from it
+            self._states[name] = jax.random.split(base)[0]
 
 
 _TRACKER = RNGStatesTracker()

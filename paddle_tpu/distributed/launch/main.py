@@ -144,7 +144,7 @@ def _watch(procs, poll_s=0.2):
         for proc, logf, _ in procs:
             proc.wait()
             logf.close()
-        return 130, n_failed
+        return 130, 0
 
 
 def launch(argv):
@@ -155,7 +155,8 @@ def launch(argv):
         args._attempt = attempt
         procs = _spawn(args, master)
         rc, n_failed = _watch(procs)
-        if rc == 0 or attempt >= args.max_restarts:
+        # rc 130 = user interrupt: terminal, never retried
+        if rc == 0 or rc == 130 or attempt >= args.max_restarts:
             return rc
         attempt += 1
         if args.elastic_level >= 2 and n_failed:

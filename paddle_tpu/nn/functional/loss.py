@@ -258,10 +258,13 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction
 
     _T = (log_probs.shape[0] if hasattr(log_probs, "shape") else 0)
     _L = (labels.shape[-1] if hasattr(labels, "shape") else 0)
-    from ...kernels.ctc import fits_vmem
-
-    if use_pallas() and fits_vmem(int(_T), int(_L)):
-        from ...kernels.ctc import ctc_loss_pallas
+    # kernels.ctc imports pallas at module level; only touch it under the
+    # policy switch so jax builds without pallas.tpu keep the scan path
+    # (mirrors the rnnt_loss guard)
+    pallas_ok = use_pallas()
+    if pallas_ok:
+        from ...kernels.ctc import ctc_loss_pallas, fits_vmem
+    if pallas_ok and fits_vmem(int(_T), int(_L)):
 
         def body_pallas(lp, lbl, in_len, lbl_len):
             loss = ctc_loss_pallas(lp, lbl, in_len, lbl_len, blank)

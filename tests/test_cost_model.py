@@ -206,3 +206,54 @@ class TestReviewRegressions:
 
         eng = Engine(model=M())
         eng.save(str(tmp_path / "m"))  # must not crash pre-fit
+
+
+class TestRaggedTail:
+    def test_fit_drops_tail_eval_predict_keep_it(self):
+        """fit plans degrees from the first batch, so it drops a ragged
+        trailing batch; evaluate/predict must still score EVERY sample
+        (ADVICE r3 + review: tail was silently dropped from inference)."""
+        from paddle_tpu.distributed import Engine
+        from paddle_tpu.distributed.mesh import set_hybrid_communicate_group
+
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 2)
+
+            def forward(self, x):
+                return self.fc(x)
+
+        paddle.seed(0)
+        net = M()
+        eng = Engine(model=net, loss=paddle.nn.CrossEntropyLoss(),
+                     optimizer=paddle.optimizer.Adam(
+                         parameters=net.parameters(), learning_rate=1e-2))
+        rng = np.random.RandomState(0)
+        x = rng.rand(19, 4).astype(np.float32)  # 19 = 2*8 + tail of 3
+        y = (x.sum(1) > 2).astype(np.int64)
+        eng.fit((x, y), epochs=1, batch_size=8)
+        # predict covers all 19 rows
+        preds = eng.predict((x, None), batch_size=8)
+        assert sum(p.shape[0] for p in preds) == 19
+        assert preds[-1].shape[0] == 3
+        # evaluate covers all rows; weighted mean matches a manual pass
+        ev = eng.evaluate((x, y), batch_size=8)
+        assert ev["eval_loss"] is not None
+        logits = np.concatenate(preds, axis=0)
+        from paddle_tpu.core.tensor import Tensor
+
+        manual = float(np.asarray(paddle.nn.CrossEntropyLoss()(
+            Tensor._wrap(logits, stop_gradient=True),
+            Tensor._wrap(y, stop_gradient=True)).numpy()))
+        # per-batch weighted mean equals the all-sample loss only when every
+        # batch mean is weighted by its size — which is what evaluate does
+        per_batch = [
+            float(np.asarray(paddle.nn.CrossEntropyLoss()(
+                Tensor._wrap(logits[i:i + 8], stop_gradient=True),
+                Tensor._wrap(y[i:i + 8], stop_gradient=True)).numpy()))
+            for i in range(0, 19, 8)]
+        expect = np.average(per_batch, weights=[8, 8, 3])
+        np.testing.assert_allclose(ev["eval_loss"], expect, rtol=1e-5)
+        np.testing.assert_allclose(ev["eval_loss"], manual, rtol=1e-5)
+        set_hybrid_communicate_group(None)
