@@ -419,14 +419,20 @@ def _iter_batches(data, batch_size, labels=True, drop_last=False):
         for i in range(0, end, bs):
             yield x[i:i + bs], None
         return
+    # iterable of batches: one-item lookahead so drop_last drops ONLY a
+    # ragged trailing batch (mid-stream size changes pass through unchanged,
+    # same semantics as the array branches)
     first_len = None
+    held = None
     for item in data:
         if isinstance(item, (tuple, list)) and len(item) == 2:
-            bx, by = np.asarray(item[0]), np.asarray(item[1])
+            cur = (np.asarray(item[0]), np.asarray(item[1]))
         else:
-            bx, by = np.asarray(item), None
+            cur = (np.asarray(item), None)
         if first_len is None:
-            first_len = len(bx)
-        elif drop_last and len(bx) != first_len:
-            continue  # ragged batch from an iterable: same policy as arrays
-        yield bx, by
+            first_len = len(cur[0])
+        if held is not None:
+            yield held
+        held = cur
+    if held is not None and not (drop_last and len(held[0]) != first_len):
+        yield held

@@ -133,18 +133,21 @@ def _watch(procs, poll_s=0.2):
                         p2.kill()
                     if not f2.closed:
                         f2.close()
-                return failed[0][1], len(failed)
+                return failed[0][1], len(failed), False
             procs = alive
             if procs:
                 time.sleep(poll_s)
-        return 0, 0
+        return 0, 0, False
     except KeyboardInterrupt:
+        # interrupted=True distinguishes the operator's Ctrl-C from a worker
+        # that itself exited 130 (e.g. SIGINT preemption — that one SHOULD
+        # go through the elastic restart path)
         for proc, logf, _ in procs:
             proc.send_signal(signal.SIGINT)
         for proc, logf, _ in procs:
             proc.wait()
             logf.close()
-        return 130, 0
+        return 130, 0, True
 
 
 def launch(argv):
@@ -154,9 +157,9 @@ def launch(argv):
     while True:
         args._attempt = attempt
         procs = _spawn(args, master)
-        rc, n_failed = _watch(procs)
-        # rc 130 = user interrupt: terminal, never retried
-        if rc == 0 or rc == 130 or attempt >= args.max_restarts:
+        rc, n_failed, interrupted = _watch(procs)
+        # the operator's Ctrl-C is terminal, never retried
+        if rc == 0 or interrupted or attempt >= args.max_restarts:
             return rc
         attempt += 1
         if args.elastic_level >= 2 and n_failed:
