@@ -34,7 +34,21 @@ from ..nn.layer import functional_call, functional_state
 from .mesh import HybridCommunicateGroup, build_mesh, set_hybrid_communicate_group
 from .strategy import DistributedStrategy
 
-__all__ = ["DistributedEngine", "shard_params_for_zero"]
+__all__ = ["DistributedEngine", "shard_params_for_zero", "state_bytes_by_device"]
+
+
+def state_bytes_by_device(*trees):
+    """Bytes resident per device for the given pytrees of jax arrays —
+    a deterministic layout accounting (sums addressable shard nbytes), the
+    observable behind the ZeRO/offload memory claims."""
+    per_dev: dict = {}
+    for tree in trees:
+        for leaf in jax.tree_util.tree_leaves(tree):
+            if not hasattr(leaf, "addressable_shards"):
+                continue
+            for sh in leaf.addressable_shards:
+                per_dev[sh.device] = per_dev.get(sh.device, 0) + sh.data.nbytes
+    return per_dev
 
 DATA_AXES = ("dp", "sharding")
 
@@ -91,7 +105,9 @@ class DistributedEngine:
         self._train_step = None
         self._train_step_outs = None
         self._grad_step = None
+        self._grad_only_step = None
         self._apply_step = None
+        self._host_update = None
         self._eval_step = None
         self._predict_step = None
         self._accum_grads = None
@@ -152,13 +168,41 @@ class DistributedEngine:
         buffers = {n: jax.device_put(v, self._nsh(P())) for n, v in buffers.items()}
         opt_state = self.optimizer.init_state_tree(params) if self.optimizer else {}
         ospecs = self._opt_specs(pspecs, opt_state)
-        opt_state = {
-            n: {k: jax.device_put(v, self._nsh(ospecs[n][k]))
-                for k, v in st.items()}
-            for n, st in opt_state.items()
-        }
+        if self._offload():
+            # ZeRO host-offload tier (reference GroupShardedStage3(offload=
+            # True) + GroupShardedOptimizerStage2 offload, group_sharded_
+            # stage3.py:84): optimizer moments live in HOST memory and never
+            # occupy accelerator HBM; the update runs on host each step.
+            host = self._host_device()
+            opt_state = {
+                n: {k: jax.device_put(v, host) for k, v in st.items()}
+                for n, st in opt_state.items()
+            }
+        else:
+            opt_state = {
+                n: {k: jax.device_put(v, self._nsh(ospecs[n][k]))
+                    for k, v in st.items()}
+                for n, st in opt_state.items()
+            }
         self._state = (params, buffers, opt_state)
         self._pspecs, self._ospecs = pspecs, ospecs
+
+    def _offload(self) -> bool:
+        if not (self.optimizer is not None and self.strategy.sharding.offload):
+            return False
+        if jax.process_count() > 1:
+            # device_put of a globally-sharded tree onto one local cpu
+            # device is ill-defined across hosts; a per-host sharded
+            # offload (host mesh + reduce-scattered moments) is the
+            # multi-host follow-up
+            raise NotImplementedError(
+                "ShardingConfig(offload=True) currently supports "
+                "single-host meshes only")
+        return True
+
+    @staticmethod
+    def _host_device():
+        return jax.local_devices(backend="cpu")[0]
 
     def _build_train_step(self):
         opt = self.optimizer
@@ -306,6 +350,68 @@ class DistributedEngine:
             donate_argnums=(0, 1, 3),
         )
 
+    # -- ZeRO host-offload tier ----------------------------------------
+    def _build_grad_only_step(self):
+        """Mesh-jitted forward+backward ONLY (no optimizer update): the
+        offload path keeps moments in host memory, so the update happens
+        off-mesh in _host_apply. Supports fused gradient accumulation like
+        the main train step."""
+        accum = max(1, self.strategy.gradient_merge_steps)
+        fl_outs = self._forward_loss_outs()
+
+        def forward_loss(params, buffers, rng, inputs, labels):
+            loss, (new_buf, _) = fl_outs(params, buffers, rng, inputs,
+                                         labels, True)
+            return loss, new_buf
+
+        def grad_step(params, buffers, rng, inputs, labels):
+            if accum > 1:
+                def micro(i, carry):
+                    gsum, lsum, buf = carry
+                    mb_in = [jax.lax.dynamic_index_in_dim(x, i, 0, False)
+                             for x in inputs]
+                    mb_lb = [jax.lax.dynamic_index_in_dim(x, i, 0, False)
+                             for x in labels]
+                    (l, buf2), g = jax.value_and_grad(
+                        forward_loss, has_aux=True)(
+                            params, buf, jax.random.fold_in(rng, i),
+                            mb_in, mb_lb)
+                    gsum = jax.tree_util.tree_map(lambda a, b: a + b, gsum, g)
+                    return gsum, lsum + l, buf2
+
+                zero_g = jax.tree_util.tree_map(jnp.zeros_like, params)
+                grads, loss, new_buf = jax.lax.fori_loop(
+                    0, accum, micro, (zero_g, jnp.zeros(()), buffers))
+                grads = jax.tree_util.tree_map(lambda g: g / accum, grads)
+                loss = loss / accum
+            else:
+                (loss, new_buf), grads = jax.value_and_grad(
+                    forward_loss, has_aux=True)(
+                        params, buffers, rng, inputs, labels)
+            return loss, new_buf, grads
+
+        pshard, bshard, _ = self._shardings()
+        return jax.jit(grad_step,
+                       in_shardings=(pshard, bshard, None, None, None),
+                       out_shardings=(None, bshard, pshard))
+
+    def _host_apply(self, params, grads, opt_state, lr):
+        """Optimizer update in HOST memory: params+grads stream down, new
+        params stream back sharded; moments never touch accelerator HBM.
+        Execution platform follows data placement (all inputs committed to
+        the host cpu device), so no mixed-platform jit is needed."""
+        host = self._host_device()
+        if self._host_update is None:
+            self._host_update = jax.jit(self.optimizer.apply_gradients,
+                                        donate_argnums=(2,))
+        params_h = jax.device_put(params, host)
+        grads_h = jax.device_put(grads, host)
+        new_params_h, new_opt = self._host_update(
+            params_h, grads_h, opt_state, jax.device_put(lr, host))
+        pshard = {n: self._nsh(s) for n, s in self._pspecs.items()}
+        new_params = jax.device_put(new_params_h, pshard)
+        return new_params, new_opt
+
     def _build_eval_step(self):
         forward_loss = self._forward_loss_outs()
 
@@ -349,7 +455,7 @@ class DistributedEngine:
         accumulates gradients (reference update=False defers minimize)."""
         inputs, labels, lr, rng = self._prep_step(inputs, labels)
         params, buffers, opt_state = self._state
-        if update and self._accum_grads is None:
+        if update and self._accum_grads is None and not self._offload():
             if self._train_step_outs is None:
                 self._train_step_outs = self._build_train_step_outs()
             loss, outs, new_buf, new_params, new_opt = self._train_step_outs(
@@ -361,9 +467,8 @@ class DistributedEngine:
             loss, outs, new_buf, grads = self._grad_step(
                 params, buffers, rng, self._accum_grads, inputs, labels)
             if update:
-                if self._apply_step is None:
-                    self._apply_step = self._build_apply_step()
-                new_params, new_opt = self._apply_step(params, opt_state, lr, grads)
+                new_params, new_opt = self._apply_grads(params, opt_state,
+                                                        lr, grads)
                 self._state = (new_params, new_buf, new_opt)
                 self._accum_grads = None
             else:
@@ -372,14 +477,21 @@ class DistributedEngine:
         self._step_count += 1
         return loss, outs
 
+    def _apply_grads(self, params, opt_state, lr, grads):
+        """Optimizer update: on-mesh jit normally, host memory when the
+        ZeRO offload tier is on."""
+        if self._offload():
+            return self._host_apply(params, grads, opt_state, lr)
+        if self._apply_step is None:
+            self._apply_step = self._build_apply_step()
+        return self._apply_step(params, opt_state, lr, grads)
+
     def flush_accum_grads(self):
         if self._accum_grads is None:
             return
         params, buffers, opt_state = self._state
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-        if self._apply_step is None:
-            self._apply_step = self._build_apply_step()
-        new_params, new_opt = self._apply_step(
+        new_params, new_opt = self._apply_grads(
             params, opt_state, lr, self._accum_grads)
         self._state = (new_params, buffers, new_opt)
         self._accum_grads = None
@@ -423,9 +535,19 @@ class DistributedEngine:
     def step(self, inputs, labels):
         """Run one training step; returns host loss."""
         inputs, labels, lr, rng = self._prep_step(inputs, labels)
+        params, buffers, opt_state = self._state
+        if self._offload():
+            if self._grad_only_step is None:
+                self._grad_only_step = self._build_grad_only_step()
+            loss, new_buf, grads = self._grad_only_step(
+                params, buffers, rng, inputs, labels)
+            new_params, new_opt = self._host_apply(params, grads,
+                                                   opt_state, lr)
+            self._state = (new_params, new_buf, new_opt)
+            self._step_count += 1
+            return loss
         if self._train_step is None:
             self._train_step = self._build_train_step()
-        params, buffers, opt_state = self._state
         loss, new_buf, new_params, new_opt = self._train_step(
             params, buffers, opt_state, lr, rng, inputs, labels)
         self._state = (new_params, new_buf, new_opt)

@@ -14,6 +14,12 @@ Supported rewrites:
   the early-return pattern via return-normalization);
 - ``while`` with traced conditions (assigned names become the loop carry);
 - ``for .. in range(..)`` with traced bounds (lowered to while);
+- ``break``/``continue``/``return`` inside compiled while/for-range loops:
+  lowered to boolean guard flags threaded through the loop carry, with the
+  statements after a control transfer wrapped in flag-guarded ifs — the
+  reference's break_continue_transformer.py / return_transformer.py
+  strategy (/root/reference/python/paddle/jit/dy2static/
+  break_continue_transformer.py:1);
 - ``and``/``or``/``not`` over tensors; ternary ``a if c else b``; ``assert``.
 
 Unsupported syntax raises :class:`UnsupportedSyntax`; ``to_static`` then
@@ -62,8 +68,10 @@ def _assigned_names(stmts):
             names.add(n.id)
         if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
             names.add(n.name)
-    # generated transform internals are scoped to their own branch/body
-    return {n for n in names if not n.startswith("_pd_")}
+    # generated transform internals are scoped to their own branch/body —
+    # EXCEPT loop-control flags (_pd_ctl_*), which must be loop carries
+    return {n for n in names
+            if n.startswith("_pd_ctl_") or not n.startswith("_pd_")}
 
 
 def _has_side_store(stmts):
@@ -168,6 +176,201 @@ def _names_tuple(names, ctx=None):
 def _str_tuple(names):
     return ast.Tuple(elts=[ast.Constant(value=n) for n in names],
                      ctx=ast.Load())
+
+
+def _desugar_for_range(node, tag):
+    """Shared for-range → while desugar. Returns (setup_stmts, while_node,
+    incr_stmt) with the increment NOT yet appended to the body (the
+    loop-control pass must guard it), or None if ``node`` isn't a plain
+    for-over-range."""
+    if not (isinstance(node.iter, ast.Call)
+            and isinstance(node.iter.func, ast.Name)
+            and node.iter.func.id == "range"
+            and isinstance(node.target, ast.Name)
+            and not node.orelse
+            and not node.iter.keywords):
+        return None
+    i = node.target.id
+    ra = node.iter.args
+    if len(ra) == 1:
+        start, stop, step = ast.Constant(value=0), ra[0], ast.Constant(value=1)
+    elif len(ra) == 2:
+        start, stop, step = ra[0], ra[1], ast.Constant(value=1)
+    else:
+        start, stop, step = ra[0], ra[1], ra[2]
+    sv, ev, tv = (f"_pd_start_{tag}", f"_pd_stop_{tag}", f"_pd_step_{tag}")
+    setup = [
+        ast.Assign(targets=[_names_tuple([sv, ev, tv], ast.Store())],
+                   value=ast.Tuple(elts=[
+                       _jst_call("to_index", [start]),
+                       _jst_call("to_index", [stop]),
+                       _jst_call("to_index", [step])], ctx=ast.Load())),
+        ast.Assign(targets=[_name(i, ast.Store())], value=_name(sv)),
+    ]
+    incr = ast.Assign(
+        targets=[_name(i, ast.Store())],
+        value=ast.BinOp(left=_name(i), op=ast.Add(), right=_name(tv)))
+    loop = ast.While(
+        test=_jst_call("range_cond", [_name(i), _name(ev), _name(tv)]),
+        body=list(node.body), orelse=[])
+    return setup, loop, incr
+
+
+class LoopControlLowering(ast.NodeTransformer):
+    """Pre-pass: lower break/continue/return inside compiled loops to guard
+    flags threaded through the loop carry (reference strategy:
+    break_continue_transformer.py + return_transformer.py). Runs BEFORE
+    Dy2StaticTransformer so the generated flag-guard ifs and flag-extended
+    loop conditions go through the normal if/while conversion.
+
+    Flag names use the reserved ``_pd_ctl_`` prefix: excluded from user
+    namespaces (transform_function rejects user identifiers starting with
+    ``_pd_``) but explicitly exempted in ``_assigned_names`` so they become
+    loop-carry variables."""
+
+    def __init__(self):
+        self._n = 0
+
+    def _uid(self):
+        self._n += 1
+        return self._n
+
+    @staticmethod
+    def _has_ctrl(stmts):
+        return _contains(stmts, _CTRL, into_loops=False)
+
+    def visit_While(self, node):
+        self.generic_visit(node)  # nested loops first (inner returns
+        # become guarded returns in this body, then lower here)
+        if node.orelse:
+            raise UnsupportedSyntax("while/else")
+        if not self._has_ctrl(node.body):
+            return node
+        return self._lower(node)
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        if not self._has_ctrl(node.body):
+            return node
+        if node.orelse:
+            raise UnsupportedSyntax("for/else with break/continue")
+        des = _desugar_for_range(node, f"c{self._uid()}")
+        if des is None:
+            # concrete-iterable python loop: break/continue keep exact
+            # python semantics; only data-dependent conditions around them
+            # are rejected later by the main transformer
+            return node
+        setup, loop, incr = des
+        return setup + self._lower(loop, incr=incr)
+
+    # -- the guard-threading core ------------------------------------------
+    def _lower(self, node, incr=None):
+        uid = self._uid()
+        has_brk = _contains(node.body, (ast.Break,), into_loops=False)
+        has_cont = _contains(node.body, (ast.Continue,), into_loops=False)
+        has_ret = _contains(node.body, (ast.Return,), into_loops=False)
+        flags = {
+            "brk": f"_pd_ctl_brk_{uid}" if has_brk else None,
+            "cont": f"_pd_ctl_cont_{uid}" if has_cont else None,
+            "retf": f"_pd_ctl_retf_{uid}" if has_ret else None,
+            "retv": f"_pd_ctl_retv_{uid}" if has_ret else None,
+        }
+        body = self._thread(list(node.body), flags)
+        # leftover control statements mean a construct we can't thread
+        # (e.g. break inside try/with)
+        for n in _walk_shallow(body, into_loops=False):
+            if isinstance(n, _CTRL) and not isinstance(n, ast.Return):
+                raise UnsupportedSyntax(
+                    "break/continue inside a construct the loop-control "
+                    "pass cannot thread (e.g. try/with)")
+        prologue = []
+        if has_cont:
+            prologue.append(_assign_const(flags["cont"], False))
+        exit_flags = [f for f in (flags["brk"], flags["retf"]) if f]
+        if incr is not None:
+            # python for semantics: continue still increments; break/return
+            # skip the increment
+            if exit_flags:
+                body.append(ast.If(test=self._not_any(exit_flags),
+                                   body=[incr], orelse=[]))
+            else:
+                body.append(incr)
+        node.body = prologue + body
+        if exit_flags:
+            node.test = ast.BoolOp(
+                op=ast.And(),
+                values=[node.test] + [ast.UnaryOp(op=ast.Not(),
+                                                  operand=_name(f))
+                                      for f in exit_flags])
+        pre = [_assign_const(f, False)
+               for f in (flags["brk"], flags["cont"], flags["retf"]) if f]
+        post = []
+        if has_ret:
+            post.append(ast.If(test=_name(flags["retf"]),
+                               body=[ast.Return(value=_name(flags["retv"]))],
+                               orelse=[]))
+        return pre + [node] + post
+
+    @staticmethod
+    def _not_any(flag_names):
+        if len(flag_names) == 1:
+            return ast.UnaryOp(op=ast.Not(), operand=_name(flag_names[0]))
+        return ast.UnaryOp(
+            op=ast.Not(),
+            operand=ast.BoolOp(op=ast.Or(),
+                               values=[_name(f) for f in flag_names]))
+
+    @staticmethod
+    def _check_return_value(s):
+        """Only single-value returns lower cleanly inside a compiled loop:
+        the undefined-branch zero-fill needs one array leaf. Reject tuple
+        literals and bare ``return`` up front with a clear diagnostic."""
+        if s.value is None or isinstance(s.value, (ast.Tuple, ast.List)):
+            raise UnsupportedSyntax(
+                "bare `return` / `return <tuple>` inside a compiled loop; "
+                "return a single tensor, or restructure with a flag "
+                "variable set in the loop")
+
+    def _thread(self, stmts, flags):
+        """Rewrite one statement list: control transfers become flag sets;
+        everything after a statement that may have transferred control is
+        wrapped in ``if not (<flags>):``. Unreachable trailing code after a
+        bare break/continue/return is dropped (python drops it too)."""
+        out = []
+        for idx, s in enumerate(stmts):
+            rest = stmts[idx + 1:]
+            if isinstance(s, ast.Break):
+                out.append(_assign_const(flags["brk"], True))
+                return out
+            if isinstance(s, ast.Continue):
+                out.append(_assign_const(flags["cont"], True))
+                return out
+            if isinstance(s, ast.Return):
+                self._check_return_value(s)
+                out.append(ast.Assign(
+                    targets=[_name(flags["retv"], ast.Store())],
+                    value=s.value))
+                out.append(_assign_const(flags["retf"], True))
+                return out
+            if isinstance(s, ast.If) and self._has_ctrl([s]):
+                s.body = self._thread(s.body, flags)
+                if s.orelse:
+                    s.orelse = self._thread(s.orelse, flags)
+                out.append(s)
+                if rest:
+                    used = [f for k, f in flags.items()
+                            if f and k != "retv"]
+                    out.append(ast.If(test=self._not_any(used),
+                                      body=self._thread(rest, flags),
+                                      orelse=[]))
+                return out
+            out.append(s)
+        return out
+
+
+def _assign_const(name, value):
+    return ast.Assign(targets=[_name(name, ast.Store())],
+                      value=ast.Constant(value=value))
 
 
 class Dy2StaticTransformer(ast.NodeTransformer):
@@ -339,37 +542,10 @@ class Dy2StaticTransformer(ast.NodeTransformer):
 
     # -- for over range ------------------------------------------------------
     def visit_For(self, node):
-        if (isinstance(node.iter, ast.Call)
-                and isinstance(node.iter.func, ast.Name)
-                and node.iter.func.id == "range"
-                and isinstance(node.target, ast.Name)
-                and not node.orelse
-                and not node.iter.keywords):
-            uid = self._uid()
-            i = node.target.id
-            ra = node.iter.args
-            if len(ra) == 1:
-                start, stop, step = ast.Constant(value=0), ra[0], ast.Constant(value=1)
-            elif len(ra) == 2:
-                start, stop, step = ra[0], ra[1], ast.Constant(value=1)
-            else:
-                start, stop, step = ra[0], ra[1], ra[2]
-            sv, ev, tv = (f"_pd_start_{uid}", f"_pd_stop_{uid}", f"_pd_step_{uid}")
-            setup = [
-                ast.Assign(targets=[_names_tuple([sv, ev, tv], ast.Store())],
-                           value=ast.Tuple(elts=[
-                               _jst_call("to_index", [start]),
-                               _jst_call("to_index", [stop]),
-                               _jst_call("to_index", [step])], ctx=ast.Load())),
-                ast.Assign(targets=[_name(i, ast.Store())], value=_name(sv)),
-            ]
-            loop = ast.While(
-                test=_jst_call("range_cond", [_name(i), _name(ev), _name(tv)]),
-                body=list(node.body) + [ast.Assign(
-                    targets=[_name(i, ast.Store())],
-                    value=ast.BinOp(left=_name(i), op=ast.Add(),
-                                    right=_name(tv)))],
-                orelse=[])
+        des = _desugar_for_range(node, str(self._uid()))
+        if des is not None:
+            setup, loop, incr = des
+            loop.body = loop.body + [incr]
             result = self.visit_While(loop)
             return setup + (result if isinstance(result, list) else [result])
         self.generic_visit(node)
@@ -400,6 +576,15 @@ def transform_function(fn):
         raise UnsupportedSyntax("not a plain function definition")
     fdef = tree.body[0]
     fdef.decorator_list = []
+    for n in ast.walk(fdef):
+        # the _pd_ namespace (branch helpers, loop internals, control flags)
+        # is reserved for generated code; a user identifier there could
+        # collide with — or trigger — flag-specific semantics like the
+        # undefined-branch zero-fill
+        if isinstance(n, ast.Name) and n.id.startswith("_pd_"):
+            raise UnsupportedSyntax(
+                f"identifier {n.id!r} uses the reserved '_pd_' prefix")
+    LoopControlLowering().visit(fdef)
     Dy2StaticTransformer().visit(fdef)
     ast.fix_missing_locations(tree)
     code = compile(tree, filename=f"<dy2static:{inner.__qualname__}>",

@@ -37,6 +37,25 @@ class _Undefined:
 UNDEFINED = _Undefined()
 
 
+class _ProbeValue:
+    """Placeholder carried through the LENIENT shape probe for loop
+    variables first assigned inside the loop (e.g. the return-value slot the
+    loop-control pass threads for ``return``-in-loop). During probing,
+    ``convert_ifelse`` resolves a placeholder-vs-value pair to the value, so
+    the variable's post-body shape/dtype can be discovered without a real
+    initial value."""
+
+    def __repr__(self):
+        return "<probe>"
+
+
+_PROBE = False
+
+
+def _is_placeholder(x):
+    return isinstance(x, (_Undefined, _ProbeValue))
+
+
 def _is_tensor(x):
     return isinstance(x, Tensor)
 
@@ -80,11 +99,57 @@ def convert_ifelse(pred, true_fn, false_fn, names=()):
     t_leaves, t_def = _flatten(t_out)
     f_leaves, f_def = _flatten(f_out)
     if t_def != f_def:
+        if any(_is_placeholder(l) for l in t_leaves + f_leaves) and any(
+                str(n).startswith("_pd_ctl_") for n in names):
+            raise TypeError(
+                "dy2static: a `return` inside a compiled loop produced a "
+                "non-array structure (e.g. a tuple); return a single tensor "
+                "from inside the loop, or initialize the result before it")
         raise TypeError(
             f"dy2static: if/else branches assign mismatched structures for "
             f"{names or 'outputs'}: {t_def} vs {f_def}")
-    for n, tl, fl in zip(names or [""] * len(t_leaves), t_leaves, f_leaves):
-        if isinstance(tl, _Undefined) or isinstance(fl, _Undefined):
+    if _PROBE:
+        # lenient shape probe (no lax.cond): placeholder-vs-value resolves
+        # to the value; value-vs-value merges to the broadcast/promoted spec
+        merged = []
+        for tl, fl in zip(t_leaves, f_leaves):
+            if _is_placeholder(tl) and _is_placeholder(fl):
+                merged.append(tl)
+            elif _is_placeholder(tl):
+                merged.append(fl)
+            elif _is_placeholder(fl):
+                merged.append(tl)
+            else:
+                a, b = _unwrap(tl), _unwrap(fl)
+                if hasattr(a, "dtype") and hasattr(b, "dtype"):
+                    spec = jnp.zeros_like(jnp.asarray(a)) + \
+                        jnp.zeros_like(jnp.asarray(b))
+                    merged.append(Tensor._wrap(spec)
+                                  if isinstance(tl, Tensor) else spec)
+                else:
+                    merged.append(tl)
+        return tree_util.tree_unflatten(t_def, merged)
+    t_leaves, f_leaves = list(t_leaves), list(f_leaves)
+    for k, (n, tl, fl) in enumerate(
+            zip(names or [""] * len(t_leaves), t_leaves, f_leaves)):
+        und_t, und_f = isinstance(tl, _Undefined), isinstance(fl, _Undefined)
+        if und_t and und_f:
+            continue  # stays undefined; the non-tensor merge keeps it
+        if und_t or und_f:
+            if n.startswith("_pd_ctl_"):
+                # loop-control slots (the threaded return value) are only
+                # ever READ under their guard flag, so the undefined branch
+                # can safely carry zeros (the reference fills UndefinedVar
+                # with RETURN_NO_VALUE the same way)
+                defined = fl if und_t else tl
+                dv = jnp.asarray(_unwrap(defined))
+                fill = (Tensor._wrap(jnp.zeros_like(dv))
+                        if isinstance(defined, Tensor) else jnp.zeros_like(dv))
+                if und_t:
+                    t_leaves[k] = fill
+                else:
+                    f_leaves[k] = fill
+                continue
             raise NameError(
                 f"dy2static: variable '{n}' is assigned in only one branch "
                 "of a compiled if/else; assign it in both (or before)")
@@ -116,37 +181,70 @@ def convert_ifelse(pred, true_fn, false_fn, names=()):
 
 def _probe_undefined(cond_fn, body_fn, vars_in, names):
     """Resolve UNDEFINED loop vars: variables assigned in the body before any
-    read get zero-initialized with the body's output shape/dtype (fixed-point
-    via eval_shape) — semantically equivalent whenever the eager code would
-    not hit UnboundLocalError."""
+    read get zero-initialized with the body's output shape/dtype —
+    semantically equivalent whenever the eager code would not hit
+    UnboundLocalError. Runs the body under the LENIENT probe (placeholders
+    flow through convert_ifelse picking the assigned branch) so even vars
+    assigned only under data-dependent conditions — like the return-value
+    slot threaded by the loop-control pass — get a concrete spec."""
+    global _PROBE
     vars_list = list(vars_in)
-    undef = [i for i, v in enumerate(vars_list) if isinstance(v, _Undefined)]
+    # placeholders can also arrive from an ENCLOSING loop's probe (nested
+    # loops whose outer condition is traced from the start) — re-probe them
+    # here the same as UNDEFINED
+    undef = [i for i, v in enumerate(vars_list) if _is_placeholder(v)]
     if not undef:
         return vars_list
+    probe_vars = list(vars_list)
     for i in undef:
-        vars_list[i] = Tensor._wrap(jnp.zeros(()))
-    for _ in range(3):
-        # per-var leaf grouping keeps indices aligned even when other loop
-        # vars are nested structures (tuples/lists of tensors)
-        out_spec = jax.eval_shape(
-            lambda: tuple(
-                tuple(jnp.asarray(x) for x in _unwrap_leaves(_flatten(v)[0]))
-                for v in body_fn(*vars_list)))
-        changed = False
+        probe_vars[i] = _ProbeValue()
+    resolved: dict[int, tuple] = {}
+
+    def _body_specs():
+        out = []
+        for v in body_fn(*probe_vars):
+            leaves = _unwrap_leaves(_flatten(v)[0])
+            if any(_is_placeholder(x) for x in leaves):
+                out.append(None)  # still unassigned this round
+            else:
+                out.append(tuple(jnp.asarray(x) for x in leaves))
+        return tuple(out)
+
+    for _ in range(4):
+        prev_probe = _PROBE  # reentrant: nested loops probe within a probe
+        _PROBE = True
+        try:
+            out_spec = jax.eval_shape(_body_specs)
+        finally:
+            _PROBE = prev_probe
+        progress = False
         for i in undef:
             var_spec = out_spec[i]
+            if var_spec is None:
+                continue
             if len(var_spec) != 1:
                 raise TypeError(
-                    f"dy2static: loop variable '{names[i] if i < len(names) else i}' "
-                    "is first assigned a nested structure inside a compiled "
-                    "while; initialize it before the loop")
+                    f"dy2static: loop variable "
+                    f"'{names[i] if i < len(names) else i}' is first "
+                    "assigned a nested structure inside a compiled while; "
+                    "initialize it before the loop")
             spec = var_spec[0]
-            cur = jnp.asarray(_unwrap(vars_list[i]))
-            if tuple(cur.shape) != tuple(spec.shape) or cur.dtype != spec.dtype:
-                vars_list[i] = Tensor._wrap(jnp.zeros(spec.shape, spec.dtype))
-                changed = True
-        if not changed:
-            return vars_list
+            key = (tuple(spec.shape), spec.dtype)
+            if resolved.get(i) != key:
+                probe_vars[i] = Tensor._wrap(jnp.zeros(spec.shape, spec.dtype))
+                resolved[i] = key
+                progress = True
+        if len(resolved) == len(undef) and not progress:
+            return probe_vars
+        if not progress:
+            break
+    missing = [names[i] if i < len(names) else str(i)
+               for i in undef if i not in resolved]
+    if missing:
+        raise TypeError(
+            f"dy2static: loop variable(s) {missing} are never assigned a "
+            "concrete value on any path through the compiled loop body; "
+            "initialize them before the loop")
     raise TypeError(
         f"dy2static: could not infer a stable shape for loop variable(s) "
         f"{[names[i] for i in undef]} first assigned inside a compiled loop")
@@ -159,13 +257,17 @@ def convert_while(cond_fn, body_fn, init_vars, names=()):
     assigned-in-body variables as the carry; carries must keep stable
     shapes/dtypes across iterations."""
     vars_t = tuple(init_vars)
-    probe = cond_fn(*vars_t)
-    p = _unwrap(probe)
-    if not isinstance(p, jax.core.Tracer):
-        while p:
-            vars_t = tuple(body_fn(*vars_t))
-            p = _unwrap(cond_fn(*vars_t))
-        return vars_t
+    # concrete-cond iterations run as plain python; if the condition BECOMES
+    # traced mid-loop (e.g. a break/return guard flag merged through
+    # lax.cond turns the test into a tensor), the remaining iterations fall
+    # through to the traced lowering below with the current vars as init
+    while True:
+        p = _unwrap(cond_fn(*vars_t))
+        if isinstance(p, jax.core.Tracer):
+            break
+        if not p:
+            return vars_t
+        vars_t = tuple(body_fn(*vars_t))
 
     vars_list = _probe_undefined(cond_fn, body_fn, vars_t, names)
     leaves, treedef = _flatten(tuple(vars_list))

@@ -269,3 +269,230 @@ class TestTransformUnit:
         assert g(1) == 2
         with pytest.raises(AssertionError, match="need positive"):
             g(-1)
+
+
+class TestBreakContinueReturn:
+    """break/continue/return inside COMPILED loops (VERDICT r3 missing #3):
+    lowered to guard flags threaded through the loop carry, the reference's
+    break_continue_transformer.py / return_transformer.py strategy."""
+
+    def test_break_in_while(self):
+        @paddle.jit.to_static
+        def f(x):
+            s = paddle.zeros([])
+            i = paddle.zeros([])
+            while i < 10:
+                s = s + x.sum()
+                if s > 5:
+                    break
+                i = i + 1
+            return s + i
+
+        def eager(xv):
+            s = i = 0.0
+            while i < 10:
+                s += xv
+                if s > 5:
+                    break
+                i += 1
+            return s + i
+
+        for v in (2.0, 0.4):
+            np.testing.assert_allclose(
+                float(f(_t([v])).numpy()), eager(v), rtol=1e-6)
+        assert "eager" not in f._cache.values()
+        assert len(f.concrete_programs) == 1
+
+    def test_continue_in_for_range(self):
+        @paddle.jit.to_static
+        def f(x):
+            s = paddle.zeros([])
+            for i in range(6):
+                if x.sum() + i < 3:  # traced condition
+                    continue
+                s = s + i
+            return s
+
+        def eager(xv):
+            s = 0.0
+            for i in range(6):
+                if xv + i < 3:
+                    continue
+                s += i
+            return s
+
+        for v in (0.0, 2.5, -10.0):
+            np.testing.assert_allclose(
+                float(f(_t([v])).numpy()), eager(v), rtol=1e-6)
+        assert "eager" not in f._cache.values()
+
+    def test_break_skips_rest_of_body(self):
+        # statements AFTER the breaking if must not run once break fired
+        @paddle.jit.to_static
+        def f(x):
+            hits = paddle.zeros([])
+            i = paddle.zeros([])
+            while i < 5:
+                if i >= x.sum():
+                    break
+                hits = hits + 1  # guarded: must not run after break
+                i = i + 1
+            return hits
+
+        np.testing.assert_allclose(float(f(_t([3.0])).numpy()), 3.0)
+        np.testing.assert_allclose(float(f(_t([0.0])).numpy()), 0.0)
+
+    def test_return_in_while(self):
+        @paddle.jit.to_static
+        def f(x):
+            i = paddle.zeros([])
+            acc = x * 0
+            while i < 8:
+                acc = acc + x
+                if acc.sum() > 4:
+                    return acc * 10  # early exit straight out of the loop
+                i = i + 1
+            return acc
+
+        # early-return path
+        np.testing.assert_allclose(f(_t([3.0])).numpy(), [60.0])
+        # loop-exhausted path, same compiled program
+        np.testing.assert_allclose(f(_t([0.1])).numpy(), [0.8], rtol=1e-5)
+        assert "eager" not in f._cache.values()
+        assert len(f.concrete_programs) == 1
+
+    def test_return_in_for_range(self):
+        @paddle.jit.to_static
+        def f(x):
+            for i in range(10):
+                if x.sum() < i:
+                    return x * i
+            return x - 1
+
+        np.testing.assert_allclose(f(_t([2.5])).numpy(), [7.5])
+        np.testing.assert_allclose(f(_t([100.0])).numpy(), [99.0])
+
+    def test_return_from_nested_loop(self):
+        @paddle.jit.to_static
+        def f(x):
+            s = paddle.zeros([])
+            for i in range(3):
+                for j in range(3):
+                    s = s + x.sum()
+                    if s > 4:
+                        return s * 100  # two loop levels out
+            return s
+
+        def eager(xv):
+            s = 0.0
+            for i in range(3):
+                for j in range(3):
+                    s += xv
+                    if s > 4:
+                        return s * 100
+            return s
+
+        for v in (1.0, 0.3):
+            np.testing.assert_allclose(
+                float(f(_t([v])).numpy()), eager(v), rtol=1e-6)
+
+    def test_continue_then_break_mixed(self):
+        @paddle.jit.to_static
+        def f(x):
+            s = paddle.zeros([])
+            for i in range(8):
+                if i < x.sum():
+                    continue
+                if i > x.sum() + 3:
+                    break
+                s = s + i
+            return s
+
+        def eager(xv):
+            s = 0.0
+            for i in range(8):
+                if i < xv:
+                    continue
+                if i > xv + 3:
+                    break
+                s += i
+            return s
+
+        for v in (2.0, 0.0, 9.0):
+            np.testing.assert_allclose(
+                float(f(_t([v])).numpy()), eager(v), rtol=1e-6)
+
+    def test_concrete_args_keep_python_semantics(self):
+        # same transformed function driven by concrete (non-traced) values
+        from paddle_tpu.jit.dy2static import transform_function
+
+        def f(n):
+            s = 0
+            for i in range(10):
+                if i >= n:
+                    break
+                s = s + i
+            return s
+
+        g = transform_function(f)
+        for n in (0, 3, 10, 15):
+            assert g(n) == f(n)
+
+    def test_return_from_nested_loop_traced_outer_cond(self):
+        # the outer while condition is traced from its FIRST evaluation, so
+        # the whole nest lowers through lax.while_loop probes (review: the
+        # placeholder for the inner return slot must survive nested probing)
+        @paddle.jit.to_static
+        def f(x):
+            s = paddle.zeros([])
+            i = paddle.zeros([])
+            while i < x.sum() + 3:
+                j = paddle.zeros([])
+                while j < 2:
+                    s = s + x.sum()
+                    if s > 4:
+                        return s * 100
+                    j = j + 1
+                i = i + 1
+            return s
+
+        def eager(xv):
+            s = i = 0.0
+            while i < xv + 3:
+                j = 0.0
+                while j < 2:
+                    s += xv
+                    if s > 4:
+                        return s * 100
+                    j += 1
+                i += 1
+            return s
+
+        for v in (2.0, 0.5):
+            np.testing.assert_allclose(
+                float(f(_t([v])).numpy()), eager(v), rtol=1e-6)
+        assert "eager" not in f._cache.values()
+
+    def test_tuple_return_in_loop_clear_error(self):
+        from paddle_tpu.jit.dy2static import UnsupportedSyntax, transform_function
+
+        def f(x):
+            i = paddle.zeros([])
+            while i < 8:
+                if x.sum() > 4:
+                    return x, i
+                i = i + 1
+            return x, i
+
+        with pytest.raises(UnsupportedSyntax, match="single tensor"):
+            transform_function(f)
+
+    def test_reserved_prefix_rejected(self):
+        from paddle_tpu.jit.dy2static import UnsupportedSyntax, transform_function
+
+        def f(x):
+            _pd_ctl_retv_1 = x * 2
+            return _pd_ctl_retv_1
+
+        with pytest.raises(UnsupportedSyntax, match="reserved"):
+            transform_function(f)
