@@ -67,6 +67,7 @@ from . import metric  # noqa: F401,E402
 from . import vision  # noqa: F401,E402
 from . import hapi  # noqa: F401,E402
 from .hapi import Model  # noqa: F401,E402
+from .hapi.model import summary  # noqa: F401,E402
 from .framework.io import load, save  # noqa: F401,E402
 from . import amp  # noqa: F401,E402
 from . import distributed  # noqa: F401,E402

@@ -475,8 +475,65 @@ class Model:
         return self.network.parameters(*args, **kwargs)
 
     def summary(self, input_size=None, dtype=None):
-        total = sum(p.size for p in self.network.parameters())
-        trainable = sum(p.size for p in self.network.parameters() if p.trainable)
-        print(f"Total params: {total}")
-        print(f"Trainable params: {trainable}")
-        return {"total_params": total, "trainable_params": trainable}
+        """Per-layer table (reference paddle.summary /
+        python/paddle/hapi/model_summary.py): layer type, output shape and
+        param count collected via forward hooks on a dummy forward when
+        ``input_size`` is given; falls back to totals-only otherwise."""
+        return summary(self.network, input_size=input_size, dtype=dtype)
+
+
+def summary(net, input_size=None, dtype=None):
+    """Standalone paddle.summary parity (reference hapi/model_summary.py:1).
+
+    ``input_size``: tuple (or list of tuples) INCLUDING the batch dim, e.g.
+    (1, 1, 28, 28). Runs a zeros forward with per-layer hooks; prints the
+    layer table; returns {'total_params', 'trainable_params'}."""
+    import numpy as np
+
+    total = sum(p.size for p in net.parameters())
+    trainable = sum(p.size for p in net.parameters() if p.trainable)
+    rows = []
+    if input_size is not None:
+        sizes = (list(input_size)
+                 if isinstance(input_size, list) else [input_size])
+        dt = np.dtype(dtype or "float32")
+        handles = []
+
+        def make_hook(name, layer):
+            def hook(lyr, inputs, outputs):
+                out = outputs[0] if isinstance(outputs, (tuple, list)) \
+                    else outputs
+                shape = list(getattr(out, "shape", []))
+                n_params = sum(
+                    p.size for p in layer.parameters(include_sublayers=False))
+                rows.append({"name": f"{type(layer).__name__}-{name}",
+                             "output_shape": shape, "params": n_params})
+
+            return hook
+
+        for name, layer in net.named_sublayers():
+            handles.append(
+                layer.register_forward_post_hook(make_hook(name, layer)))
+        try:
+            from ..core.autograd import no_grad
+
+            ins = [Tensor._wrap(jnp.zeros(tuple(s), dt)) for s in sizes]
+            with no_grad():
+                net(*ins)
+        finally:
+            for h in handles:
+                h.remove()
+        name_w = max([len(r["name"]) for r in rows] + [12]) + 2
+        print(f"{'Layer (type)':<{name_w}} {'Output Shape':<20} {'Param #':>10}")
+        print("=" * (name_w + 32))
+        for r in rows:
+            print(f"{r['name']:<{name_w}} {str(r['output_shape']):<20} "
+                  f"{r['params']:>10}")
+        print("=" * (name_w + 32))
+    print(f"Total params: {total}")
+    print(f"Trainable params: {trainable}")
+    print(f"Non-trainable params: {total - trainable}")
+    out = {"total_params": total, "trainable_params": trainable}
+    if rows:
+        out["layers"] = rows
+    return out

@@ -295,6 +295,11 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
+        self.use_buffer_reader = use_buffer_reader
+        self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
+        self.persistent_workers = persistent_workers
+        self._mp_iter = None  # live fleet when persistent_workers
         self.iterable_mode = isinstance(dataset, IterableDataset)
         self.batch_size = batch_size
         self.drop_last = drop_last
@@ -362,10 +367,35 @@ class DataLoader:
                 yield self.collate_fn([self.dataset[i] for i in idx_batch])
 
     def __iter__(self):
-        if self.num_workers == 0:
+        if self.num_workers > 0:
+            # real worker PROCESSES + shared-memory ring (reference
+            # dataloader_iter.py multi-process path) — python transform
+            # pipelines escape the GIL. The native C++ batcher still wins
+            # for plain array datasets, so it keeps precedence.
+            arrays = self._native_arrays()
+            if arrays is not None:
+                yield from self._native_iter(arrays)
+                return
+            from .worker import MultiProcessLoaderIter
+
+            if self.persistent_workers and not self.iterable_mode:
+                # fleet survives across epochs (reference
+                # persistent_workers): re-fork only if workers died
+                if self._mp_iter is None or not self._mp_iter.alive():
+                    self._mp_iter = MultiProcessLoaderIter(self)
+                yield from self._mp_iter
+                return
+            it = MultiProcessLoaderIter(self)
+            try:
+                yield from it
+            finally:
+                it.close()
+            return
+        if not self.use_buffer_reader:
             yield from self._raw_iter()
             return
-        # thread prefetch: overlap host batching with device compute
+        # num_workers=0 + buffered reader: single background thread overlaps
+        # host batching with device compute (the pre-round-4 >0 path)
         q: queue.Queue = queue.Queue(maxsize=self.prefetch_factor * max(self.num_workers, 1))
         sentinel = object()
         stop = threading.Event()
@@ -385,10 +415,16 @@ class DataLoader:
             except BaseException as e:  # surface worker errors to the consumer
                 err.append(e)
             finally:
-                try:
-                    q.put_nowait(sentinel)
-                except queue.Full:
-                    pass
+                # the sentinel MUST arrive or the consumer blocks forever —
+                # a put_nowait here silently drops it whenever the queue is
+                # full at end-of-data (the consumer then drains the queue
+                # and hangs); poll-put until delivered or abandoned
+                while not stop.is_set():
+                    try:
+                        q.put(sentinel, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()

@@ -58,31 +58,47 @@ _shift_right = _shift_right_f
 _shift_left = _shift_left_f
 
 
-def _alpha_kernel(logp_ref, same_ref, alpha_ref, *, T):
-    """logp_ref: [T, 8, Sp]; same_ref: [8, Sp]; alpha_ref out: [T, 8, Sp]."""
+def _alpha_kernel(logp_ref, same_ref, alpha_ref, carry_ref, *, Tt):
+    """One TIME TILE of the forward recursion. logp_ref: [Tt, 8, Sp];
+    alpha_ref out: [Tt, 8, Sp]; carry_ref scratch [8, Sp] holds the last
+    alpha row across sequential time-tile grid steps (grid dim 1)."""
     Sp = logp_ref.shape[-1]
+    tt = pl.program_id(1)
     lane = jax.lax.broadcasted_iota(jnp.int32, (_BT, Sp), 1)
     same = same_ref[...]
 
-    alpha0 = jnp.where(lane < 2, logp_ref[0], _neg32())
-    alpha_ref[pl.ds(0, 1), :, :] = alpha0[None]
+    @pl.when(tt == 0)
+    def _init_carry():
+        carry_ref[...] = jnp.full((_BT, Sp), _NEG, jnp.float32)
+
+    base = tt * jnp.int32(Tt)
 
     def step(t, alpha):
         lp_t = logp_ref[pl.ds(t, 1), :, :].reshape(_BT, Sp)
         a2 = _shift_right(alpha, 1, lane)
         a3 = jnp.where(same > 0, _neg32(), _shift_right(alpha, 2, lane))
-        new = _lse3(alpha, a2, a3) + lp_t
+        rec = _lse3(alpha, a2, a3) + lp_t
+        # global t == 0 takes the start distribution instead of recursing
+        new = jnp.where(base + t == 0,
+                        jnp.where(lane < 2, lp_t, _neg32()), rec)
         alpha_ref[pl.ds(t, 1), :, :] = new[None]
         return new
 
-    jax.lax.fori_loop(jnp.int32(1), jnp.int32(T), step, alpha0)
+    final = jax.lax.fori_loop(jnp.int32(0), jnp.int32(Tt), step,
+                              carry_ref[...])
+    carry_ref[...] = final
 
 
-def _beta_kernel(logp_ref, same_ref, inlen_ref, slast_ref, beta_ref, *, T):
-    """Branch-free ragged beta: full static T loop; at each t the per-row
-    terminal init (t == in_len-1) merges in by mask. logp_ref: [T, 8, Sp];
-    inlen/slast: [8, 1] i32; beta_ref out: [T, 8, Sp]."""
+def _beta_kernel(logp_ref, same_ref, inlen_ref, slast_ref, beta_ref,
+                 carry_ref, *, Tt, n_tt):
+    """One TIME TILE of the branch-free ragged beta recursion, tiles
+    processed high-to-low (reversed index map). The carry is
+    ``tmp = logp[t+1] + beta[t+1]`` — the only cross-tile state the
+    recursion needs, which also removes the old lp_next reread. Per-row
+    terminal init (t == in_len-1) still merges in by mask, so ragged
+    lengths stay branch-free across tiles."""
     Sp = logp_ref.shape[-1]
+    tt = pl.program_id(1)  # 0 = highest time tile (index map reverses)
     lane = jax.lax.broadcasted_iota(jnp.int32, (_BT, Sp), 1)
     same = same_ref[...]
     in_len = inlen_ref[...]  # [8, 1] i32
@@ -93,33 +109,49 @@ def _beta_kernel(logp_ref, same_ref, inlen_ref, slast_ref, beta_ref, *, T):
         (lane == s_last) | ((lane == s_last - 1) & (s_last > 0)),
         jnp.float32(0.0), _neg32())  # [8, Sp]
 
-    beta_T = jnp.full((_BT, Sp), _NEG, jnp.float32)
+    @pl.when(tt == 0)
+    def _init_carry():
+        carry_ref[...] = jnp.full((_BT, Sp), _NEG, jnp.float32)
 
-    def step(i, beta_next):
-        t = jnp.int32(T - 1) - i
-        lp_next = logp_ref[pl.ds(jnp.minimum(t + 1, jnp.int32(T - 1)), 1),
-                           :, :].reshape(_BT, Sp)
-        tmp = lp_next + beta_next
-        b2 = _shift_left(tmp, 1, lane, Sp)
-        b3 = jnp.where(same_l2 > 0, _neg32(), _shift_left(tmp, 2, lane, Sp))
-        rec = _lse3(tmp, b2, b3)
+    base = (jnp.int32(n_tt) - 1 - tt) * jnp.int32(Tt)
+
+    def step(i, tmp_next):
+        t = jnp.int32(Tt - 1) - i
+        b2 = _shift_left(tmp_next, 1, lane, Sp)
+        b3 = jnp.where(same_l2 > 0, _neg32(),
+                       _shift_left(tmp_next, 2, lane, Sp))
+        rec = _lse3(tmp_next, b2, b3)
         # rows where t is the terminal step take the init; rows with
-        # t >= in_len keep -inf (beta_next is -inf so rec stays -inf)
-        new = jnp.where(t == in_len - 1, init, rec)
+        # t >= in_len keep -inf (tmp_next is -inf so rec stays -inf)
+        new = jnp.where(base + t == in_len - 1, init, rec)
         beta_ref[pl.ds(t, 1), :, :] = new[None]
-        return new
+        lp_t = logp_ref[pl.ds(t, 1), :, :].reshape(_BT, Sp)
+        return lp_t + new
 
-    jax.lax.fori_loop(jnp.int32(0), jnp.int32(T), step, beta_T)
+    final = jax.lax.fori_loop(jnp.int32(0), jnp.int32(Tt), step,
+                              carry_ref[...])
+    carry_ref[...] = final
+
+
+def _time_tile(T, Sp, budget_bytes=6 * 1024 * 1024):
+    """Largest time-tile whose in+out blocks (double-buffered) fit the VMEM
+    budget, capped at 256 rows."""
+    per_row = 4 * _BT * Sp * 4  # in + out, double-buffered, f32
+    return max(1, min(T, 256, budget_bytes // per_row))
 
 
 def _prep(log_probs, labels, blank):
-    """ext labels, gathered ext log-probs [T, B, Sp], same-mask [B, Sp] —
-    batch padded to a multiple of 8 sublane rows."""
+    """ext labels, gathered ext log-probs [Tp, B, Sp], same-mask [B, Sp] —
+    batch padded to a multiple of 8 sublane rows, time padded to a multiple
+    of the VMEM time-tile (padded steps carry -inf log-probs: the alpha
+    recursion freewheels, the beta recursion keeps them at -inf)."""
     T, B, C = log_probs.shape
     L = labels.shape[1]
     S = 2 * L + 1
     Sp = _lanes(S)
     Bp = ((B + _BT - 1) // _BT) * _BT
+    Tt = _time_tile(T, Sp)
+    Tp = ((T + Tt - 1) // Tt) * Tt
     lbl = labels.astype(jnp.int32)
     ext = jnp.full((B, S), blank, jnp.int32)
     ext = ext.at[:, 1::2].set(lbl)
@@ -129,45 +161,50 @@ def _prep(log_probs, labels, blank):
     same = jnp.concatenate(
         [jnp.ones((B, 2), jnp.int32),
          (ext[:, 2:] == ext[:, :-2]).astype(jnp.int32)], axis=1)
-    logp_ext = jnp.pad(logp_ext, ((0, 0), (0, Bp - B), (0, Sp - S)),
+    logp_ext = jnp.pad(logp_ext, ((0, Tp - T), (0, Bp - B), (0, Sp - S)),
                        constant_values=_NEG)
     same = jnp.pad(same, ((0, Bp - B), (0, Sp - S)), constant_values=1)
-    return ext, logp_ext, same, S, Sp, Bp
+    return ext, logp_ext, same, S, Sp, Bp, Tt
 
 
-def _alphas(logp_ext, same, T, Sp):
-    Bp = logp_ext.shape[1]
+def _alphas(logp_ext, same, Tt, Sp):
+    Tp, Bp = logp_ext.shape[0], logp_ext.shape[1]
+    n_tt = Tp // Tt
     return pl.pallas_call(
-        functools.partial(_alpha_kernel, T=T),
-        grid=(Bp // _BT,),
+        functools.partial(_alpha_kernel, Tt=Tt),
+        grid=(Bp // _BT, n_tt),
         in_specs=[
-            pl.BlockSpec((T, _BT, Sp), lambda b: (_i0(), b, _i0())),
-            pl.BlockSpec((_BT, Sp), lambda b: (b, _i0())),
+            pl.BlockSpec((Tt, _BT, Sp), lambda b, tt: (tt, b, _i0())),
+            pl.BlockSpec((_BT, Sp), lambda b, tt: (b, _i0())),
         ],
-        out_specs=pl.BlockSpec((T, _BT, Sp), lambda b: (_i0(), b, _i0())),
-        out_shape=jax.ShapeDtypeStruct((T, Bp, Sp), jnp.float32),
+        out_specs=pl.BlockSpec((Tt, _BT, Sp), lambda b, tt: (tt, b, _i0())),
+        out_shape=jax.ShapeDtypeStruct((Tp, Bp, Sp), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((_BT, Sp), jnp.float32)],
         interpret=_interpret_mode(),
     )(logp_ext, same)
 
 
-def _betas(logp_ext, same, in_len, s_last, T, Sp):
-    Bp = logp_ext.shape[1]
+def _betas(logp_ext, same, in_len, s_last, Tt, Sp):
+    Tp, Bp = logp_ext.shape[0], logp_ext.shape[1]
+    n_tt = Tp // Tt
     B = in_len.shape[0]
     inlen2 = jnp.pad(in_len.astype(jnp.int32), (0, Bp - B),
                      constant_values=-1)[:, None]  # [Bp, 1]
     slast2 = jnp.pad(s_last.astype(jnp.int32), (0, Bp - B),
                      constant_values=-1)[:, None]
+    rev = lambda b, tt: (jnp.int32(n_tt - 1) - tt, b, _i0())
     return pl.pallas_call(
-        functools.partial(_beta_kernel, T=T),
-        grid=(Bp // _BT,),
+        functools.partial(_beta_kernel, Tt=Tt, n_tt=n_tt),
+        grid=(Bp // _BT, n_tt),
         in_specs=[
-            pl.BlockSpec((T, _BT, Sp), lambda b: (_i0(), b, _i0())),
-            pl.BlockSpec((_BT, Sp), lambda b: (b, _i0())),
-            pl.BlockSpec((_BT, 1), lambda b: (b, _i0())),
-            pl.BlockSpec((_BT, 1), lambda b: (b, _i0())),
+            pl.BlockSpec((Tt, _BT, Sp), rev),
+            pl.BlockSpec((_BT, Sp), lambda b, tt: (b, _i0())),
+            pl.BlockSpec((_BT, 1), lambda b, tt: (b, _i0())),
+            pl.BlockSpec((_BT, 1), lambda b, tt: (b, _i0())),
         ],
-        out_specs=pl.BlockSpec((T, _BT, Sp), lambda b: (_i0(), b, _i0())),
-        out_shape=jax.ShapeDtypeStruct((T, Bp, Sp), jnp.float32),
+        out_specs=pl.BlockSpec((Tt, _BT, Sp), rev),
+        out_shape=jax.ShapeDtypeStruct((Tp, Bp, Sp), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((_BT, Sp), jnp.float32)],
         interpret=_interpret_mode(),
     )(logp_ext, same, inlen2, slast2)
 
@@ -199,9 +236,8 @@ def ctc_loss_pallas(log_probs, labels, input_lengths, label_lengths,
 
 
 def _fwd(log_probs, labels, input_lengths, label_lengths, blank):
-    T = log_probs.shape[0]
-    ext, logp_ext, same, S, Sp, Bp = _prep(log_probs, labels, blank)
-    alphas = _alphas(logp_ext, same, T, Sp)
+    ext, logp_ext, same, S, Sp, Bp, Tt = _prep(log_probs, labels, blank)
+    alphas = _alphas(logp_ext, same, Tt, Sp)
     ll, s_last = _loglik(alphas, input_lengths, label_lengths, S)
     # logp_ext is NOT saved: it is one cheap gather away from log_probs
     # (recomputed in _bwd) and would otherwise pin T*Bp*Sp floats in HBM
@@ -214,10 +250,11 @@ def _fwd(log_probs, labels, input_lengths, label_lengths, blank):
 def _bwd(blank, res, g):
     (log_probs, labels, in_len, lbl_len, alphas, ll, s_last) = res
     T, B, C = log_probs.shape
-    ext, logp_ext, same, S, Sp, Bp = _prep(log_probs, labels, blank)
-    betas = _betas(logp_ext, same, in_len, s_last, T, Sp)
+    ext, logp_ext, same, S, Sp, Bp, Tt = _prep(log_probs, labels, blank)
+    betas = _betas(logp_ext, same, in_len, s_last, Tt, Sp)
     # posterior over ext states; rows t >= in_len carry -inf betas -> 0
-    post = jnp.exp(alphas[:, :B] + betas[:, :B]
+    # (time-padded rows t >= T are sliced off)
+    post = jnp.exp(alphas[:T, :B] + betas[:T, :B]
                    - ll[None, :, None])  # [T, B, Sp]
     g_ext = -post * g[None, :, None]  # d(-ll)/dlogp_ext * upstream
     # scatter ext states back to classes on the MXU: one-hot [B,S,C] einsum
@@ -229,11 +266,11 @@ def _bwd(blank, res, g):
 
 
 def fits_vmem(T, L, budget_bytes=6 * 1024 * 1024):
-    """Whether the untiled [T, 8, Sp] blocks fit VMEM (double-buffered in +
-    out). Long utterances fall back to the scan lattice until the kernel
-    grows T-tiling."""
+    """Time-tiling (round 4) removed the old whole-T VMEM ceiling: any T
+    works as long as a SINGLE time row's in+out blocks fit the budget
+    (pathologically long label sequences are the only remaining fallback)."""
     Sp = _lanes(2 * L + 1)
-    return 2 * (T * _BT * Sp * 4) <= budget_bytes
+    return 4 * _BT * Sp * 4 <= budget_bytes
 
 
 ctc_loss_pallas.defvjp(_fwd, _bwd)

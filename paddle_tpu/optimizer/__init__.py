@@ -1,5 +1,6 @@
 """paddle.optimizer parity surface."""
 from . import lr  # noqa: F401
+from .lbfgs import LBFGS  # noqa: F401
 from .optimizer import Optimizer  # noqa: F401
 from .optimizers import (  # noqa: F401
     SGD,
@@ -15,5 +16,5 @@ from .optimizers import (  # noqa: F401
 
 __all__ = [
     "Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adagrad", "RMSProp",
-    "Adadelta", "Adamax", "Lamb", "lr",
+    "Adadelta", "Adamax", "Lamb", "LBFGS", "lr",
 ]

@@ -1,0 +1,324 @@
+"""L-BFGS with strong-Wolfe line search.
+
+Parity: /root/reference/python/paddle/optimizer/lbfgs.py:1 (paddle's LBFGS,
+itself the classic two-loop-recursion + cubic-interpolation line search of
+Nocedal & Wright ch.6-7) and
+/root/reference/python/paddle/incubate/optimizer/line_search_dygraph.py.
+
+TPU stance: L-BFGS is a HOST-side driver — each iteration re-evaluates the
+user's closure (which may itself be jitted) and does O(m·n) vector math on
+the flattened parameters. The curvature history and line search run in
+float64 numpy for robustness; only the closure touches the accelerator.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.autograd import enable_grad
+from .optimizer import Optimizer
+
+__all__ = ["LBFGS"]
+
+
+def _cubic_interpolate(x1, f1, g1, x2, f2, g2, bounds=None):
+    """Minimizer of the cubic through (x1,f1,g1), (x2,f2,g2); falls back to
+    bisection when the interpolation is ill-conditioned."""
+    if bounds is not None:
+        xmin_bound, xmax_bound = bounds
+    else:
+        xmin_bound, xmax_bound = (x1, x2) if x1 <= x2 else (x2, x1)
+    d1 = g1 + g2 - 3 * (f1 - f2) / (x1 - x2)
+    d2_square = d1 ** 2 - g1 * g2
+    if d2_square >= 0:
+        d2 = np.sqrt(d2_square)
+        if x1 <= x2:
+            min_pos = x2 - (x2 - x1) * ((g2 + d2 - d1) / (g2 - g1 + 2 * d2))
+        else:
+            min_pos = x1 - (x1 - x2) * ((g1 + d2 - d1) / (g1 - g2 + 2 * d2))
+        return float(min(max(min_pos, xmin_bound), xmax_bound))
+    return float((xmin_bound + xmax_bound) / 2.0)
+
+
+def _strong_wolfe(obj_func, x, t, d, f, g, gtd, c1=1e-4, c2=0.9,
+                  tolerance_change=1e-9, max_ls=25):
+    """Bracketing strong-Wolfe search: returns (f_new, g_new, t, n_evals).
+    ``obj_func(x, t, d)`` evaluates loss+grad at x + t·d."""
+    d_norm = np.abs(d).max()
+    g = g.copy()
+    f_new, g_new = obj_func(x, t, d)
+    ls_func_evals = 1
+    gtd_new = float(g_new @ d)
+
+    t_prev, f_prev, g_prev, gtd_prev = 0.0, f, g, gtd
+    done = False
+    ls_iter = 0
+    while ls_iter < max_ls:
+        if f_new > (f + c1 * t * gtd) or (ls_iter > 1 and f_new >= f_prev):
+            bracket = [t_prev, t]
+            bracket_f = [f_prev, f_new]
+            bracket_g = [g_prev, g_new.copy()]
+            bracket_gtd = [gtd_prev, gtd_new]
+            break
+        if abs(gtd_new) <= -c2 * gtd:
+            bracket = [t, t]
+            bracket_f = [f_new, f_new]
+            bracket_g = [g_new, g_new]
+            done = True
+            break
+        if gtd_new >= 0:
+            bracket = [t_prev, t]
+            bracket_f = [f_prev, f_new]
+            bracket_g = [g_prev, g_new.copy()]
+            bracket_gtd = [gtd_prev, gtd_new]
+            break
+
+        min_step = t + 0.01 * (t - t_prev)
+        max_step = t * 10
+        tmp = t
+        t = _cubic_interpolate(t_prev, f_prev, gtd_prev, t, f_new, gtd_new,
+                               bounds=(min_step, max_step))
+        t_prev, f_prev, g_prev, gtd_prev = tmp, f_new, g_new.copy(), gtd_new
+        f_new, g_new = obj_func(x, t, d)
+        ls_func_evals += 1
+        gtd_new = float(g_new @ d)
+        ls_iter += 1
+    else:
+        bracket = [0.0, t]
+        bracket_f = [f, f_new]
+        bracket_g = [g, g_new]
+
+    # zoom phase
+    insuf_progress = False
+    low_pos, high_pos = (0, 1) if bracket_f[0] <= bracket_f[-1] else (1, 0)
+    while not done and ls_iter < max_ls:
+        if abs(bracket[1] - bracket[0]) * d_norm < tolerance_change:
+            break
+        t = _cubic_interpolate(bracket[0], bracket_f[0], bracket_gtd[0],
+                               bracket[1], bracket_f[1], bracket_gtd[1])
+        eps = 0.1 * (max(bracket) - min(bracket))
+        if min(max(bracket) - t, t - min(bracket)) < eps:
+            if insuf_progress or t >= max(bracket) or t <= min(bracket):
+                t = (max(bracket) - eps if abs(t - max(bracket))
+                     < abs(t - min(bracket)) else min(bracket) + eps)
+                insuf_progress = False
+            else:
+                insuf_progress = True
+        else:
+            insuf_progress = False
+
+        f_new, g_new = obj_func(x, t, d)
+        ls_func_evals += 1
+        gtd_new = float(g_new @ d)
+        ls_iter += 1
+
+        if f_new > (f + c1 * t * gtd) or f_new >= bracket_f[low_pos]:
+            bracket[high_pos] = t
+            bracket_f[high_pos] = f_new
+            bracket_g[high_pos] = g_new.copy()
+            bracket_gtd[high_pos] = gtd_new
+            low_pos, high_pos = ((0, 1) if bracket_f[0] <= bracket_f[1]
+                                 else (1, 0))
+        else:
+            if abs(gtd_new) <= -c2 * gtd:
+                done = True
+            elif gtd_new * (bracket[high_pos] - bracket[low_pos]) >= 0:
+                bracket[high_pos] = bracket[low_pos]
+                bracket_f[high_pos] = bracket_f[low_pos]
+                bracket_g[high_pos] = bracket_g[low_pos]
+                bracket_gtd[high_pos] = bracket_gtd[low_pos]
+            bracket[low_pos] = t
+            bracket_f[low_pos] = f_new
+            bracket_g[low_pos] = g_new.copy()
+            bracket_gtd[low_pos] = gtd_new
+
+    t = bracket[low_pos]
+    return bracket_f[low_pos], bracket_g[low_pos], t, ls_func_evals
+
+
+class LBFGS(Optimizer):
+    """Limited-memory BFGS (reference paddle.optimizer.LBFGS).
+
+    ``step(closure)`` drives the whole inner optimization: the closure must
+    clear grads, recompute the loss, call ``loss.backward()`` and return the
+    loss (same contract as the reference/torch). ``line_search_fn`` is
+    ``None`` (fixed learning_rate step) or ``'strong_wolfe'``.
+    """
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate=learning_rate, parameters=parameters,
+                         weight_decay=weight_decay, grad_clip=grad_clip,
+                         name=name)
+        if line_search_fn not in (None, "strong_wolfe"):
+            raise ValueError("only 'strong_wolfe' is supported as "
+                             f"line_search_fn, got {line_search_fn!r}")
+        if grad_clip is not None:
+            # loud, not silent: clipping inside a curvature-history + line
+            # search loop would corrupt the quasi-Newton model
+            raise ValueError("LBFGS does not support grad_clip")
+        self.max_iter = max_iter
+        self.max_eval = max_eval if max_eval is not None else max_iter * 5 // 4
+        self.tolerance_grad = tolerance_grad
+        self.tolerance_change = tolerance_change
+        self.history_size = history_size
+        self.line_search_fn = line_search_fn
+        self._hist = {"old_dirs": [], "old_stps": [], "ro": [],
+                      "prev_flat_grad": None, "d": None, "t": None,
+                      "h_diag": 1.0, "n_iter": 0, "func_evals": 0}
+
+    # -- flat-vector plumbing over the parameter list ---------------------
+    def _params(self):
+        if self._parameter_list is None:
+            raise ValueError("LBFGS constructed without parameters")
+        return [p for p in self._parameter_list if p.trainable]
+
+    def _gather_flat_grad(self):
+        chunks = []
+        for p in self._params():
+            g = p._grad
+            flat = (np.zeros(int(np.prod(p.shape)) or 1)
+                    if g is None else np.asarray(g, np.float64).ravel())
+            if self._weight_decay:
+                # L2 regularization folds into the gradient so the line
+                # search and curvature pairs see the regularized objective
+                flat = flat + float(self._weight_decay) * np.asarray(
+                    p._value, np.float64).ravel()
+            chunks.append(flat)
+        return np.concatenate(chunks) if chunks else np.zeros(0)
+
+    def _clone_flat_params(self):
+        return np.concatenate([
+            np.asarray(p._value, np.float64).ravel() for p in self._params()])
+
+    def _set_flat_params(self, flat):
+        off = 0
+        for p in self._params():
+            n = int(np.prod(p.shape)) or 1
+            p._value = jnp.asarray(
+                flat[off:off + n].reshape(tuple(p.shape) or ()),
+                dtype=p._value.dtype)
+            off += n
+
+    def _evaluate(self, closure, x, t, d):
+        """loss+flat grad at x + t·d (params restored by the caller)."""
+        self._set_flat_params(x + t * d)
+        with enable_grad():
+            loss = closure()
+        self._hist["func_evals"] += 1
+        return float(np.asarray(loss._value)), self._gather_flat_grad()
+
+    # -- the driver -------------------------------------------------------
+    def step(self, closure):
+        st = self._hist
+        lr = self.get_lr()
+        with enable_grad():
+            orig_loss = closure()
+        loss = float(np.asarray(orig_loss._value))
+        st["func_evals"] += 1
+        current_evals = 1
+
+        flat_grad = self._gather_flat_grad()
+        if np.abs(flat_grad).max(initial=0.0) <= self.tolerance_grad:
+            return orig_loss
+
+        d, t = st["d"], st["t"]
+        old_dirs, old_stps, ro = st["old_dirs"], st["old_stps"], st["ro"]
+        h_diag = st["h_diag"]
+        prev_flat_grad = st["prev_flat_grad"]
+        prev_loss = loss
+
+        n_iter = 0
+        while n_iter < self.max_iter:
+            n_iter += 1
+            st["n_iter"] += 1
+
+            if st["n_iter"] == 1:
+                d = -flat_grad
+                h_diag = 1.0
+            else:
+                y = flat_grad - prev_flat_grad
+                s = d * t
+                ys = float(y @ s)
+                if ys > 1e-10:
+                    if len(old_dirs) >= self.history_size:
+                        old_dirs.pop(0)
+                        old_stps.pop(0)
+                        ro.pop(0)
+                    old_dirs.append(y)
+                    old_stps.append(s)
+                    ro.append(1.0 / ys)
+                    h_diag = ys / float(y @ y)
+                # two-loop recursion
+                q = -flat_grad.copy()
+                al = [0.0] * len(old_dirs)
+                for i in range(len(old_dirs) - 1, -1, -1):
+                    al[i] = float(old_stps[i] @ q) * ro[i]
+                    q -= al[i] * old_dirs[i]
+                d = q * h_diag
+                for i in range(len(old_dirs)):
+                    be_i = float(old_dirs[i] @ d) * ro[i]
+                    d += (al[i] - be_i) * old_stps[i]
+
+            prev_flat_grad = flat_grad.copy()
+            prev_loss = loss
+
+            gtd = float(flat_grad @ d)
+            if gtd > -self.tolerance_change:
+                break
+            t = (min(1.0, 1.0 / np.abs(flat_grad).sum()) * lr
+                 if st["n_iter"] == 1 else lr)
+
+            if self.line_search_fn == "strong_wolfe":
+                x_init = self._clone_flat_params()
+                loss, flat_grad, t, ls_evals = _strong_wolfe(
+                    lambda x, step_t, dd: self._evaluate(closure, x, step_t, dd),
+                    x_init, t, d, loss, flat_grad, gtd,
+                    tolerance_change=self.tolerance_change)
+                self._set_flat_params(x_init + t * d)
+                current_evals += ls_evals
+            else:
+                self._set_flat_params(self._clone_flat_params() + t * d)
+                if n_iter != self.max_iter:
+                    with enable_grad():
+                        loss = float(np.asarray(closure()._value))
+                    flat_grad = self._gather_flat_grad()
+                    current_evals += 1
+                    st["func_evals"] += 1
+
+            if current_evals >= self.max_eval:
+                break
+            if np.abs(flat_grad).max(initial=0.0) <= self.tolerance_grad:
+                break
+            if np.abs(d * t).max(initial=0.0) <= self.tolerance_change:
+                break
+            if abs(loss - prev_loss) < self.tolerance_change:
+                break
+
+        st.update(d=d, t=t, prev_flat_grad=prev_flat_grad, h_diag=h_diag)
+        self._step_count += 1
+        return orig_loss
+
+    def state_dict(self):
+        out = super().state_dict()
+        st = self._hist
+        out["lbfgs_state"] = {
+            "old_dirs": [np.asarray(a) for a in st["old_dirs"]],
+            "old_stps": [np.asarray(a) for a in st["old_stps"]],
+            "ro": list(st["ro"]),
+            "prev_flat_grad": st["prev_flat_grad"],
+            "d": st["d"], "t": st["t"], "h_diag": st["h_diag"],
+            "n_iter": st["n_iter"], "func_evals": st["func_evals"],
+        }
+        return out
+
+    def set_state_dict(self, state):
+        super().set_state_dict(state)
+        saved = state.get("lbfgs_state")
+        if saved:
+            self._hist.update(saved)
+            self._hist["old_dirs"] = [np.asarray(a) for a in saved["old_dirs"]]
+            self._hist["old_stps"] = [np.asarray(a) for a in saved["old_stps"]]

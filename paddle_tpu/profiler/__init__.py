@@ -25,6 +25,7 @@ __all__ = [
     "Profiler", "ProfilerTarget", "ProfilerState", "RecordEvent",
     "make_scheduler", "export_chrome_tracing", "Benchmark", "benchmark",
     "TimeAverager", "transformer_flops_per_token", "peak_flops", "mfu",
+    "parse_trace_op_times", "format_op_table",
 ]
 
 
@@ -216,8 +217,21 @@ class Profiler:
         """jax traces are written at stop time; returns the trace dir."""
         return self._last_export_dir
 
-    def summary(self, **kwargs):
-        return self._benchmark.report()
+    def summary(self, max_rows=10, print_table=True, **kwargs):
+        """Throughput report + per-op time tables parsed from the exported
+        trace (reference profiler_statistic.py:1 summary tables). Returns
+        the benchmark report dict extended with ``op_summary`` (device ops)
+        and ``host_summary`` rows; prints the formatted table like the
+        reference unless ``print_table=False``."""
+        report = self._benchmark.report()
+        if self._last_export_dir is not None:
+            dev_rows, host_rows = parse_trace_op_times(self._last_export_dir)
+            report["op_summary"] = dev_rows[:max_rows]
+            report["host_summary"] = host_rows[:max_rows]
+            if print_table and (dev_rows or host_rows):
+                print(format_op_table(dev_rows[:max_rows],
+                                      host_rows[:max_rows]))
+        return report
 
 
 # ---------------------------------------------------------------------------
@@ -322,6 +336,81 @@ class Benchmark:
     def reset(self):
         self.reader.reset()
         self.batch.reset()
+
+
+# ---------------------------------------------------------------------------
+# Per-op summary tables from the exported trace
+# (reference python/paddle/profiler/profiler_statistic.py:1)
+# ---------------------------------------------------------------------------
+
+def parse_trace_op_times(trace_dir):
+    """Aggregate the chrome trace jax.profiler exported under ``trace_dir``
+    into (device_rows, host_rows): per-op name {calls, total_us, avg_us,
+    pct} sorted by total time desc. Device rows come from ``/device:*``
+    processes (TPU op execution); host rows are non-python-frame host spans
+    (RecordEvent annotations, dispatch)."""
+    import collections
+    import glob
+    import gzip
+    import json
+    import os
+
+    files = glob.glob(os.path.join(
+        trace_dir, "plugins", "profile", "*", "*.trace.json.gz"))
+    dev = collections.defaultdict(lambda: [0, 0.0])
+    host = collections.defaultdict(lambda: [0, 0.0])
+    for f in files:
+        try:
+            with gzip.open(f, "rt") as fh:
+                events = json.load(fh).get("traceEvents", [])
+        except Exception:
+            continue
+        pname = {}
+        for e in events:
+            if e.get("ph") == "M" and e.get("name") == "process_name":
+                pname[e.get("pid")] = e.get("args", {}).get("name", "")
+        for e in events:
+            if e.get("ph") != "X":
+                continue
+            name = e.get("name", "")
+            if name.startswith("$"):  # python stack-frame span
+                continue
+            proc = pname.get(e.get("pid"), "")
+            bucket = dev if "/device" in proc else host
+            entry = bucket[name]
+            entry[0] += 1
+            entry[1] += float(e.get("dur", 0.0))
+
+    def rows(bucket):
+        total = sum(v[1] for v in bucket.values()) or 1.0
+        out = [{"name": n, "calls": c, "total_us": round(t, 1),
+                "avg_us": round(t / c, 2) if c else 0.0,
+                "pct": round(100.0 * t / total, 2)}
+               for n, (c, t) in bucket.items()]
+        out.sort(key=lambda r: -r["total_us"])
+        return out
+
+    return rows(dev), rows(host)
+
+
+def format_op_table(dev_rows, host_rows):
+    """Render rows like the reference's summary tables."""
+    lines = []
+
+    def table(title, rows):
+        if not rows:
+            return
+        lines.append(f"---- {title} " + "-" * max(0, 66 - len(title)))
+        lines.append(f"{'Name':<44} {'Calls':>6} {'Total(us)':>12} "
+                     f"{'Avg(us)':>10} {'Ratio(%)':>9}")
+        for r in rows:
+            nm = r["name"] if len(r["name"]) <= 44 else r["name"][:41] + "..."
+            lines.append(f"{nm:<44} {r['calls']:>6} {r['total_us']:>12.1f} "
+                         f"{r['avg_us']:>10.2f} {r['pct']:>9.2f}")
+
+    table("Device (TPU) op summary", dev_rows)
+    table("Host summary", host_rows)
+    return "\n".join(lines)
 
 
 _GLOBAL_BENCHMARK = Benchmark()

@@ -4,7 +4,8 @@ NLP datasets + the ViterbiDecoder layer from paddle.text.viterbi_decode).
 Datasets fall back to deterministic synthetic corpora in air-gapped
 environments, same policy as paddle_tpu.vision.datasets.
 """
-from .datasets import Imdb, UCIHousing  # noqa: F401
+from .datasets import Conll05st, Imdb, Imikolov, UCIHousing  # noqa: F401
 from .viterbi import ViterbiDecoder, viterbi_decode  # noqa: F401
 
-__all__ = ["Imdb", "UCIHousing", "ViterbiDecoder", "viterbi_decode"]
+__all__ = ["Imdb", "UCIHousing", "Imikolov", "Conll05st",
+           "ViterbiDecoder", "viterbi_decode"]

@@ -7,7 +7,7 @@ import numpy as np
 
 from ..io import Dataset
 
-__all__ = ["Imdb", "UCIHousing"]
+__all__ = ["Imdb", "UCIHousing", "Imikolov", "Conll05st"]
 
 
 class Imdb(Dataset):
@@ -64,3 +64,96 @@ class UCIHousing(Dataset):
 
     def get_arrays(self):
         return self.features, self.prices
+
+
+class Imikolov(Dataset):
+    """PTB n-gram language-model dataset (reference
+    python/paddle/text/datasets/imikolov.py: items are int64 n-grams over a
+    frequency-cut vocabulary; data_type NGRAM|SEQ). Synthetic corpus: a
+    deterministic order-2 Markov chain so n-gram statistics are learnable."""
+
+    VOCAB = 1024
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, download=True):
+        if data_type not in ("NGRAM", "SEQ"):
+            raise ValueError("data_type must be 'NGRAM' or 'SEQ'")
+        self.data_type = data_type
+        self.window_size = window_size
+        n_tokens = 40000 if mode == "train" else 8000
+        rng = np.random.RandomState(4 if mode == "train" else 5)
+        # markov chain: each token prefers a deterministic successor
+        succ = rng.permutation(self.VOCAB)
+        toks = np.empty(n_tokens, np.int64)
+        toks[0] = rng.randint(self.VOCAB)
+        jump = rng.rand(n_tokens) < 0.15
+        rand_next = rng.randint(0, self.VOCAB, n_tokens)
+        for i in range(1, n_tokens):
+            toks[i] = rand_next[i] if jump[i] else succ[toks[i - 1]]
+        self.word_idx = {f"w{i}": i for i in range(self.VOCAB)}
+        if data_type == "NGRAM":
+            n = window_size
+            idx = np.arange(n_tokens - n + 1)[:, None] + np.arange(n)[None]
+            self.data = toks[idx]  # [N, window_size] int64
+        else:
+            seq_len = 20
+            n_seq = n_tokens // seq_len
+            self.data = toks[:n_seq * seq_len].reshape(n_seq, seq_len)
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+    def get_arrays(self):
+        return (self.data,)
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 semantic-role labeling (reference
+    python/paddle/text/datasets/conll05.py: each item is the 9-tuple
+    (word_ids, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, pred_idx, mark,
+    label_ids), all int64 [seq_len]). Synthetic: predicate-anchored label
+    pattern so the SRL structure is learnable."""
+
+    WORD_VOCAB = 4096
+    PRED_VOCAB = 512
+    NUM_LABELS = 67  # reference label dict size (BIO over 32 roles + O...)
+    SEQ = 30
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, emb_file=None,
+                 download=True, mode="train"):
+        n = 1500 if mode == "train" else 300
+        rng = np.random.RandomState(6 if mode == "train" else 7)
+        S = self.SEQ
+        self.word_ids = rng.randint(2, self.WORD_VOCAB, (n, S)).astype(np.int64)
+        pred_pos = rng.randint(0, S, n)
+        self.pred_idx = rng.randint(0, self.PRED_VOCAB, (n, 1)).repeat(S, 1)
+        self.mark = np.zeros((n, S), np.int64)
+        self.mark[np.arange(n), pred_pos] = 1
+        # labels: role depends on distance to the predicate
+        dist = np.abs(np.arange(S)[None] - pred_pos[:, None])
+        self.labels = np.minimum(dist, self.NUM_LABELS - 1).astype(np.int64)
+        pad = np.zeros((n, 2), np.int64)
+        w = self.word_ids
+        self.ctx = [np.concatenate([pad[:, :k2], w[:, :S - k2]], 1)
+                    if k2 > 0 else w for k2 in (2, 1)]
+        self.ctx += [w]
+        self.ctx += [np.concatenate([w[:, k2:], pad[:, :k2]], 1)
+                     for k2 in (1, 2)]
+        self.word_dict = {f"w{i}": i for i in range(self.WORD_VOCAB)}
+        self.predicate_dict = {f"v{i}": i for i in range(self.PRED_VOCAB)}
+        self.label_dict = {f"l{i}": i for i in range(self.NUM_LABELS)}
+
+    def get_dict(self):
+        return self.word_dict, self.predicate_dict, self.label_dict
+
+    def __getitem__(self, idx):
+        c_n2, c_n1, c_0, c_p1, c_p2 = (c[idx] for c in self.ctx)
+        return (self.word_ids[idx], c_n2, c_n1, c_0, c_p1, c_p2,
+                self.pred_idx[idx], self.mark[idx], self.labels[idx])
+
+    def __len__(self):
+        return len(self.word_ids)

@@ -15,7 +15,7 @@ import numpy as np
 
 from ..io import Dataset
 
-__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100"]
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "Flowers"]
 
 
 def _synthetic_images(n, num_classes, hw, seed, channels=1, template_seed=1234):
@@ -117,3 +117,29 @@ class Cifar10(Dataset):
 
 class Cifar100(Cifar10):
     NUM_CLASSES = 100
+
+
+class Flowers(Dataset):
+    """102-category Oxford flowers (reference
+    python/paddle/vision/datasets/flowers.py: items are (HWC uint8 image ->
+    transform, int64 label in [0,102))). Synthetic class-templated images,
+    deterministic per split (train/valid/test)."""
+
+    NUM_CLASSES = 102
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        self.transform = transform
+        n = {"train": 2040, "valid": 510, "test": 1020}.get(mode, 1020)
+        seed = {"train": 8, "valid": 9, "test": 10}.get(mode, 10)
+        self.images, self.labels = _synthetic_images(
+            n, self.NUM_CLASSES, (32, 32), seed=seed, channels=3)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32) / 127.5 - 1.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(self.labels[idx], np.int64)
+
+    def __len__(self):
+        return len(self.images)

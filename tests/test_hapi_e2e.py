@@ -104,3 +104,51 @@ def test_dataloader():
     dl2 = DataLoader(Sq(), batch_size=4, num_workers=2)
     b2 = list(dl2)
     np.testing.assert_array_equal(b2[0][1].numpy(), batches[0][1].numpy())
+
+
+def test_datasets_long_tail():
+    """Imikolov / Conll05st / Flowers (VERDICT r3 missing #9)."""
+    from paddle_tpu.text import Conll05st, Imikolov
+    from paddle_tpu.vision.datasets import Flowers
+
+    ng = Imikolov(data_type="NGRAM", window_size=5)
+    assert ng[0].shape == (5,) and ng[0].dtype == np.int64
+    # markov structure: the bigram successor must dominate
+    import collections
+    succ = collections.Counter()
+    for i in range(2000):
+        succ[(int(ng[i][0]), int(ng[i][1]))] += 1
+    top = succ.most_common(1)[0][1]
+    assert top > 3  # deterministic successor repeats; uniform noise wouldn't
+
+    sq = Imikolov(data_type="SEQ", mode="test")
+    assert sq[0].shape == (20,)
+
+    c = Conll05st()
+    item = c[0]
+    assert len(item) == 9
+    assert all(a.shape == (Conll05st.SEQ,) for a in item)
+    w, p, l = c.get_dict()
+    assert len(l) == Conll05st.NUM_LABELS
+    # the mark vector flags exactly one predicate
+    assert int(item[7].sum()) == 1
+
+    f = Flowers(mode="test")
+    img, lbl = f[0]
+    assert img.shape == (3, 32, 32) or img.shape == (32, 32, 3)
+    assert 0 <= int(lbl) < 102
+    assert len(Flowers(mode="train")) == 2040
+
+
+def test_model_summary_table(capsys):
+    from paddle_tpu.vision.models import LeNet
+
+    model = paddle.Model(LeNet())
+    rep = model.summary(input_size=(1, 1, 28, 28))
+    out = capsys.readouterr().out
+    assert "Layer (type)" in out and "Param #" in out
+    assert rep["total_params"] > 0
+    assert "layers" in rep and len(rep["layers"]) >= 3
+    # conv layers report their output shapes
+    assert any("Conv2D" in r["name"] for r in rep["layers"])
+    assert all(isinstance(r["output_shape"], list) for r in rep["layers"])

@@ -123,3 +123,106 @@ class TestLRSchedulers:
         s.step(1.0)
         s.step(1.0)
         assert s.get_lr() == pytest.approx(0.05)
+
+
+class TestLBFGS:
+    """L-BFGS + strong-Wolfe line search (VERDICT r3 missing #6; reference
+    python/paddle/optimizer/lbfgs.py)."""
+
+    def test_rosenbrock_converges(self):
+        # the classic curvature test: SGD crawls, L-BFGS nails it
+        x = paddle.to_tensor(np.array([-1.2, 1.0], np.float32),
+                             stop_gradient=False)
+        opt = paddle.optimizer.LBFGS(
+            learning_rate=1.0, max_iter=25, history_size=10,
+            line_search_fn="strong_wolfe", parameters=[x])
+
+        def closure():
+            opt.clear_grad()
+            a, b = x[0], x[1]
+            loss = (1 - a) ** 2 + 100 * (b - a * a) ** 2
+            loss.backward()
+            return loss
+
+        for _ in range(8):
+            loss = opt.step(closure)
+        assert float(np.asarray(loss._value)) < 1e-5
+        np.testing.assert_allclose(x.numpy(), [1.0, 1.0], atol=1e-3)
+
+    def test_quadratic_one_step_newton_like(self):
+        # on a quadratic with line search, a few steps reach the optimum
+        A = np.array([[3.0, 0.5], [0.5, 1.0]], np.float32)
+        b = np.array([1.0, -2.0], np.float32)
+        x = paddle.to_tensor(np.zeros(2, np.float32), stop_gradient=False)
+        opt = paddle.optimizer.LBFGS(parameters=[x],
+                                     line_search_fn="strong_wolfe")
+
+        def closure():
+            opt.clear_grad()
+            loss = 0.5 * (x * (paddle.to_tensor(A) @ x)).sum() - (
+                paddle.to_tensor(b) * x).sum()
+            loss.backward()
+            return loss
+
+        for _ in range(3):
+            opt.step(closure)
+        expect = np.linalg.solve(A, b)
+        np.testing.assert_allclose(x.numpy(), expect, atol=1e-4)
+
+    def test_fixed_step_no_line_search(self):
+        x = paddle.to_tensor(np.array([4.0], np.float32),
+                             stop_gradient=False)
+        opt = paddle.optimizer.LBFGS(learning_rate=0.5, max_iter=10,
+                                     parameters=[x])
+
+        def closure():
+            opt.clear_grad()
+            loss = (x ** 2).sum()
+            loss.backward()
+            return loss
+
+        l0 = float(np.asarray(opt.step(closure)._value))
+        l1 = float(np.asarray(opt.step(closure)._value))
+        assert l1 < l0
+
+    def test_mlp_training_beats_sgd_budget(self):
+        paddle.seed(3)
+        net = paddle.nn.Linear(4, 1)
+        xs = np.random.RandomState(0).rand(64, 4).astype(np.float32)
+        w_true = np.array([[1.5], [-2.0], [0.5], [3.0]], np.float32)
+        ys = xs @ w_true + 0.7
+        opt = paddle.optimizer.LBFGS(parameters=net.parameters(),
+                                     line_search_fn="strong_wolfe",
+                                     max_iter=10)
+        xt, yt = paddle.to_tensor(xs), paddle.to_tensor(ys)
+
+        def closure():
+            opt.clear_grad()
+            loss = ((net(xt) - yt) ** 2).mean()
+            loss.backward()
+            return loss
+
+        for _ in range(5):
+            loss = opt.step(closure)
+        assert float(np.asarray(loss._value)) < 1e-6  # exact-fit regression
+
+    def test_state_dict_roundtrip(self):
+        x = paddle.to_tensor(np.array([2.0, -1.0], np.float32),
+                             stop_gradient=False)
+        opt = paddle.optimizer.LBFGS(parameters=[x],
+                                     line_search_fn="strong_wolfe")
+
+        def closure():
+            opt.clear_grad()
+            loss = ((x - 3) ** 2).sum()
+            loss.backward()
+            return loss
+
+        opt.step(closure)
+        sd = opt.state_dict()
+        assert sd["lbfgs_state"]["n_iter"] >= 1
+        opt2 = paddle.optimizer.LBFGS(parameters=[x],
+                                      line_search_fn="strong_wolfe")
+        opt2.set_state_dict(sd)
+        assert opt2._hist["n_iter"] == opt._hist["n_iter"]
+        opt2.step(closure)  # continues from restored curvature history

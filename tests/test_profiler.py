@@ -68,3 +68,42 @@ def test_mfu_accounting():
     f = prof.transformer_flops_per_token(100, 2, 4, 8)
     assert f == 6 * 100 + 12 * 2 * 4 * 8
     assert prof.mfu(1e9, 1000.0, "cpu") == 1e12 / 1e12
+
+
+class TestOpSummary:
+    """Per-op summary tables parsed from the exported trace (VERDICT r3
+    missing #7; reference profiler_statistic.py:1)."""
+
+    def test_summary_has_op_tables(self, tmp_path, capsys):
+        import jax.numpy as jnp
+
+        from paddle_tpu import profiler as prof
+
+        p = prof.Profiler(
+            on_trace_ready=prof.export_chrome_tracing(str(tmp_path)))
+        p.start()
+        with prof.RecordEvent("op_summary_test_span"):
+            x = jnp.ones((128, 128))
+            for _ in range(3):
+                x = jnp.tanh(x @ x)
+            x.block_until_ready()
+        p.step(num_samples=128)
+        p.stop()
+        rep = p.summary(max_rows=10)
+        assert "op_summary" in rep and "host_summary" in rep
+        rows = rep["host_summary"] + rep["op_summary"]
+        assert rows, "no events parsed from the exported trace"
+        names = [r["name"] for r in rows]
+        assert any("op_summary_test_span" in n for n in names)
+        for r in rows:
+            assert r["calls"] >= 1 and r["total_us"] >= 0
+        out = capsys.readouterr().out
+        assert "summary" in out and "Calls" in out  # printed table
+
+    def test_format_op_table(self):
+        from paddle_tpu.profiler import format_op_table
+
+        s = format_op_table(
+            [{"name": "fusion.1", "calls": 3, "total_us": 10.0,
+              "avg_us": 3.33, "pct": 100.0}], [])
+        assert "Device (TPU) op summary" in s and "fusion.1" in s

@@ -96,12 +96,17 @@ class TestGeoSGD:
         server.create_dense_table("w", w0, lr=1.0)
 
         results = {}
+        # lockstep barrier: without it, thread scheduling on a loaded 1-core
+        # box can let one worker finish all 20 steps before the other ever
+        # syncs — then nobody rebases and the convergence assert flakes
+        bar = threading.Barrier(2, timeout=30)
 
         def worker(rank, target):
             c = PSClient("127.0.0.1", server.port)
             geo = GeoCommunicator(c, geo_steps=5)
             w = geo.register("w", c.pull_dense("w"))
             for step in range(20):
+                bar.wait()
                 grad = (w - target)  # pull toward the worker's target
                 w = w - 0.2 * grad   # LOCAL step, no server traffic
                 w = geo.maybe_sync({"w": w})["w"]
