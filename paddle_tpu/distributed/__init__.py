@@ -1,5 +1,5 @@
 """paddle.distributed parity surface, TPU-native (SURVEY §2.3, §5.8)."""
-from . import collective, fleet, rpc  # noqa: F401
+from . import collective, fleet, rpc, sharding  # noqa: F401
 from .fleet_random import (  # noqa: F401
     MODEL_PARALLEL_RNG, RNGStatesTracker, get_rng_state_tracker,
     model_parallel_random_seed)

@@ -74,6 +74,64 @@ class _SparseTable:
             self.rows[int(i)] = self.rows[int(i)] + d
 
 
+class _SSDSparseTable(_SparseTable):
+    """Disk-backed sparse table: hot rows stay in an LRU memory cache, cold
+    rows spill to a fixed-stride slot file (the reference's SSD cache tier,
+    /root/reference/paddle/fluid/distributed/ps/table/ssd_sparse_table.cc —
+    embedding tables beyond RAM at recommendation scale). Rows rehydrate on
+    touch; freed slots are reused."""
+
+    def __init__(self, dim, lr, init_std=0.01, seed=0, cache_rows=4096,
+                 path=None):
+        super().__init__(dim, lr, init_std, seed)
+        import collections
+        import os
+        import tempfile
+
+        self.rows = collections.OrderedDict()
+        self.cache_rows = max(1, int(cache_rows))
+        self._dir = path or tempfile.mkdtemp(prefix="pdtpu_ssd_table_")
+        os.makedirs(self._dir, exist_ok=True)
+        self._file = open(os.path.join(self._dir, "rows.bin"), "w+b")
+        self._stride = self.dim * 4
+        self._disk_slot: dict[int, int] = {}
+        self._free_slots: list[int] = []
+        self._next_slot = 0
+
+    def _row(self, i):
+        i = int(i)
+        if i in self.rows:
+            self.rows.move_to_end(i)
+            return self.rows[i]
+        if i in self._disk_slot:
+            slot = self._disk_slot.pop(i)
+            self._file.seek(slot * self._stride)
+            row = np.frombuffer(self._file.read(self._stride),
+                                np.float32).copy()
+            self._free_slots.append(slot)
+        else:
+            row = self._rng.randn(self.dim).astype(np.float32) * self.init_std
+        self.rows[i] = row
+        self._evict()
+        return row
+
+    def _evict(self):
+        while len(self.rows) > self.cache_rows:
+            old_id, row = self.rows.popitem(last=False)
+            slot = (self._free_slots.pop() if self._free_slots
+                    else self._next_slot)
+            if slot == self._next_slot:
+                self._next_slot += 1
+            self._file.seek(slot * self._stride)
+            self._file.write(np.ascontiguousarray(row, np.float32).tobytes())
+            self._disk_slot[old_id] = slot
+
+    def stats(self):
+        return {"mem_rows": len(self.rows),
+                "disk_rows": len(self._disk_slot),
+                "disk_bytes": self._next_slot * self._stride}
+
+
 class ParameterServer:
     """Hosts tables; serves pull/push/barrier over TCP."""
 
@@ -96,9 +154,20 @@ class ParameterServer:
         with self._lock:
             self._tables[name] = _DenseTable(value, lr)
 
-    def create_sparse_table(self, name, dim, lr=0.01, init_std=0.01):
+    def create_sparse_table(self, name, dim, lr=0.01, init_std=0.01,
+                            cache_rows=None, ssd_path=None):
         with self._lock:
-            self._tables[name] = _SparseTable(dim, lr, init_std)
+            if cache_rows is not None:
+                self._tables[name] = _SSDSparseTable(
+                    dim, lr, init_std, cache_rows=cache_rows, path=ssd_path)
+            else:
+                self._tables[name] = _SparseTable(dim, lr, init_std)
+
+    def table_stats(self, name):
+        with self._lock:
+            t = self._tables[name]
+            return t.stats() if hasattr(t, "stats") else {
+                "mem_rows": len(getattr(t, "rows", {})), "disk_rows": 0}
 
     # -- rpc plumbing -----------------------------------------------------
     def _serve(self):
@@ -159,8 +228,12 @@ class ParameterServer:
                 self.create_dense_table(req["table"], req["value"], req["lr"])
                 return {"ok": True}
             if op == "create_sparse":
-                self.create_sparse_table(req["table"], req["dim"], req["lr"])
+                self.create_sparse_table(req["table"], req["dim"], req["lr"],
+                                         cache_rows=req.get("cache_rows"),
+                                         ssd_path=req.get("ssd_path"))
                 return {"ok": True}
+            if op == "table_stats":
+                return {"ok": True, "value": self.table_stats(req["table"])}
             if op == "barrier":
                 with self._cv:
                     gen = self._barrier_gen
@@ -228,8 +301,15 @@ class PSClient:
         return self._call(op="create_dense", table=table,
                           value=np.asarray(value, np.float32), lr=lr)
 
-    def create_sparse_table(self, table, dim, lr=0.01):
-        return self._call(op="create_sparse", table=table, dim=dim, lr=lr)
+    def create_sparse_table(self, table, dim, lr=0.01, cache_rows=None,
+                            ssd_path=None):
+        """``cache_rows`` bounds in-memory rows: colder rows spill to the
+        server's SSD slot file (reference ssd_sparse_table)."""
+        return self._call(op="create_sparse", table=table, dim=dim, lr=lr,
+                          cache_rows=cache_rows, ssd_path=ssd_path)
+
+    def table_stats(self, table):
+        return self._call(op="table_stats", table=table)
 
     def pull_dense(self, table):
         return self._call(op="pull_dense", table=table)

@@ -136,3 +136,55 @@ class TestGeoSGD:
         np.testing.assert_allclose(np.asarray(c.pull_dense("t")), [1, 2, 3])
         c.push_sparse_delta  # surface exists for sparse tables too
         c.close(); server.stop()
+
+
+class TestSSDSparseTable:
+    """SSD cache tier (VERDICT r3 missing #8 depth item; reference
+    paddle/fluid/distributed/ps/table/ssd_sparse_table.cc): hot rows in an
+    LRU memory cache, cold rows in a fixed-stride slot file, transparent
+    rehydration on touch."""
+
+    def test_spill_and_rehydrate_roundtrip(self, tmp_path):
+        from paddle_tpu.distributed.ps import _SSDSparseTable
+
+        t = _SSDSparseTable(dim=8, lr=0.1, cache_rows=16,
+                            path=str(tmp_path))
+        ids = np.arange(64)
+        first = t.pull(ids).copy()  # creates 64 rows; 48 spill to disk
+        st = t.stats()
+        assert st["mem_rows"] == 16 and st["disk_rows"] == 48
+        assert st["disk_bytes"] >= 48 * 8 * 4
+        # rehydrated rows are bit-identical to their first materialization
+        np.testing.assert_array_equal(t.pull(ids), first)
+
+    def test_updates_survive_eviction(self, tmp_path):
+        from paddle_tpu.distributed.ps import _SSDSparseTable
+
+        t = _SSDSparseTable(dim=4, lr=0.5, cache_rows=8, path=str(tmp_path))
+        ids = np.arange(32)
+        base = t.pull(ids).copy()
+        t.push(ids, np.ones((32, 4), np.float32))  # row -= 0.5 * 1
+        # touch OTHER ids to force the updated rows out to disk
+        t.pull(np.arange(100, 140))
+        np.testing.assert_allclose(t.pull(ids), base - 0.5, rtol=1e-6)
+        # slots are reused after rehydration: disk never grows unboundedly
+        for _ in range(4):
+            t.pull(ids)
+            t.pull(np.arange(100, 140))
+        assert t.stats()["disk_bytes"] <= (32 + 40 + 8) * 4 * 4
+
+    def test_through_the_wire(self):
+        from paddle_tpu.distributed.ps import ParameterServer, PSClient
+
+        server = ParameterServer(port=0)
+        c = PSClient("127.0.0.1", server.port)
+        c.create_sparse_table("emb", dim=4, lr=0.1, cache_rows=8)
+        ids = np.arange(40)
+        v = c.pull_sparse("emb", ids)
+        assert v.shape == (40, 4)
+        st = c.table_stats("emb")
+        assert st["mem_rows"] == 8 and st["disk_rows"] == 32
+        c.push_sparse("emb", ids, np.ones((40, 4), np.float32))
+        v2 = c.pull_sparse("emb", ids)
+        np.testing.assert_allclose(v2, v - 0.1, rtol=1e-5)
+        c.close(); server.stop()

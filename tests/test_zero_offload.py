@@ -156,3 +156,55 @@ class TestMemoryLadder:
         b3o = max_mesh_bytes(3, True)
         assert b3 < b1, f"stage3 ({b3}) must beat stage1 ({b1})"
         assert b3o < b3, f"offload ({b3o}) must beat stage3 ({b3})"
+
+
+class TestGroupShardedFacade:
+    """paddle.distributed.sharding.group_sharded_parallel (reference
+    python/paddle/distributed/sharding/group_sharded.py) — the facade
+    configures the ambient strategy; engines built after it train
+    group-sharded."""
+
+    def test_levels_map_to_stages_and_offload(self):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed import fleet
+
+        paddle.seed(0)
+        net = MLP(16)
+        opt = paddle.optimizer.Adam(parameters=net.parameters(),
+                                    learning_rate=1e-2)
+        m, o, s = dist.sharding.group_sharded_parallel(
+            net, opt, "p_g_os", offload=True)
+        assert m is net and o is opt and s is None
+        strat = fleet.get_strategy()
+        assert strat.sharding.stage == 3 and strat.sharding.offload
+        assert strat.hybrid_configs.sharding_degree > 1
+
+        # an engine built NOW trains with the configured sharding
+        eng = DistributedEngine(net, loss_fn=paddle.nn.CrossEntropyLoss(),
+                                optimizer=opt, strategy=strat)
+        x, y = next(iter(_batches(1)))
+        l0 = float(np.asarray(eng.step(x, y)))
+        l1 = float(np.asarray(eng.step(x, y)))
+        assert np.isfinite(l0) and l1 < l0
+        host = DistributedEngine._host_device()
+        moments = state_bytes_by_device(eng.state[2])
+        assert set(moments) == {host}  # offload took effect
+
+    def test_bad_level_raises(self):
+        import paddle_tpu.distributed as dist
+
+        with pytest.raises(ValueError, match="level"):
+            dist.sharding.group_sharded_parallel(None, None, "stage9")
+
+    def test_save_group_sharded_model(self, tmp_path):
+        import paddle_tpu.distributed as dist
+
+        paddle.seed(1)
+        net = MLP(16)
+        opt = paddle.optimizer.Adam(parameters=net.parameters(),
+                                    learning_rate=1e-2)
+        dist.sharding.save_group_sharded_model(net, str(tmp_path), opt)
+        import os
+
+        assert os.path.exists(str(tmp_path) + "/model.pdparams")
+        assert os.path.exists(str(tmp_path) + "/model.pdopt")

@@ -6,13 +6,22 @@ per-step eager harnesses on this setup vary 2-5x run-to-run (measured) and
 can even invert the ranking. Round-4 chip numbers (BT=8 rows/tile,
 time-tile cap 256):
 
-    T=256  B=32 C=1024 L=48: pallas 23.3 ms  scan 30.3 ms  -> 1.30x
-    T=2048 B=16 C=1024 L=48: pallas 66.4 ms  scan 94.7 ms  -> 1.43x
-    T=4096 B=8  C=512  L=96: pallas 81.8 ms  scan 159.8 ms -> 1.95x
+    T=256  B=32 C=1024 L=48: pallas 20.3 ms  scan 29.3 ms  -> 1.44x
+    T=2048 B=16 C=1024 L=48: pallas 63.8 ms  scan 92.8 ms  -> 1.45x
+    T=4096 B=8  C=512  L=96: pallas 84.5 ms  scan 158.3 ms -> 1.87x
+
+(Sequences that fit the VMEM budget run as a SINGLE tile — zero padding;
+an early fixed-256-row tiling cost 37% at T=400 from pad rows, caught by
+the model bench's conformer regression and fixed with even splits.)
 
 T=2048/4096 previously fell back to the scan path entirely
 (kernels/ctc.py fits_vmem before time-tiling)."""
+import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np, jax, jax.numpy as jnp
 import paddle_tpu as paddle
 from paddle_tpu.kernels import set_platform, set_use_pallas

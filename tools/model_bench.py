@@ -77,21 +77,31 @@ def _bench_config(name, build, steps):
     loss, params, buffers, opt_state = step(params, buffers, opt_state, rng,
                                             *batches[0])
     float(np.asarray(loss))  # compile + warmup (true completion sync)
-    t0 = time.perf_counter()
-    tot = None
-    for i in range(steps):
-        loss, params, buffers, opt_state = step(params, buffers, opt_state,
-                                                rng, *batches[i % len(batches)])
-        tot = loss if tot is None else tot + loss
-    # host readback of a value depending on every step: through a remote
-    # tunnel block_until_ready can return early; this cannot
-    float(np.asarray(tot))
-    dt = (time.perf_counter() - t0) / steps
+
+    def window(n):
+        nonlocal params, buffers, opt_state, loss
+        t0 = time.perf_counter()
+        tot = None
+        for i in range(n):
+            loss, params, buffers, opt_state = step(
+                params, buffers, opt_state, rng, *batches[i % len(batches)])
+            tot = loss if tot is None else tot + loss
+        # host readback of a value depending on every step: through a
+        # remote tunnel block_until_ready can return early; this cannot
+        float(np.asarray(tot))
+        return (time.perf_counter() - t0) / n
+
+    # best-of-3 windows: per-dispatch tunnel latency is VARIABLE (2-5x
+    # swings measured) and dominates short-step models; the fastest window
+    # is the least-contaminated estimate, and all three are recorded
+    dts = [window(steps) for _ in range(3)]
+    dt = min(dts)
     return {
         "metric": name,
         "value": round(n_samples / dt, 2),
         "unit": unit,
         "extra": {"step_ms": round(dt * 1000, 2),
+                  "window_ms": [round(d * 1000, 2) for d in dts],
                   "loss": float(np.asarray(loss)),
                   "platform": jax.devices()[0].platform},
     }
