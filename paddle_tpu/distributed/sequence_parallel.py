@@ -42,6 +42,36 @@ def _block_attn(q, k, v, sm_scale, mask):
     return acc, m, l
 
 
+def _block_flash(q, k, v, sm_scale, causal):
+    """Per-ring-block flash attention: the Pallas kernel (jnp mirror under
+    the CPU interpreter) over [B,S,H,D], returning the normalized partial
+    and its logsumexp — the pair the online-softmax merge needs. The lse
+    cotangent from the merge flows back through the kernel's custom_vjp."""
+    from ..kernels.flash_attention import _flash_core
+
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+
+    def to_bhsd(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, x.shape[1], D)
+
+    out, lse = _flash_core(to_bhsd(q), to_bhsd(k), to_bhsd(v), None, None,
+                           None, None, causal, sm_scale, 0.0, H)
+    out = out.reshape(B, H, Sq, D).transpose(0, 2, 1, 3)
+    return out, lse.reshape(B, H, Sq, 1)
+
+
+def _merge_partials(o1, lse1, o2, lse2):
+    """Online-softmax merge of two normalized partials ([B,S,H,D], [B,H,S,1])."""
+    m = jnp.maximum(lse1, lse2)
+    w1 = jnp.exp(lse1 - m)
+    w2 = jnp.exp(lse2 - m)
+    denom = jnp.maximum(w1 + w2, 1e-30)
+    sw = lambda w: jnp.moveaxis(w, 1, 2)  # [B,S,H,1] for the [B,S,H,D] layout
+    out = (o1 * sw(w1) + o2 * sw(w2)) / sw(denom)
+    return out.astype(o1.dtype), m + jnp.log(denom)
+
+
 def ring_attention(q, k, v, mesh=None, axis="sep", causal=True, scale=None):
     """q,k,v: [B, S, H, D] GLOBAL arrays sharded over `axis` on dim 1.
     Returns attention output with the same sharding. Must run inside jit
@@ -65,45 +95,43 @@ def ring_attention(q, k, v, mesh=None, axis="sep", causal=True, scale=None):
 
         # carries must be typed varying-over-axis from tick 0 (check_vma)
         pv = lambda a: jax.lax.pcast(a, (axis,), to="varying")
-        m0 = pv(jnp.full((B, H, Sl, 1), NEG_INF, jnp.float32))
-        l0 = pv(jnp.zeros((B, H, Sl, 1), jnp.float32))
-        acc0 = pv(jnp.zeros((B, Sl, H, D), jnp.float32))
+        lse0 = pv(jnp.full((B, H, Sl, 1), NEG_INF, jnp.float32))
+        out0 = pv(jnp.zeros((B, Sl, H, D), jnp.float32))
 
         def step(carry, r):
-            acc, m, l, kr, vr = carry
+            out, lse, kr, vr = carry
             # kv block currently resident came from rank (my - r) mod n
             src = (my - r) % n
             if causal:
-                # src < my: full block; src == my: causal diagonal; src > my: skip
-                use_full = src < my
-                use_diag = src == my
-                a_f, m_f, l_f = _block_attn(q, kr, vr, sm_scale, None)
-                a_d, m_d, l_d = _block_attn(q, kr, vr, sm_scale, "causal_diag")
-                a_b = jnp.where(use_diag, a_d, a_f)
-                m_b = jnp.where(use_diag, m_d, m_f)
-                l_b = jnp.where(use_diag, l_d, l_f)
-                skip = jnp.logical_not(jnp.logical_or(use_full, use_diag))
-                m_b = jnp.where(skip, NEG_INF, m_b)
-                l_b = jnp.where(skip, 0.0, l_b)
-                a_b = jnp.where(skip, 0.0, a_b)
+                # src < my: full flash block; src == my: causal-diagonal flash
+                # block; src > my: skip. lax.switch runs exactly ONE branch —
+                # the Pallas kernel is dispatched once per ring tick.
+                def full(_):
+                    o, s = _block_flash(q, kr, vr, sm_scale, False)
+                    return o.astype(jnp.float32), s
+
+                def diag(_):
+                    o, s = _block_flash(q, kr, vr, sm_scale, True)
+                    return o.astype(jnp.float32), s
+
+                def skip(_):
+                    # fresh constants must be typed varying like the flash
+                    # branches' outputs (check_vma)
+                    return (pv(jnp.zeros((B, Sl, H, D), jnp.float32)),
+                            pv(jnp.full((B, H, Sl, 1), NEG_INF, jnp.float32)))
+
+                idx = jnp.where(src < my, 0, jnp.where(src == my, 1, 2))
+                o_b, lse_b = jax.lax.switch(idx, (full, diag, skip), None)
             else:
-                a_b, m_b, l_b = _block_attn(q, kr, vr, sm_scale, None)
-            m_new = jnp.maximum(m, m_b)
-            alpha = jnp.exp(m - m_new)
-            beta = jnp.exp(m_b - m_new)
-            l_new = alpha * l + beta * l_b
-            # acc layout [B,S,H,D] vs stats [B,H,S,1]: move axes for scaling
-            scale_old = jnp.moveaxis(alpha, 1, 2)  # [B,Sq,H,1]
-            scale_new = jnp.moveaxis(beta, 1, 2)
-            acc_new = acc * scale_old + a_b * scale_new
+                o_b, lse_b = _block_flash(q, kr, vr, sm_scale, False)
+            out, lse = _merge_partials(out, lse, o_b.astype(out.dtype), lse_b)
             kr = jax.lax.ppermute(kr, axis, perm)
             vr = jax.lax.ppermute(vr, axis, perm)
-            return (acc_new, m_new, l_new, kr, vr), None
+            return (out, lse, kr, vr), None
 
-        (acc, m, l, _, _), _ = jax.lax.scan(
-            step, (acc0, m0, l0, k, v), jnp.arange(n))
-        denom = jnp.moveaxis(jnp.maximum(l, 1e-30), 1, 2)
-        return (acc / denom).astype(q.dtype)
+        (out, lse, _, _), _ = jax.lax.scan(
+            step, (out0, lse0, k, v), jnp.arange(n))
+        return out.astype(q.dtype)
 
     spec = P(None, axis, None, None)
     return jax.shard_map(

@@ -19,9 +19,13 @@ from ...core.dispatch import apply
 __all__ = ["scaled_dot_product_attention", "flash_attention", "sdpa_ref"]
 
 
-def sdpa_ref(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None):
+def sdpa_ref(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
+             scale=None, training=True, **_ignored):
     """Reference einsum attention on raw arrays, [B, S, H, D] layout (paddle's
-    flash_attention layout). GQA supported: Hk may divide Hq."""
+    flash_attention layout). GQA supported: Hk may divide Hq. Dropout is
+    applied to the softmax probabilities (upscale-in-train), matching the
+    reference's _math_attention
+    (/root/reference/python/paddle/nn/functional/flash_attention.py)."""
     B, Sq, Hq, D = q.shape
     Sk, Hk = k.shape[1], k.shape[2]
     if Hk != Hq:
@@ -40,6 +44,11 @@ def sdpa_ref(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None
         else:
             logits = logits + attn_mask
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_p and training:
+        from ...framework.random import next_key
+
+        keep = jax.random.bernoulli(next_key(), 1.0 - dropout_p, probs.shape)
+        probs = probs * keep.astype(probs.dtype) / (1.0 - dropout_p)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
@@ -53,7 +62,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
 
     def body(q, k, v, m=None):
         return impl(q, k, v, attn_mask=m, dropout_p=dropout_p,
-                    is_causal=is_causal, scale=scale)
+                    is_causal=is_causal, scale=scale, training=training)
 
     if attn_mask is None:
         return apply(body, query, key, value, op_name="sdpa")
@@ -66,4 +75,27 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     """reference flash_attention API shape: returns (out, softmax?)."""
     out = scaled_dot_product_attention(
         query, key, value, dropout_p=dropout, is_causal=causal, training=training)
+    return (out, None) if return_softmax else (out, None)
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q=None, max_seqlen_k=None, scale=None,
+                        dropout=0.0, causal=False, return_softmax=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """Varlen (packed-sequence) flash attention over [total_tokens, H, D]
+    inputs with cu_seqlens offsets. Parity: flash_attn_unpadded
+    (/root/reference/python/paddle/nn/functional/flash_attention.py:272).
+    Runs the Pallas segment-ids kernel with cross-sequence block skipping;
+    interpret mode (CPU) runs the same kernel under the Pallas interpreter."""
+    from ...kernels.flash_attention import flash_attn_varlen_pallas
+
+    def body(q, k, v, cq, ck):
+        return flash_attn_varlen_pallas(
+            q, k, v, cq, ck, max_seqlen_q, max_seqlen_k, scale=scale,
+            dropout_p=dropout, causal=causal, training=training,
+            fixed_seed=fixed_seed_offset)
+
+    out = apply(body, query, key, value, cu_seqlens_q, cu_seqlens_k,
+                op_name="flash_attn_unpadded")
     return (out, None) if return_softmax else (out, None)

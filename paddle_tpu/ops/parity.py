@@ -1211,7 +1211,7 @@ def _register_aliases():
     _alias("warpctc", F.ctc_loss)
     _alias("warprnnt", F.rnnt_loss)
     _alias("flash_attn", F.flash_attention)
-    _alias("flash_attn_unpadded", F.flash_attention)
+    _alias("flash_attn_unpadded", F.flash_attn_unpadded)  # real varlen kernel
     _alias("memory_efficient_attention", F.scaled_dot_product_attention)
 
     # interpolate modes (reference has one op per mode)

@@ -89,3 +89,203 @@ def test_jnp_mirror_matches_interpret_kernel(causal):
     for a, b in zip((dq_k, dk_k, dv_k), (dq_m, dk_m, dv_m)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=2e-4, rtol=2e-4)
+
+
+# ---------------------------------------------------------------------------
+# round 5: varlen (cu_seqlens), dense masks, dropout through the kernel
+# (reference: flash_attn_unpadded at
+#  /root/reference/python/paddle/nn/functional/flash_attention.py:272 and
+#  the masked paths of scaled_dot_product_attention)
+# ---------------------------------------------------------------------------
+
+from paddle_tpu.kernels import flash_attention as fa
+from paddle_tpu.kernels.flash_attention import flash_attn_varlen_pallas
+
+
+class TestMaskedFlash:
+    def test_bool_padding_mask_matches_oracle(self):
+        rng = np.random.default_rng(2)
+        B, S, H, D = 2, 256, 2, 32
+        q, k, v = (jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+                   for _ in range(3))
+        lens = jnp.array([200, 128])
+        amask = (jnp.arange(S)[None, :] < lens[:, None])[:, None, None, :]
+        out = flash_attention_pallas(q, k, v, attn_mask=amask)
+        ref = sdpa_ref(q, k, v, attn_mask=amask)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=3e-5, rtol=3e-5)
+
+    @pytest.mark.parametrize("mshape", [(1, 2, 256, 256), (2, 1, 1, 256),
+                                        (1, 1, 256, 256)])
+    def test_float_bias_shapes_and_grads(self, mshape):
+        rng = np.random.default_rng(3)
+        B, S, H, D = 2, 256, 2, 32
+        q, k, v = (jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+                   for _ in range(3))
+        bias = jnp.asarray(rng.standard_normal(mshape), jnp.float32) * 0.5
+
+        def lp(q, k, v):
+            return jnp.sum(flash_attention_pallas(
+                q, k, v, attn_mask=bias, is_causal=True) ** 2)
+
+        def lr(q, k, v):
+            return jnp.sum(sdpa_ref(q, k, v, attn_mask=bias,
+                                    is_causal=True) ** 2)
+
+        np.testing.assert_allclose(float(lp(q, k, v)), float(lr(q, k, v)),
+                                   rtol=1e-4)
+        gp = jax.grad(lp, (0, 1, 2))(q, k, v)
+        gr = jax.grad(lr, (0, 1, 2))(q, k, v)
+        for a, b in zip(gp, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-4, rtol=5e-4)
+
+    def test_mask_rejects_bad_shape(self):
+        q = jnp.zeros((2, 64, 2, 16))
+        with pytest.raises(ValueError, match="broadcastable"):
+            flash_attention_pallas(q, q, q, attn_mask=jnp.zeros((3, 1, 1, 64)))
+
+
+class TestVarlenFlash:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_per_sequence_oracle(self, causal):
+        rng = np.random.default_rng(4)
+        H, D = 2, 32
+        cu = jnp.array([0, 100, 228, 300], jnp.int32)
+        T = 300
+        q, k, v = (jnp.asarray(rng.standard_normal((T, H, D)), jnp.float32)
+                   for _ in range(3))
+        out = flash_attn_varlen_pallas(q, k, v, cu, cu, causal=causal)
+        refs = [sdpa_ref(q[None, s:e], k[None, s:e], v[None, s:e],
+                         is_causal=causal)[0]
+                for s, e in zip([0, 100, 228], [100, 228, 300])]
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(jnp.concatenate(refs, 0)),
+                                   atol=3e-5, rtol=3e-5)
+
+    def test_grads_match_per_sequence_oracle(self):
+        rng = np.random.default_rng(5)
+        H, D = 2, 16
+        cu = jnp.array([0, 60, 200, 256], jnp.int32)
+        T = 256
+        q, k, v = (jnp.asarray(rng.standard_normal((T, H, D)), jnp.float32)
+                   for _ in range(3))
+
+        def lp(q, k, v):
+            return jnp.sum(flash_attn_varlen_pallas(
+                q, k, v, cu, cu, causal=True) ** 2)
+
+        def lr(q, k, v):
+            tot = 0.0
+            for s, e in zip([0, 60, 200], [60, 200, 256]):
+                tot = tot + jnp.sum(sdpa_ref(q[None, s:e], k[None, s:e],
+                                             v[None, s:e], is_causal=True) ** 2)
+            return tot
+
+        gp = jax.grad(lp, (0, 1, 2))(q, k, v)
+        gr = jax.grad(lr, (0, 1, 2))(q, k, v)
+        for a, b in zip(gp, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-4, rtol=5e-4)
+
+    def test_functional_unpadded_api(self):
+        """nn.functional.flash_attn_unpadded: reference signature, (out, None)."""
+        from paddle_tpu.nn.functional.attention import flash_attn_unpadded
+
+        rng = np.random.default_rng(6)
+        cu = jnp.array([0, 50, 128], jnp.int32)
+        q, k, v = (jnp.asarray(rng.standard_normal((128, 2, 16)), jnp.float32)
+                   for _ in range(3))
+        out, sm = flash_attn_unpadded(q, k, v, cu, cu, 64, 64,
+                                      scale=1.0 / 4.0, causal=True)
+        assert sm is None
+        assert tuple(out.shape) == (128, 2, 16)
+        ref = jnp.concatenate([
+            sdpa_ref(q[None, s:e], k[None, s:e], v[None, s:e],
+                     is_causal=True, scale=0.25)[0]
+            for s, e in [(0, 50), (50, 128)]], 0)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=3e-5, rtol=3e-5)
+
+    def test_block_skip_bounds(self):
+        """The searchsorted block ranges must cover exactly the blocks a
+        packed layout needs (skipping cross-sequence blocks)."""
+        qseg = jnp.array([[0, 0, 0, 1, 1, 2, 2, 2]], jnp.int32)
+        kseg = qseg
+        lob, hib = fa._varlen_bounds_q(qseg, kseg, 2, 2, False)
+        # q-blocks [0,0],[0,1],[1,2],[2,2]: seg0 spans k pos 0-2 (k-blocks
+        # 0-1), seg1 pos 3-4, seg2 pos 5-7 -> block ranges below
+        np.testing.assert_array_equal(np.asarray(lob)[0], [0, 0, 1, 2])
+        np.testing.assert_array_equal(np.asarray(hib)[0], [2, 3, 4, 4])
+        lob2, hib2 = fa._varlen_bounds_kv(qseg, kseg, 2, 2, False)
+        np.testing.assert_array_equal(np.asarray(lob2)[0], [0, 0, 1, 2])
+        np.testing.assert_array_equal(np.asarray(hib2)[0], [2, 3, 4, 4])
+
+
+class TestDropoutFlash:
+    def test_mirror_bwd_matches_autodiff_exactly(self):
+        """With dropout, the custom_vjp backward formula must equal jax
+        autodiff of the mirror forward (same seed -> same mask)."""
+        rng = np.random.default_rng(7)
+        BH, S, D = 4, 64, 16
+        q, k, v = (jnp.asarray(rng.standard_normal((BH, S, D)), jnp.float32)
+                   for _ in range(3))
+        seed = jnp.array([7], jnp.int32)
+        smv = 1.0 / np.sqrt(D)
+        g = jnp.asarray(rng.standard_normal((BH, S, D)), jnp.float32)
+
+        def mirror_out(q, k, v):
+            out, _ = fa._mirror_fwd(q, k, v, None, None, None, seed, True,
+                                    smv, 0.3, 1)
+            return out
+
+        def core_out(q, k, v):
+            out, _ = fa._flash_core(q, k, v, None, None, None, seed, True,
+                                    smv, 0.3, 1)
+            return out
+
+        truth = jax.grad(lambda *a: jnp.vdot(mirror_out(*a), g), (0, 1, 2))(q, k, v)
+        mine = jax.grad(lambda *a: jnp.vdot(core_out(*a), g), (0, 1, 2))(q, k, v)
+        for a, b in zip(mine, truth):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-5, rtol=2e-5)
+
+    def test_dropout_statistics_and_determinism(self):
+        rng = np.random.default_rng(8)
+        B, S, H, D = 2, 128, 2, 16
+        q, k = (jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+                for _ in range(2))
+        v = jnp.ones((B, S, H, D), jnp.float32)
+        o1 = flash_attention_pallas(q, k, v, dropout_p=0.4, fixed_seed=3)
+        o2 = flash_attention_pallas(q, k, v, dropout_p=0.4, fixed_seed=3)
+        o3 = flash_attention_pallas(q, k, v, dropout_p=0.4, fixed_seed=4)
+        assert bool(jnp.allclose(o1, o2))
+        assert not bool(jnp.allclose(o1, o3))
+        # upscale-in-train keeps the mean ~1 with v = ones
+        assert abs(float(o1.mean()) - 1.0) < 0.05
+        # eval mode: no dropout
+        oe = flash_attention_pallas(q, k, v, dropout_p=0.4, training=False)
+        np.testing.assert_allclose(np.asarray(oe),
+                                   np.asarray(flash_attention_pallas(q, k, v)),
+                                   atol=1e-6)
+
+
+class TestRingUsesFlashBlocks:
+    def test_block_flash_merge_equals_full(self):
+        """Splitting KV in two flash blocks and merging (out, lse) partials
+        must equal one full flash call — the ring attention invariant."""
+        from paddle_tpu.distributed.sequence_parallel import (
+            _block_flash, _merge_partials)
+
+        rng = np.random.default_rng(9)
+        B, S, H, D = 2, 128, 2, 16
+        q, k, v = (jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+                   for _ in range(3))
+        smv = 1.0 / np.sqrt(D)
+        o1, l1 = _block_flash(q, k[:, :64], v[:, :64], smv, False)
+        o2, l2 = _block_flash(q, k[:, 64:], v[:, 64:], smv, False)
+        merged, _ = _merge_partials(o1.astype(jnp.float32), l1,
+                                    o2.astype(jnp.float32), l2)
+        full, _ = _block_flash(q, k, v, smv, False)
+        np.testing.assert_allclose(np.asarray(merged), np.asarray(full),
+                                   atol=3e-5, rtol=3e-5)
