@@ -26,22 +26,6 @@ __all__ = ["ring_attention", "ulysses_attention"]
 NEG_INF = -1e30
 
 
-def _block_attn(q, k, v, sm_scale, mask):
-    """Blockwise attention returning (unnormalized acc, row max, row sumexp).
-
-    q [B,Sq,H,D], k/v [B,Sk,H,D]; mask: None | 'causal_diag'."""
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * sm_scale
-    if mask == "causal_diag":
-        Sq, Sk = q.shape[1], k.shape[1]
-        tri = jnp.tril(jnp.ones((Sq, Sk), bool))
-        s = jnp.where(tri, s, NEG_INF)
-    m = jnp.max(s, axis=-1, keepdims=True)  # [B,H,Sq,1]
-    p = jnp.exp(s - m)
-    l = jnp.sum(p, axis=-1, keepdims=True)
-    acc = jnp.einsum("bhqk,bkhd->bqhd", p, v)
-    return acc, m, l
-
-
 def _block_flash(q, k, v, sm_scale, causal):
     """Per-ring-block flash attention: the Pallas kernel (jnp mirror under
     the CPU interpreter) over [B,S,H,D], returning the normalized partial

@@ -398,26 +398,33 @@ def _varlen_bounds_kv(qseg, kseg, bq, bk, causal):
     return lob, jnp.maximum(hib, lob)
 
 
-def _mask_bidx(mask_b, BH, heads):
-    """Static mapper from the [B*H] grid index to the mask's batch dim."""
-    if mask_b == 1:
+def _mask_bidx(mask_b, BH, heads, mask_mode):
+    """Static mapper from the [B*H] grid index to the mask's batch dim.
+
+    mask_mode disambiguates shapes (B == heads would otherwise be ambiguous):
+    'one' [1,...], 'batch' [B,...] broadcast over heads, 'head' [H,...]
+    broadcast over batch, 'bh' [B*H,...]."""
+    if mask_mode == "one" or mask_b == 1:
         return lambda b: 0
-    if mask_b == BH:
+    if mask_mode == "bh":
         return lambda b: b
-    return lambda b: b // heads  # per-batch mask broadcast over heads
+    if mask_mode == "head":
+        return lambda b: b % heads
+    return lambda b: b // heads  # 'batch'
 
 
 # ---------------------------------------------------------------------------
 # jnp mirrors (exact kernel math, unblocked; the block loop is associative)
 # ---------------------------------------------------------------------------
 
-def _mirror_logits(q, k, causal, sm_scale, qseg, kseg, mask, heads):
+def _mirror_logits(q, k, causal, sm_scale, qseg, kseg, mask, heads,
+                   mask_mode):
     BH, Sq, D = q.shape
     Sk = k.shape[1]
     s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32) * sm_scale,
                    k.astype(jnp.float32))
     if mask is not None:
-        mb = _mask_bidx(mask.shape[0], BH, heads)
+        mb = _mask_bidx(mask.shape[0], BH, heads, mask_mode)
         idx = jnp.array([mb(b) for b in range(BH)])
         s = s + mask[idx].astype(jnp.float32)
     if causal:
@@ -442,8 +449,9 @@ def _mirror_dropmask(seed, BH, Sq, Sk, dropout_p):
 
 
 def _mirror_fwd(q, k, v, qseg, kseg, mask, seed, causal, sm_scale,
-                dropout_p, heads):
-    s = _mirror_logits(q, k, causal, sm_scale, qseg, kseg, mask, heads)
+                dropout_p, heads, mask_mode="batch"):
+    s = _mirror_logits(q, k, causal, sm_scale, qseg, kseg, mask, heads,
+                       mask_mode)
     m = jnp.max(s, axis=-1, keepdims=True)
     p = jnp.exp(s - m)
     l = jnp.sum(p, axis=-1, keepdims=True)
@@ -457,8 +465,9 @@ def _mirror_fwd(q, k, v, qseg, kseg, mask, seed, causal, sm_scale,
 
 
 def _mirror_bwd(q, k, v, g, glse, lse, delta, qseg, kseg, mask, seed,
-                causal, sm_scale, dropout_p, heads):
-    s = _mirror_logits(q, k, causal, sm_scale, qseg, kseg, mask, heads)
+                causal, sm_scale, dropout_p, heads, mask_mode="batch"):
+    s = _mirror_logits(q, k, causal, sm_scale, qseg, kseg, mask, heads,
+                       mask_mode)
     p = jnp.exp(s - lse)
     gf = g.astype(jnp.float32)
     dp = jnp.einsum("bqd,bkd->bqk", gf, v.astype(jnp.float32))
@@ -479,7 +488,7 @@ def _mirror_bwd(q, k, v, g, glse, lse, delta, qseg, kseg, mask, seed,
 # ---------------------------------------------------------------------------
 
 def _build_specs(BH, Sq, Sk, D, bq, bk, heads, qseg, kseg, mask,
-                 seed, *, qseg_blocked, kseg_blocked):
+                 seed, *, qseg_blocked, kseg_blocked, mask_mode="batch"):
     """in_specs/extra-args for the optional seg/mask/seed inputs, in the
     order _unpack expects them (after the dense tensor refs)."""
     specs, args = [], []
@@ -507,13 +516,14 @@ def _build_specs(BH, Sq, Sk, D, bq, bk, heads, qseg, kseg, mask,
         if qseg_blocked:  # fwd/dq kernels: mask blocked along q, whole k
             specs.append(pl.BlockSpec(
                 (1, mrows if mrows == 1 else bq, Sk),
-                (lambda b, i, _mb=_mask_bidx(mb, BH, heads):
+                (lambda b, i, _mb=_mask_bidx(mb, BH, heads, mask_mode):
                  (_mb(b), 0 if mrows == 1 else i, 0)),
                 memory_space=pltpu.VMEM))
         else:  # dkv kernel: whole q rows, blocked along k
             specs.append(pl.BlockSpec(
                 (1, mrows, bk),
-                (lambda b, i, _mb=_mask_bidx(mb, BH, heads): (_mb(b), 0, i)),
+                (lambda b, i, _mb=_mask_bidx(mb, BH, heads, mask_mode):
+                 (_mb(b), 0, i)),
                 memory_space=pltpu.VMEM))
         args.append(mask)
     if seed is not None:
@@ -523,7 +533,7 @@ def _build_specs(BH, Sq, Sk, D, bq, bk, heads, qseg, kseg, mask,
 
 
 def _core_fwd(q, k, v, qseg, kseg, mask, seed, causal, sm_scale,
-              dropout_p, heads):
+              dropout_p, heads, mask_mode="batch"):
     BH, Sq, D = q.shape
     Sk = k.shape[1]
     has_seg = qseg is not None
@@ -535,7 +545,7 @@ def _core_fwd(q, k, v, qseg, kseg, mask, seed, causal, sm_scale,
         if fit is None:
             _warn_fallback(Sq, Sk, D, has_mask)
         return _mirror_fwd(q, k, v, qseg, kseg, mask, seed, causal, sm_scale,
-                           dropout_p, heads), True
+                           dropout_p, heads, mask_mode), True
     bq, bk = fit
     if has_seg:
         lob, hib = _varlen_bounds_q(qseg, kseg, bq, bk, causal)
@@ -545,7 +555,7 @@ def _core_fwd(q, k, v, qseg, kseg, mask, seed, causal, sm_scale,
 
     extra_specs, extra_args = _build_specs(
         BH, Sq, Sk, D, bq, bk, heads, qseg, kseg, mask, seed,
-        qseg_blocked=True, kseg_blocked=False)
+        qseg_blocked=True, kseg_blocked=False, mask_mode=mask_mode)
     if has_seg:
         extra_args = extra_args[:2] + [lob, hib] + extra_args[2:]
 
@@ -590,22 +600,22 @@ def _warn_fallback(Sq, Sk, D, has_mask):
             f"(O(S^2) scores materialized).", stacklevel=3)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11))
 def _flash_core(q, k, v, qseg, kseg, mask, seed, causal, sm_scale,
-                dropout_p, heads):
+                dropout_p, heads, mask_mode="batch"):
     (out, lse), _ = _core_fwd(q, k, v, qseg, kseg, mask, seed, causal,
-                              sm_scale, dropout_p, heads)
+                              sm_scale, dropout_p, heads, mask_mode)
     return out, lse
 
 
 def _flash_core_fwd(q, k, v, qseg, kseg, mask, seed, causal, sm_scale,
-                    dropout_p, heads):
+                    dropout_p, heads, mask_mode="batch"):
     (out, lse), _ = _core_fwd(q, k, v, qseg, kseg, mask, seed, causal,
-                              sm_scale, dropout_p, heads)
+                              sm_scale, dropout_p, heads, mask_mode)
     return (out, lse), (q, k, v, qseg, kseg, mask, seed, out, lse)
 
 
-def _flash_core_bwd(causal, sm_scale, dropout_p, heads, res, cot):
+def _flash_core_bwd(causal, sm_scale, dropout_p, heads, mask_mode, res, cot):
     q, k, v, qseg, kseg, mask, seed, out, lse = res
     g, glse = cot
     BH, Sq, D = q.shape
@@ -632,7 +642,8 @@ def _flash_core_bwd(causal, sm_scale, dropout_p, heads, res, cot):
 
     if fit is None or _use_jnp_mirror(vma, dropout_p, *(fit or (1, 1))):
         dq, dk, dv = _mirror_bwd(q, k, v, g, glse, lse, delta, qseg, kseg,
-                                 mask, seed, causal, sm_scale, dropout_p, heads)
+                                 mask, seed, causal, sm_scale, dropout_p,
+                                 heads, mask_mode)
         return (dq, dk, dv) + _int_cots()
 
     bq, bk = fit
@@ -644,12 +655,12 @@ def _flash_core_bwd(causal, sm_scale, dropout_p, heads, res, cot):
 
     dq_specs, dq_args = _build_specs(
         BH, Sq, Sk, D, bq, bk, heads, qseg, kseg, mask, seed,
-        qseg_blocked=True, kseg_blocked=False)
+        qseg_blocked=True, kseg_blocked=False, mask_mode=mask_mode)
     if has_seg:
         dq_args = dq_args[:2] + [lob_q, hib_q] + dq_args[2:]
     dkv_specs, dkv_args = _build_specs(
         BH, Sq, Sk, D, bq, bk, heads, qseg, kseg, mask, seed,
-        qseg_blocked=False, kseg_blocked=True)
+        qseg_blocked=False, kseg_blocked=True, mask_mode=mask_mode)
     if has_seg:
         dkv_args = dkv_args[:2] + [lob_k, hib_k] + dkv_args[2:]
 
@@ -738,8 +749,10 @@ def _bwd_mirror(q, k, v, g, lse, delta, causal, sm_scale):
 
 def _canon_mask(attn_mask, B, Hq, Sq, Sk):
     """Normalize an attention mask broadcastable to [B, H, Sq, Sk] into the
-    kernel's [1|B|B*H, 1|Sq, Sk] additive layout. Bool masks (True = keep)
-    become 0/-1e30 bf16 (exactly representable); float masks stay f32."""
+    kernel's [N, 1|Sq, Sk] additive layout plus its broadcast mode ('one' /
+    'batch' / 'head' / 'bh' — see _mask_bidx), WITHOUT materializing pure
+    broadcast dims. Bool masks (True = keep) become 0/-1e30 bf16 (exactly
+    representable); float masks stay f32."""
     m = attn_mask
     while m.ndim < 4:
         m = m[None]
@@ -755,14 +768,12 @@ def _canon_mask(attn_mask, B, Hq, Sq, Sk):
     if mk == 1:
         m = jnp.broadcast_to(m, (mb, mh, mq, Sk))
     if mh == 1 and mb == 1:
-        out = m.reshape(1, mq, Sk)
-    elif mh == 1:
-        out = m.reshape(mb, mq, Sk)  # per-batch, broadcast over heads
-    else:
-        if mb == 1 and B > 1:
-            m = jnp.broadcast_to(m, (B, mh, mq, Sk))
-        out = m.reshape(-1, mq, Sk)  # [B*H, mq, Sk]
-    return out
+        return m.reshape(1, mq, Sk), "one"
+    if mh == 1:
+        return m.reshape(mb, mq, Sk), "batch"   # broadcast over heads
+    if mb == 1:
+        return m.reshape(mh, mq, Sk), "head"    # broadcast over batch
+    return m.reshape(mb * mh, mq, Sk), "bh"
 
 
 def _dropout_seed(fixed_seed=None):
@@ -792,8 +803,18 @@ def flash_attention_pallas(q, k, v, attn_mask=None, dropout_p=0.0,
     if not training:
         dropout_p = 0.0
     mask = None
+    mask_mode = "batch"
     if attn_mask is not None:
-        mask = _canon_mask(jax.lax.stop_gradient(attn_mask), B, Hq, Sq, Sk)
+        if attn_mask.dtype != jnp.bool_:
+            # Float (additive-bias) masks differentiate through the bias; the
+            # kernel treats masks as constants (zero cotangent), so route the
+            # bias case to the einsum composition like the reference does
+            # (flash_attn accepts no bias there either — _math_attention runs).
+            from ..nn.functional.attention import sdpa_ref
+
+            return sdpa_ref(q, k, v, attn_mask=attn_mask, dropout_p=dropout_p,
+                            is_causal=is_causal, scale=scale, training=training)
+        mask, mask_mode = _canon_mask(attn_mask, B, Hq, Sq, Sk)
     seed = _dropout_seed(fixed_seed) if dropout_p > 0 else None
 
     # [B, S, H, D] -> [B*H, S, D]
@@ -801,7 +822,8 @@ def flash_attention_pallas(q, k, v, attn_mask=None, dropout_p=0.0,
         return x.transpose(0, 2, 1, 3).reshape(B * Hq, x.shape[1], D)
 
     out, _ = _flash_core(to_bhsd(q), to_bhsd(k), to_bhsd(v), None, None,
-                         mask, seed, is_causal, sm_scale, float(dropout_p), Hq)
+                         mask, seed, is_causal, sm_scale, float(dropout_p),
+                         Hq, mask_mode)
     return out.reshape(B, Hq, Sq, D).transpose(0, 2, 1, 3)
 
 
@@ -811,7 +833,8 @@ def _segments_from_cu(cu, total, pad_to, pad_id):
     pos = jnp.arange(pad_to, dtype=jnp.int32)
     seg = jnp.searchsorted(cu.astype(jnp.int32), pos, side="right") - 1
     nseg = cu.shape[0] - 1
-    seg = jnp.where((pos < cu[-1]) & (seg < nseg), seg, pad_id)
+    valid = pos < jnp.minimum(jnp.int32(total), cu[-1])
+    seg = jnp.where(valid & (seg < nseg), seg, pad_id)
     return seg[None, :]
 
 
@@ -838,6 +861,22 @@ def flash_attn_varlen_pallas(q, k, v, cu_seqlens_q, cu_seqlens_k,
     sm_scale = scale if scale is not None else 1.0 / math.sqrt(D)
     if not training:
         dropout_p = 0.0
+    if causal and cu_seqlens_q.shape != cu_seqlens_k.shape:
+        raise ValueError(
+            "causal varlen attention requires cu_seqlens_q == cu_seqlens_k "
+            "(positional causality is defined within aligned packed "
+            "sequences); got different shapes")
+    if causal and cu_seqlens_q is not cu_seqlens_k:
+        import numpy as _np
+
+        try:  # concrete inputs: validate values loudly
+            if not bool(_np.array_equal(_np.asarray(cu_seqlens_q),
+                                        _np.asarray(cu_seqlens_k))):
+                raise ValueError(
+                    "causal varlen attention requires cu_seqlens_q == "
+                    "cu_seqlens_k; per-sequence q/k lengths differ")
+        except jax.errors.TracerArrayConversionError:
+            pass  # traced: documented precondition, cannot check at trace time
     nseg = cu_seqlens_q.shape[0] - 1
 
     def pad_to(n):

@@ -16,7 +16,8 @@ import jax.numpy as jnp
 
 from ...core.dispatch import apply
 
-__all__ = ["scaled_dot_product_attention", "flash_attention", "sdpa_ref"]
+__all__ = ["scaled_dot_product_attention", "flash_attention",
+           "flash_attn_unpadded", "sdpa_ref"]
 
 
 def sdpa_ref(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,

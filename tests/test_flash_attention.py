@@ -115,21 +115,33 @@ class TestMaskedFlash:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=3e-5, rtol=3e-5)
 
-    @pytest.mark.parametrize("mshape", [(1, 2, 256, 256), (2, 1, 1, 256),
-                                        (1, 1, 256, 256)])
-    def test_float_bias_shapes_and_grads(self, mshape):
+    @pytest.mark.parametrize("mshape,mode", [
+        ((1, 2, 256, 256), "head"), ((2, 1, 1, 256), "batch"),
+        ((1, 1, 256, 256), "one"), ((2, 2, 256, 256), "bh")])
+    def test_kernel_float_bias_modes(self, mshape, mode):
+        """All four mask broadcast modes of the kernel (additive f32 bias,
+        used internally — the public API routes float biases to einsum so
+        the bias itself differentiates)."""
         rng = np.random.default_rng(3)
         B, S, H, D = 2, 256, 2, 32
         q, k, v = (jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
                    for _ in range(3))
         bias = jnp.asarray(rng.standard_normal(mshape), jnp.float32) * 0.5
+        cm, cmode = fa._canon_mask(bias, B, H, S, S)
+        assert cmode == mode
+        smv = 1.0 / np.sqrt(D)
+
+        def to_bhsd(x):
+            return x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
 
         def lp(q, k, v):
-            return jnp.sum(flash_attention_pallas(
-                q, k, v, attn_mask=bias, is_causal=True) ** 2)
+            out, _ = fa._flash_core(to_bhsd(q), to_bhsd(k), to_bhsd(v),
+                                    None, None, cm, None, True, smv, 0.0,
+                                    H, cmode)
+            return jnp.sum(out ** 2)
 
         def lr(q, k, v):
-            return jnp.sum(sdpa_ref(q, k, v, attn_mask=bias,
+            return jnp.sum(sdpa_ref(q, k, v, attn_mask=bias, scale=smv,
                                     is_causal=True) ** 2)
 
         np.testing.assert_allclose(float(lp(q, k, v)), float(lr(q, k, v)),
@@ -140,10 +152,33 @@ class TestMaskedFlash:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=5e-4, rtol=5e-4)
 
+    def test_public_float_bias_differentiates_through_mask(self):
+        """A learnable additive bias passed to the public API must receive
+        real gradients (routed to the einsum path; the kernel would treat
+        the mask as a constant)."""
+        rng = np.random.default_rng(30)
+        B, S, H, D = 2, 64, 2, 16
+        q, k, v = (jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+                   for _ in range(3))
+        bias = jnp.asarray(rng.standard_normal((1, H, S, S)), jnp.float32)
+
+        def lp(b):
+            return jnp.sum(flash_attention_pallas(q, k, v, attn_mask=b) ** 2)
+
+        def lr(b):
+            return jnp.sum(sdpa_ref(q, k, v, attn_mask=b) ** 2)
+
+        gp = jax.grad(lp)(bias)
+        gr = jax.grad(lr)(bias)
+        assert float(jnp.abs(gp).max()) > 0
+        np.testing.assert_allclose(np.asarray(gp), np.asarray(gr),
+                                   atol=1e-5, rtol=1e-5)
+
     def test_mask_rejects_bad_shape(self):
         q = jnp.zeros((2, 64, 2, 16))
         with pytest.raises(ValueError, match="broadcastable"):
-            flash_attention_pallas(q, q, q, attn_mask=jnp.zeros((3, 1, 1, 64)))
+            flash_attention_pallas(
+                q, q, q, attn_mask=jnp.zeros((3, 1, 1, 64), jnp.bool_))
 
 
 class TestVarlenFlash:
