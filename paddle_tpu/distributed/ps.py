@@ -90,6 +90,7 @@ class _SSDSparseTable(_SparseTable):
 
         self.rows = collections.OrderedDict()
         self.cache_rows = max(1, int(cache_rows))
+        self._own_dir = path is None
         self._dir = path or tempfile.mkdtemp(prefix="pdtpu_ssd_table_")
         os.makedirs(self._dir, exist_ok=True)
         self._file = open(os.path.join(self._dir, "rows.bin"), "w+b")
@@ -130,6 +131,26 @@ class _SSDSparseTable(_SparseTable):
         return {"mem_rows": len(self.rows),
                 "disk_rows": len(self._disk_slot),
                 "disk_bytes": self._next_slot * self._stride}
+
+    def close(self):
+        """Release the spill file and (if this table created it) the temp
+        spill directory — ParameterServer.stop calls this; without it every
+        server lifecycle leaked an fd and a /tmp directory."""
+        import shutil
+
+        if self._file is not None and not self._file.closed:
+            try:
+                self._file.flush()
+            finally:
+                self._file.close()
+        if self._own_dir:
+            shutil.rmtree(self._dir, ignore_errors=True)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 class ParameterServer:
@@ -262,6 +283,13 @@ class ParameterServer:
 
     def stop(self):
         self._listener.close()
+        # serialize against in-flight _dispatch handlers: table ops run
+        # under this lock, so closing spill files mid-request would raise
+        # 'seek of closed file' into a live client
+        with self._lock:
+            for t in self._tables.values():
+                if hasattr(t, "close"):
+                    t.close()
 
 
 class PSClient:

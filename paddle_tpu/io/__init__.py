@@ -382,6 +382,10 @@ class DataLoader:
                 # fleet survives across epochs (reference
                 # persistent_workers): re-fork only if workers died
                 if self._mp_iter is None or not self._mp_iter.alive():
+                    if self._mp_iter is not None:
+                        # alive() is False if ANY worker died — reap the
+                        # survivors + their shm ring before re-forking
+                        self._mp_iter.close()
                     self._mp_iter = MultiProcessLoaderIter(self)
                 yield from self._mp_iter
                 return

@@ -303,17 +303,53 @@ class MultiProcessLoaderIter:
         return bool(self._workers) and all(p.is_alive()
                                            for p in self._workers)
 
+    @staticmethod
+    def _read_segment(name, end):
+        """Copy `end` bytes out of the named shared-memory segment. Linux
+        exposes segments under /dev/shm (direct read avoids 3.12's
+        resource-tracker double-registration on attach); other POSIX systems
+        fall back to a SharedMemory attach with tracking suppressed."""
+        try:
+            with open(f"/dev/shm/{name}", "rb") as f:
+                return f.read(end)
+        except FileNotFoundError:
+            from multiprocessing import shared_memory
+
+            try:
+                seg = shared_memory.SharedMemory(name=name, track=False)
+            except TypeError:  # <3.13: no track kwarg; unregister manually
+                seg = shared_memory.SharedMemory(name=name)
+                try:
+                    from multiprocessing import resource_tracker
+
+                    resource_tracker.unregister(seg._name, "shared_memory")
+                except Exception:
+                    pass
+            try:
+                return bytes(seg.buf[:end])
+            finally:
+                seg.close()
+
+    #: timeout=0 (paddle's "no timeout") maps to this cap instead of blocking
+    #: forever: the fleet is fork-started from a multithreaded JAX parent, and
+    #: a child that forked while another thread held a lock (malloc/numpy/
+    #: logging) can wedge silently — a bounded get turns that hang into a
+    #: diagnosable error.
+    DEFAULT_READ_TIMEOUT = 600.0
+
     def _read_one(self, w):
         import queue as _queue
 
-        timeout = getattr(self._loader, "timeout", 0) or None
+        timeout = (getattr(self._loader, "timeout", 0)
+                   or self.DEFAULT_READ_TIMEOUT)
         try:
             msg = self._result_qs[w].get(timeout=timeout)
         except _queue.Empty:
             self.close()
             raise RuntimeError(
-                f"DataLoader worker {w} timed out after {timeout}s "
-                "(stuck __getitem__/collate_fn?)") from None
+                f"DataLoader worker {w} timed out after {timeout}s (stuck "
+                "__getitem__/collate_fn, or a fork-while-threaded deadlock "
+                "— set DataLoader(timeout=...) to tune the cap)") from None
         kind = msg[0]
         if kind == "err":
             self.close()
@@ -329,8 +365,7 @@ class MultiProcessLoaderIter:
         # producing unlink-race warnings against the owning worker
         end = max((off + int(np.prod(shape or (1,))) * np.dtype(dt).itemsize)
                   for shape, dt, off in specs) if specs else 0
-        with open(f"/dev/shm/{name}", "rb") as f:
-            raw = f.read(end)
+        raw = self._read_segment(name, end)
         arrays = []
         for shape, dtype, off in specs:
             n = int(np.prod(shape)) if shape else 1

@@ -84,6 +84,50 @@ def _rewrap(vals, like_leaves):
     return out
 
 
+def _fill_undefined_vars(t_out, f_out, names):
+    """Resolve per-VARIABLE undefined branches before flattening.
+
+    The outputs are tuples aligned with ``names`` (one slot per assigned
+    variable); a variable may flatten to several leaves, so undefined-branch
+    handling must happen at variable granularity — zipping names against the
+    fully flattened leaf list would shift alignment after any nested value.
+    """
+    if not (names and isinstance(t_out, (tuple, list))
+            and isinstance(f_out, (tuple, list))
+            and len(t_out) == len(f_out) == len(names)):
+        return t_out, f_out
+    t_vars, f_vars = list(t_out), list(f_out)
+    for k, n in enumerate(names):
+        und_t = isinstance(t_vars[k], _Undefined)
+        und_f = isinstance(f_vars[k], _Undefined)
+        if not (und_t or und_f) or (und_t and und_f):
+            continue
+        if str(n).startswith("_pd_ctl_"):
+            # loop-control slots (the threaded return value) are only ever
+            # READ under their guard flag, so the undefined branch can carry
+            # zeros (the reference fills UndefinedVar with RETURN_NO_VALUE
+            # the same way) — per-leaf over the defined value's structure
+            defined = f_vars[k] if und_t else t_vars[k]
+
+            def _zero(leaf):
+                u = _unwrap(leaf)
+                if hasattr(u, "dtype") or isinstance(u, (int, float, complex)):
+                    z = jnp.zeros_like(jnp.asarray(u))
+                    return Tensor._wrap(z) if isinstance(leaf, Tensor) else z
+                return leaf  # non-array python values: copy defined side
+
+            fill = tree_util.tree_map(_zero, defined, is_leaf=_is_tensor)
+            if und_t:
+                t_vars[k] = fill
+            else:
+                f_vars[k] = fill
+        else:
+            raise NameError(
+                f"dy2static: variable '{n}' is assigned in only one branch "
+                "of a compiled if/else; assign it in both (or before)")
+    return type(t_out)(t_vars), type(f_out)(f_vars)
+
+
 def convert_ifelse(pred, true_fn, false_fn, names=()):
     """if/else over a possibly-traced predicate.
 
@@ -96,6 +140,7 @@ def convert_ifelse(pred, true_fn, false_fn, names=()):
 
     t_out = true_fn()
     f_out = false_fn()
+    t_out, f_out = _fill_undefined_vars(t_out, f_out, names)
     t_leaves, t_def = _flatten(t_out)
     f_leaves, f_def = _flatten(f_out)
     if t_def != f_def:
@@ -130,29 +175,20 @@ def convert_ifelse(pred, true_fn, false_fn, names=()):
                     merged.append(tl)
         return tree_util.tree_unflatten(t_def, merged)
     t_leaves, f_leaves = list(t_leaves), list(f_leaves)
-    for k, (n, tl, fl) in enumerate(
-            zip(names or [""] * len(t_leaves), t_leaves, f_leaves)):
+    for tl, fl in zip(t_leaves, f_leaves):
         und_t, und_f = isinstance(tl, _Undefined), isinstance(fl, _Undefined)
         if und_t and und_f:
             continue  # stays undefined; the non-tensor merge keeps it
         if und_t or und_f:
-            if n.startswith("_pd_ctl_"):
-                # loop-control slots (the threaded return value) are only
-                # ever READ under their guard flag, so the undefined branch
-                # can safely carry zeros (the reference fills UndefinedVar
-                # with RETURN_NO_VALUE the same way)
-                defined = fl if und_t else tl
-                dv = jnp.asarray(_unwrap(defined))
-                fill = (Tensor._wrap(jnp.zeros_like(dv))
-                        if isinstance(defined, Tensor) else jnp.zeros_like(dv))
-                if und_t:
-                    t_leaves[k] = fill
-                else:
-                    f_leaves[k] = fill
-                continue
+            # single-sided undefineds are resolved per VARIABLE by
+            # _fill_undefined_vars above; reaching here means the outputs
+            # were not a names-aligned tuple, so no leaf-level name can be
+            # trusted (nested values shift the alignment) — fail loudly
+            # instead of zero-filling the wrong leaf
             raise NameError(
-                f"dy2static: variable '{n}' is assigned in only one branch "
-                "of a compiled if/else; assign it in both (or before)")
+                f"dy2static: one of {names or 'the outputs'} is assigned in "
+                "only one branch of a compiled if/else; assign it in both "
+                "(or before)")
     tv, fv = _unwrap_leaves(t_leaves), _unwrap_leaves(f_leaves)
     # non-array python leaves (ints, None, strings) must agree between
     # branches — they are baked into the compiled program

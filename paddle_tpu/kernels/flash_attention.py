@@ -812,8 +812,17 @@ def flash_attention_pallas(q, k, v, attn_mask=None, dropout_p=0.0,
             # (flash_attn accepts no bias there either — _math_attention runs).
             from ..nn.functional.attention import sdpa_ref
 
+            key = (Sq, Sk, "float-bias")
+            if key not in _warned:
+                _warned.add(key)
+                warnings.warn(
+                    "flash attention: float additive bias routes to the "
+                    "O(S^2) einsum composition so the bias differentiates; "
+                    "use a bool mask to stay on the Pallas kernel.",
+                    stacklevel=2)
             return sdpa_ref(q, k, v, attn_mask=attn_mask, dropout_p=dropout_p,
-                            is_causal=is_causal, scale=scale, training=training)
+                            is_causal=is_causal, scale=scale,
+                            training=training, fixed_seed=fixed_seed)
         mask, mask_mode = _canon_mask(attn_mask, B, Hq, Sq, Sk)
     seed = _dropout_seed(fixed_seed) if dropout_p > 0 else None
 

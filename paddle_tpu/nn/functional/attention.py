@@ -46,9 +46,14 @@ def sdpa_ref(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
             logits = logits + attn_mask
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
     if dropout_p and training:
-        from ...framework.random import next_key
+        fixed_seed = _ignored.get("fixed_seed")
+        if fixed_seed is not None:
+            key = jax.random.PRNGKey(int(fixed_seed))
+        else:
+            from ...framework.random import next_key
 
-        keep = jax.random.bernoulli(next_key(), 1.0 - dropout_p, probs.shape)
+            key = next_key()
+        keep = jax.random.bernoulli(key, 1.0 - dropout_p, probs.shape)
         probs = probs * keep.astype(probs.dtype) / (1.0 - dropout_p)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
