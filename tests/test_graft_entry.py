@@ -20,6 +20,7 @@ ENTRY = os.path.join(REPO, "__graft_entry__.py")
 @pytest.mark.slow
 def test_dryrun_multichip_hermetic_with_broken_tunnel():
     env = dict(os.environ)
+    env.pop("PADDLE_TPU_DRYRUN_CASES", None)  # stray selector would skip cases
     # Deliberately break the plugin's tunnel endpoints. The hermetic
     # re-exec must strip the plugin entirely, so these are never consulted.
     env["PALLAS_AXON_POOL_IPS"] = "10.255.255.1"
@@ -28,7 +29,10 @@ def test_dryrun_multichip_hermetic_with_broken_tunnel():
         [sys.executable, ENTRY, "dryrun", "8"],
         env=env, capture_output=True, text=True, timeout=600)
     assert out.returncode == 0, (out.stdout + out.stderr)[-2000:]
-    assert "dryrun_multichip(8)" in out.stdout
+    # the full topology matrix must be green (3-step loss-sequence parity)
+    for topo in ("dp8", "dp2xmp4", "pp2xmp2xsharding2", "ep4_moe", "sp8_ring"):
+        assert f"{topo}: " in out.stdout and "MISMATCH" not in out.stdout, \
+            out.stdout[-2000:]
 
 
 def test_hermetic_env_strips_plugin_and_forces_cpu():
