@@ -236,19 +236,54 @@ alltoall = all_to_all
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
-    # psum everywhere == reduce + broadcast; dst semantics preserved at API level
-    return all_reduce(tensor, op=op, group=group)
+    """Rank-asymmetric reduce (reference
+    /root/reference/python/paddle/distributed/communication/reduce.py):
+    rank `dst` receives the reduction; every OTHER rank's result is its own
+    input unchanged (the reference leaves non-dst outputs untouched)."""
+    g = _resolve_group(group)
+    arr = _v(tensor)
+    reducer = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
+               ReduceOp.MIN: jax.lax.pmin, ReduceOp.AVG: jax.lax.pmean}.get(op)
+    if reducer is None:  # PROD: psum of logs is lossy; gather
+        def body(x):
+            xs = jax.lax.all_gather(x, g.axis)
+            red = jnp.prod(xs, axis=0)
+            me = jax.lax.axis_index(g.axis)
+            return jnp.where(me == dst, red, x)
+    else:
+        def body(x):
+            red = reducer(x, g.axis)
+            me = jax.lax.axis_index(g.axis)
+            return jnp.where(me == dst, red, x)
+
+    return _wrap_like(_shard_mapped(g, body, arr), tensor)
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    """Scatter: rank r receives entry r of rank `src`'s tensor_list
+    (reference /root/reference/python/paddle/distributed/communication/
+    scatter.py). Single-controller NOTE, loudly: under this emulation every
+    rank shares the controller's ``tensor_list`` — it IS src's list by
+    construction, so the rank-asymmetric "other ranks' lists are ignored"
+    clause is vacuously satisfied rather than exercised; the divergent-list
+    case only exists in multi-process execution (jax.distributed), where
+    each process passes its own list and only src's reaches the mesh. The
+    data movement itself is real: the stacked list is laid out group-sharded
+    so rank r's shard is exactly entry r."""
     g = _resolve_group(group)
-    if tensor_list is not None:
-        full = jnp.stack([_v(t) for t in tensor_list], axis=0)
-    else:
-        full = _v(tensor)
     n = g.nranks
-    shard = full[g.hcg._coord(g.axis) % n] if tensor_list is not None else full
-    return _wrap_like(jnp.asarray(shard), tensor)
+    if tensor_list is None:
+        return _wrap_like(jnp.asarray(_v(tensor)), tensor)
+    if len(tensor_list) != n:
+        raise ValueError(
+            f"scatter needs one entry per rank ({n}), got {len(tensor_list)}")
+    stacked = np.stack([np.asarray(jax.device_get(_v(t)))
+                        for t in tensor_list], axis=0)
+    flat = stacked.reshape(n * stacked.shape[1] if stacked.ndim > 1 else n,
+                           *stacked.shape[2:])
+    sharding = NamedSharding(g.hcg.mesh, _axis_spec(flat.ndim, g.axis, 0))
+    # reference mutates `tensor` in place; preserve that contract
+    return _wrap_like(jax.device_put(flat, sharding), tensor)
 
 
 def barrier(group=None):

@@ -66,17 +66,97 @@ def _pool(x, kernel, stride, padding, n, reducer, init, ceil_mode=False, channel
     return body
 
 
+
+def _max_pool_with_index(x, kernel, stride, padding, n, ceil_mode,
+                         channels_last):
+    """Max pooling that ALSO returns the argmax index into the flattened
+    input spatial plane — the reference max_pool2d/3d_with_index contract
+    (/root/reference/paddle/phi/kernels/funcs/pooling.h MaxPool*WithIndex).
+    Windows are extracted as patches (pre-padded with -inf so borders never
+    pick padding), maxed/argmaxed over the window axis, and the local window
+    offset is converted to a global row-major spatial index."""
+
+    def body(v):
+        vv = jnp.moveaxis(v, -1, 1) if channels_last else v
+        N, C = vv.shape[0], vv.shape[1]
+        spatial = vv.shape[2:]
+        k = _tuple(kernel, n)
+        st = _tuple(stride if stride is not None else kernel, n)
+        pads = []
+        if isinstance(padding, str):
+            mode = padding.upper()
+            for i in range(n):
+                if mode == "VALID":
+                    pads.append((0, 0))
+                else:  # SAME: out = ceil(in / stride), TF-style asymmetric
+                    out_i = -(-spatial[i] // st[i])
+                    total = max((out_i - 1) * st[i] + k[i] - spatial[i], 0)
+                    pads.append((total // 2, total - total // 2))
+        else:
+            pd = _tuple(padding, n)
+            for i in range(n):
+                lo = hi = pd[i]
+                if ceil_mode:
+                    size = spatial[i] + lo + hi
+                    rem = (size - k[i]) % st[i]
+                    if rem:
+                        hi += st[i] - rem
+                pads.append((lo, hi))
+        neg = jnp.finfo(vv.dtype).min
+        vp = jnp.pad(vv, [(0, 0), (0, 0)] + pads, constant_values=neg)
+        # identity-filter conv: force HIGHEST precision so values survive
+        # bit-exact (the MXU would otherwise round through bf16)
+        patches = lax.conv_general_dilated_patches(
+            vp, k, st, "VALID",
+            precision=lax.Precision.HIGHEST)  # [N, C*prod(k), *out] C-major
+        out_spatial = patches.shape[2:]
+        kk = int(np.prod(k))
+        patches = patches.reshape(N, C, kk, *out_spatial)
+        out = jnp.max(patches, axis=2)
+        loc = jnp.argmax(patches, axis=2).astype(jnp.int64)  # window offset
+        # window offset (row-major over k) -> global row-major spatial index
+        idx = jnp.zeros_like(loc)
+        mult = 1
+        for i in reversed(range(n)):
+            ogrid = jnp.arange(out_spatial[i])
+            shape = [1] * loc.ndim
+            shape[2 + i] = out_spatial[i]
+            start = ogrid.reshape(shape) * st[i] - pads[i][0]
+            off = (loc // mult) % k[i]
+            coord = jnp.clip(start + off, 0, spatial[i] - 1)
+            idx = idx + coord * int(np.prod(spatial[i + 1:], dtype=np.int64))
+            mult *= k[i]
+        if channels_last:
+            out = jnp.moveaxis(out, 1, -1)
+            idx = jnp.moveaxis(idx, 1, -1)
+        return out, idx
+
+    return body
+
+
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, name=None):
+    if return_mask:
+        body = _max_pool_with_index(x, kernel_size, stride, padding, 1,
+                                    ceil_mode, False)
+        return apply(body, x, op_name="max_pool1d_with_index")
     body = _pool(x, kernel_size, stride, padding, 1, lax.max, _neg_inf, ceil_mode)
     return apply(body, x, op_name="max_pool1d")
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCHW", name=None):
+    if return_mask:
+        body = _max_pool_with_index(x, kernel_size, stride, padding, 2,
+                                    ceil_mode, data_format == "NHWC")
+        return apply(body, x, op_name="max_pool2d_with_index")
     body = _pool(x, kernel_size, stride, padding, 2, lax.max, _neg_inf, ceil_mode, channels_last=data_format == "NHWC")
     return apply(body, x, op_name="max_pool2d")
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCDHW", name=None):
+    if return_mask:
+        body = _max_pool_with_index(x, kernel_size, stride, padding, 3,
+                                    ceil_mode, data_format == "NDHWC")
+        return apply(body, x, op_name="max_pool3d_with_index")
     body = _pool(x, kernel_size, stride, padding, 3, lax.max, _neg_inf, ceil_mode, channels_last=data_format == "NDHWC")
     return apply(body, x, op_name="max_pool3d")
 
@@ -146,12 +226,30 @@ def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
 
 
 def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        raise NotImplementedError(
+            "adaptive_max_pool1d(return_mask=True): window indices for "
+            "variable-size adaptive windows are not implemented; use "
+            "max_pool1d(return_mask=True) (was previously silently "
+            "ignored)")
     return _adaptive(x, output_size, 1, jnp.max)
 
 
 def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        raise NotImplementedError(
+            "adaptive_max_pool2d(return_mask=True): window indices for "
+            "variable-size adaptive windows are not implemented; use "
+            "max_pool2d(return_mask=True) (was previously silently "
+            "ignored)")
     return _adaptive(x, output_size, 2, jnp.max)
 
 
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        raise NotImplementedError(
+            "adaptive_max_pool3d(return_mask=True): window indices for "
+            "variable-size adaptive windows are not implemented; use "
+            "max_pool3d(return_mask=True) (was previously silently "
+            "ignored)")
     return _adaptive(x, output_size, 3, jnp.max)

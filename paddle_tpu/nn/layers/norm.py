@@ -80,9 +80,29 @@ class BatchNorm3D(_BatchNormBase):
 class SyncBatchNorm(_BatchNormBase):
     """Cross-replica BN. Under SPMD the batch axis is sharded over the mesh and
     jnp.mean inside jit already reduces globally (GSPMD inserts the collective)
-    — so the single-device implementation IS sync BN on TPU. The eager
-    multi-worker path would need explicit psum (reference:
-    python/paddle/nn/layer/norm.py SyncBatchNorm + c_sync_calc kernels)."""
+    — so the single-device implementation IS sync BN on TPU; a test proves
+    the stats span the whole dp-sharded batch (tests/test_alias_audit.py).
+    The eager MULTI-PROCESS path (one process per device, divergent local
+    batches outside jit) would need explicit psum like the reference's
+    c_sync_calc kernels (python/paddle/nn/layer/norm.py SyncBatchNorm);
+    that regime raises loudly instead of silently computing local stats."""
+
+    def forward(self, x):
+        import jax
+
+        from ...core.tensor import Tensor
+
+        val = x._value if isinstance(x, Tensor) else x
+        if jax.process_count() > 1 and not isinstance(val, jax.core.Tracer):
+            # traced execution (jit/Engine) is the supported multi-process
+            # regime — GSPMD reduces stats globally; only EAGER
+            # multi-process would silently compute local stats
+            raise NotImplementedError(
+                "SyncBatchNorm: eager multi-process execution computes LOCAL "
+                "batch stats; run the model under jit/Engine (GSPMD makes "
+                "the stats global) — explicit eager cross-process stat sync "
+                "is not implemented")
+        return super().forward(x)
 
     @classmethod
     def convert_sync_batchnorm(cls, layer):

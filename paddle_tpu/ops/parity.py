@@ -1179,8 +1179,22 @@ def _register_aliases():
     _alias("conv3d", F.conv3d)
     _alias("conv2d_transpose", F.conv2d_transpose)
     _alias("conv3d_transpose", F.conv3d_transpose)
-    _alias("depthwise_conv2d", F.conv2d)  # groups=C path of the same kernel
-    _alias("depthwise_conv2d_transpose", F.conv2d_transpose)
+    def _depthwise(fn):
+        def conv(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                 groups=None, data_format="NCHW", **kw):
+            # reference depthwise kernel: groups == input channels (inferred
+            # from shapes when the caller leaves groups unset)
+            if groups is None or groups == 1:
+                groups = (x.shape[1] if data_format.startswith("NC")
+                          else x.shape[-1])
+            return fn(x, weight, bias, stride, padding,
+                      dilation=dilation, groups=int(groups),
+                      data_format=data_format, **kw)
+
+        return conv
+
+    _alias("depthwise_conv2d", _depthwise(F.conv2d))
+    _alias("depthwise_conv2d_transpose", _depthwise(F.conv2d_transpose))
     _alias("batch_norm", F.batch_norm)
     _alias("sync_batch_norm_", F.batch_norm)  # mesh-global stats under GSPMD
     _alias("layer_norm", F.layer_norm)
@@ -1195,10 +1209,29 @@ def _register_aliases():
     _alias("label_smooth", F.label_smooth)
     _alias("class_center_sample", F.class_center_sample)
     _alias("bilinear", F.bilinear)
-    _alias("pool2d", F.avg_pool2d)
-    _alias("pool3d", F.avg_pool3d)
-    _alias("max_pool2d_with_index", F.max_pool2d)
-    _alias("max_pool3d_with_index", F.max_pool3d)
+    def _pool_nd(avg, mx):
+        def pool(x, kernel_size=2, stride=None, padding=0,
+                 pooling_type="max", **kw):
+            # reference pool2d/pool3d carry a pooling_type attribute
+            fn = mx if str(pooling_type).lower() == "max" else avg
+            return fn(x, kernel_size, stride, padding, **kw)
+
+        return pool
+
+    _alias("pool2d", _pool_nd(F.avg_pool2d, F.max_pool2d))
+    _alias("pool3d", _pool_nd(F.avg_pool3d, F.max_pool3d))
+
+    def _with_index(fn):
+        def pool(x, kernel_size=2, stride=None, padding=0, **kw):
+            kw.pop("return_mask", None)
+            # reference contract: ALWAYS returns (out, mask with argmax
+            # indices into the flattened input plane), phi MaxPoolWithIndex
+            return fn(x, kernel_size, stride, padding, return_mask=True, **kw)
+
+        return pool
+
+    _alias("max_pool2d_with_index", _with_index(F.max_pool2d))
+    _alias("max_pool3d_with_index", _with_index(F.max_pool3d))
     _alias("prelu", F.prelu)
     _alias("logsigmoid", OPS["log_sigmoid"].fn)
     _alias("tanh_shrink", OPS["tanhshrink"].fn)
