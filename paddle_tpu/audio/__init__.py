@@ -4,7 +4,8 @@ functional mel/window math + feature Layers).
 TPU-first: every feature is frame -> rfft -> matmul composition with static
 shapes, so a whole batch of spectrograms is one fused XLA program feeding
 the MXU (the fbank/DCT applications are matmuls)."""
-from . import functional  # noqa: F401
+from . import backends, datasets, functional  # noqa: F401
+from .backends import info, load, save  # noqa: F401
 from .features import (  # noqa: F401
     MFCC,
     LogMelSpectrogram,
@@ -12,5 +13,6 @@ from .features import (  # noqa: F401
     Spectrogram,
 )
 
-__all__ = ["functional", "Spectrogram", "MelSpectrogram",
-           "LogMelSpectrogram", "MFCC"]
+__all__ = ["functional", "backends", "datasets", "Spectrogram",
+           "MelSpectrogram", "LogMelSpectrogram", "MFCC",
+           "info", "load", "save"]

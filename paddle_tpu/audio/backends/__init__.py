@@ -1,0 +1,52 @@
+"""paddle.audio.backends parity (reference
+/root/reference/python/paddle/audio/backends/ — init_backend.py dispatch +
+wave_backend.py stdlib-wave IO). Only the dependency-free wave backend is
+built in; third-party backends (paddleaudio/soundfile) can register via
+``set_backend`` if installed."""
+from . import wave_backend  # noqa: F401
+
+_BACKENDS = {"wave_backend": wave_backend}
+_current = "wave_backend"
+
+__all__ = ["get_current_backend", "list_available_backends", "set_backend",
+           "register_backend", "info", "load", "save"]
+
+
+def list_available_backends():
+    return sorted(_BACKENDS)
+
+
+def get_current_backend() -> str:
+    return _current
+
+
+def register_backend(name: str, module):
+    """Register a third-party backend (must expose info/load/save)."""
+    for attr in ("info", "load", "save"):
+        if not callable(getattr(module, attr, None)):
+            raise TypeError(f"backend {name!r} lacks a callable {attr}()")
+    _BACKENDS[name] = module
+
+
+def set_backend(backend_name: str):
+    global _current
+    if backend_name not in _BACKENDS:
+        raise NotImplementedError(
+            f"backend {backend_name!r} not available; installed: "
+            f"{list_available_backends()} (register_backend() adds one)")
+    _current = backend_name
+
+
+def _dispatch(name):
+    def call(*args, **kwargs):
+        return getattr(_BACKENDS[_current], name)(*args, **kwargs)
+
+    call.__name__ = name
+    call.__doc__ = getattr(wave_backend, name).__doc__
+    return call
+
+
+# live dispatchers: follow set_backend even through by-value re-exports
+info = _dispatch("info")
+load = _dispatch("load")
+save = _dispatch("save")

@@ -1,0 +1,102 @@
+"""PCM16 WAV IO over the stdlib ``wave`` module (reference
+/root/reference/python/paddle/audio/backends/wave_backend.py — same
+contract: load -> (Tensor[-1,1] float32 | int16 raw, sample_rate),
+channels_first default; save writes PCM16)."""
+from __future__ import annotations
+
+import wave
+from dataclasses import dataclass
+from pathlib import Path
+
+import numpy as np
+
+__all__ = ["AudioInfo", "info", "load", "save"]
+
+
+@dataclass
+class AudioInfo:
+    sample_rate: int
+    num_frames: int
+    num_channels: int
+    bits_per_sample: int
+    encoding: str = "PCM_S"
+
+
+def _open(filepath):
+    if hasattr(filepath, "read"):
+        return filepath, False
+    return open(filepath, "rb"), True
+
+
+def info(filepath) -> AudioInfo:
+    fobj, owned = _open(filepath)
+    try:
+        f = wave.open(fobj)
+    except wave.Error as e:
+        if owned:
+            fobj.close()
+        raise NotImplementedError(
+            "wave backend supports only PCM16 WAV files") from e
+    try:
+        return AudioInfo(sample_rate=f.getframerate(),
+                         num_frames=f.getnframes(),
+                         num_channels=f.getnchannels(),
+                         bits_per_sample=f.getsampwidth() * 8)
+    finally:
+        if owned:
+            fobj.close()
+
+
+def load(filepath, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True):
+    """Returns (waveform Tensor, sample_rate). normalize=True scales PCM16
+    into [-1, 1) float32; False keeps raw int16 values (as float32, like
+    the reference). channels_first gives [C, T]."""
+    from ...core.tensor import to_tensor
+
+    fobj, owned = _open(filepath)
+    try:
+        f = wave.open(fobj)
+    except wave.Error as e:
+        if owned:
+            fobj.close()
+        raise NotImplementedError(
+            "wave backend supports only PCM16 WAV files") from e
+    channels = f.getnchannels()
+    sr = f.getframerate()
+    frames = f.getnframes()
+    raw = f.readframes(frames)
+    if owned:
+        fobj.close()
+    data = np.frombuffer(raw, dtype=np.int16).astype(np.float32)
+    if normalize:
+        data = data / 32768.0
+    wavef = data.reshape(frames, channels)
+    if num_frames != -1:
+        wavef = wavef[frame_offset:frame_offset + num_frames, :]
+    elif frame_offset:
+        wavef = wavef[frame_offset:, :]
+    if channels_first:
+        wavef = wavef.T
+    return to_tensor(np.ascontiguousarray(wavef)), sr
+
+
+def save(filepath, src, sample_rate: int, channels_first: bool = True,
+         encoding: str = "PCM_16", bits_per_sample: int = 16):
+    """Write PCM16 WAV. src: float waveform in [-1, 1] (or int16-range
+    values), [C, T] when channels_first."""
+    if encoding != "PCM_16" or bits_per_sample != 16:
+        raise NotImplementedError("wave backend writes PCM_16 only")
+    arr = np.asarray(src.numpy() if hasattr(src, "numpy") else src)
+    if arr.ndim == 1:
+        arr = arr[None, :] if channels_first else arr[:, None]
+    if channels_first:
+        arr = arr.T  # [T, C]
+    if arr.dtype.kind == "f":
+        arr = np.clip(arr, -1.0, 1.0 - 1.0 / 32768) * 32768.0
+    pcm = arr.astype(np.int16)
+    with wave.open(str(Path(filepath)), "wb") as f:
+        f.setnchannels(pcm.shape[1])
+        f.setsampwidth(2)
+        f.setframerate(int(sample_rate))
+        f.writeframes(pcm.tobytes())

@@ -1,0 +1,176 @@
+"""paddle.audio.datasets parity (reference
+/root/reference/python/paddle/audio/datasets/{dataset,esc50,tess}.py).
+
+No-network environment: when the downloaded archives are absent, each
+dataset generates a deterministic synthetic-but-learnable corpus — per-class
+sinusoid mixtures with fixed per-class frequency templates shared across
+splits (same policy as the vision datasets' synthetic fallback), so
+train/dev accuracy is meaningful. Real archives, when present under
+``DATA_HOME``, are read through the wave backend.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["AudioClassificationDataset", "ESC50", "TESS"]
+
+DATA_HOME = os.path.expanduser("~/.cache/paddle_tpu/datasets")
+
+
+class AudioClassificationDataset(Dataset):
+    """files + labels -> (feature, label) pairs.
+
+    feat_type: 'raw' (waveform) | 'melspectrogram' | 'mfcc' |
+    'logmelspectrogram' | 'spectrogram' — feature extraction composes the
+    MXU-friendly feature Layers from paddle_tpu.audio.features."""
+
+    def __init__(self, files, labels, feat_type="raw", sample_rate=None,
+                 **feat_config):
+        super().__init__()
+        known = ("raw", "melspectrogram", "logmelspectrogram", "mfcc",
+                 "spectrogram")
+        if feat_type not in known:
+            raise RuntimeError(
+                f"Unknown feat_type: {feat_type}, it must be one in {list(known)}")
+        self.files = files
+        self.labels = labels
+        self.feat_type = feat_type
+        self.sample_rate = sample_rate
+        self.feat_config = feat_config
+        self._feat_layer = None
+
+    def _waveform(self, item):
+        if isinstance(item, np.ndarray):
+            return item, self.sample_rate or 16000
+        from .backends import load
+
+        wav, sr = load(item)
+        return np.asarray(wav.numpy())[0], sr
+
+    def _feature(self, wave, sr):
+        if self.feat_type == "raw":
+            return wave.astype(np.float32)
+        if self._feat_layer is None:
+            from . import LogMelSpectrogram, MFCC, MelSpectrogram, Spectrogram
+
+            ctor = {"melspectrogram": MelSpectrogram,
+                    "logmelspectrogram": LogMelSpectrogram,
+                    "mfcc": MFCC, "spectrogram": Spectrogram}[self.feat_type]
+            kwargs = dict(self.feat_config)
+            if self.feat_type != "spectrogram":
+                kwargs.setdefault("sr", sr)
+            self._feat_layer = ctor(**kwargs)
+        out = self._feat_layer(wave[None, :].astype(np.float32))
+        return np.asarray(out.numpy())[0]
+
+    def __getitem__(self, idx):
+        wave, sr = self._waveform(self.files[idx])
+        return self._feature(wave, sr), np.int64(self.labels[idx])
+
+    def __len__(self):
+        return len(self.files)
+
+
+def _synthetic_corpus(n_classes, per_class, sr, seconds, seed):
+    """Per-class sinusoid mixtures + noise; class templates are derived from
+    a fixed seed so train/dev share the class structure."""
+    t = np.arange(int(sr * seconds), dtype=np.float32) / sr
+    tmpl_rng = np.random.RandomState(1234)
+    freqs = tmpl_rng.uniform(80.0, sr / 4, size=(n_classes, 3)).astype(np.float32)
+    rng = np.random.RandomState(seed)
+    waves, labels = [], []
+    for c in range(n_classes):
+        for _ in range(per_class):
+            phase = rng.uniform(0, 2 * np.pi, size=3).astype(np.float32)
+            amp = rng.uniform(0.5, 1.0, size=3).astype(np.float32)
+            w = sum(a * np.sin(2 * np.pi * f * t + p)
+                    for a, f, p in zip(amp, freqs[c], phase))
+            w = w / 3.0 + rng.randn(t.shape[0]).astype(np.float32) * 0.05
+            waves.append(w.astype(np.float32))
+            labels.append(c)
+    order = rng.permutation(len(waves))
+    return [waves[i] for i in order], [labels[i] for i in order]
+
+
+class ESC50(AudioClassificationDataset):
+    """ESC-50 environmental sounds: 50 classes x 40 clips x 5s @ 44.1kHz,
+    5-fold split where ``split`` selects the dev fold (reference
+    /root/reference/python/paddle/audio/datasets/esc50.py). Synthetic
+    fallback keeps the class/fold arithmetic (8 clips per class per fold)
+    at a reduced sample rate so tests stay cheap."""
+
+    n_classes = 50
+    meta = os.path.join("ESC-50-master", "meta", "esc50.csv")
+
+    def __init__(self, mode="train", split=1, feat_type="raw", sr=8000,
+                 seconds=1.0, **kwargs):
+        if split not in range(1, 6):
+            raise AssertionError(
+                f"split must be in [1, 5] (5-fold ESC-50), got {split}")
+        files, labels = self._load(mode, split, sr, seconds)
+        super().__init__(files, labels, feat_type=feat_type, sample_rate=sr,
+                         **kwargs)
+
+    def _load(self, mode, split, sr, seconds):
+        meta_path = os.path.join(DATA_HOME, self.meta)
+        if os.path.isfile(meta_path):
+            files, labels = [], []
+            audio_dir = os.path.join(DATA_HOME, "ESC-50-master", "audio")
+            with open(meta_path) as rf:
+                for line in list(rf)[1:]:
+                    fname, fold, target = line.strip().split(",")[:3]
+                    in_dev = int(fold) == int(split)
+                    # reference: any non-'train' mode selects the dev fold
+                    if (mode != "train") == in_dev:
+                        files.append(os.path.join(audio_dir, fname))
+                        labels.append(int(target))
+            return files, labels
+        per_class = 8 if mode == "train" else 2
+        seed = 7 if mode == "train" else 8
+        return _synthetic_corpus(self.n_classes, per_class, sr, seconds, seed)
+
+
+class TESS(AudioClassificationDataset):
+    """TESS emotional speech: 7 emotions x 2 speakers x 200 words
+    (reference /root/reference/python/paddle/audio/datasets/tess.py).
+    n_folds folds; ``split`` selects the dev fold."""
+
+    n_classes = 7
+    label_list = ["angry", "disgust", "fear", "happy", "neutral",
+                  "ps", "sad"]
+    archive_dir = "TESS_Toronto_emotional_speech_set"
+
+    def __init__(self, mode="train", n_folds=5, split=1, feat_type="raw",
+                 sr=8000, seconds=1.0, **kwargs):
+        if not (isinstance(n_folds, int) and n_folds >= 1):
+            raise AssertionError(f"n_folds must be a positive int, got {n_folds}")
+        if split not in range(1, n_folds + 1):
+            raise AssertionError(
+                f"split must be in [1, {n_folds}], got {split}")
+        files, labels = self._load(mode, n_folds, split, sr, seconds)
+        super().__init__(files, labels, feat_type=feat_type, sample_rate=sr,
+                         **kwargs)
+
+    def _load(self, mode, n_folds, split, sr, seconds):
+        root = os.path.join(DATA_HOME, self.archive_dir)
+        if os.path.isdir(root):
+            files, labels = [], []
+            all_files = sorted(
+                os.path.join(dp, f) for dp, _, fs in os.walk(root)
+                for f in fs if f.endswith(".wav"))
+            for i, path in enumerate(all_files):
+                emotion = os.path.basename(path).split("_")[-1][:-4].lower()
+                if emotion not in self.label_list:
+                    continue
+                in_dev = (i % n_folds) == (split - 1)
+                if (mode != "train") == in_dev:
+                    files.append(path)
+                    labels.append(self.label_list.index(emotion))
+            return files, labels
+        per_class = 10 if mode == "train" else 3
+        seed = 17 if mode == "train" else 18
+        return _synthetic_corpus(self.n_classes, per_class, sr, seconds, seed)

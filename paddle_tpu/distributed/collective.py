@@ -244,6 +244,8 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     arr = _v(tensor)
     reducer = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
                ReduceOp.MIN: jax.lax.pmin, ReduceOp.AVG: jax.lax.pmean}.get(op)
+    if reducer is None and op != ReduceOp.PROD:
+        raise ValueError(f"unsupported reduce op: {op!r}")
     if reducer is None:  # PROD: psum of logs is lossy; gather
         def body(x):
             xs = jax.lax.all_gather(x, g.axis)

@@ -102,7 +102,8 @@ def _max_pool_with_index(x, kernel, stride, padding, n, ceil_mode,
                     if rem:
                         hi += st[i] - rem
                 pads.append((lo, hi))
-        neg = jnp.finfo(vv.dtype).min
+        neg = (jnp.finfo(vv.dtype).min if jnp.issubdtype(vv.dtype, jnp.floating)
+               else jnp.iinfo(vv.dtype).min)
         vp = jnp.pad(vv, [(0, 0), (0, 0)] + pads, constant_values=neg)
         # identity-filter conv: force HIGHEST precision so values survive
         # bit-exact (the MXU would otherwise round through bf16)
@@ -177,11 +178,16 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusiv
 
 
 def _neg_inf(dtype):
-    return -jnp.inf if jnp.issubdtype(dtype, jnp.floating) else jnp.iinfo(dtype).min
+    # typed NUMPY scalar: a weak python int init (int64) mismatches an int32
+    # operand under x64, and a jnp array init becomes a traced operand that
+    # breaks reverse-mode AD through reduce_window
+    if jnp.issubdtype(dtype, jnp.floating):
+        return np.asarray(-np.inf, np.dtype(dtype))[()]
+    return np.asarray(jnp.iinfo(dtype).min, np.dtype(dtype))[()]
 
 
 def _zero(dtype):
-    return jnp.array(0, dtype).item() if not jnp.issubdtype(dtype, jnp.floating) else 0.0
+    return np.asarray(0, np.dtype(dtype))[()]
 
 
 def _adaptive(x, output_size, n, op):

@@ -1,0 +1,108 @@
+"""GoogLeNet / Inception v1 (reference
+python/paddle/vision/models/googlenet.py:107; Szegedy 2014). Forward
+returns (out, aux1, aux2) like the reference (aux heads after stages 4a
+and 4d feed the auxiliary losses during training)."""
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["GoogLeNet", "googlenet"]
+
+
+class ConvReLU(nn.Sequential):
+    def __init__(self, c_in, c_out, kernel, stride=1, padding=0):
+        super().__init__(
+            nn.Conv2D(c_in, c_out, kernel, stride=stride, padding=padding),
+            nn.ReLU(),
+        )
+
+
+class Inception(nn.Layer):
+    """Four parallel branches concatenated on channels."""
+
+    def __init__(self, c_in, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.b1 = ConvReLU(c_in, c1, 1)
+        self.b2 = nn.Sequential(ConvReLU(c_in, c3r, 1),
+                                ConvReLU(c3r, c3, 3, padding=1))
+        self.b3 = nn.Sequential(ConvReLU(c_in, c5r, 1),
+                                ConvReLU(c5r, c5, 5, padding=2))
+        self.b4 = nn.Sequential(nn.MaxPool2D(3, stride=1, padding=1),
+                                ConvReLU(c_in, proj, 1))
+
+    def forward(self, x):
+        from ... import ops as P
+
+        return P.concat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)],
+                        axis=1)
+
+
+class _AuxHead(nn.Layer):
+    def __init__(self, c_in, num_classes):
+        super().__init__()
+        self.pool = nn.AvgPool2D(5, stride=3)
+        self.conv = ConvReLU(c_in, 128, 1)
+        self.fc1 = nn.Linear(128 * 4 * 4, 1024)
+        self.relu = nn.ReLU()
+        self.drop = nn.Dropout(0.7)
+        self.fc2 = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        from ... import ops as P
+
+        h = self.conv(self.pool(x))
+        h = self.relu(self.fc1(P.flatten(h, start_axis=1)))
+        return self.fc2(self.drop(h))
+
+
+class GoogLeNet(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            ConvReLU(3, 64, 7, stride=2, padding=3),
+            nn.MaxPool2D(3, stride=2, ceil_mode=True),
+            ConvReLU(64, 64, 1),
+            ConvReLU(64, 192, 3, padding=1),
+            nn.MaxPool2D(3, stride=2, ceil_mode=True),
+        )
+        self.inc3a = Inception(192, 64, 96, 128, 16, 32, 32)
+        self.inc3b = Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = nn.MaxPool2D(3, stride=2, ceil_mode=True)
+        self.inc4a = Inception(480, 192, 96, 208, 16, 48, 64)
+        self.inc4b = Inception(512, 160, 112, 224, 24, 64, 64)
+        self.inc4c = Inception(512, 128, 128, 256, 24, 64, 64)
+        self.inc4d = Inception(512, 112, 144, 288, 32, 64, 64)
+        self.inc4e = Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = nn.MaxPool2D(3, stride=2, ceil_mode=True)
+        self.inc5a = Inception(832, 256, 160, 320, 32, 128, 128)
+        self.inc5b = Inception(832, 384, 192, 384, 48, 128, 128)
+        if with_pool:
+            self.pool5 = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.drop = nn.Dropout(0.4)
+            self.fc = nn.Linear(1024, num_classes)
+            self.aux1 = _AuxHead(512, num_classes)
+            self.aux2 = _AuxHead(528, num_classes)
+
+    def forward(self, x):
+        from ... import ops as P
+
+        h = self.stem(x)
+        h = self.pool3(self.inc3b(self.inc3a(h)))
+        h = self.inc4a(h)
+        aux1 = self.aux1(h) if self.num_classes > 0 else None
+        h = self.inc4d(self.inc4c(self.inc4b(h)))
+        aux2 = self.aux2(h) if self.num_classes > 0 else None
+        h = self.pool4(self.inc4e(h))
+        h = self.inc5b(self.inc5a(h))
+        if self.with_pool:
+            h = self.pool5(h)
+        if self.num_classes > 0:
+            h = self.fc(self.drop(P.flatten(h, start_axis=1)))
+        return h, aux1, aux2
+
+
+def googlenet(pretrained=False, **kwargs):
+    return GoogLeNet(**kwargs)

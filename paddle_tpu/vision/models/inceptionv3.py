@@ -1,0 +1,163 @@
+"""Inception v3 (reference python/paddle/vision/models/inceptionv3.py:488;
+Szegedy 2015 factorized 7x7 / label-smoothing era architecture)."""
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["InceptionV3", "inception_v3"]
+
+
+class ConvBN(nn.Sequential):
+    def __init__(self, c_in, c_out, kernel, stride=1, padding=0):
+        super().__init__(
+            nn.Conv2D(c_in, c_out, kernel, stride=stride, padding=padding,
+                      bias_attr=False),
+            nn.BatchNorm2D(c_out),
+            nn.ReLU(),
+        )
+
+
+def _cat(xs):
+    from ... import ops as P
+
+    return P.concat(xs, axis=1)
+
+
+class InceptionA(nn.Layer):
+    def __init__(self, c_in, pool_features):
+        super().__init__()
+        self.b1 = ConvBN(c_in, 64, 1)
+        self.b2 = nn.Sequential(ConvBN(c_in, 48, 1),
+                                ConvBN(48, 64, 5, padding=2))
+        self.b3 = nn.Sequential(ConvBN(c_in, 64, 1),
+                                ConvBN(64, 96, 3, padding=1),
+                                ConvBN(96, 96, 3, padding=1))
+        self.b4 = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                ConvBN(c_in, pool_features, 1))
+
+    def forward(self, x):
+        return _cat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)])
+
+
+class InceptionB(nn.Layer):
+    """Grid reduction 35x35 -> 17x17."""
+
+    def __init__(self, c_in):
+        super().__init__()
+        self.b1 = ConvBN(c_in, 384, 3, stride=2)
+        self.b2 = nn.Sequential(ConvBN(c_in, 64, 1),
+                                ConvBN(64, 96, 3, padding=1),
+                                ConvBN(96, 96, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return _cat([self.b1(x), self.b2(x), self.pool(x)])
+
+
+class InceptionC(nn.Layer):
+    """Factorized 7x7 branches."""
+
+    def __init__(self, c_in, c7):
+        super().__init__()
+        self.b1 = ConvBN(c_in, 192, 1)
+        self.b2 = nn.Sequential(
+            ConvBN(c_in, c7, 1),
+            ConvBN(c7, c7, (1, 7), padding=(0, 3)),
+            ConvBN(c7, 192, (7, 1), padding=(3, 0)))
+        self.b3 = nn.Sequential(
+            ConvBN(c_in, c7, 1),
+            ConvBN(c7, c7, (7, 1), padding=(3, 0)),
+            ConvBN(c7, c7, (1, 7), padding=(0, 3)),
+            ConvBN(c7, c7, (7, 1), padding=(3, 0)),
+            ConvBN(c7, 192, (1, 7), padding=(0, 3)))
+        self.b4 = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                ConvBN(c_in, 192, 1))
+
+    def forward(self, x):
+        return _cat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)])
+
+
+class InceptionD(nn.Layer):
+    """Grid reduction 17x17 -> 8x8."""
+
+    def __init__(self, c_in):
+        super().__init__()
+        self.b1 = nn.Sequential(ConvBN(c_in, 192, 1),
+                                ConvBN(192, 320, 3, stride=2))
+        self.b2 = nn.Sequential(
+            ConvBN(c_in, 192, 1),
+            ConvBN(192, 192, (1, 7), padding=(0, 3)),
+            ConvBN(192, 192, (7, 1), padding=(3, 0)),
+            ConvBN(192, 192, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return _cat([self.b1(x), self.b2(x), self.pool(x)])
+
+
+class InceptionE(nn.Layer):
+    """Expanded-filter-bank output blocks."""
+
+    def __init__(self, c_in):
+        super().__init__()
+        self.b1 = ConvBN(c_in, 320, 1)
+        self.b2_stem = ConvBN(c_in, 384, 1)
+        self.b2_a = ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.b2_b = ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.b3_stem = nn.Sequential(ConvBN(c_in, 448, 1),
+                                     ConvBN(448, 384, 3, padding=1))
+        self.b3_a = ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.b4 = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                ConvBN(c_in, 192, 1))
+
+    def forward(self, x):
+        h2 = self.b2_stem(x)
+        h3 = self.b3_stem(x)
+        return _cat([self.b1(x),
+                     _cat([self.b2_a(h2), self.b2_b(h2)]),
+                     _cat([self.b3_a(h3), self.b3_b(h3)]),
+                     self.b4(x)])
+
+
+class InceptionV3(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            ConvBN(3, 32, 3, stride=2),
+            ConvBN(32, 32, 3),
+            ConvBN(32, 64, 3, padding=1),
+            nn.MaxPool2D(3, stride=2),
+            ConvBN(64, 80, 1),
+            ConvBN(80, 192, 3),
+            nn.MaxPool2D(3, stride=2),
+        )
+        self.blocks = nn.Sequential(
+            InceptionA(192, 32), InceptionA(256, 64), InceptionA(288, 64),
+            InceptionB(288),
+            InceptionC(768, 128), InceptionC(768, 160), InceptionC(768, 160),
+            InceptionC(768, 192),
+            InceptionD(768),
+            InceptionE(1280), InceptionE(2048),
+        )
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.drop = nn.Dropout(0.2)
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        from ... import ops as P
+
+        h = self.blocks(self.stem(x))
+        if self.with_pool:
+            h = self.pool(h)
+        if self.num_classes > 0:
+            h = self.fc(self.drop(P.flatten(h, start_axis=1)))
+        return h
+
+
+def inception_v3(pretrained=False, **kwargs):
+    return InceptionV3(**kwargs)

@@ -1,0 +1,67 @@
+"""MobileNetV1 (reference python/paddle/vision/models/mobilenetv1.py;
+Howard 2017 depthwise-separable convolutions)."""
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["MobileNetV1", "mobilenet_v1"]
+
+
+class ConvBNReLU(nn.Sequential):
+    def __init__(self, c_in, c_out, kernel=3, stride=1, groups=1):
+        super().__init__(
+            nn.Conv2D(c_in, c_out, kernel, stride=stride,
+                      padding=(kernel - 1) // 2, groups=groups,
+                      bias_attr=False),
+            nn.BatchNorm2D(c_out),
+            nn.ReLU(),
+        )
+
+
+class DepthwiseSeparable(nn.Layer):
+    def __init__(self, c_in, c_out, stride):
+        super().__init__()
+        self.dw = ConvBNReLU(c_in, c_in, 3, stride=stride, groups=c_in)
+        self.pw = ConvBNReLU(c_in, c_out, 1)
+
+    def forward(self, x):
+        return self.pw(self.dw(x))
+
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.scale = scale
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            return max(int(ch * scale), 8)
+
+        cfg = [  # (c_in, c_out, stride)
+            (32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+            (256, 256, 1), (256, 512, 2),
+            (512, 512, 1), (512, 512, 1), (512, 512, 1), (512, 512, 1),
+            (512, 512, 1), (512, 1024, 2), (1024, 1024, 1),
+        ]
+        feats = [ConvBNReLU(3, c(32), stride=2)]
+        feats += [DepthwiseSeparable(c(a), c(b), s) for a, b, s in cfg]
+        self.features = nn.Sequential(*feats)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(c(1024), num_classes)
+
+    def forward(self, x):
+        from ... import ops as P
+
+        h = self.features(x)
+        if self.with_pool:
+            h = self.pool(h)
+        if self.num_classes > 0:
+            h = self.fc(P.flatten(h, start_axis=1))
+        return h
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV1(scale=scale, **kwargs)

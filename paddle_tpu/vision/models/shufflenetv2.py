@@ -1,0 +1,152 @@
+"""ShuffleNetV2 (reference python/paddle/vision/models/shufflenetv2.py:195;
+Ma 2018 — channel split + shuffle units)."""
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = [
+    "ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+    "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
+    "shufflenet_v2_x2_0", "shufflenet_v2_swish",
+]
+
+_STAGE_OUT = {
+    0.25: [24, 24, 48, 96, 512],
+    0.33: [24, 32, 64, 128, 512],
+    0.5: [24, 48, 96, 192, 1024],
+    1.0: [24, 116, 232, 464, 1024],
+    1.5: [24, 176, 352, 704, 1024],
+    2.0: [24, 244, 488, 976, 2048],
+}
+_REPEATS = [4, 8, 4]
+
+
+def _activation(act):
+    return nn.Swish() if act == "swish" else nn.ReLU()
+
+
+class ConvBNAct(nn.Sequential):
+    def __init__(self, c_in, c_out, kernel, stride=1, groups=1, act="relu"):
+        layers = [
+            nn.Conv2D(c_in, c_out, kernel, stride=stride,
+                      padding=(kernel - 1) // 2, groups=groups,
+                      bias_attr=False),
+            nn.BatchNorm2D(c_out),
+        ]
+        if act:
+            layers.append(_activation(act))
+        super().__init__(*layers)
+
+
+def _shuffle(x, groups=2):
+    from ...nn import functional as F
+
+    return F.channel_shuffle(x, groups)
+
+
+class ShuffleUnit(nn.Layer):
+    """Stride-1 unit: split channels, transform the right half, shuffle."""
+
+    def __init__(self, channels, act):
+        super().__init__()
+        assert channels % 2 == 0
+        c = channels // 2
+        self.branch = nn.Sequential(
+            ConvBNAct(c, c, 1, act=act),
+            ConvBNAct(c, c, 3, groups=c, act=None),  # dw
+            ConvBNAct(c, c, 1, act=act),
+        )
+
+    def forward(self, x):
+        from ... import ops as P
+
+        left, right = P.split(x, 2, axis=1)
+        out = P.concat([left, self.branch(right)], axis=1)
+        return _shuffle(out)
+
+
+class ShuffleUnitDS(nn.Layer):
+    """Downsample unit: both branches stride 2, channels double."""
+
+    def __init__(self, c_in, c_out, act):
+        super().__init__()
+        c = c_out // 2
+        self.left = nn.Sequential(
+            ConvBNAct(c_in, c_in, 3, stride=2, groups=c_in, act=None),
+            ConvBNAct(c_in, c, 1, act=act),
+        )
+        self.right = nn.Sequential(
+            ConvBNAct(c_in, c, 1, act=act),
+            ConvBNAct(c, c, 3, stride=2, groups=c, act=None),
+            ConvBNAct(c, c, 1, act=act),
+        )
+
+    def forward(self, x):
+        from ... import ops as P
+
+        out = P.concat([self.left(x), self.right(x)], axis=1)
+        return _shuffle(out)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        if scale not in _STAGE_OUT:
+            raise ValueError(f"scale must be one of {sorted(_STAGE_OUT)}")
+        chans = _STAGE_OUT[scale]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(ConvBNAct(3, chans[0], 3, stride=2, act=act),
+                                  nn.MaxPool2D(3, stride=2, padding=1))
+        stages = []
+        c_in = chans[0]
+        for stage_i, reps in enumerate(_REPEATS):
+            c_out = chans[stage_i + 1]
+            stages.append(ShuffleUnitDS(c_in, c_out, act))
+            stages += [ShuffleUnit(c_out, act) for _ in range(reps - 1)]
+            c_in = c_out
+        self.stages = nn.Sequential(*stages)
+        self.head = ConvBNAct(c_in, chans[-1], 1, act=act)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(chans[-1], num_classes)
+
+    def forward(self, x):
+        from ... import ops as P
+
+        h = self.head(self.stages(self.stem(x)))
+        if self.with_pool:
+            h = self.pool(h)
+        if self.num_classes > 0:
+            h = self.fc(P.flatten(h, start_axis=1))
+        return h
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.25, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.33, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.5, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.0, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.5, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=2.0, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.0, act="swish", **kwargs)

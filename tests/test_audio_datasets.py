@@ -1,0 +1,104 @@
+"""Audio datasets + wave backend (VERDICT r4 missing #5; reference
+/root/reference/python/paddle/audio/datasets/{esc50,tess}.py and
+backends/wave_backend.py)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import audio
+
+
+class TestWaveBackend:
+    def test_roundtrip_pcm16(self):
+        sr = 16000
+        t = np.linspace(-1, 1, 4000).astype(np.float32) * 0.25
+        wavef = np.stack([t, -t])
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "x.wav")
+            audio.save(path, wavef, sr)
+            meta = audio.info(path)
+            assert (meta.sample_rate, meta.num_frames,
+                    meta.num_channels, meta.bits_per_sample) == (sr, 4000, 2, 16)
+            back, sr2 = audio.load(path)
+            assert sr2 == sr and tuple(back.shape) == (2, 4000)
+            np.testing.assert_allclose(back.numpy(), wavef, atol=1.0 / 32768)
+            raw, _ = audio.load(path, normalize=False)
+            assert np.abs(raw.numpy()).max() > 1000  # int16-range values
+            part, _ = audio.load(path, frame_offset=100, num_frames=50)
+            np.testing.assert_allclose(part.numpy(), back.numpy()[:, 100:150],
+                                       atol=1e-7)
+
+    def test_non_wav_raises(self):
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "x.mp3")
+            with open(path, "wb") as f:
+                f.write(b"ID3 not a wav")
+            with pytest.raises(NotImplementedError):
+                audio.load(path)
+
+    def test_backend_registry(self):
+        assert audio.backends.get_current_backend() == "wave_backend"
+        assert "wave_backend" in audio.backends.list_available_backends()
+        with pytest.raises(NotImplementedError):
+            audio.backends.set_backend("soundfile")
+
+
+class TestAudioDatasets:
+    def test_esc50_shapes_and_splits(self):
+        tr = audio.datasets.ESC50(mode="train")
+        dv = audio.datasets.ESC50(mode="dev")
+        assert len(tr) == 400 and len(dv) == 100
+        a, l = tr[0]
+        assert a.dtype == np.float32 and a.ndim == 1
+        assert 0 <= int(l) < 50
+        labels = {int(tr[i][1]) for i in range(0, 400, 7)}
+        assert len(labels) > 10  # many classes present
+
+    def test_esc50_feature_types(self):
+        ds = audio.datasets.ESC50(mode="dev", feat_type="mfcc", n_mfcc=13,
+                                  n_fft=256, hop_length=128)
+        f, _ = ds[0]
+        assert f.shape[0] == 13
+        ds2 = audio.datasets.ESC50(mode="dev", feat_type="logmelspectrogram",
+                                   n_fft=256, hop_length=128, n_mels=20)
+        f2, _ = ds2[0]
+        assert f2.shape[0] == 20
+        with pytest.raises(RuntimeError, match="feat_type"):
+            audio.datasets.ESC50(feat_type="bogus")
+
+    def test_tess_folds(self):
+        tr = audio.datasets.TESS(mode="train", n_folds=5, split=2)
+        dv = audio.datasets.TESS(mode="dev", n_folds=5, split=2)
+        assert len(tr) == 70 and len(dv) == 21
+        with pytest.raises(AssertionError):
+            audio.datasets.TESS(split=9)
+
+    def test_dataset_learnable_with_dataloader(self):
+        """Synthetic corpus is class-separable: a tiny linear probe on the
+        mel features should beat chance quickly."""
+        paddle.seed(0)
+        ds = audio.datasets.ESC50(mode="train", feat_type="melspectrogram",
+                                  n_fft=256, hop_length=256, n_mels=16)
+        loader = paddle.io.DataLoader(ds, batch_size=32, shuffle=True)
+        feat, _ = ds[0]
+        net = paddle.nn.Sequential(
+            paddle.nn.Flatten(),
+            paddle.nn.Linear(int(np.prod(feat.shape)), 50))
+        opt = paddle.optimizer.Adam(parameters=net.parameters(),
+                                    learning_rate=5e-3)
+        lossf = paddle.nn.CrossEntropyLoss()
+        for _ in range(2):
+            for xb, yb in loader:
+                loss = lossf(net(xb), yb)
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+        correct = total = 0
+        for xb, yb in loader:
+            pred = net(xb).numpy().argmax(-1)
+            correct += int((pred == yb.numpy().ravel()).sum())
+            total += len(pred)
+        assert correct / total > 0.2  # chance is 0.02
