@@ -62,6 +62,12 @@ def load(filepath, frame_offset: int = 0, num_frames: int = -1,
             fobj.close()
         raise NotImplementedError(
             "wave backend supports only PCM16 WAV files") from e
+    if f.getsampwidth() != 2:
+        if owned:
+            fobj.close()
+        raise NotImplementedError(
+            f"wave backend supports only PCM16 WAV; this file is "
+            f"{f.getsampwidth() * 8}-bit")
     channels = f.getnchannels()
     sr = f.getframerate()
     frames = f.getnframes()
@@ -93,7 +99,12 @@ def save(filepath, src, sample_rate: int, channels_first: bool = True,
     if channels_first:
         arr = arr.T  # [T, C]
     if arr.dtype.kind == "f":
-        arr = np.clip(arr, -1.0, 1.0 - 1.0 / 32768) * 32768.0
+        if np.abs(arr).max(initial=0.0) > 1.0:
+            # int16-range float values (e.g. a normalize=False load):
+            # already in PCM scale, round-trip them unscaled
+            arr = np.clip(arr, -32768, 32767)
+        else:
+            arr = np.clip(arr, -1.0, 1.0 - 1.0 / 32768) * 32768.0
     pcm = arr.astype(np.int16)
     with wave.open(str(Path(filepath)), "wb") as f:
         f.setnchannels(pcm.shape[1])

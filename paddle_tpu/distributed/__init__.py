@@ -55,6 +55,7 @@ from .mp_layers import (  # noqa: F401
     VocabParallelEmbedding,
     mark_sharding,
 )
+from .spawn import MultiprocessContext, spawn  # noqa: F401
 from .parallel import (  # noqa: F401
     DataParallel,
     ParallelEnv,
@@ -74,7 +75,7 @@ from .strategy import DistributedStrategy  # noqa: F401
 from .tcp_store import TCPStore  # noqa: F401
 
 __all__ = [
-    "init_parallel_env", "get_rank", "get_world_size", "ParallelEnv", "DataParallel",
+    "init_parallel_env", "spawn", "MultiprocessContext", "get_rank", "get_world_size", "ParallelEnv", "DataParallel",
     "ReduceOp", "all_reduce", "all_gather", "reduce_scatter", "broadcast",
     "all_to_all", "alltoall", "reduce", "scatter", "barrier", "send", "recv",
     "ppermute", "new_group", "shard_to_group", "unshard",

@@ -3,18 +3,14 @@ Szegedy 2015 factorized 7x7 / label-smoothing era architecture)."""
 from __future__ import annotations
 
 from ... import nn
+from ..ops import ConvNormActivation
 
 __all__ = ["InceptionV3", "inception_v3"]
 
 
-class ConvBN(nn.Sequential):
+class ConvBN(ConvNormActivation):
     def __init__(self, c_in, c_out, kernel, stride=1, padding=0):
-        super().__init__(
-            nn.Conv2D(c_in, c_out, kernel, stride=stride, padding=padding,
-                      bias_attr=False),
-            nn.BatchNorm2D(c_out),
-            nn.ReLU(),
-        )
+        super().__init__(c_in, c_out, kernel, stride=stride, padding=padding)
 
 
 def _cat(xs):

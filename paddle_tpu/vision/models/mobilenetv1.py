@@ -3,19 +3,14 @@ Howard 2017 depthwise-separable convolutions)."""
 from __future__ import annotations
 
 from ... import nn
+from ..ops import ConvNormActivation
 
 __all__ = ["MobileNetV1", "mobilenet_v1"]
 
 
-class ConvBNReLU(nn.Sequential):
+class ConvBNReLU(ConvNormActivation):
     def __init__(self, c_in, c_out, kernel=3, stride=1, groups=1):
-        super().__init__(
-            nn.Conv2D(c_in, c_out, kernel, stride=stride,
-                      padding=(kernel - 1) // 2, groups=groups,
-                      bias_attr=False),
-            nn.BatchNorm2D(c_out),
-            nn.ReLU(),
-        )
+        super().__init__(c_in, c_out, kernel, stride=stride, groups=groups)
 
 
 class DepthwiseSeparable(nn.Layer):

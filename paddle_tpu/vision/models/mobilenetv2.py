@@ -3,6 +3,7 @@ Sandler 2018 inverted residuals + linear bottlenecks)."""
 from __future__ import annotations
 
 from ... import nn
+from ..ops import ConvNormActivation
 
 __all__ = ["MobileNetV2", "mobilenet_v2"]
 
@@ -15,15 +16,10 @@ def _make_divisible(v, divisor=8, min_value=None):
     return new_v
 
 
-class ConvBNReLU(nn.Sequential):
+class ConvBNReLU(ConvNormActivation):
     def __init__(self, c_in, c_out, kernel=3, stride=1, groups=1):
-        super().__init__(
-            nn.Conv2D(c_in, c_out, kernel, stride=stride,
-                      padding=(kernel - 1) // 2, groups=groups,
-                      bias_attr=False),
-            nn.BatchNorm2D(c_out),
-            nn.ReLU6(),
-        )
+        super().__init__(c_in, c_out, kernel, stride=stride, groups=groups,
+                         activation_layer=nn.ReLU6)
 
 
 class InvertedResidual(nn.Layer):

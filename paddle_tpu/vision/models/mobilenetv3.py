@@ -4,27 +4,19 @@ excitation bottlenecks with hardswish activations)."""
 from __future__ import annotations
 
 from ... import nn
+from ..ops import ConvNormActivation
 from .mobilenetv2 import _make_divisible
 
 __all__ = ["MobileNetV3Small", "MobileNetV3Large", "mobilenet_v3_small",
            "mobilenet_v3_large"]
 
 
-def _act(kind):
-    return nn.Hardswish() if kind == "HS" else nn.ReLU()
-
-
-class ConvBNAct(nn.Sequential):
+class ConvBNAct(ConvNormActivation):
     def __init__(self, c_in, c_out, kernel=3, stride=1, groups=1, act="HS"):
-        layers = [
-            nn.Conv2D(c_in, c_out, kernel, stride=stride,
-                      padding=(kernel - 1) // 2, groups=groups,
-                      bias_attr=False),
-            nn.BatchNorm2D(c_out),
-        ]
-        if act:
-            layers.append(_act(act))
-        super().__init__(*layers)
+        super().__init__(
+            c_in, c_out, kernel, stride=stride, groups=groups,
+            activation_layer={"HS": nn.Hardswish, "RE": nn.ReLU,
+                              None: None}[act])
 
 
 class SqueezeExcitation(nn.Layer):

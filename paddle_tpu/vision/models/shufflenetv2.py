@@ -3,6 +3,7 @@ Ma 2018 — channel split + shuffle units)."""
 from __future__ import annotations
 
 from ... import nn
+from ..ops import ConvNormActivation
 
 __all__ = [
     "ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
@@ -21,21 +22,12 @@ _STAGE_OUT = {
 _REPEATS = [4, 8, 4]
 
 
-def _activation(act):
-    return nn.Swish() if act == "swish" else nn.ReLU()
-
-
-class ConvBNAct(nn.Sequential):
+class ConvBNAct(ConvNormActivation):
     def __init__(self, c_in, c_out, kernel, stride=1, groups=1, act="relu"):
-        layers = [
-            nn.Conv2D(c_in, c_out, kernel, stride=stride,
-                      padding=(kernel - 1) // 2, groups=groups,
-                      bias_attr=False),
-            nn.BatchNorm2D(c_out),
-        ]
-        if act:
-            layers.append(_activation(act))
-        super().__init__(*layers)
+        super().__init__(
+            c_in, c_out, kernel, stride=stride, groups=groups,
+            activation_layer={"relu": nn.ReLU, "swish": nn.Swish,
+                              None: None}[act])
 
 
 def _shuffle(x, groups=2):

@@ -1,0 +1,60 @@
+"""paddle.vision.ops parity surface (reference
+/root/reference/python/paddle/vision/ops.py): detection functionals
+re-exported from the op registry + the shared ConvNormActivation block
+(reference :1796) used across the model zoo."""
+from __future__ import annotations
+
+from .. import nn
+from ..ops.registry import OPS
+
+__all__ = [
+    "ConvNormActivation", "nms", "roi_align", "roi_pool", "yolo_box",
+    "yolo_loss", "prior_box", "box_coder", "matrix_nms",
+    "distribute_fpn_proposals", "generate_proposals",
+]
+
+
+class ConvNormActivation(nn.Sequential):
+    """Conv2D -> norm -> activation (reference vision/ops.py:1796). The one
+    block the whole zoo composes: norm_layer/activation_layer None skips
+    that stage; bias defaults to norm_layer is None."""
+
+    def __init__(self, in_channels, out_channels, kernel_size=3, stride=1,
+                 padding=None, groups=1, norm_layer=nn.BatchNorm2D,
+                 activation_layer=nn.ReLU, dilation=1, bias=None):
+        if padding is None:
+            k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+            padding = (k - 1) // 2 * dilation
+        if bias is None:
+            bias = norm_layer is None
+        layers = [nn.Conv2D(in_channels, out_channels, kernel_size,
+                            stride=stride, padding=padding, dilation=dilation,
+                            groups=groups,
+                            bias_attr=None if bias else False)]
+        if norm_layer is not None:
+            layers.append(norm_layer(out_channels))
+        if activation_layer is not None:
+            layers.append(activation_layer())
+        super().__init__(*layers)
+
+
+def _export(name):
+    # resolved lazily: the op table finishes registering (ops.parity import)
+    # after the vision package is first imported
+    def wrapper(*args, **kwargs):
+        return OPS[name].fn(*args, **kwargs)
+
+    wrapper.__name__ = name
+    return wrapper
+
+
+nms = _export("nms")
+roi_align = _export("roi_align")
+roi_pool = _export("roi_pool")
+yolo_box = _export("yolo_box")
+yolo_loss = _export("yolo_loss")
+prior_box = _export("prior_box")
+box_coder = _export("box_coder")
+matrix_nms = _export("matrix_nms")
+distribute_fpn_proposals = _export("distribute_fpn_proposals")
+generate_proposals = _export("generate_proposals")

@@ -1,0 +1,28 @@
+"""Module-level worker functions for paddle.distributed.spawn tests (spawn
+start method pickles by module path, so they cannot be test-local)."""
+import os
+
+import numpy as np
+
+
+def collective_worker(out_dir):
+    """2-process x 4-CPU-device worker: init the global mesh, prove
+    cross-process collectives, write evidence for the parent to assert."""
+    import jax
+
+    import paddle_tpu.distributed as dist
+
+    dist.init_parallel_env()
+    rank = jax.process_index()
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(
+        np.array([rank * 10 + 7], np.int32))
+    with open(os.path.join(out_dir, f"rank{rank}.txt"), "w") as f:
+        f.write(f"{jax.process_count()},{jax.device_count()},"
+                f"{gathered.ravel().tolist()}")
+    return rank
+
+
+def failing_worker():
+    raise ValueError("deliberate child failure")

@@ -102,3 +102,31 @@ class TestAudioDatasets:
             correct += int((pred == yb.numpy().ravel()).sum())
             total += len(pred)
         assert correct / total > 0.2  # chance is 0.02
+
+
+class TestWaveBackendRound5Fixes:
+    def test_unnormalized_roundtrip_preserved(self):
+        """normalize=False load -> save must round-trip, not clip to ±1
+        (review finding: int16-range floats were destroyed)."""
+        sr = 8000
+        wavef = (np.sin(np.linspace(0, 20, 2000)) * 0.5).astype(np.float32)[None]
+        with tempfile.TemporaryDirectory() as d:
+            p1, p2 = os.path.join(d, "a.wav"), os.path.join(d, "b.wav")
+            audio.save(p1, wavef, sr)
+            raw, _ = audio.load(p1, normalize=False)
+            audio.save(p2, raw, sr)
+            back, _ = audio.load(p2)
+            np.testing.assert_allclose(back.numpy(), wavef, atol=2.0 / 32768)
+
+    def test_non_pcm16_raises(self):
+        import wave as wv
+
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "8bit.wav")
+            with wv.open(p, "wb") as f:
+                f.setnchannels(1)
+                f.setsampwidth(1)  # 8-bit PCM
+                f.setframerate(8000)
+                f.writeframes(bytes(100))
+            with pytest.raises(NotImplementedError, match="8-bit"):
+                audio.load(p)
