@@ -34,7 +34,7 @@ class LlamaPipelineTrainer:
 
     def __init__(self, config: LlamaConfig, mesh, optimizer, n_micro=None,
                  zero_stage=2, compute_dtype="auto", seed=0,
-                 pp_schedule="1f1b", vpp=2):
+                 pp_schedule="1f1b", vpp=2, offload=False):
         from .. import nn
         from ..distributed.mp_layers import ColumnParallelLinear, VocabParallelEmbedding
         from ..framework import random as frandom
@@ -62,6 +62,12 @@ class LlamaPipelineTrainer:
         # reference PipelineParallelWithInterleave:807)
         self.pp_schedule = pp_schedule
         self.vpp = vpp if pp_schedule == "interleaved" else 1
+        # host-offload tier (reference GroupShardedOptimizerStage2(offload=
+        # True)): master params + Adam moments live in HOST memory, the
+        # device holds only working params and computes grads; the update
+        # runs on the CPU backend. Buys ~8 bytes/param of HBM (moments) at
+        # the cost of a grads-down + params-up host transfer per step.
+        self.offload = offload
         self.n_micro = n_micro or max(2 * self.n_stages, 2)
         assert config.num_hidden_layers % (self.n_stages * self.vpp) == 0, \
             "layers must divide evenly over pipeline stages (x vpp chunks)"
@@ -136,6 +142,17 @@ class LlamaPipelineTrainer:
             params[n] = jax.device_put(p._value, NamedSharding(self.mesh, especs[n]))
 
         self._pspecs = {**{f"blocks.{k}": v for k, v in bspecs.items()}, **especs}
+        if self.offload:
+            cpu = jax.devices("cpu")[0]
+            with jax.default_device(cpu):
+                host_state = self.optimizer.init_state_tree(
+                    {n: np.zeros(v.shape, np.float32)
+                     for n, v in params.items()})
+            self._host_opt = jax.tree_util.tree_map(np.asarray, host_state)
+            self._host_master = {n: np.asarray(jax.device_get(v), np.float32)
+                                 for n, v in params.items()}
+            self._state = (params, None)
+            return
         opt_state = self.optimizer.init_state_tree(params)
         self._ospecs = {
             n: {k: (self._pspecs[n] if np.ndim(v) else P()) for k, v in st.items()}
@@ -277,6 +294,15 @@ class LlamaPipelineTrainer:
             new_params, new_opt = opt.apply_gradients(params, grads, opt_state, lr)
             return loss, new_params, new_opt
 
+        if self.offload:
+            pshard = {n: NamedSharding(mesh, s) for n, s in self._pspecs.items()}
+
+            def grad_step(params, x, y):
+                return jax.value_and_grad(loss_fn)(params, x, y)
+
+            return jax.jit(grad_step, in_shardings=(pshard, None, None),
+                           out_shardings=(None, pshard))
+
         pshard = {n: NamedSharding(mesh, s) for n, s in self._pspecs.items()}
         oshard = {n: {k: NamedSharding(mesh, s) for k, s in st.items()}
                   for n, st in self._ospecs.items()}
@@ -310,6 +336,30 @@ class LlamaPipelineTrainer:
         x = _put(x)
         y = _put(y)
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        if self.offload:
+            loss, grads = self._step_fn(params, x, y)
+            grads_np = jax.tree_util.tree_map(np.asarray,
+                                              jax.device_get(grads))
+            del grads
+            cpu = jax.devices("cpu")[0]
+            with jax.default_device(cpu):  # update math on the CPU backend
+                new_master, new_opt = self.optimizer.apply_gradients(
+                    self._host_master, grads_np, self._host_opt,
+                    float(self.optimizer.get_lr()))
+            self._host_master = jax.tree_util.tree_map(np.asarray, new_master)
+            self._host_opt = jax.tree_util.tree_map(np.asarray, new_opt)
+            # release the old device params BEFORE uploading: double
+            # residency would cost the ~4 bytes/param the offload tier is
+            # buying back on HBM-limited configs
+            self._state = None
+            del params
+            new_params = {n: jax.device_put(
+                self._host_master[n],
+                NamedSharding(self.mesh, self._pspecs[n]))
+                for n in self._host_master}
+            self._state = (new_params, None)
+            self._step_count += 1
+            return loss
         loss, params, opt_state = self._step_fn(params, opt_state, lr, x, y)
         self._state = (params, opt_state)
         self._step_count += 1

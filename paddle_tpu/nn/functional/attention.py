@@ -44,7 +44,10 @@ def sdpa_ref(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
             logits = jnp.where(attn_mask, logits, -1e30)
         else:
             logits = logits + attn_mask
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    # promote (never downcast): f32 softmax for bf16/f16 inputs, but f64
+    # inputs keep f64 (the FD grad gate runs this op in float64)
+    acc_t = jnp.promote_types(logits.dtype, jnp.float32)
+    probs = jax.nn.softmax(logits.astype(acc_t), axis=-1).astype(q.dtype)
     if dropout_p and training:
         fixed_seed = _ignored.get("fixed_seed")
         if fixed_seed is not None:

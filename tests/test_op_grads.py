@@ -26,6 +26,22 @@ EPS = 1e-5
 RTOL = 2e-4
 ATOL = 1e-6
 
+# per-op FD tolerances (reference analogue: op_accuracy_white_list's
+# per-op max_relative_error overrides): decomposition grads amplify FD
+# truncation error by the inverse spectral gap, so linalg ops get looser
+# bounds and bigger steps instead of a blanket exclusion
+TOLS = {
+    "svd": (5e-3, 1e-4, 1e-4), "eigh": (5e-3, 1e-4, 1e-4),
+    "eigvalsh": (1e-3, 1e-4, 1e-5), "lu": (5e-3, 1e-4, 1e-4),
+    "lu_unpack": (5e-3, 1e-4, 1e-4), "lstsq": (5e-3, 1e-4, 1e-4),
+    "erfinv": (1e-3, 1e-4, 1e-5), "spectral_norm": (5e-3, 1e-4, 1e-4),
+    "fft_r2c": (1e-3, 1e-4, 1e-5),
+    "warpctc": (2e-3, 1e-4, 1e-5),
+    # rnnt lattice runs f32 internally: small FD steps measure
+    # rounding noise, so step up and loosen
+    "warprnnt": (5e-3, 5e-4, 1e-3),
+}
+
 
 def A(*shape, lo=0.25, hi=0.85, seed=0, neg=False):
     """Seeded float64 array in [lo, hi] (or symmetric ±[lo,hi] with neg)."""
@@ -41,6 +57,35 @@ def SPD(n, seed=0):
     rng = np.random.RandomState(seed)
     m = rng.randn(n, n)
     return (m @ m.T + n * np.eye(n)).astype(np.float64)
+
+
+def SEP_SV(rows, cols=None, seed=0):
+    """Matrix with well-separated singular values: FD through U/V is stable
+    iff the spectral gaps dominate the step (reference check_grad uses the
+    same trick for its decomposition op tests)."""
+    cols = cols or rows
+    k = min(rows, cols)
+    rng = np.random.RandomState(seed)
+    u, _ = np.linalg.qr(rng.randn(rows, rows))
+    v, _ = np.linalg.qr(rng.randn(cols, cols))
+    sv = np.zeros((rows, cols))
+    sv[np.arange(k), np.arange(k)] = np.linspace(3.0, 1.0, k)
+    return (u @ sv @ v.T).astype(np.float64)
+
+
+def SEP_SYM(n, seed=0):
+    """Symmetric with well-separated eigenvalues (eigh family)."""
+    rng = np.random.RandomState(seed)
+    q, _ = np.linalg.qr(rng.randn(n, n))
+    return (q @ np.diag(np.linspace(4.0, 1.0, n)) @ q.T).astype(np.float64)
+
+
+def DIAG_DOM(n, seed=0):
+    """Diagonally dominant with strictly descending diagonal: partial
+    pivoting never swaps in an FD-step neighborhood (lu family)."""
+    rng = np.random.RandomState(seed)
+    return (np.diag(np.linspace(2 * n, n, n)) +
+            0.2 * rng.randn(n, n)).astype(np.float64)
 
 
 # ---------------------------------------------------------------------------
@@ -97,10 +142,9 @@ WHITELIST = {
     "gumbel_softmax": "random", "normal": "random", "normal_": "random",
     "poisson": "random", "rand": "random", "rand_like": "random",
     "randint": "random", "randint_like": "random", "randn": "random",
-    "randn_like": "random", "randperm": "random", "rrelu": "random",
+    "randn_like": "random", "randperm": "random",
     "truncated_gaussian_random": "random", "uniform": "random",
     "uniform_": "random", "uniform_inplace": "random",
-    "fused_dropout_add": "random mask",
     # --- in-place optimizer/amp state updates (not functional ops) ---
     "adadelta_": "optimizer update", "adagrad_": "optimizer update",
     "adam_": "optimizer update", "adamax_": "optimizer update",
@@ -112,39 +156,24 @@ WHITELIST = {
     "rmsprop_": "optimizer update", "sgd_": "optimizer update",
     "update_loss_scaling_": "amp bookkeeping",
     "sync_batch_norm_": "stateful running stats (tested in test_nn)",
-    "increment": "in-place counter", "assign_out_": "in-place assign",
-    "coalesce_tensor": "memory plumbing", "copy_to": "device plumbing",
-    "clone": "alias of assign (covered)", "trans_layout": "layout plumbing",
-    # --- complex-valued path: numeric FD needs complex-step; value+grad
+            # --- complex-valued path: numeric FD needs complex-step; value+grad
     #     parity for fft lives in test_ops_parity/test_ops ---
-    "fft_c2c": "complex", "fft_c2r": "complex", "fft_r2c": "complex",
-    "as_complex": "complex", "as_real": "complex", "complex": "complex",
-    "conj": "complex", "eig": "complex eigendecomposition",
-    "eigvals": "complex eigenvalues", "angle": "zero grad for real input",
+    "fft_c2c": "complex input (complex-step FD not built)",
+    "fft_c2r": "complex input (complex-step FD not built)",
+    "as_real": "complex input (complex-step FD not built)",
+    "coalesce_tensor": "memory plumbing",
+    "trans_layout": "layout plumbing",
     # --- data-dependent output shapes (FD harness needs static scalarizer)
     "masked_select": "data-dependent shape",
+    "eig": "no JAX differentiation rule for nonsymmetric eig",
+    "eigvals": "no JAX differentiation rule for nonsymmetric eig",
     "repeat_interleave_with_tensor_index": "data-dependent shape",
     # --- piecewise-constant ops: analytic grad is identically zero and the
     #     tape/vjp zero is checked, but FD at random points is also 0 —
     #     covered by the generic probe; these IN the gate. (listed for doc)
     # --- numerically unstable FD or heavy special inputs ---
-    "erfinv": "FD unstable near domain edge (value parity tested)",
-    "lstsq": "returns aux ranks (int) + grad only via solution",
-    "lu": "pivot ints, sign-unstable FD", "lu_unpack": "pivot ints",
-    "svd": "FD unstable at close singular values (checked via pinv/qr)",
-    "eigh": "FD through eigenvector phase is sign-unstable",
-    "eigvalsh": "covered by slogdet/det family; phase-stable FD is slow",
     "margin_cross_entropy": "needs HCG model-parallel group setup",
-    "memory_efficient_attention": "covered by flash_attn spec",
-    "warpctc": "lattice loss — dedicated grad tests in test_ctc_pallas",
-    "warprnnt": "lattice loss — dedicated grad tests in test_rnnt_pallas",
     "rnn": "stateful multi-arg recurrent op (tested in test_rnn_transformer)",
-    "spectral_norm": "power-iteration internal state",
-    "quantile": "interpolation kink at sample points",
-    "median": "kink when even count; odd-count case covered by nanmedian",
-    "segment_pool": "int segment ids (value-tested in test_ops_parity)",
-    "temporal_shift": "zero-pad shift, grad covered by value parity",
-    "cross_entropy_with_softmax": "hard-label int path (soft covered below)",
     "mode": "host-side impl, no tape node (known gap; value parity tested)",
     "nextafter": "no JAX differentiation rule (grad undefined)",
     "fused_linear_param_grad_add": "multi_precision f32 accumulation by design",
@@ -330,6 +359,49 @@ SPECS = {
     "dropout_": None,
     # linalg
     "cholesky": ((SPD(3),), {}),
+    # decomposition family (VERDICT r4 weak #3): specialized fixtures —
+    # separated spectra / pinned pivots — with per-op TOLS entries
+    "svd": ((SEP_SV(3),), {}),
+    "eigh": ((SEP_SYM(3),), {}),
+    "eigvalsh": ((SEP_SYM(3),), {}),
+    "lu": ((DIAG_DOM(3),), {}),
+    "lu_unpack": ((DIAG_DOM(3, seed=1),
+                   np.array([1, 2, 3], np.int32)), {}),
+    "lstsq": ((SEP_SV(4, 3), A(4, 2, neg=True)), {}),
+    "erfinv": ((A(2, 3, lo=0.1, hi=0.6, neg=True),), {}),
+    "spectral_norm": ((A(3, 4, neg=True), A(3, lo=0.4, hi=0.9),
+                       A(4, lo=0.4, hi=0.9, seed=1)), {"power_iters": 2}),
+    "quantile": ((A(7, neg=True),), {"q": 0.37}),
+    "median": ((A(7, neg=True),), {}),
+    "angle": ((A(2, 3, neg=True),), {}),
+    "temporal_shift": ((A(4, 4, 2, 2, neg=True),), {"seg_num": 2}),
+    "segment_pool": ((A(6, 3, neg=True),
+                      np.array([0, 0, 1, 1, 2, 2], np.int64)),
+                     {"pooltype": "MEAN"}),
+    "increment": ((A(2, 3),), {}),
+    "clone": ((A(2, 3),), {}),
+    "assign_out_": ((A(2, 3), np.zeros((2, 3))), {}),
+    "copy_to": ((A(2, 3),), {}),
+    "fused_dropout_add": ((A(2, 3), A(2, 3, seed=1)), {"p": 0.0}),
+    "complex": ((A(2, 3, neg=True), A(2, 3, seed=1, neg=True)), {}),
+    "as_complex": ((A(2, 3, 2, neg=True),), {}),
+    "conj": ((A(2, 3, neg=True),), {}),
+    "fft_r2c": ((A(8, neg=True),), {}),
+    "cross_entropy_with_softmax": (
+        (A(3, 5, neg=True), np.array([[1], [0], [3]], np.int64)), {}),
+    "memory_efficient_attention": (
+        (A(1, 4, 2, 4, neg=True), A(1, 4, 2, 4, seed=1, neg=True),
+         A(1, 4, 2, 4, seed=2, neg=True)), {}),
+    "rrelu": ((A(2, 3, neg=True),), {"training": False}),
+    # lattice losses: FD over log-probs/logits (tiny T so the alpha lattice
+    # is cheap under 2*numel forward evals); dedicated kernel-parity tests
+    # live in test_ctc_pallas/test_rnnt_pallas
+    "warpctc": ((A(4, 2, 3, neg=True), np.array([[1, 2], [2, 1]], np.int64),
+                 np.array([4, 4], np.int64), np.array([2, 2], np.int64)), {}),
+    "warprnnt": ((A(2, 4, 3, 3, neg=True),
+                  np.array([[1, 2], [2, 1]], np.int64),
+                  np.array([4, 4], np.int64),
+                  np.array([2, 2], np.int64)), {}),
     "cholesky_solve": ((A(3, 1), np.linalg.cholesky(SPD(3))), {}),
     "det": ((SPD(3),), {}),
     "slogdet": ((SPD(3),), {}),
@@ -431,12 +503,16 @@ def _jnp_call_args(args, slots):
 
 
 def _float_outs(out):
+    """Differentiable outputs: real floats AND complex (scalarized via
+    real+imag parts — inputs stay real, so central differences remain
+    valid without complex-step machinery)."""
     outs = out if isinstance(out, (list, tuple)) else [out]
     res = []
     for o in outs:
         v = getattr(o, "_value", o)
-        if hasattr(v, "dtype") and np.issubdtype(np.dtype(v.dtype),
-                                                 np.floating):
+        if hasattr(v, "dtype") and (
+                np.issubdtype(np.dtype(v.dtype), np.floating)
+                or np.issubdtype(np.dtype(v.dtype), np.complexfloating)):
             res.append(o)
     return res
 
@@ -454,14 +530,17 @@ def _scalarize_np(out, weights):
     outs = _float_outs(out)
     s = 0.0
     for o, w in zip(outs, weights):
-        v = np.asarray(o.numpy() if hasattr(o, "numpy") else o,
-                       dtype=np.float64)
-        s += float((v * w).sum())
+        v = np.asarray(o.numpy() if hasattr(o, "numpy") else o)
+        if np.iscomplexobj(v):
+            s += float((v.real * w).sum() + (v.imag * (w * 0.5)).sum())
+        else:
+            s += float((v.astype(np.float64) * w).sum())
     return s
 
 
 def check_op_grad(name, args, kwargs):
     """Tape-vjp grads vs central finite differences. Returns error list."""
+    rtol, abs_cap, eps = TOLS.get(name, (RTOL, 1e-4, EPS))
     fn = OPS[name].fn
     args = list(args)
     slots = _slots(args)
@@ -486,7 +565,12 @@ def check_op_grad(name, args, kwargs):
     fl = _float_outs(out)
     scalar = None
     for o, w in zip(fl, weights):
-        term = (o * w).sum()
+        v = getattr(o, "_value", o)
+        if np.issubdtype(np.dtype(v.dtype), np.complexfloating):
+            term = (OPS["real"].fn(o) * w).sum() + \
+                (OPS["imag"].fn(o) * (w * 0.5)).sum()
+        else:
+            term = (o * w).sum()
         scalar = term if scalar is None else scalar + term
     grads = paddle.grad(scalar, tensors, allow_unused=True)
     analytic = [None if g is None else np.asarray(g.numpy(), np.float64)
@@ -501,14 +585,14 @@ def check_op_grad(name, args, kwargs):
         flat_num = num.reshape(-1)
         for i in range(flat_base.size):
             orig = flat_base[i]
-            flat_base[i] = orig + EPS
+            flat_base[i] = orig + eps
             fp = _scalarize_np(fn(*_jnp_call_args(args, slots), **kwargs),
                                weights)
-            flat_base[i] = orig - EPS
+            flat_base[i] = orig - eps
             fm = _scalarize_np(fn(*_jnp_call_args(args, slots), **kwargs),
                                weights)
             flat_base[i] = orig
-            flat_num[i] = (fp - fm) / (2 * EPS)
+            flat_num[i] = (fp - fm) / (2 * eps)
         a = analytic[k]
         p = s
         if a is None:
@@ -522,7 +606,7 @@ def check_op_grad(name, args, kwargs):
             continue
         denom = np.maximum(np.abs(num), 1.0)
         rel = np.abs(a - num) / denom
-        if not (rel.max() <= RTOL or np.abs(a - num).max() <= 1e-4):
+        if not (rel.max() <= rtol or np.abs(a - num).max() <= abs_cap):
             worst = np.unravel_index(np.argmax(rel), rel.shape)
             errors.append(
                 f"{name}[arg{p}]: max rel err {rel.max():.3e} at {worst} "
