@@ -93,6 +93,74 @@ def _transient(err_msg):
                                   "timed out", "socket"))
 
 
+def _run_rung_subprocess(rung):
+    """Execute one rung probe in a fresh process; returns its JSON result."""
+    import subprocess
+
+    cmd = [sys.executable, os.path.abspath(__file__), "--rung",
+           json.dumps(rung)]
+    try:
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=1800)
+    except subprocess.TimeoutExpired:
+        return {"status": "failed", "error": "Timeout",
+                "error_msg": "rung probe exceeded 1800s"}
+    for line in reversed(out.stdout.splitlines()):
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                pass
+    return {"status": "failed", "error": "SubprocessError",
+            "error_msg": (out.stderr.strip().splitlines() or ["no output"])[-1][:200]}
+
+
+def _rung_worker(rung):
+    """Child-process entry: probe one rung, print ONE JSON line."""
+    import jax
+
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".jax_compile_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+    from paddle_tpu import profiler as prof
+    from paddle_tpu.models import LlamaConfig, llama_tiny
+
+    platform = jax.devices()[0].platform
+    try:
+        if rung.get("smoke"):
+            cfg = llama_tiny(vocab=256, hidden=64, layers=2, heads=4,
+                             kv_heads=2, inter=128, seq=128)
+        else:
+            cfg = LlamaConfig(
+                vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+                num_hidden_layers=rung["layers"], num_attention_heads=32,
+                num_key_value_heads=32, max_position_embeddings=2048)
+        trainer, mesh = _build_trainer(cfg, rung["remat"],
+                                       offload=rung["offload"])
+        bufs = _make_bufs(mesh, cfg, rung["batch"], rung["seq"], n_bufs=2)
+        _sync_steps(trainer, bufs, 1)   # compile
+        _sync_steps(trainer, bufs, 1)   # warm
+        n = rung["probe_steps"]
+        dt, _ = _sync_steps(trainer, bufs, n)
+        tok_s = rung["batch"] * rung["seq"] * n / dt
+        f_tok = trainer.matmul_flops_per_token(rung["seq"])
+        print(json.dumps({
+            "status": "ok", "tok_per_sec": round(tok_s, 1),
+            "batch_cost": round(dt / n, 5),
+            "params": trainer.num_params(),
+            "mfu": round(prof.mfu(tok_s, f_tok, platform), 4)}))
+        return 0
+    except Exception as e:
+        msg = str(e).splitlines()[0][:200] if str(e) else type(e).__name__
+        print(json.dumps({"status": "failed", "error": type(e).__name__,
+                          "error_msg": msg}))
+        return 0
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true", help="tiny CPU-safe run")
@@ -102,7 +170,11 @@ def main():
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--seq", type=int, default=None)
     ap.add_argument("--layers", type=int, default=None)
+    ap.add_argument("--rung", type=str, default=None,
+                    help="(internal) probe one rung in this process")
     args = ap.parse_args()
+    if args.rung:
+        return _rung_worker(json.loads(args.rung))
 
     import jax
 
@@ -152,17 +224,23 @@ def main():
                   ("off", 4, 2048, headline_layers, False, "headline"),
                   ("dots", 8, 2048, headline_layers, False, "headline"),
                   ("dots", 4, 2048, headline_layers, False, "headline"),
-                  # deep rungs: full remat; 6/8-layer with host-offloaded
-                  # master+moments (device holds params+grads only)
-                  ("dots", 4, 2048, 6, True, "deep"),
-                  ("dots", 4, 2048, 8, True, "deep"),
-                  ("dots", 2, 2048, 4, False, "deep")]
+                  # deep rungs: FULL remat (block-boundary activations
+                  # only); 6/8-layer with host-offloaded master+moments
+                  # (device holds params+grads only), 3-layer fully
+                  # on-device. HBM arithmetic: params+grads 8 B/param
+                  # offloaded, 16 B/param on-device
+                  ("full", 2, 2048, 6, True, "deep"),
+                  ("full", 2, 2048, 8, True, "deep"),
+                  ("full", 2, 2048, 3, False, "deep")]
         if args.batch or args.seq:
             ladder = [(os.environ.get("PADDLE_TPU_REMAT_POLICY", "dots"),
                        args.batch or 8, args.seq or 2048, headline_layers,
                        False, "headline")]
 
-    # ---- phase 1: probe every rung (compile + 2 warmup + short window) ----
+    # ---- phase 1: probe every rung, each in an ISOLATED subprocess ----
+    # an OOMing rung must not poison later rungs (r4's window-phase crashes
+    # traced back to leftover allocations from failed deep-rung probes);
+    # the persistent compile cache keeps the per-process cost to startup
     probe_steps = 4
     ladder_report = []
     scored = []      # headline: (probe_tok_s, remat, batch, seq)
@@ -170,50 +248,36 @@ def main():
     for remat, batch, seq, layers, offload, role in ladder:
         entry = {"remat": remat, "batch": batch, "seq": seq,
                  "layers": layers, "offload": offload, "role": role}
-        rung_cfg = cfg if layers == headline_layers else mk_cfg(layers)
         for attempt in (1, 2):
-            trainer = None
-            try:
-                trainer, mesh = _build_trainer(rung_cfg, remat,
-                                               offload=offload)
-                bufs = _make_bufs(mesh, rung_cfg, batch, seq, n_bufs=2)
-                _sync_steps(trainer, bufs, 1)   # compile
-                _sync_steps(trainer, bufs, 1)   # warm
-                # offload rungs pay a host round-trip of the full parameter
-                # set per step — probe with one step, not four
-                n_probe = 1 if offload else probe_steps
-                dt, _ = _sync_steps(trainer, bufs, n_probe)
-                tok_s = batch * seq * n_probe / dt
+            res = _run_rung_subprocess(
+                dict(remat=remat, batch=batch, seq=seq, layers=layers,
+                     offload=offload, probe_steps=1 if offload else probe_steps,
+                     smoke=bool(args.smoke or not on_tpu)))
+            if res.get("status") == "ok":
                 entry.pop("error", None)       # a retried success is a
                 entry.pop("error_msg", None)   # success, not an error rung
-                entry.update(status="ok", probe_tok_per_sec=round(tok_s, 1),
-                             probe_batch_cost=round(dt / n_probe, 5))
+                entry.update(status="ok",
+                             probe_tok_per_sec=res["tok_per_sec"],
+                             probe_batch_cost=res["batch_cost"])
                 if role == "headline":
-                    scored.append((tok_s, remat, batch, seq))
+                    scored.append((res["tok_per_sec"], remat, batch, seq))
                 else:
-                    # the deep rung's own MFU, from ITS trainer's FLOPs
-                    f_tok = trainer.matmul_flops_per_token(seq)
                     deep_rungs.append({
                         "layers": layers, "remat": remat, "batch": batch,
                         "seq": seq, "offload": offload,
-                        "params": trainer.num_params(),
-                        "tok_per_sec": round(tok_s, 1),
-                        "mfu": round(prof.mfu(tok_s, f_tok, platform), 4)})
+                        "params": res.get("params"),
+                        "tok_per_sec": res["tok_per_sec"],
+                        "mfu": res.get("mfu")})
                 break
-            except Exception as e:  # OOM / compile failure — recorded
-                msg = (str(e).splitlines()[0][:200] if str(e)
-                       else type(e).__name__)
-                entry.update(status="failed", error=type(e).__name__,
-                             error_msg=msg)
-                if attempt == 1 and _transient(msg):
-                    entry["retried"] = True
-                    print(f"# retrying transient rung failure: {msg}",
-                          file=sys.stderr)
-                    continue
-                break
-            finally:
-                del trainer
-                gc.collect()
+            msg = res.get("error_msg", "")[:200]
+            entry.update(status="failed", error=res.get("error", "Unknown"),
+                         error_msg=msg)
+            if attempt == 1 and _transient(msg):
+                entry["retried"] = True
+                print(f"# retrying transient rung failure: {msg}",
+                      file=sys.stderr)
+                continue
+            break
         ladder_report.append(entry)
         print(f"# probe {entry}", file=sys.stderr)
 

@@ -256,10 +256,13 @@ class LoopControlLowering(ast.NodeTransformer):
             raise UnsupportedSyntax("for/else with break/continue")
         des = _desugar_for_range(node, f"c{self._uid()}")
         if des is None:
-            # concrete-iterable python loop: break/continue keep exact
-            # python semantics; only data-dependent conditions around them
-            # are rejected later by the main transformer
-            return node
+            # concrete-iterable python loop: the trip count is static, so
+            # break/continue under TRACED conditions lower by guarded
+            # unrolling — every iteration still runs, wrapped in
+            # `if not (brk|ret)`, and the guard ifs become lax.cond in the
+            # main transformer (reference break_continue_transformer.py:1
+            # threads the same flags through its static loop)
+            return self._lower_concrete_for(node)
         setup, loop, incr = des
         return setup + self._lower(loop, incr=incr)
 
@@ -311,6 +314,44 @@ class LoopControlLowering(ast.NodeTransformer):
                                orelse=[]))
         return pre + [node] + post
 
+    def _lower_concrete_for(self, node):
+        """Guarded unroll for a python-iterable for loop containing
+        break/continue/return: flags thread exactly as in _lower, but the
+        python for statement itself is kept (static trip count)."""
+        uid = self._uid()
+        has_brk = _contains(node.body, (ast.Break,), into_loops=False)
+        has_cont = _contains(node.body, (ast.Continue,), into_loops=False)
+        has_ret = _contains(node.body, (ast.Return,), into_loops=False)
+        flags = {
+            "brk": f"_pd_ctl_brk_{uid}" if has_brk else None,
+            "cont": f"_pd_ctl_cont_{uid}" if has_cont else None,
+            "retf": f"_pd_ctl_retf_{uid}" if has_ret else None,
+            "retv": f"_pd_ctl_retv_{uid}" if has_ret else None,
+        }
+        body = self._thread(list(node.body), flags)
+        for n in _walk_shallow(body, into_loops=False):
+            if isinstance(n, _CTRL) and not isinstance(n, ast.Return):
+                raise UnsupportedSyntax(
+                    "break/continue inside a construct the loop-control "
+                    "pass cannot thread (e.g. try/with)")
+        prologue = []
+        if has_cont:
+            prologue.append(_assign_const(flags["cont"], False))
+        exit_flags = [f for f in (flags["brk"], flags["retf"]) if f]
+        if exit_flags:
+            node.body = [ast.If(test=self._not_any(exit_flags),
+                                body=prologue + body, orelse=[])]
+        else:
+            node.body = prologue + body
+        pre = [_assign_const(f, False)
+               for f in (flags["brk"], flags["cont"], flags["retf"]) if f]
+        post = []
+        if has_ret:
+            post.append(ast.If(test=_name(flags["retf"]),
+                               body=[ast.Return(value=_name(flags["retv"]))],
+                               orelse=[]))
+        return pre + [node] + post
+
     @staticmethod
     def _not_any(flag_names):
         if len(flag_names) == 1:
@@ -322,14 +363,15 @@ class LoopControlLowering(ast.NodeTransformer):
 
     @staticmethod
     def _check_return_value(s):
-        """Only single-value returns lower cleanly inside a compiled loop:
-        the undefined-branch zero-fill needs one array leaf. Reject tuple
-        literals and bare ``return`` up front with a clear diagnostic."""
-        if s.value is None or isinstance(s.value, (ast.Tuple, ast.List)):
+        """Tuple/single-value returns both lower (the _pd_ctl_retv carry
+        holds a pytree; convert_ifelse zero-fills undefined branches per
+        VARIABLE over all leaves). Only a bare ``return`` is rejected —
+        it would make the function's value None on one path and the carry
+        can't represent that."""
+        if s.value is None:
             raise UnsupportedSyntax(
-                "bare `return` / `return <tuple>` inside a compiled loop; "
-                "return a single tensor, or restructure with a flag "
-                "variable set in the loop")
+                "bare `return` inside a compiled loop; return a value "
+                "(or restructure with a flag variable set in the loop)")
 
     def _thread(self, stmts, flags):
         """Rewrite one statement list: control transfers become flag sets;

@@ -98,6 +98,19 @@ def _fill_undefined_vars(t_out, f_out, names):
         return t_out, f_out
     t_vars, f_vars = list(t_out), list(f_out)
     for k, n in enumerate(names):
+        # probe mode ONLY: a WHOLE-variable placeholder (loop var first
+        # assigned inside the loop) vs a structured value resolves to the
+        # value at variable granularity — leaf-positional resolution can't
+        # line a single placeholder leaf up against a tuple's several
+        # leaves. Outside the probe, one-sided _Undefined stays an error.
+        ph_t = _PROBE and _is_placeholder(t_vars[k])
+        ph_f = _PROBE and _is_placeholder(f_vars[k])
+        if ph_t != ph_f:
+            if ph_t:
+                t_vars[k] = f_vars[k]
+            else:
+                f_vars[k] = t_vars[k]
+            continue
         und_t = isinstance(t_vars[k], _Undefined)
         und_f = isinstance(f_vars[k], _Undefined)
         if not (und_t or und_f) or (und_t and und_f):
@@ -236,13 +249,17 @@ def _probe_undefined(cond_fn, body_fn, vars_in, names):
         probe_vars[i] = _ProbeValue()
     resolved: dict[int, tuple] = {}
 
+    treedefs: dict[int, object] = {}
+
     def _body_specs():
         out = []
-        for v in body_fn(*probe_vars):
-            leaves = _unwrap_leaves(_flatten(v)[0])
+        for idx, v in enumerate(body_fn(*probe_vars)):
+            leaves, tdef = _flatten(v)
+            leaves = _unwrap_leaves(leaves)
             if any(_is_placeholder(x) for x in leaves):
                 out.append(None)  # still unassigned this round
             else:
+                treedefs[idx] = tdef  # static structure captured per round
                 out.append(tuple(jnp.asarray(x) for x in leaves))
         return tuple(out)
 
@@ -258,16 +275,14 @@ def _probe_undefined(cond_fn, body_fn, vars_in, names):
             var_spec = out_spec[i]
             if var_spec is None:
                 continue
-            if len(var_spec) != 1:
-                raise TypeError(
-                    f"dy2static: loop variable "
-                    f"'{names[i] if i < len(names) else i}' is first "
-                    "assigned a nested structure inside a compiled while; "
-                    "initialize it before the loop")
-            spec = var_spec[0]
-            key = (tuple(spec.shape), spec.dtype)
+            # nested structures (e.g. a tuple return threaded through the
+            # _pd_ctl_retv carry) zero-init per leaf, rebuilt to the probed
+            # treedef
+            key = tuple((tuple(sp.shape), sp.dtype) for sp in var_spec)
             if resolved.get(i) != key:
-                probe_vars[i] = Tensor._wrap(jnp.zeros(spec.shape, spec.dtype))
+                zeros = [Tensor._wrap(jnp.zeros(sp.shape, sp.dtype))
+                         for sp in var_spec]
+                probe_vars[i] = tree_util.tree_unflatten(treedefs[i], zeros)
                 resolved[i] = key
                 progress = True
         if len(resolved) == len(undef) and not progress:
