@@ -93,6 +93,16 @@ def _transient(err_msg):
                                   "timed out", "socket"))
 
 
+def _write_partial(ladder_report, deep_rungs):
+    """Incremental side artifact: if the driver's window expires mid-bench,
+    the probes measured so far are still attributable."""
+    try:
+        with open("BENCH_PARTIAL.json", "w") as f:
+            json.dump({"ladder": ladder_report, "deep_rungs": deep_rungs}, f)
+    except OSError:
+        pass
+
+
 def _run_rung_subprocess(rung):
     """Execute one rung probe in a fresh process; returns its JSON result."""
     import subprocess
@@ -101,10 +111,12 @@ def _run_rung_subprocess(rung):
            json.dumps(rung)]
     try:
         out = subprocess.run(cmd, capture_output=True, text=True,
-                             timeout=1800)
+                             timeout=600)
     except subprocess.TimeoutExpired:
         return {"status": "failed", "error": "Timeout",
-                "error_msg": "rung probe exceeded 1800s"}
+                "error_msg": "rung probe exceeded 600s (offload rungs: the "
+                             "tunnel's host<->device bandwidth bounds the "
+                             "per-step param round-trip)"}
     for line in reversed(out.stdout.splitlines()):
         if line.startswith("{"):
             try:
@@ -245,7 +257,10 @@ def main():
     ladder_report = []
     scored = []      # headline: (probe_tok_s, remat, batch, seq)
     deep_rungs = []  # measured real-depth datapoints
-    for remat, batch, seq, layers, offload, role in ladder:
+    deep_ladder = [r for r in ladder if r[5] == "deep"]
+    ladder = [r for r in ladder if r[5] == "headline"]
+
+    def _probe_rung(remat, batch, seq, layers, offload, role):
         entry = {"remat": remat, "batch": batch, "seq": seq,
                  "layers": layers, "offload": offload, "role": role}
         for attempt in (1, 2):
@@ -280,6 +295,10 @@ def main():
             break
         ladder_report.append(entry)
         print(f"# probe {entry}", file=sys.stderr)
+        _write_partial(ladder_report, deep_rungs)
+
+    for rung in ladder:
+        _probe_rung(*rung)
 
     if not scored:
         print(json.dumps({"metric": "llama_train_tokens_per_sec_per_chip",
@@ -342,6 +361,11 @@ def main():
         tok_s, remat, batch, seq = scored[0]
         best_overall = (tok_s, batch * seq / tok_s, remat, batch, seq,
                         [batch * seq / tok_s], None)
+
+    # ---- phase 3: deep rungs (real-depth MFU datapoints) — LAST, so an
+    # overrun can never cost the headline measurement ----
+    for rung in deep_ladder:
+        _probe_rung(*rung)
 
     tok_per_sec, best_cost, remat, batch, seq, window_costs, loss = best_overall
     med_cost = statistics.median(window_costs)

@@ -339,8 +339,15 @@ class LoopControlLowering(ast.NodeTransformer):
             prologue.append(_assign_const(flags["cont"], False))
         exit_flags = [f for f in (flags["brk"], flags["retf"]) if f]
         if exit_flags:
+            # python freezes the loop variable at the break point, but the
+            # kept-for statement reassigns it every iteration — so iterate a
+            # hidden temp and only bind the real target inside the guard
+            it_tmp = f"_pd_ctl_it_{uid}"
+            bind = ast.Assign(targets=[node.target],
+                              value=_name(it_tmp))
+            node.target = _name(it_tmp, ast.Store())
             node.body = [ast.If(test=self._not_any(exit_flags),
-                                body=prologue + body, orelse=[])]
+                                body=[bind] + prologue + body, orelse=[])]
         else:
             node.body = prologue + body
         pre = [_assign_const(f, False)

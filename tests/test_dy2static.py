@@ -545,3 +545,17 @@ class TestBreakContinueReturn:
 
         with pytest.raises(UnsupportedSyntax, match="reserved"):
             transform_function(f)
+
+
+def test_concrete_for_break_freezes_loop_variable():
+    """python semantics: the loop variable keeps its break-point value."""
+    @paddle.jit.to_static
+    def f(x):
+        v = 0.0
+        for v in [1.0, 2.0, 3.0]:
+            if x.sum() > 0:
+                break
+        return x + v
+
+    np.testing.assert_allclose(f(_t([5.0])).numpy(), [6.0])   # broke at v=1
+    np.testing.assert_allclose(f(_t([-5.0])).numpy(), [-2.0])  # ran out, v=3
