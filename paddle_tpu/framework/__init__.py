@@ -1,2 +1,4 @@
 from . import random  # noqa: F401
 from .random import seed  # noqa: F401
+
+from . import op_version  # noqa: F401,E402

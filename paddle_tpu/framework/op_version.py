@@ -1,0 +1,107 @@
+"""Op-version / program-compat registry (reference
+/root/reference/paddle/fluid/framework/op_version_registry.h — the
+mechanism that lets a serialized program declare which revision of each
+op's semantics it was built against, so artifact evolution is defined
+rather than accidental).
+
+TPU-native shape: exported archives (jit.save / save_inference_model /
+onnx.export) embed a ``.pdversion`` JSON sidecar with the framework
+version, the serialization IR, and the op-version table snapshot; loaders
+call :func:`check_compat` which (a) accepts artifacts whose op versions
+are <= the live registry's (older semantics are upgradable), and
+(b) rejects artifacts carrying NEWER op versions with an actionable error
+(the reference's IsProgramVersionSupported role,
+paddle/fluid/framework/program_utils.cc).
+"""
+from __future__ import annotations
+
+import json
+import os
+
+__all__ = [
+    "FRAMEWORK_VERSION", "register_op_version", "op_version",
+    "version_snapshot", "write_version_file", "read_version_file",
+    "check_compat",
+]
+
+FRAMEWORK_VERSION = "0.5.0"  # round-5 build
+_IR = "stablehlo+jax.export"
+
+# op -> (version, changelog). Seeded with the ops whose semantics have
+# already evolved ACROSS ROUNDS of this framework — the registry exists so
+# the next change is recorded here, not silently.
+_REGISTRY: dict[str, tuple[int, str]] = {}
+
+
+def register_op_version(op: str, version: int, note: str):
+    cur = _REGISTRY.get(op, (0, ""))[0]
+    if version <= cur:
+        raise ValueError(
+            f"op_version({op!r}): new version {version} must exceed {cur}")
+    _REGISTRY[op] = (version, note)
+
+
+def op_version(op: str) -> int:
+    return _REGISTRY.get(op, (0, ""))[0]
+
+
+# --- seeded history (semantics changes shipped in earlier rounds) ---------
+register_op_version(
+    "flash_attn_unpadded", 2,
+    "r5: real cu_seqlens varlen kernel; r4 and earlier aliased the padded "
+    "path (artifacts saved before r5 never contained true varlen graphs)")
+register_op_version(
+    "max_pool2d_with_index", 2,
+    "r5: returns real argmax indices into the flattened input plane; "
+    "earlier rounds returned the pooled values only")
+register_op_version(
+    "reduce", 2,
+    "r5: rank-asymmetric dst semantics (non-dst ranks keep their input); "
+    "earlier rounds broadcast the reduction to every rank")
+register_op_version(
+    "dropout", 2,
+    "r4: eval-mode downscale_in_infer honored; r3 ignored mode")
+
+
+def version_snapshot() -> dict:
+    return {
+        "framework_version": FRAMEWORK_VERSION,
+        "ir": _IR,
+        "op_versions": {k: v for k, (v, _) in _REGISTRY.items()},
+    }
+
+
+def write_version_file(path_prefix: str):
+    """Sidecar next to the artifact: <prefix>.pdversion."""
+    with open(path_prefix + ".pdversion", "w") as f:
+        json.dump(version_snapshot(), f, indent=1)
+
+
+def read_version_file(path_prefix: str) -> dict | None:
+    p = path_prefix + ".pdversion"
+    if not os.path.exists(p):
+        return None
+    with open(p) as f:
+        return json.load(f)
+
+
+def check_compat(meta: dict | None, origin: str = "artifact"):
+    """Raise if the artifact claims NEWER op semantics than this build
+    provides; tolerate absent metadata (pre-r5 artifacts) and older
+    versions (this build can execute their graphs)."""
+    if meta is None:
+        return  # pre-versioning artifact: jax.export's own IR versioning
+        # still guards deserialization
+    if meta.get("ir") not in (None, _IR):
+        raise RuntimeError(
+            f"{origin}: serialized with IR {meta.get('ir')!r}; this build "
+            f"loads {_IR!r}")
+    newer = {
+        op: v for op, v in (meta.get("op_versions") or {}).items()
+        if v > op_version(op)
+    }
+    if newer:
+        raise RuntimeError(
+            f"{origin}: built against newer op semantics than this "
+            f"framework provides: { {k: f'artifact v{v} > runtime v{op_version(k)}' for k, v in newer.items()} }. "
+            "Upgrade paddle_tpu or re-export the model.")

@@ -22,10 +22,88 @@ def memory_efficient_attention(query, key, value, attn_bias=None, p=0.0,
         training=training)
 
 
-def fused_multi_head_attention(x, qkv_weight=None, out_weight=None, **kwargs):
-    raise NotImplementedError(
-        "use incubate.nn.FusedMultiHeadAttention (layer form); the raw-weight "
-        "functional form is CUDA-kernel-specific plumbing")
+def fused_multi_head_attention(
+        x, qkv_weight, linear_weight, pre_layer_norm=False,
+        pre_ln_scale=None, pre_ln_bias=None, ln_scale=None, ln_bias=None,
+        pre_ln_epsilon=1e-5, qkv_bias=None, linear_bias=None, cache_kv=None,
+        attn_mask=None, dropout_rate=0.5, attn_dropout_rate=0.5,
+        ln_epsilon=1e-5, training=True, mode="upscale_in_train", ring_id=-1,
+        add_residual=True, num_heads=-1, transpose_qkv_wb=False, name=None):
+    """Raw-weight fused self-attention (reference
+    /root/reference/python/paddle/incubate/nn/functional/fused_transformer.py:465
+    — the reference hand-writes this fusion in CUDA; here it is ONE traced
+    body XLA fuses, with the Pallas flash kernel carrying the attention).
+    qkv_weight: [3, num_heads, head_dim, embed] (or [embed, 3*embed] with
+    transpose_qkv_wb=True and num_heads set)."""
+    import jax.numpy as jnp
+
+    from ..core.dispatch import apply
+
+    def body(xv, qkv_w, lin_w, *rest):
+        names = [n for n, v in optional if v is not None]
+        extras = dict(zip(names, rest))
+        residual = xv
+        h = xv
+        if pre_layer_norm:
+            mu = jnp.mean(h, -1, keepdims=True)
+            var = jnp.var(h, -1, keepdims=True)
+            h = (h - mu) / jnp.sqrt(var + pre_ln_epsilon)
+            if "pre_ln_scale" in extras:
+                h = h * extras["pre_ln_scale"]
+            if "pre_ln_bias" in extras:
+                h = h + extras["pre_ln_bias"]
+        B, S, E = h.shape
+        if transpose_qkv_wb:
+            nh = int(num_heads)
+            qkv = h @ qkv_w  # [B,S,3E]
+            if "qkv_bias" in extras:
+                qkv = qkv + extras["qkv_bias"]
+            qkv = qkv.reshape(B, S, 3, nh, E // nh)
+        else:
+            nh = qkv_w.shape[1]
+            hd = qkv_w.shape[2]
+            qkv = jnp.einsum("bse,knde->bskn d".replace(" ", ""), h,
+                             qkv_w)  # [B,S,3,nh,hd]
+            if "qkv_bias" in extras:
+                qkv = qkv + extras["qkv_bias"].reshape(3, nh, hd)
+        q, k, v = (qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2])  # [B,S,nh,hd]
+        from ..kernels import attention_impl
+
+        out = attention_impl()(
+            q, k, v, attn_mask=attn_mask,
+            dropout_p=attn_dropout_rate if training else 0.0,
+            is_causal=False, training=training)
+        out = out.reshape(B, S, E)
+        out = out @ (lin_w if lin_w.ndim == 2
+                     else lin_w.reshape(E, E))
+        if "linear_bias" in extras:
+            out = out + extras["linear_bias"]
+        if dropout_rate and training:
+            import jax
+
+            from ..framework.random import next_key
+
+            keep = jax.random.bernoulli(next_key(), 1.0 - dropout_rate,
+                                        out.shape)
+            out = out * keep.astype(out.dtype) / (1.0 - dropout_rate)
+        if add_residual:
+            out = residual + out
+        if not pre_layer_norm:
+            mu = jnp.mean(out, -1, keepdims=True)
+            var = jnp.var(out, -1, keepdims=True)
+            out = (out - mu) / jnp.sqrt(var + ln_epsilon)
+            if "ln_scale" in extras:
+                out = out * extras["ln_scale"]
+            if "ln_bias" in extras:
+                out = out + extras["ln_bias"]
+        return out
+
+    optional = [("pre_ln_scale", pre_ln_scale), ("pre_ln_bias", pre_ln_bias),
+                ("ln_scale", ln_scale), ("ln_bias", ln_bias),
+                ("qkv_bias", qkv_bias), ("linear_bias", linear_bias)]
+    extra_args = [v for _, v in optional if v is not None]
+    return apply(body, x, qkv_weight, linear_weight, *extra_args,
+                 op_name="fused_multi_head_attention")
 
 
 def fused_feedforward(x, w1, b1, w2, b2, activation="relu"):

@@ -313,6 +313,11 @@ def save(layer, path, input_spec=None, **configs):
             },
             f,
         )
+    # program-compat metadata (reference op_version_registry.h role):
+    # records which op-semantics revision this artifact was built against
+    from ..framework.op_version import write_version_file
+
+    write_version_file(path)
 
 
 class TranslatedLayer(Layer):
@@ -356,6 +361,9 @@ def load(path, layer_cls=None, params_file=None, **configs):
         return layer
     from jax import export as jexport
 
+    from ..framework.op_version import check_compat, read_version_file
+
+    check_compat(read_version_file(path), origin=path)
     with open(path + ".pdmodel", "rb") as f:
         exported = jexport.deserialize(f.read())
     return TranslatedLayer(blob["params"], blob["buffers"], exported,
