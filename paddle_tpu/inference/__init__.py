@@ -72,31 +72,55 @@ class Config:
 
 
 class _IOHandle:
-    """Zero-copy-style tensor handle (reference ZeroCopyTensor)."""
+    """Zero-copy-style tensor handle (reference ZeroCopyTensor,
+    paddle_infer_tensor_utils): ``copy_from_cpu`` stages host data;
+    ``share_external_data`` ADOPTS an existing device array without a host
+    bounce (the zero-copy discipline — outputs are likewise held as device
+    buffers until ``copy_to_cpu`` forces the transfer)."""
 
     def __init__(self):
-        self._value = None
+        self._value = None     # np.ndarray (host) or jax.Array (device)
+        self._on_device = False
 
     def copy_from_cpu(self, array):
         self._value = np.asarray(array)
+        self._on_device = False
+
+    def share_external_data(self, array):
+        """Adopt a device-resident array zero-copy (reference
+        ShareExternalData)."""
+        if isinstance(array, jax.Array):
+            self._value = array
+            self._on_device = True
+        else:
+            self.copy_from_cpu(array)
 
     def reshape(self, shape):
         if self._value is not None:
             self._value = self._value.reshape(shape)
 
     def copy_to_cpu(self):
-        return np.asarray(self._value)
+        return np.asarray(jax.device_get(self._value)
+                          if self._on_device else self._value)
 
     def shape(self):
         return None if self._value is None else list(self._value.shape)
 
 
 class Predictor:
-    def __init__(self, config: Config):
+    """reference AnalysisPredictor: handle workflow + clone() sharing the
+    loaded program/weights (each clone gets independent IO handles, so
+    clones serve concurrent requests — the multi-predictor serving pattern
+    of analysis_predictor.h::Clone; the underlying XLA executable is
+    thread-compatible and shared, not copied)."""
+
+    def __init__(self, config: Config, _shared_layer=None):
         from ..jit import load as jit_load
 
         self._config = config
-        self._layer = jit_load(config._prefix, params_file=config.params_file())
+        self._layer = (_shared_layer if _shared_layer is not None
+                       else jit_load(config._prefix,
+                                     params_file=config.params_file()))
         n_in = len(self._layer.in_shapes or [])
         self._inputs = {f"input_{i}": _IOHandle() for i in range(max(n_in, 1))}
         self._outputs = {}
@@ -126,22 +150,26 @@ class Predictor:
             if missing:
                 raise ValueError(
                     f"input handle(s) not filled before run(): {missing}")
-            arrays = [h._value for h in self._inputs.values()]
+            arrays = [h._value for h in self._inputs.values()]  # device
+            # arrays adopted via share_external_data pass through untouched
         else:
             # arity unknown (older save blob): pass whatever was filled
             arrays = [h._value for h in self._inputs.values() if h._value is not None]
         if self._device is not None:
-            arrays = [jax.device_put(a, self._device) for a in arrays]
+            arrays = [a if isinstance(a, jax.Array)
+                      and a.devices() == {self._device}
+                      else jax.device_put(a, self._device) for a in arrays]
         out = self._layer(*arrays)
         outs = out if isinstance(out, (list, tuple)) else [out]
-        outs = [np.asarray(o._value if hasattr(o, "_value") else o) for o in outs]
+        raw = [o._value if hasattr(o, "_value") else o for o in outs]
         self._outputs = {}
-        for i, o in enumerate(outs):
+        for i, o in enumerate(raw):
             h = _IOHandle()
-            h.copy_from_cpu(o)
+            # zero-copy: outputs stay device-resident until copy_to_cpu
+            h.share_external_data(o)
             self._outputs[f"output_{i}"] = h
         if inputs is not None:
-            return outs
+            return [np.asarray(jax.device_get(o)) for o in raw]
         return None
 
     def get_output_names(self):
@@ -149,6 +177,11 @@ class Predictor:
 
     def get_output_handle(self, name):
         return self._outputs[name]
+
+    def clone(self):
+        """Share the loaded program + weights; fresh IO handles (reference
+        AnalysisPredictor::Clone — the serving fan-out entry)."""
+        return Predictor(self._config, _shared_layer=self._layer)
 
 
 def create_predictor(config: Config) -> Predictor:

@@ -143,3 +143,92 @@ class TestOpVersionRegistry:
 
         with pytest.raises(ValueError, match="must exceed"):
             ov.register_op_version("dropout", 1, "regression")
+
+
+class TestPredictorDepth:
+    """VERDICT r4 missing #7: clone/multi-predictor, zero-copy handles,
+    quantized-artifact execution (reference analysis_predictor.h)."""
+
+    def _save(self, tmp_path, net, name="m"):
+        from paddle_tpu import jit
+
+        path = str(tmp_path / name)
+        jit.save(net, path, input_spec=[([2, 4], "float32")])
+        return path
+
+    def test_clone_shares_program_and_serves_independently(self, tmp_path):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        from paddle_tpu import inference
+
+        paddle.seed(0)
+        net = nn.Linear(4, 3)
+        path = self._save(tmp_path, net)
+        cfg = inference.Config(path + ".pdmodel", path + ".pdiparams")
+        p1 = inference.create_predictor(cfg)
+        p2 = p1.clone()
+        assert p2._layer is p1._layer  # program + weights shared, not reloaded
+        x1 = np.random.RandomState(0).rand(2, 4).astype(np.float32)
+        x2 = np.random.RandomState(1).rand(2, 4).astype(np.float32)
+        p1.get_input_handle("input_0").copy_from_cpu(x1)
+        p2.get_input_handle("input_0").copy_from_cpu(x2)
+        p1.run()
+        p2.run()
+        o1 = p1.get_output_handle("output_0").copy_to_cpu()
+        o2 = p2.get_output_handle("output_0").copy_to_cpu()
+        # independent handles: each predictor served its own request
+        ref1 = net(paddle.to_tensor(x1)).numpy()
+        ref2 = net(paddle.to_tensor(x2)).numpy()
+        np.testing.assert_allclose(o1, ref1, atol=1e-5, rtol=1e-5)
+        np.testing.assert_allclose(o2, ref2, atol=1e-5, rtol=1e-5)
+
+    def test_zero_copy_device_residency(self, tmp_path):
+        import jax
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        from paddle_tpu import inference
+
+        paddle.seed(1)
+        net = nn.Linear(4, 2)
+        path = self._save(tmp_path, net)
+        cfg = inference.Config(path + ".pdmodel", path + ".pdiparams")
+        p = inference.create_predictor(cfg)
+        dev_in = jax.device_put(np.ones((2, 4), np.float32))
+        h = p.get_input_handle("input_0")
+        h.share_external_data(dev_in)
+        assert h._value is dev_in  # adopted, no host bounce
+        p.run()
+        out_h = p.get_output_handle("output_0")
+        assert isinstance(out_h._value, jax.Array)  # device-resident
+        host = out_h.copy_to_cpu()  # transfer happens HERE
+        assert isinstance(host, np.ndarray) and host.shape == (2, 2)
+
+    def test_quantized_artifact_runs(self, tmp_path):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        from paddle_tpu import inference
+        from paddle_tpu.quantization import AbsmaxObserver, PTQ, QuantConfig
+
+        paddle.seed(2)
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        cfg_q = QuantConfig(activation=AbsmaxObserver(),
+                            weight=AbsmaxObserver())
+        ptq = PTQ(cfg_q)
+        observed = ptq.quantize(net, inplace=True)
+        for _ in range(4):  # calibration passes
+            observed(paddle.to_tensor(
+                np.random.RandomState(3).rand(2, 4).astype(np.float32)))
+        converted = ptq.convert(observed, inplace=True)
+        path = self._save(tmp_path, converted, "q")
+        cfg = inference.Config(path + ".pdmodel", path + ".pdiparams")
+        p = inference.create_predictor(cfg)
+        x = np.random.RandomState(4).rand(2, 4).astype(np.float32)
+        outs = p.run([x])
+        ref = converted(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(outs[0], ref, atol=1e-5, rtol=1e-5)
