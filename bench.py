@@ -188,6 +188,32 @@ def main():
     if args.rung:
         return _rung_worker(json.loads(args.rung))
 
+    # tunnel-health guard: when the axon terminal is down, backend
+    # registration BLOCKS jax import indefinitely — probe in a bounded
+    # subprocess so a dead tunnel yields an attributable artifact instead
+    # of a hang (r5: the tunnel died mid-round after offload-rung compiles)
+    import subprocess
+
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=300)
+        alive = probe.returncode == 0 and probe.stdout.strip()
+        err = (probe.stderr.strip().splitlines()[-1][:200]
+               if probe.stderr.strip() else "")
+    except subprocess.TimeoutExpired:
+        alive = False
+        err = "backend init did not return within 300s (blocked tunnel)"
+    if not alive:
+        print(json.dumps({
+            "metric": "llama_train_tokens_per_sec_per_chip", "value": 0,
+            "unit": "tokens/s/chip", "vs_baseline": 0.0,
+            "extra": {"error": "accelerator backend unavailable "
+                               "(tunnel down?); no measurement possible",
+                      "probe_stderr": err}}))
+        return 1
+
     import jax
 
     # persistent compile cache: the driver's end-of-round run reuses the
