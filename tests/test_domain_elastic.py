@@ -190,6 +190,28 @@ class TestOnnxSurface:
         assert len(graph[5]) >= 10                    # weight initializers
         assert len(graph[11]) == 1 and len(graph[12]) == 1
 
+    @pytest.mark.slow
+    def test_native_onnx_emission_resnet18(self, tmp_path):
+        """ResNet-class coverage: residual adds, eval-BN decomposition
+        (Sub/Div/Sqrt/Mul), strided convs, global avg pool as
+        ReduceSum/Div, Gemm-free MatMul head."""
+        import numpy as np
+
+        from paddle_tpu.vision.models import resnet18
+
+        paddle.seed(0)
+        net = resnet18(num_classes=10)
+        p = str(tmp_path / "r18.onnx")
+        paddle.onnx.export(net, p,
+                           input_spec=[np.zeros((1, 3, 64, 64), np.float32)])
+        graph = _read_proto(_read_proto(open(p, "rb").read())[7][0])
+        from collections import Counter
+
+        ops = Counter(_read_proto(n)[4][0].decode() for n in graph[1])
+        assert ops["Conv"] == 20 and ops["MatMul"] == 1
+        assert ops["MaxPool"] == 1 and ops["Max"] == 17  # relu-as-Max
+        assert len(graph[5]) > 50  # weights + BN stats inline
+
     def test_unsupported_primitive_raises_with_cause(self, tmp_path):
         import numpy as np
 
