@@ -128,13 +128,16 @@ def ulysses_attention(q, k, v, mesh=None, axis="sep", causal=True, scale=None,
                       attn_fn=None):
     """Ulysses SP: all-to-all scatter heads / gather sequence, run full-seq
     attention per head group, then reverse. Requires H % sep == 0."""
-    from ..nn.functional.attention import sdpa_ref
+    from ..kernels import attention_impl
 
     if mesh is None:
         from .mesh import current_mesh
 
         mesh = current_mesh()
-    attn = attn_fn or (lambda a, b, c: sdpa_ref(a, b, c, is_causal=causal, scale=scale))
+    # default = the platform attention policy: the Pallas flash kernel on
+    # chip, einsum composition on CPU meshes
+    attn = attn_fn or (lambda a, b, c: attention_impl()(
+        a, b, c, is_causal=causal, scale=scale))
     if mesh is None or dict(zip(mesh.axis_names, mesh.devices.shape)).get(axis, 1) == 1:
         return attn(q, k, v)
 

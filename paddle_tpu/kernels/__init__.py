@@ -21,6 +21,7 @@ import jax
 __all__ = [
     "use_pallas", "use_pallas_explicit", "set_use_pallas", "attention_impl",
     "set_platform", "active_platform", "layer_norm_impl",
+    "rmsnorm_impl", "softmax_ce_impl",
 ]
 
 _FORCE = os.environ.get("PADDLE_TPU_USE_PALLAS")  # "1" | "0" | None
@@ -99,6 +100,34 @@ def attention_impl():
         except Exception:
             return sdpa_ref
     return sdpa_ref
+
+
+def rmsnorm_impl():
+    """Fused RMSNorm(+residual) kernel — OPT-IN (use_pallas_explicit): the
+    r5 on-chip measurement protocol (tools/op_bench_r5.py ->
+    OPBENCH_r05.json) decides the default; until a recorded win, the XLA
+    composition stays default (same honesty policy as the RNNT lattice)."""
+    if use_pallas_explicit():
+        try:
+            from .rmsnorm import rmsnorm_residual_pallas
+
+            return rmsnorm_residual_pallas
+        except Exception:
+            return None
+    return None
+
+
+def softmax_ce_impl():
+    """Streaming softmax-CE kernel — OPT-IN, same measured-default policy
+    as rmsnorm_impl."""
+    if use_pallas_explicit():
+        try:
+            from .softmax_ce import softmax_ce_pallas
+
+            return softmax_ce_pallas
+        except Exception:
+            return None
+    return None
 
 
 def layer_norm_impl():
