@@ -28,6 +28,25 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
                   soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0, name=None):
     def body(logits, lbl, w=None):
         ax = int(axis) % logits.ndim
+        from ...kernels import softmax_ce_impl
+
+        kern = softmax_ce_impl()
+        if (kern is not None and not soft_label and use_softmax
+                and not label_smoothing and w is None
+                and ax == logits.ndim - 1
+                and lbl.ndim in (logits.ndim - 1, logits.ndim)):
+            lbl_i = lbl.astype(jnp.int32)
+            if lbl_i.ndim == logits.ndim:
+                lbl_i = jnp.squeeze(lbl_i, axis=ax)
+            valid = lbl_i != ignore_index
+            # streaming kernel: ignored rows pick no logit (iota never
+            # matches a negative id) -> finite lse; mask after
+            loss = jnp.where(valid, kern(logits, jnp.where(valid, lbl_i, 0)),
+                             0.0)
+            if reduction == "mean":
+                denom = jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+                return jnp.sum(loss) / denom
+            return _reduce(loss, reduction)
         logp = jax.nn.log_softmax(logits, axis=ax) if use_softmax else jnp.log(
             jnp.maximum(logits, 1e-30)
         )

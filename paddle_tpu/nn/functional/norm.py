@@ -92,6 +92,14 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=N
 
 def rms_norm(x, weight=None, epsilon=1e-6, axis=-1, name=None):
     """RMSNorm (beyond-reference; the Llama-family norm)."""
+    from ...kernels import rmsnorm_impl
+
+    kern = rmsnorm_impl() if (weight is not None and axis in (-1,)) else None
+    if kern is not None:
+        from ...kernels.rmsnorm import rmsnorm_pallas
+
+        return apply(lambda v, w: rmsnorm_pallas(v, w, epsilon), x, weight,
+                     op_name="rms_norm")
 
     def body(v, w=None):
         ms = jnp.mean(jnp.square(v.astype(jnp.float32)), axis=axis, keepdims=True)

@@ -95,3 +95,31 @@ class TestStreamingSoftmaxCE:
                                    lab[..., None], -1)[..., 0]
         np.testing.assert_allclose(np.asarray(loss), np.asarray(ref),
                                    atol=2e-5, rtol=2e-5)
+
+
+class TestPolicyWiring:
+    """The opt-in actually reaches the kernels (review finding: selectors
+    with zero call sites would make FLAGS_use_pallas a no-op)."""
+
+    def test_rms_norm_and_cross_entropy_optin_parity(self):
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(8, 256).astype(np.float32)
+        w = rng.randn(256).astype(np.float32)
+        lab = rng.randint(0, 256, (8,)).astype(np.int64)
+        lab[::3] = -100  # ignore_index rows
+        base_n = F.rms_norm(paddle.to_tensor(x), paddle.to_tensor(w)).numpy()
+        base_ce = F.cross_entropy(paddle.to_tensor(x),
+                                  paddle.to_tensor(lab)).numpy()
+        kernels.set_use_pallas(True)
+        try:
+            opt_n = F.rms_norm(paddle.to_tensor(x),
+                               paddle.to_tensor(w)).numpy()
+            opt_ce = F.cross_entropy(paddle.to_tensor(x),
+                                     paddle.to_tensor(lab)).numpy()
+        finally:
+            kernels.set_use_pallas(None)
+        np.testing.assert_allclose(opt_n, base_n, atol=2e-5, rtol=2e-5)
+        np.testing.assert_allclose(opt_ce, base_ce, atol=2e-5, rtol=2e-5)
