@@ -7,9 +7,11 @@ a second implementation.
 """
 from . import asp  # noqa: F401
 from . import nn  # noqa: F401
-from .optimizer import LookAhead, ModelAverage  # noqa: F401
+from .optimizer import (  # noqa: F401
+    DistributedFusedLamb, LookAhead, ModelAverage)
 
-__all__ = ["nn", "asp", "LookAhead", "ModelAverage", "autotune"]
+__all__ = ["nn", "asp", "LookAhead", "ModelAverage",
+           "DistributedFusedLamb", "autotune"]
 
 
 def autotune(config=None):
