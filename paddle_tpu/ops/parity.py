@@ -883,6 +883,79 @@ def _roi_bilinear(feat, ys, xs):
             + at(y1, x1) * (wy * wx)[None])
 
 
+@defop("deform_conv2d")
+def _deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                   dilation=1, deformable_groups=1, groups=1, mask=None):
+    """Deformable convolution v1/v2 (reference
+    /root/reference/python/paddle/vision/ops.py:742, phi deformable_conv
+    kernels). TPU-native: the sampled im2col is built with ONE vectorized
+    bilinear gather over [N, dg, K, Ho, Wo] grids (no per-position loops),
+    then contracted with the weights on the MXU — offsets channel layout
+    [N, 2*dg*kh*kw, Ho, Wo] with (k, {dy,dx}) interleave, mask (v2)
+    [N, dg*kh*kw, Ho, Wo]."""
+    def _pair(v):
+        return (int(v), int(v)) if isinstance(v, int) else tuple(
+            int(a) for a in v)
+
+    sh, sw = _pair(stride)
+    ph, pw = _pair(padding)
+    dh, dw = _pair(dilation)
+    N, Cin, H, W = x.shape
+    Cout, Cin_g, kh, kw = weight.shape
+    K = kh * kw
+    dg = int(deformable_groups)
+    Ho = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    Wo = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+
+    off = offset.reshape(N, dg, K, 2, Ho, Wo)
+    # base sampling grid [K, Ho, Wo]
+    ky = jnp.repeat(jnp.arange(kh) * dh, kw)
+    kx = jnp.tile(jnp.arange(kw) * dw, kh)
+    oy = jnp.arange(Ho) * sh - ph
+    ox = jnp.arange(Wo) * sw - pw
+    ys = (ky[:, None, None] + oy[None, :, None]
+          + jnp.zeros((1, 1, Wo))).astype(jnp.float32)
+    xs = (kx[:, None, None] + ox[None, None, :]
+          + jnp.zeros((1, Ho, 1))).astype(jnp.float32)
+    ys = ys[None, None] + off[:, :, :, 0]   # [N, dg, K, Ho, Wo]
+    xs = xs[None, None] + off[:, :, :, 1]
+
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    wy = ys - y0
+    wx = xs - x0
+
+    def gather(yi, xi):
+        inb = (yi >= 0) & (yi <= H - 1) & (xi >= 0) & (xi <= W - 1)
+        yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+        flat = (yc * W + xc).reshape(N, dg, 1, K * Ho * Wo)
+        xg = x.reshape(N, dg, Cin // dg, H * W)
+        vals = jnp.take_along_axis(
+            xg, jnp.broadcast_to(flat, (N, dg, Cin // dg, K * Ho * Wo)),
+            axis=3).reshape(N, dg, Cin // dg, K, Ho, Wo)
+        return vals * inb[:, :, None].astype(x.dtype)
+
+    v00 = gather(y0, x0)
+    v01 = gather(y0, x0 + 1)
+    v10 = gather(y0 + 1, x0)
+    v11 = gather(y0 + 1, x0 + 1)
+    wy_ = wy[:, :, None]
+    wx_ = wx[:, :, None]
+    cols = (v00 * (1 - wy_) * (1 - wx_) + v01 * (1 - wy_) * wx_
+            + v10 * wy_ * (1 - wx_) + v11 * wy_ * wx_)
+    if mask is not None:
+        cols = cols * mask.reshape(N, dg, 1, K, Ho, Wo)
+    cols = cols.reshape(N, Cin, K, Ho, Wo)
+    # grouped contraction on the MXU: w [G, Cout/G, Cin/G, K]
+    wq = weight.reshape(groups, Cout // groups, Cin_g, K)
+    cg = cols.reshape(N, groups, Cin // groups, K, Ho, Wo)
+    out = jnp.einsum("ngckhw,gdck->ngdhw", cg, wq).reshape(N, Cout, Ho, Wo)
+    if bias is not None:
+        out = out + bias.reshape(1, Cout, 1, 1)
+    return out
+
+
 @defop("roi_align")
 def _roi_align(x, boxes, boxes_num, pooled_height=1, pooled_width=1,
                spatial_scale=1.0, sampling_ratio=-1, aligned=True):

@@ -8,9 +8,10 @@ from .. import nn
 from ..ops.registry import OPS
 
 __all__ = [
-    "ConvNormActivation", "nms", "roi_align", "roi_pool", "yolo_box",
-    "yolo_loss", "prior_box", "box_coder", "matrix_nms",
-    "distribute_fpn_proposals", "generate_proposals",
+    "ConvNormActivation", "DeformConv2D", "deform_conv2d", "nms",
+    "roi_align", "roi_pool", "yolo_box", "yolo_loss", "prior_box",
+    "box_coder", "matrix_nms", "distribute_fpn_proposals",
+    "generate_proposals",
 ]
 
 
@@ -48,6 +49,7 @@ def _export(name):
     return wrapper
 
 
+deform_conv2d = _export("deform_conv2d")
 nms = _export("nms")
 roi_align = _export("roi_align")
 roi_pool = _export("roi_pool")
@@ -58,3 +60,31 @@ box_coder = _export("box_coder")
 matrix_nms = _export("matrix_nms")
 distribute_fpn_proposals = _export("distribute_fpn_proposals")
 generate_proposals = _export("generate_proposals")
+
+
+class DeformConv2D(nn.Layer):
+    """Deformable conv layer (reference paddle.vision.ops.DeformConv2D):
+    forward takes (x, offset, mask=None); weight/bias are parameters."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        from ..nn import initializer as I
+
+        k = ((kernel_size, kernel_size) if isinstance(kernel_size, int)
+             else tuple(kernel_size))
+        self._cfg = dict(stride=stride, padding=padding, dilation=dilation,
+                         deformable_groups=deformable_groups, groups=groups)
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, k[0], k[1]],
+            default_initializer=I.XavierUniform())
+        self.bias = (None if bias_attr is False
+                     else self.create_parameter([out_channels], is_bias=True))
+
+    def forward(self, x, offset, mask=None):
+        args = [x, offset, self.weight]
+        kwargs = dict(self._cfg, mask=mask)
+        if self.bias is not None:
+            kwargs["bias"] = self.bias
+        return OPS["deform_conv2d"].fn(*args, **kwargs)

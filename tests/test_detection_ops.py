@@ -167,3 +167,61 @@ class TestFinalCoverage:
         print(f"\nfinal coverage: {cov['covered']}/{cov['total']}"
               f" = {cov['pct']:.1%}; missing: {cov['missing']}")
         assert cov["pct"] >= 0.99, cov["missing"]
+
+
+class TestDeformConv2D:
+    """round 5: deformable conv v1/v2 (reference vision/ops.py:742) —
+    verified by identity: zero offsets == regular conv, integer dy shift
+    == conv over the shifted image, v2 mask scales contributions."""
+
+    def test_zero_offset_equals_conv(self):
+        import numpy as np
+
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.vision.ops import deform_conv2d
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 4, 8, 8).astype(np.float32)
+        w = rng.randn(6, 2, 3, 3).astype(np.float32)
+        off = np.zeros((2, 18, 6, 6), np.float32)
+        out = deform_conv2d(x, off, w, groups=2)
+        ref = F.conv2d(x, w, groups=2)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-4)
+
+    def test_integer_shift_and_mask(self):
+        import numpy as np
+
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.vision.ops import deform_conv2d
+
+        rng = np.random.RandomState(1)
+        x = rng.randn(1, 3, 8, 8).astype(np.float32)
+        w = rng.randn(5, 3, 3, 3).astype(np.float32)
+        off = np.zeros((1, 1, 9, 2, 6, 6), np.float32)
+        off[:, :, :, 0] = 1.0  # dy=+1 for every kernel tap
+        out = deform_conv2d(x, off.reshape(1, 18, 6, 6), w)
+        xs = np.zeros_like(x)
+        xs[:, :, :-1] = x[:, :, 1:]
+        np.testing.assert_allclose(out.numpy(), F.conv2d(xs, w).numpy(),
+                                   atol=1e-4)
+        m = np.full((1, 9, 6, 6), 0.25, np.float32)
+        out_m = deform_conv2d(x, np.zeros((1, 18, 6, 6), np.float32), w,
+                              mask=m)
+        np.testing.assert_allclose(out_m.numpy(),
+                                   0.25 * F.conv2d(x, w).numpy(), atol=1e-4)
+
+    def test_layer_form_trains(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu.vision.ops import DeformConv2D
+
+        paddle.seed(0)
+        layer = DeformConv2D(3, 4, 3, padding=1)
+        x = paddle.to_tensor(
+            np.random.RandomState(2).randn(1, 3, 6, 6).astype(np.float32))
+        off = paddle.to_tensor(np.zeros((1, 18, 6, 6), np.float32))
+        out = layer(x, off)
+        assert out.shape == [1, 4, 6, 6]
+        out.sum().backward()
+        assert layer.weight.grad is not None

@@ -392,6 +392,9 @@ SPECS = {
     "memory_efficient_attention": (
         (A(1, 4, 2, 4, neg=True), A(1, 4, 2, 4, seed=1, neg=True),
          A(1, 4, 2, 4, seed=2, neg=True)), {}),
+    "deform_conv2d": ((A(1, 2, 5, 5, neg=True),
+                       A(1, 8, 4, 4, lo=0.05, hi=0.3, neg=True),
+                       A(3, 2, 2, 2, neg=True)), {}),
     "rrelu": ((A(2, 3, neg=True),), {"training": False}),
     # lattice losses: FD over log-probs/logits (tiny T so the alpha lattice
     # is cheap under 2*numel forward evals); dedicated kernel-parity tests
