@@ -31,6 +31,13 @@ def _interpret_mode() -> bool:
     return active_platform() not in ("tpu",)
 
 
+def _vma(*xs):
+    out = frozenset()
+    for x in xs:
+        out |= getattr(jax.typeof(x), "vma", frozenset())
+    return out
+
+
 def _fwd_kernel(*refs, eps, has_resid):
     if has_resid:
         x_ref, r_ref, w_ref, o_ref, rms_ref = refs
@@ -88,10 +95,24 @@ def _rmsnorm_core(x, resid, w, eps, has_resid):
     return out
 
 
+def _mirror(x, resid, w, eps, has_resid):
+    """jnp transcription for interpret-under-shard_map (check_vma): the
+    Pallas HLO interpreter cannot trace there, same policy as
+    flash_attention's mirrors."""
+    v = x.astype(jnp.float32)
+    if has_resid:
+        v = v + resid.astype(jnp.float32)
+    rstd = jax.lax.rsqrt(jnp.mean(v * v, -1, keepdims=True) + eps)
+    return (v * rstd * w.astype(jnp.float32)).astype(x.dtype), rstd
+
+
 def _fwd(x, resid, w, eps, has_resid):
     R, F = x.shape
     br = _rows_block(R)
     interp = _interpret_mode()
+    vma = _vma(x, resid, w)
+    if interp and vma:
+        return _mirror(x, resid, w, eps, has_resid)
     args = (x, resid, w.reshape(1, F)) if has_resid else (x, w.reshape(1, F))
     in_specs = ([_row_spec(br, F)] * (2 if has_resid else 1)) + [_w_spec(F)]
     # x64 weak-type promotion inside kernels trips Mosaic (mixed i32/i64
@@ -104,8 +125,8 @@ def _fwd(x, resid, w, eps, has_resid):
             out_specs=[_row_spec(br, F),
                        pl.BlockSpec((br, 1), lambda i: (i, 0),
                                     memory_space=pltpu.VMEM)],
-            out_shape=[jax.ShapeDtypeStruct((R, F), x.dtype),
-                       jax.ShapeDtypeStruct((R, 1), jnp.float32)],
+            out_shape=[jax.ShapeDtypeStruct((R, F), x.dtype, vma=vma),
+                       jax.ShapeDtypeStruct((R, 1), jnp.float32, vma=vma)],
             interpret=interp,
         )(*args)
     return out, rstd
@@ -116,11 +137,28 @@ def _core_fwd(x, resid, w, eps, has_resid):
     return out, (x, resid, w, rstd)
 
 
+def _mirror_bwd(x, resid, w, rstd, g, has_resid):
+    v = x.astype(jnp.float32)
+    if has_resid:
+        v = v + resid.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    gw = gf * w.astype(jnp.float32)
+    dot = jnp.mean(v * gw, axis=1, keepdims=True)
+    dx = rstd * gw - v * (rstd ** 3) * dot
+    dw = jnp.sum((v * rstd) * gf, axis=0)
+    return dx.astype(x.dtype), dw.astype(w.dtype)
+
+
 def _core_bwd(eps, has_resid, res, g):
     x, resid, w, rstd = res
     R, F = x.shape
     br = _rows_block(R)
     interp = _interpret_mode()
+    vma = _vma(x, resid, w, g)
+    if interp and vma:
+        dx, dw = _mirror_bwd(x, resid, w, rstd, g, has_resid)
+        return dx, (dx.astype(resid.dtype) if has_resid
+                    else jnp.zeros_like(resid)), dw
     args = ((x, resid, w.reshape(1, F), rstd, g) if has_resid
             else (x, w.reshape(1, F), rstd, g))
     in_specs = ([_row_spec(br, F)] * (2 if has_resid else 1)
@@ -136,9 +174,9 @@ def _core_bwd(eps, has_resid, res, g):
             out_specs=[_row_spec(br, F),
                        pl.BlockSpec((8, F), lambda i: (i, 0),
                                     memory_space=pltpu.VMEM)],
-            out_shape=[jax.ShapeDtypeStruct((R, F), x.dtype),
+            out_shape=[jax.ShapeDtypeStruct((R, F), x.dtype, vma=vma),
                        jax.ShapeDtypeStruct((8 * (R // br), F),
-                                            jnp.float32)],
+                                            jnp.float32, vma=vma)],
             interpret=interp,
         )(*args)
     dw = jnp.sum(dw_part, axis=0).astype(w.dtype)

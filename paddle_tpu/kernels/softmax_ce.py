@@ -28,6 +28,13 @@ def _interpret_mode() -> bool:
     return active_platform() not in ("tpu",)
 
 
+def _vma(*xs):
+    out = frozenset()
+    for x in xs:
+        out |= getattr(jax.typeof(x), "vma", frozenset())
+    return out
+
+
 def _fwd_kernel(x_ref, lab_ref, loss_ref, lse_ref):
     x = x_ref[...].astype(jnp.float32)        # [br, V]
     lab = lab_ref[...]                        # [br, 1] int32
@@ -63,10 +70,22 @@ def _ce_core(x, labels):
     return loss
 
 
+def _mirror_fwd(x, labels):
+    xf = x.astype(jnp.float32)
+    m = jnp.max(xf, axis=1, keepdims=True)
+    lse = m + jnp.log(jnp.sum(jnp.exp(xf - m), axis=1, keepdims=True))
+    picked = jnp.take_along_axis(
+        xf, labels.reshape(-1, 1).astype(jnp.int32), axis=1)
+    return (lse - picked)[:, 0], lse
+
+
 def _fwd(x, labels):
     N, V = x.shape
     br = _rows_block(N)
     interp = _interpret_mode()
+    vma = _vma(x, labels)
+    if interp and vma:
+        return _mirror_fwd(x, labels)
     with jax.enable_x64(False):
             loss, lse = pl.pallas_call(
             _fwd_kernel,
@@ -79,8 +98,8 @@ def _fwd(x, labels):
                 pl.BlockSpec((br, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
                 pl.BlockSpec((br, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
             ],
-            out_shape=[jax.ShapeDtypeStruct((N, 1), jnp.float32),
-                       jax.ShapeDtypeStruct((N, 1), jnp.float32)],
+            out_shape=[jax.ShapeDtypeStruct((N, 1), jnp.float32, vma=vma),
+                       jax.ShapeDtypeStruct((N, 1), jnp.float32, vma=vma)],
             interpret=interp,
         )(x, labels.reshape(N, 1).astype(jnp.int32))
     return loss[:, 0], lse
@@ -96,6 +115,13 @@ def _core_bwd(res, g):
     N, V = x.shape
     br = _rows_block(N)
     interp = _interpret_mode()
+    vma = _vma(x, labels, g)
+    if interp and vma:
+        p = jnp.exp(x.astype(jnp.float32) - lse)
+        onehot = jax.nn.one_hot(labels.reshape(-1), V, dtype=jnp.float32)
+        dx = (g.reshape(-1, 1).astype(jnp.float32) * (p - onehot)).astype(
+            x.dtype)
+        return dx, np.zeros(labels.shape, jax.dtypes.float0)
     with jax.enable_x64(False):
             dx = pl.pallas_call(
             _bwd_kernel,
@@ -108,7 +134,7 @@ def _core_bwd(res, g):
             ],
             out_specs=pl.BlockSpec((br, V), lambda i: (i, 0),
                                    memory_space=pltpu.VMEM),
-            out_shape=jax.ShapeDtypeStruct((N, V), x.dtype),
+            out_shape=jax.ShapeDtypeStruct((N, V), x.dtype, vma=vma),
             interpret=interp,
         )(x, labels.reshape(N, 1).astype(jnp.int32), lse,
           g.reshape(N, 1).astype(jnp.float32))
