@@ -142,11 +142,14 @@ def _enrich_error(e, op_name, leaves):
         if isinstance(l, Tensor):
             v = l._value
             sigs.append(f"Tensor{tuple(v.shape)}:{v.dtype}")
+    note = (f"[paddle_tpu] in op '{op_name}' "
+            f"(tensor inputs: {', '.join(sigs) or 'none'})")
     try:
-        e.add_note(f"[paddle_tpu] in op '{op_name}' "
-                   f"(tensor inputs: {', '.join(sigs) or 'none'})")
+        e.add_note(note)
     except AttributeError:
-        pass  # pre-3.11 python: original exception unchanged
+        # pre-3.11 python has no add_note, but __notes__ is just an
+        # attribute convention (PEP 678) that tracebacks/pytest honor
+        e.__notes__ = getattr(e, "__notes__", []) + [note]
 
 
 def _debug_hooks(op_name, result):

@@ -1,0 +1,61 @@
+"""Version shims for jax APIs this codebase targets.
+
+The code is written against the current jax surface (``jax.shard_map`` with
+``check_vma``/``axis_names``, ``jax.typeof``, ``ShapeDtypeStruct(vma=...)``,
+``jax.enable_x64``); the pinned jaxlib in some environments (0.4.x) predates
+those spellings. Everything funnels through here so call sites stay written
+in the modern API:
+
+- :func:`shard_map` — maps ``check_vma`` -> ``check_rep`` and
+  ``axis_names`` -> the complementary ``auto`` set on old jax.
+- :func:`typeof` — ``jax.typeof`` or the aval via ``jax.core.get_aval``
+  (whose aval has no ``vma`` attribute, so vma unions read as empty — the
+  old check_rep machinery tracks replication itself).
+- :func:`shape_dtype_struct` — drops the ``vma=`` kwarg when unsupported.
+"""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["shard_map", "typeof", "shape_dtype_struct"]
+
+_HAS_NATIVE_SHARD_MAP = hasattr(jax, "shard_map")
+_HAS_TYPEOF = hasattr(jax, "typeof")
+try:
+    jax.ShapeDtypeStruct((1,), "float32", vma=frozenset())
+    _SDS_HAS_VMA = True
+except TypeError:
+    _SDS_HAS_VMA = False
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
+              check_vma=True):
+    """``jax.shard_map`` facade over both keyword surfaces."""
+    if _HAS_NATIVE_SHARD_MAP:
+        kw = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_vma)
+        if axis_names is not None:
+            kw["axis_names"] = set(axis_names)
+        return jax.shard_map(f, **kw)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    auto = frozenset()
+    if axis_names is not None:
+        auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+    # check_rep=False always: old jax's replication checker has no rule for
+    # sharding_constraint (its own error message recommends disabling it),
+    # and the callers' vma annotations (_pvary) are no-ops here anyway
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False, auto=auto)
+
+
+def typeof(x):
+    if _HAS_TYPEOF:
+        return jax.typeof(x)
+    return jax.core.get_aval(x)
+
+
+def shape_dtype_struct(shape, dtype, vma=frozenset()):
+    if _SDS_HAS_VMA:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)

@@ -23,6 +23,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
+from ..core.jaxcompat import shard_map as _shard_map
 
 from ..core.tensor import Tensor
 from .mesh import HybridCommunicateGroup, get_hybrid_communicate_group
@@ -128,7 +129,7 @@ def _shard_mapped(g: Group, fn, *arrays, in_specs=None, out_specs=None):
     in_specs = in_specs if in_specs is not None else tuple(
         _axis_spec(a.ndim, g.axis) for a in arrays)
     out_specs = out_specs if out_specs is not None else in_specs[0]
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
         check_vma=False,
     )

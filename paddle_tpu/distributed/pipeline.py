@@ -25,6 +25,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
+from ..core.jaxcompat import shard_map as _shard_map
 
 from .. import nn
 
@@ -36,6 +37,10 @@ __all__ = [
 
 
 def _pvary(x, axes=("pp",)):
+    if not hasattr(jax.lax, "pcast"):
+        # old jax: no vma system — replication is check_rep's business and
+        # the compat shard_map shim already degrades check_vma accordingly
+        return x
     return jax.lax.pcast(x, axes, to="varying")
 
 
@@ -213,7 +218,7 @@ def spmd_pipeline_interleaved(stage_fn, stage_params, x_micro, mesh, n_stages,
         return jax.lax.psum(outputs, "pp")
 
     pp_specs = jax.tree_util.tree_map(lambda _: P("pp"), stage_params)
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         per_stage,
         mesh=mesh,
         in_specs=(pp_specs, P()) + tuple(P() for _ in extra_args),
@@ -368,7 +373,7 @@ def spmd_pipeline_1f1b(stage_fn, loss_fn, stage_params, edge_params, x_micro,
 
     pp_specs = jax.tree_util.tree_map(lambda _: P("pp"), stage_params)
     e_specs = jax.tree_util.tree_map(lambda _: P(), edge_params)
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         per_stage,
         mesh=mesh,
         in_specs=(pp_specs, e_specs, P(), P()),

@@ -20,6 +20,7 @@ import math
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
+from ..core.jaxcompat import shard_map as _shard_map
 
 __all__ = ["ring_attention", "ulysses_attention"]
 
@@ -118,7 +119,7 @@ def ring_attention(q, k, v, mesh=None, axis="sep", causal=True, scale=None):
         return out.astype(q.dtype)
 
     spec = P(None, axis, None, None)
-    return jax.shard_map(
+    return _shard_map(
         local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         axis_names={axis}, check_vma=True,
     )(q, k, v)
@@ -168,7 +169,7 @@ def ulysses_attention(q, k, v, mesh=None, axis="sep", causal=True, scale=None,
         return head_to_seq(out, H)
 
     spec = P(None, axis, None, None)
-    return jax.shard_map(
+    return _shard_map(
         local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         axis_names={axis}, check_vma=True,
     )(q, k, v)

@@ -118,6 +118,26 @@ class Predictor:
         from ..jit import load as jit_load
 
         self._config = config
+        if _shared_layer is None:
+            # fail here with the actual paths, not deep inside jit.load
+            # with an opaque open() error
+            if config._prefix is None:
+                raise ValueError(
+                    "inference.Config has no model to load: neither "
+                    "prog_file nor params_file is set, so there is no "
+                    "'<prefix>.pdmodel' / '<prefix>.pdiparams' pair to "
+                    "read. Pass them to Config(prog_file, params_file) or "
+                    "call set_prog_file() / set_params_file() first.")
+            import os
+
+            missing = [p for p in (config.prog_file(), config.params_file())
+                       if not os.path.exists(p)]
+            if missing:
+                raise FileNotFoundError(
+                    "inference model file(s) not found: "
+                    + ", ".join(missing)
+                    + " (expected the jit.save pair <prefix>.pdmodel / "
+                      "<prefix>.pdiparams)")
         self._layer = (_shared_layer if _shared_layer is not None
                        else jit_load(config._prefix,
                                      params_file=config.params_file()))

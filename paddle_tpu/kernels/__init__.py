@@ -21,7 +21,7 @@ import jax
 __all__ = [
     "use_pallas", "use_pallas_explicit", "set_use_pallas", "attention_impl",
     "set_platform", "active_platform", "layer_norm_impl",
-    "rmsnorm_impl", "softmax_ce_impl",
+    "rmsnorm_impl", "softmax_ce_impl", "paged_attention_impl",
 ]
 
 _FORCE = os.environ.get("PADDLE_TPU_USE_PALLAS")  # "1" | "0" | None
@@ -128,6 +128,28 @@ def softmax_ce_impl():
         except Exception:
             return None
     return None
+
+
+def x64_off():
+    """Context manager disabling x64 weak-type promotion while tracing a
+    Pallas kernel (x64 python-literal promotion trips Mosaic's index
+    lowering). ``jax.enable_x64`` left the top-level jax namespace; the
+    supported spelling is ``jax.experimental.enable_x64(False)``."""
+    from jax.experimental import enable_x64
+
+    return enable_x64(False)
+
+
+def paged_attention_impl():
+    """Selector for the serving engine's ragged paged-attention decode op
+    (mirrors attention_impl): the Pallas block-gather kernel when the policy
+    picks Pallas, else the jnp gather mirror — the mirror is also the path
+    taken on CPU test runs, where it is authoritative for semantics."""
+    from .paged_attention import paged_attention_pallas, paged_attention_ref
+
+    if use_pallas():
+        return paged_attention_pallas
+    return paged_attention_ref
 
 
 def layer_norm_impl():

@@ -43,6 +43,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+from ..core.jaxcompat import shape_dtype_struct as _sds, typeof as _typeof
+from . import x64_off
 
 __all__ = ["flash_attention_pallas", "flash_attn_varlen_pallas"]
 
@@ -314,7 +316,7 @@ def _out_vma(*examples):
     for e in examples:
         if e is None:
             continue
-        vma |= getattr(jax.typeof(e), "vma", frozenset())
+        vma |= getattr(_typeof(e), "vma", frozenset())
     return vma
 
 
@@ -565,7 +567,7 @@ def _core_fwd(q, k, v, qseg, kseg, mask, seed, causal, sm_scale,
         dropout_p=dropout_p)
     # x64 weak-type promotion inside kernels trips a Mosaic lowering
     # recursion; kernels are pure f32/bf16 so trace them with x64 off
-    with jax.enable_x64(False):
+    with x64_off():
         out, lse = pl.pallas_call(
             kern,
             grid=grid,
@@ -579,8 +581,8 @@ def _core_fwd(q, k, v, qseg, kseg, mask, seed, causal, sm_scale,
                 pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
             ],
             out_shape=[
-                jax.ShapeDtypeStruct((BH, Sq, D), q.dtype, vma=vma),
-                jax.ShapeDtypeStruct((BH, Sq, 1), jnp.float32, vma=vma),
+                _sds((BH, Sq, D), q.dtype, vma=vma),
+                _sds((BH, Sq, 1), jnp.float32, vma=vma),
             ],
             interpret=interpret,
         )(q, k, v, *extra_args)
@@ -664,7 +666,7 @@ def _flash_core_bwd(causal, sm_scale, dropout_p, heads, mask_mode, res, cot):
     if has_seg:
         dkv_args = dkv_args[:2] + [lob_k, hib_k] + dkv_args[2:]
 
-    with jax.enable_x64(False):
+    with x64_off():
         dq = pl.pallas_call(
             functools.partial(_bwd_dq_kernel, block_k=bk, sm_scale=sm_scale,
                               causal=causal, seq_k=Sk, heads=heads,
@@ -682,7 +684,7 @@ def _flash_core_bwd(causal, sm_scale, dropout_p, heads, mask_mode, res, cot):
             ] + dq_specs,
             out_specs=pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0),
                                    memory_space=pltpu.VMEM),
-            out_shape=jax.ShapeDtypeStruct((BH, Sq, D), q.dtype, vma=vma),
+            out_shape=_sds((BH, Sq, D), q.dtype, vma=vma),
             interpret=interpret,
         )(q, k, v, g, lse, delta, glse, *dq_args)
 
@@ -706,8 +708,8 @@ def _flash_core_bwd(causal, sm_scale, dropout_p, heads, mask_mode, res, cot):
                 pl.BlockSpec((1, bk, D), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
             ],
             out_shape=[
-                jax.ShapeDtypeStruct((BH, Sk, D), k.dtype, vma=vma),
-                jax.ShapeDtypeStruct((BH, Sk, D), v.dtype, vma=vma),
+                _sds((BH, Sk, D), k.dtype, vma=vma),
+                _sds((BH, Sk, D), v.dtype, vma=vma),
             ],
             interpret=interpret,
         )(q, k, v, g, lse, delta, glse, *dkv_args)

@@ -19,8 +19,9 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+from ..core.jaxcompat import shape_dtype_struct as _sds, typeof as _typeof
 
-from . import active_platform
+from . import active_platform, x64_off
 
 __all__ = ["rmsnorm_residual_pallas", "rmsnorm_pallas"]
 
@@ -34,7 +35,7 @@ def _interpret_mode() -> bool:
 def _vma(*xs):
     out = frozenset()
     for x in xs:
-        out |= getattr(jax.typeof(x), "vma", frozenset())
+        out |= getattr(_typeof(x), "vma", frozenset())
     return out
 
 
@@ -117,7 +118,7 @@ def _fwd(x, resid, w, eps, has_resid):
     in_specs = ([_row_spec(br, F)] * (2 if has_resid else 1)) + [_w_spec(F)]
     # x64 weak-type promotion inside kernels trips Mosaic (mixed i32/i64
     # index tuples); kernels are pure f32/bf16 so trace with x64 off
-    with jax.enable_x64(False):
+    with x64_off():
             out, rstd = pl.pallas_call(
             functools.partial(_fwd_kernel, eps=eps, has_resid=has_resid),
             grid=(R // br,),
@@ -125,8 +126,8 @@ def _fwd(x, resid, w, eps, has_resid):
             out_specs=[_row_spec(br, F),
                        pl.BlockSpec((br, 1), lambda i: (i, 0),
                                     memory_space=pltpu.VMEM)],
-            out_shape=[jax.ShapeDtypeStruct((R, F), x.dtype, vma=vma),
-                       jax.ShapeDtypeStruct((R, 1), jnp.float32, vma=vma)],
+            out_shape=[_sds((R, F), x.dtype, vma=vma),
+                       _sds((R, 1), jnp.float32, vma=vma)],
             interpret=interp,
         )(*args)
     return out, rstd
@@ -166,7 +167,7 @@ def _core_bwd(eps, has_resid, res, g):
                    pl.BlockSpec((br, 1), lambda i: (i, 0),
                                 memory_space=pltpu.VMEM),
                    _row_spec(br, F)])
-    with jax.enable_x64(False):
+    with x64_off():
             dx, dw_part = pl.pallas_call(
             functools.partial(_bwd_kernel, eps=eps, has_resid=has_resid),
             grid=(R // br,),
@@ -174,8 +175,8 @@ def _core_bwd(eps, has_resid, res, g):
             out_specs=[_row_spec(br, F),
                        pl.BlockSpec((8, F), lambda i: (i, 0),
                                     memory_space=pltpu.VMEM)],
-            out_shape=[jax.ShapeDtypeStruct((R, F), x.dtype, vma=vma),
-                       jax.ShapeDtypeStruct((8 * (R // br), F),
+            out_shape=[_sds((R, F), x.dtype, vma=vma),
+                       _sds((8 * (R // br), F),
                                             jnp.float32, vma=vma)],
             interpret=interp,
         )(*args)

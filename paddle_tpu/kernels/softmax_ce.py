@@ -16,8 +16,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+from ..core.jaxcompat import shape_dtype_struct as _sds, typeof as _typeof
 
-from . import active_platform
+from . import active_platform, x64_off
 
 __all__ = ["softmax_ce_pallas"]
 
@@ -31,7 +32,7 @@ def _interpret_mode() -> bool:
 def _vma(*xs):
     out = frozenset()
     for x in xs:
-        out |= getattr(jax.typeof(x), "vma", frozenset())
+        out |= getattr(_typeof(x), "vma", frozenset())
     return out
 
 
@@ -86,7 +87,7 @@ def _fwd(x, labels):
     vma = _vma(x, labels)
     if interp and vma:
         return _mirror_fwd(x, labels)
-    with jax.enable_x64(False):
+    with x64_off():
             loss, lse = pl.pallas_call(
             _fwd_kernel,
             grid=(N // br,),
@@ -98,8 +99,8 @@ def _fwd(x, labels):
                 pl.BlockSpec((br, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
                 pl.BlockSpec((br, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
             ],
-            out_shape=[jax.ShapeDtypeStruct((N, 1), jnp.float32, vma=vma),
-                       jax.ShapeDtypeStruct((N, 1), jnp.float32, vma=vma)],
+            out_shape=[_sds((N, 1), jnp.float32, vma=vma),
+                       _sds((N, 1), jnp.float32, vma=vma)],
             interpret=interp,
         )(x, labels.reshape(N, 1).astype(jnp.int32))
     return loss[:, 0], lse
@@ -122,7 +123,7 @@ def _core_bwd(res, g):
         dx = (g.reshape(-1, 1).astype(jnp.float32) * (p - onehot)).astype(
             x.dtype)
         return dx, np.zeros(labels.shape, jax.dtypes.float0)
-    with jax.enable_x64(False):
+    with x64_off():
             dx = pl.pallas_call(
             _bwd_kernel,
             grid=(N // br,),
@@ -134,7 +135,7 @@ def _core_bwd(res, g):
             ],
             out_specs=pl.BlockSpec((br, V), lambda i: (i, 0),
                                    memory_space=pltpu.VMEM),
-            out_shape=jax.ShapeDtypeStruct((N, V), x.dtype, vma=vma),
+            out_shape=_sds((N, V), x.dtype, vma=vma),
             interpret=interp,
         )(x, labels.reshape(N, 1).astype(jnp.int32), lse,
           g.reshape(N, 1).astype(jnp.float32))

@@ -31,7 +31,8 @@ from ..distributed.mp_layers import (
 from ..nn import functional as F
 from ..ops import manipulation as M
 
-__all__ = ["LlamaConfig", "LlamaForCausalLM", "LlamaDecoderLayer", "llama_tiny", "llama_7b"]
+__all__ = ["LlamaConfig", "LlamaForCausalLM", "LlamaDecoderLayer",
+           "llama_tiny", "llama_7b", "apply_rope", "apply_rope_at"]
 
 
 @dataclass
@@ -81,6 +82,20 @@ def apply_rope(x, cos, sin):
     ).astype(x.dtype)
 
 
+def apply_rope_at(x, cos, sin, positions):
+    """RoPE at explicit token positions (cached decode: the new token sits
+    mid-sequence, not at index 0). positions: int [B, S] or [S]."""
+    d2 = x.shape[-1] // 2
+    if positions.ndim == 1:
+        positions = positions[None]
+    c = cos[positions][:, :, None, :]   # [B, S, 1, D/2]
+    s = sin[positions][:, :, None, :]
+    x1, x2 = x[..., :d2], x[..., d2:]
+    return jnp.concatenate(
+        [x1 * c - x2 * s, x2 * c + x1 * s], axis=-1
+    ).astype(x.dtype)
+
+
 class LlamaAttention(nn.Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()
@@ -96,8 +111,9 @@ class LlamaAttention(nn.Layer):
                                         c.hidden_size, has_bias=False,
                                         input_is_parallel=True)
         self.config = c
+        self.layer_idx = 0  # set by LlamaForCausalLM for KV-cache routing
 
-    def forward(self, x, rope_cos, rope_sin):
+    def forward(self, x, rope_cos, rope_sin, cache=None, positions=None):
         B, S = x.shape[0], x.shape[1]
         qkv = self.qkv_proj(x)
         q_sz = self.num_heads * self.head_dim
@@ -112,9 +128,24 @@ class LlamaAttention(nn.Layer):
         v = mark_sharding(v, None, None, "mp", None)
         from ..core.dispatch import apply as _apply
 
-        q = _apply(apply_rope, q, rope_cos, rope_sin, op_name="rope")
-        k = _apply(apply_rope, k, rope_cos, rope_sin, op_name="rope")
-        out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        if positions is None:
+            q = _apply(apply_rope, q, rope_cos, rope_sin, op_name="rope")
+            k = _apply(apply_rope, k, rope_cos, rope_sin, op_name="rope")
+        else:
+            q = _apply(apply_rope_at, q, rope_cos, rope_sin, positions,
+                       op_name="rope")
+            k = _apply(apply_rope_at, k, rope_cos, rope_sin, positions,
+                       op_name="rope")
+        if cache is None:
+            out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        else:
+            # duck-typed KV-cache hook (serving.DenseKVCache /
+            # serving.PagedCacheView): the cache absorbs this layer's new
+            # K/V and returns attention over the full context
+            import functools
+
+            out = _apply(functools.partial(cache.attend, self.layer_idx),
+                         q, k, v, op_name="kv_cached_attention")
         out = M.reshape(out, [B, S, self.num_heads * self.head_dim])
         return self.o_proj(out)
 
@@ -144,8 +175,9 @@ class LlamaDecoderLayer(nn.Layer):
         self.post_attention_layernorm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
         self.mlp = LlamaMLP(config)
 
-    def forward(self, x, rope_cos, rope_sin):
-        h = x + self.self_attn(self.input_layernorm(x), rope_cos, rope_sin)
+    def forward(self, x, rope_cos, rope_sin, cache=None, positions=None):
+        h = x + self.self_attn(self.input_layernorm(x), rope_cos, rope_sin,
+                               cache=cache, positions=positions)
         return h + self.mlp(self.post_attention_layernorm(h))
 
 
@@ -156,6 +188,8 @@ class LlamaForCausalLM(nn.Layer):
         self.embed_tokens = VocabParallelEmbedding(config.vocab_size, config.hidden_size)
         self.layers = nn.LayerList(
             [LlamaDecoderLayer(config) for _ in range(config.num_hidden_layers)])
+        for i, layer in enumerate(self.layers):
+            layer.self_attn.layer_idx = i
         self.norm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
         self.lm_head = ColumnParallelLinear(
             config.hidden_size, config.vocab_size, has_bias=False, gather_output=True)
@@ -166,10 +200,29 @@ class LlamaForCausalLM(nn.Layer):
         self.register_buffer("rope_cos", Tensor(cos), persistable=False)
         self.register_buffer("rope_sin", Tensor(sin), persistable=False)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, cache=None, positions=None):
+        """Causal-LM forward; ``cache`` opts into KV-cached decode.
+
+        cache:     None (full causal forward, unchanged) or a KV cache view
+                   (``serving.DenseKVCache`` for concat-style past_kv,
+                   ``serving.PagedCacheView`` inside the serving engine).
+                   The cache absorbs each layer's new K/V and answers
+                   attention over past + new — inference-only (no_grad).
+        positions: int [B, S] token positions for RoPE when the inputs are
+                   a suffix (cached decode); defaults to 0..S-1.
+        """
+        if cache is None:
+            return self._forward_body(input_ids, None, positions)
+        from ..core.autograd import no_grad
+
+        with no_grad():
+            return self._forward_body(input_ids, cache, positions)
+
+    def _forward_body(self, input_ids, cache, positions):
         h = self.embed_tokens(input_ids)
         for layer in self.layers:
-            h = layer(h, self.rope_cos, self.rope_sin)
+            h = layer(h, self.rope_cos, self.rope_sin, cache=cache,
+                      positions=positions)
         h = self.norm(h)
         return self.lm_head(h)
 

@@ -16,7 +16,7 @@ from .layers.loss import *  # noqa: F401,F403
 from .layers.norm import *  # noqa: F401,F403
 from .layers.pooling import *  # noqa: F401,F403
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
-from .decode import BeamSearchDecoder, dynamic_decode  # noqa: F401
+from .decode import BeamSearchDecoder, dynamic_decode, sample_logits  # noqa: F401
 from .layers.rnn import *  # noqa: F401,F403
 from .layers.transformer import *  # noqa: F401,F403
 
@@ -32,7 +32,7 @@ from .layers import transformer as _transformer
 __all__ = (
     ["Layer", "LayerList", "Sequential", "ParameterList", "functional",
      "initializer", "ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm",
-     "BeamSearchDecoder", "dynamic_decode"]
+     "BeamSearchDecoder", "dynamic_decode", "sample_logits"]
     + _act.__all__ + _common.__all__ + _conv.__all__
     + _loss.__all__ + _norm.__all__ + _pooling.__all__
     + _rnn.__all__ + _transformer.__all__

@@ -10,11 +10,76 @@ from __future__ import annotations
 import numpy as np
 
 import jax
+import jax.numpy as jnp
 
 from ..core.tensor import Tensor, to_tensor
 from ..ops.registry import OPS
 
-__all__ = ["BeamSearchDecoder", "dynamic_decode"]
+__all__ = ["BeamSearchDecoder", "dynamic_decode", "sample_logits"]
+
+
+def sample_logits(logits, temperature=1.0, top_k=0, top_p=1.0, key=None):
+    """Sample next-token ids from logits — the serving engine's sampler.
+
+    logits:      [V] or [B, V] raw (unnormalized) logits; jit-safe.
+    temperature: scalar or [B]. ``0`` means greedy (argmax of the raw
+                 logits); rows mix freely (per-row temperatures).
+    top_k:       scalar or [B] int; keep only the k largest logits
+                 (``0`` disables). Traced values are fine (clamped to
+                 [1, V] inside).
+    top_p:       scalar or [B]; nucleus sampling — keep the smallest
+                 prefix of the sorted distribution with mass >= p
+                 (``1.0`` disables; the top-1 token is always kept).
+    key:         a PRNG key, or [B] stacked keys for per-row streams
+                 (continuous batching needs per-request keys so a row's
+                 tokens don't depend on its batch neighbours). May be
+                 omitted only for pure-greedy calls.
+
+    Returns int32 ids, scalar for 1-D input. Same key -> same tokens.
+    """
+    squeeze = logits.ndim == 1
+    lg = (logits[None] if squeeze else logits).astype(jnp.float32)
+    B, V = lg.shape
+    temp = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), (B,))
+    tk = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (B,))
+    tp = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), (B,))
+    greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+
+    if key is None:
+        tok = greedy  # greedy-only call; sampling rows need a key
+    else:
+        key = jnp.asarray(key)
+        if key.ndim == 2:
+            keys = key
+        elif B == 1:
+            # a lone row consumes the key directly, so batched callers that
+            # fold a per-request key per row (the engine) and single-row
+            # callers (prefill / naive_generate) draw the SAME stream
+            keys = key[None]
+        else:
+            keys = jax.random.split(key, B)
+        desc = jnp.sort(lg, axis=-1)[:, ::-1]
+        # top-k: threshold at the k-th largest logit (k=0 -> keep all)
+        k_eff = jnp.clip(jnp.where(tk <= 0, V, tk), 1, V)
+        kth = jnp.take_along_axis(desc, (k_eff - 1)[:, None], axis=-1)
+        masked = jnp.where(lg >= kth, lg, -jnp.inf)
+        # top-p over the surviving distribution: keep sorted entries whose
+        # *exclusive* cumulative mass is < p (always keeps the top-1)
+        probs = jax.nn.softmax(masked, axis=-1)
+        sp = jnp.sort(probs, axis=-1)[:, ::-1]
+        csum = jnp.cumsum(sp, axis=-1)
+        first = jnp.arange(V, dtype=jnp.int32)[None] == 0
+        keep = ((csum - sp) < tp[:, None]) | first
+        thresh = jnp.min(jnp.where(keep, sp, jnp.inf), axis=-1, keepdims=True)
+        masked = jnp.where(probs >= thresh, masked, -jnp.inf)
+        # Gumbel-max with a per-row key: argmax(logits/T + g)
+        scaled = masked / jnp.maximum(temp, 1e-6)[:, None]
+        u = jax.vmap(lambda kk: jax.random.uniform(
+            kk, (V,), minval=1e-20, maxval=1.0))(keys)
+        sampled = jnp.argmax(scaled - jnp.log(-jnp.log(u)),
+                             axis=-1).astype(jnp.int32)
+        tok = jnp.where(temp > 0, sampled, greedy)
+    return tok[0] if squeeze else tok
 
 
 def _np(x):

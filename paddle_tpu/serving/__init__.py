@@ -1,0 +1,29 @@
+"""paddle_tpu.serving — continuous-batching LLM serving.
+
+The three pillars (docs/SERVING.md has the full tour):
+
+- :mod:`.kv_cache` — the paged KV cache: one fixed-shape block pool, a
+  free-list allocator, per-sequence block tables, and the functional cache
+  views the jitted steps thread through the model.
+- :mod:`paddle_tpu.kernels.paged_attention` — the ragged paged-attention
+  decode kernel (Pallas on TPU, jnp mirror on CPU).
+- :mod:`.scheduler` / :mod:`.engine` — continuous batching: admission
+  control against free blocks, join-on-finish decode slots,
+  preempt-and-requeue on pool exhaustion, seeded sampling, streaming
+  outputs, and serving counters (TTFT, tokens/s, queue depth, cache
+  utilization).
+"""
+from .engine import LLMEngine, naive_generate  # noqa: F401
+from .kv_cache import (  # noqa: F401
+    BlockAllocator,
+    DenseKVCache,
+    PagedCacheView,
+    PagedKVCache,
+)
+from .scheduler import Request, RequestState, SamplingParams, Scheduler  # noqa: F401
+
+__all__ = [
+    "LLMEngine", "naive_generate", "BlockAllocator", "PagedKVCache",
+    "PagedCacheView", "DenseKVCache", "Request", "RequestState",
+    "SamplingParams", "Scheduler",
+]

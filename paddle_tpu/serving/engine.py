@@ -1,0 +1,316 @@
+"""Continuous-batching LLM serving engine.
+
+``LLMEngine`` drives a ``models.llama.LlamaForCausalLM`` through two jitted
+step functions over the paged KV cache:
+
+- **prefill** (per admitted request, batch 1): the prompt — padded to a
+  power-of-two number of KV blocks so trace count stays logarithmic — runs
+  densely causal, its K/V scattered into the request's blocks, and the
+  first new token is sampled from the last valid position's logits (TTFT).
+- **decode** (all running slots, one fused call): one token per slot with
+  *static* shapes — the whole pool, [slots, max_blocks] block tables, and
+  per-slot context lengths/sampling params are traced inputs, so the step
+  compiles exactly once no matter how sequences grow, join, or finish.
+  A trace counter asserts this (the ``static.Executor`` discipline).
+
+Sampling is seeded per (request, output index) — batch composition,
+preemption, and re-prefill cannot change a request's tokens, which is what
+makes continuous batching output-equivalent to one-at-a-time decoding.
+
+``naive_generate`` is the uncached baseline (full re-prefill every step)
+used by the parity tests and ``tools/serving_bench.py``.
+"""
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..kernels import active_platform
+from ..nn.decode import sample_logits
+from ..nn.layer import functional_call, functional_state
+from .kv_cache import PagedCacheView, PagedKVCache
+from .scheduler import Request, RequestState, SamplingParams, Scheduler
+
+__all__ = ["LLMEngine", "naive_generate"]
+
+
+class LLMEngine:
+    """Continuous-batching serving engine over a paged KV cache.
+
+    model:         a ``LlamaForCausalLM`` (any cache-aware causal LM whose
+                   forward accepts ``cache=`` / ``positions=`` works)
+    block_size:    tokens per KV block (pool granularity)
+    num_blocks:    pool size incl. the reserved scratch block; default sizes
+                   the pool so every slot can reach ``max_model_len``
+    max_slots:     decode batch width (concurrent running requests)
+    max_model_len: hard cap on prompt + generated tokens per request
+    eos_token_id:  optional early-stop token
+    """
+
+    def __init__(self, model, *, block_size=16, num_blocks=None, max_slots=4,
+                 max_model_len=None, eos_token_id=None, kv_dtype=None):
+        cfg = model.config
+        self.model = model
+        self.block_size = int(block_size)
+        self.max_model_len = int(max_model_len or cfg.max_position_embeddings)
+        self.max_slots = int(max_slots)
+        self.eos_token_id = eos_token_id
+        # static per-sequence table width
+        self.max_blocks = -(-self.max_model_len // self.block_size)
+        if num_blocks is None:
+            num_blocks = self.max_slots * self.max_blocks + 1
+        if num_blocks - 1 < self.max_blocks:
+            raise ValueError(
+                f"pool of {num_blocks} blocks (1 reserved) cannot hold one "
+                f"max_model_len={self.max_model_len} sequence "
+                f"({self.max_blocks} blocks); shrink max_model_len or grow "
+                f"num_blocks")
+        self.params, self.buffers = functional_state(model)
+        if kv_dtype is None:
+            kv_dtype = next(iter(self.params.values())).dtype
+        self.cache = PagedKVCache(
+            cfg.num_hidden_layers, num_blocks, cfg.num_key_value_heads,
+            self.block_size, cfg.head_dim, dtype=kv_dtype)
+        self.scheduler = Scheduler(self.cache, self.max_slots,
+                                   self.max_model_len)
+
+        self._next_rid = 0
+        self._decode_fn = None
+        self._prefill_fns: dict[int, object] = {}
+        self.decode_traces = 0
+        self.prefill_traces: dict[int, int] = {}
+        self._donate = (2,) if active_platform() == "tpu" else ()
+
+        self.finished: list[Request] = []
+        self._total_generated = 0
+        self._serve_start: float | None = None
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+    def add_request(self, prompt, sampling: SamplingParams | None = None,
+                    on_token=None) -> Request:
+        """Queue a prompt (list/array of token ids); returns the live
+        request handle (``output_tokens`` grows as the engine steps;
+        ``on_token(req, tok)`` streams each new token)."""
+        req = Request(rid=self._next_rid, prompt=[int(t) for t in prompt],
+                      sampling=sampling or SamplingParams(),
+                      on_token=on_token)
+        self._next_rid += 1
+        self.scheduler.add(req)
+        return req
+
+    def step(self) -> bool:
+        """One engine iteration: admit + prefill new requests, then one
+        batched decode step over the running slots. Returns True while
+        there is work left."""
+        if self._serve_start is None and self.scheduler.has_work():
+            self._serve_start = time.monotonic()
+        for slot, req in self.scheduler.admit():
+            self._run_prefill(slot, req)
+        if self.scheduler.running:
+            self.scheduler.ensure_decode_capacity()
+            self._run_decode()
+        return self.scheduler.has_work()
+
+    def run(self):
+        """Drive until every queued request has finished."""
+        while self.step():
+            pass
+
+    def generate(self, prompts, sampling=None):
+        """Batch convenience: serve all ``prompts`` to completion, return
+        their output token lists in order."""
+        if isinstance(sampling, (SamplingParams, type(None))):
+            sampling = [sampling] * len(prompts)
+        reqs = [self.add_request(p, s) for p, s in zip(prompts, sampling)]
+        self.run()
+        return [r.output_tokens for r in reqs]
+
+    def stream(self, prompt, sampling: SamplingParams | None = None):
+        """Generator yielding tokens of one request as the engine produces
+        them (other queued requests keep batching along)."""
+        req = self.add_request(prompt, sampling)
+        emitted = 0
+        while True:
+            while emitted < len(req.output_tokens):
+                yield req.output_tokens[emitted]
+                emitted += 1
+            if req.state is RequestState.FINISHED:
+                return
+            self.step()
+
+    def stats(self) -> dict:
+        alloc = self.cache.allocator
+        elapsed = (time.monotonic() - self._serve_start
+                   if self._serve_start else 0.0)
+        ttfts = [r.ttft for r in self.finished if r.ttft is not None]
+        return {
+            "queue_depth": self.scheduler.queue_depth,
+            "num_running": len(self.scheduler.running),
+            "num_finished": len(self.finished),
+            "blocks_used": alloc.num_used,
+            "blocks_free": alloc.num_free,
+            "block_high_water": alloc.high_water,
+            "cache_utilization": self.cache.utilization(),
+            "num_preemptions": self.scheduler.num_preemptions,
+            "decode_traces": self.decode_traces,
+            "prefill_traces": dict(self.prefill_traces),
+            "total_generated_tokens": self._total_generated,
+            "tokens_per_sec": (self._total_generated / elapsed
+                               if elapsed > 0 else 0.0),
+            "mean_ttft": float(np.mean(ttfts)) if ttfts else None,
+        }
+
+    # ------------------------------------------------------------------
+    # prefill
+    # ------------------------------------------------------------------
+    def _bucket(self, length: int) -> int:
+        """Pad prompts to a power-of-two number of blocks (capped at the
+        model max) so distinct prefill traces stay O(log max_len)."""
+        nb = max(1, -(-length // self.block_size))
+        nb = 1 << (nb - 1).bit_length()
+        return min(nb, self.max_blocks) * self.block_size
+
+    def _get_prefill_fn(self, P: int):
+        fn = self._prefill_fns.get(P)
+        if fn is not None:
+            return fn
+        model = self.model
+
+        def prefill(params, buffers, pool, tokens, length, bt,
+                    temp, top_k, top_p, seed, step_idx):
+            self.prefill_traces[P] = self.prefill_traces.get(P, 0) + 1
+            view = PagedCacheView(pool, bt[None, :], None, self.block_size)
+            positions = jnp.arange(P, dtype=jnp.int32)[None]
+            logits, _ = functional_call(
+                model, params, buffers, tokens[None], cache=view,
+                positions=positions, training=False)
+            last = logits[0, length - 1].astype(jnp.float32)
+            key = jax.random.fold_in(jax.random.PRNGKey(seed), step_idx)
+            tok = sample_logits(last, temp, top_k, top_p, key)
+            return tok, view.pool
+
+        fn = jax.jit(prefill, donate_argnums=self._donate)
+        self._prefill_fns[P] = fn
+        return fn
+
+    def _run_prefill(self, slot: int, req: Request):
+        toks = req.prefill_tokens
+        L = len(toks)
+        P = self._bucket(L)
+        padded = np.zeros(P, np.int32)
+        padded[:L] = toks
+        bt = self.cache.table_array([req.rid], P // self.block_size)[0]
+        sp = req.sampling
+        tok, pool = self._get_prefill_fn(P)(
+            self.params, self.buffers, self.cache.pool,
+            jnp.asarray(padded), jnp.int32(L), jnp.asarray(bt),
+            jnp.float32(sp.temperature), jnp.int32(sp.top_k),
+            jnp.float32(sp.top_p), jnp.int32(sp.seed),
+            jnp.int32(len(req.output_tokens)))
+        self.cache.pool = pool
+        self._emit(slot, req, int(tok))
+
+    # ------------------------------------------------------------------
+    # decode
+    # ------------------------------------------------------------------
+    def _get_decode_fn(self):
+        if self._decode_fn is not None:
+            return self._decode_fn
+        model = self.model
+
+        def decode(params, buffers, pool, tokens, bt, ctx,
+                   temps, top_ks, top_ps, seeds, step_idx):
+            self.decode_traces += 1
+            view = PagedCacheView(pool, bt, ctx, self.block_size)
+            logits, _ = functional_call(
+                model, params, buffers, tokens[:, None], cache=view,
+                positions=ctx[:, None], training=False)
+            last = logits[:, -1].astype(jnp.float32)
+            keys = jax.vmap(
+                lambda s, t: jax.random.fold_in(jax.random.PRNGKey(s), t)
+            )(seeds, step_idx)
+            toks = sample_logits(last, temps, top_ks, top_ps, keys)
+            return toks, view.pool
+
+        self._decode_fn = jax.jit(decode, donate_argnums=self._donate)
+        return self._decode_fn
+
+    def _run_decode(self):
+        S = self.max_slots
+        running = dict(self.scheduler.running)  # slot -> req snapshot
+        tokens = np.zeros(S, np.int32)
+        ctx = np.ones(S, np.int32)       # inactive: 1 garbage scratch token
+        temps = np.zeros(S, np.float32)
+        top_ks = np.zeros(S, np.int32)
+        top_ps = np.ones(S, np.float32)
+        seeds = np.zeros(S, np.int32)
+        steps = np.zeros(S, np.int32)
+        sids = [None] * S
+        for slot, req in running.items():
+            sids[slot] = req.rid
+            tokens[slot] = (req.output_tokens[-1] if req.output_tokens
+                            else req.prompt[-1])
+            ctx[slot] = req.total_len - 1
+            temps[slot] = req.sampling.temperature
+            top_ks[slot] = req.sampling.top_k
+            top_ps[slot] = req.sampling.top_p
+            seeds[slot] = req.sampling.seed
+            steps[slot] = len(req.output_tokens)
+        bt = self.cache.table_array(sids, self.max_blocks)
+
+        toks, pool = self._get_decode_fn()(
+            self.params, self.buffers, self.cache.pool,
+            jnp.asarray(tokens), jnp.asarray(bt), jnp.asarray(ctx),
+            jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps),
+            jnp.asarray(seeds), jnp.asarray(steps))
+        self.cache.pool = pool
+        toks = np.asarray(toks)
+        for slot, req in running.items():
+            self._emit(slot, req, int(toks[slot]))
+
+    def _emit(self, slot: int, req: Request, token: int):
+        req.emit(token)
+        self._total_generated += 1
+        if (self.eos_token_id is not None and token == self.eos_token_id):
+            self._finish(slot, "stop")
+        elif len(req.output_tokens) >= req.sampling.max_new_tokens:
+            self._finish(slot, "length")
+
+    def _finish(self, slot: int, reason: str):
+        req = self.scheduler.running[slot]
+        self.scheduler.finish(slot, reason)
+        self.finished.append(req)
+
+
+# ---------------------------------------------------------------------------
+# uncached baseline
+# ---------------------------------------------------------------------------
+
+def naive_generate(model, prompt, sampling: SamplingParams | None = None,
+                   eos_token_id=None):
+    """Reference decode loop with NO KV cache: every step re-runs the full
+    forward over the whole prefix (what L9's one-shot Predictor amounts to).
+    Tokens are keyed exactly like the engine — (seed, output index) — so the
+    engine must reproduce this stream token-for-token."""
+    sp = sampling or SamplingParams()
+    params, buffers = functional_state(model)
+    toks = [int(t) for t in prompt]
+    out = []
+    for i in range(sp.max_new_tokens):
+        logits, _ = functional_call(
+            model, params, buffers, jnp.asarray([toks], jnp.int32),
+            training=False)
+        last = logits[0, -1].astype(jnp.float32)
+        key = jax.random.fold_in(jax.random.PRNGKey(sp.seed), i)
+        tok = int(sample_logits(last, sp.temperature, sp.top_k, sp.top_p,
+                                key))
+        out.append(tok)
+        toks.append(tok)
+        if eos_token_id is not None and tok == eos_token_id:
+            break
+    return out

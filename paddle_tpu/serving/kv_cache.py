@@ -1,0 +1,247 @@
+"""Paged KV cache: one preallocated block pool shared by all sequences.
+
+The pool is a fixed-shape array
+
+    [num_layers, num_blocks, 2, kv_heads, block_size, head_dim]
+
+(dim 2 is K/V). Sequences own *block tables* — lists of pool indices — so a
+sequence of any length lives in ceil(len / block_size) blocks and every
+engine step runs with static shapes: the decode step sees the whole pool
+plus fixed-size [slots, max_blocks] tables and never retraces as sequences
+grow (asserted by the engine's trace counter, the ``static.Executor``
+no-retrace discipline).
+
+Block 0 is a reserved scratch block: inactive decode slots carry all-zero
+tables, so their (masked-out) K/V writes land in scratch instead of a live
+sequence's block. The allocator therefore hands out ids 1..num_blocks-1.
+
+Host side: :class:`BlockAllocator` (free list + high-water mark) and
+:class:`PagedKVCache` (pool + per-sequence tables). Trace side:
+:class:`PagedCacheView`, the per-step functional view the jitted engine
+functions thread through ``LlamaForCausalLM.forward(cache=...)`` — it
+scatters new K/V into the pool and attends through the ragged
+paged-attention kernel. :class:`DenseKVCache` is the simple concatenating
+(HF ``past_kv``-style) cache used for parity testing and one-off decode.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["BlockAllocator", "PagedKVCache", "PagedCacheView", "DenseKVCache",
+           "SCRATCH_BLOCK"]
+
+SCRATCH_BLOCK = 0  # reserved: masked writes from inactive slots land here
+
+
+class BlockAllocator:
+    """Free-list allocator over the pool's block ids (1..num_blocks-1).
+
+    Tracks a high-water mark so tests can assert the pool never overflows
+    and the engine can report peak cache pressure.
+    """
+
+    def __init__(self, num_blocks: int, reserved: int = 1):
+        if num_blocks <= reserved:
+            raise ValueError(
+                f"need more than {reserved} block(s), got {num_blocks}")
+        self.num_blocks = num_blocks
+        self.reserved = reserved
+        # pop() takes from the end: hand out low ids first
+        self._free = list(range(num_blocks - 1, reserved - 1, -1))
+        self._live: set[int] = set()
+        self.high_water = 0
+
+    @property
+    def num_usable(self) -> int:
+        return self.num_blocks - self.reserved
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_used(self) -> int:
+        return len(self._live)
+
+    def alloc(self, n: int = 1):
+        """Allocate ``n`` blocks; returns their ids, or None if the pool
+        cannot satisfy the request (caller preempts or queues)."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        self._live.update(out)
+        self.high_water = max(self.high_water, len(self._live))
+        return out
+
+    def free(self, blocks):
+        for b in blocks:
+            if b not in self._live:
+                raise ValueError(f"double free / foreign block id {b}")
+            self._live.discard(b)
+            self._free.append(b)
+
+
+class PagedKVCache:
+    """The block pool plus per-sequence block tables (host bookkeeping)."""
+
+    def __init__(self, num_layers, num_blocks, kv_heads, block_size,
+                 head_dim, dtype=jnp.float32):
+        self.pool = jnp.zeros(
+            (num_layers, num_blocks, 2, kv_heads, block_size, head_dim),
+            dtype)
+        self.allocator = BlockAllocator(num_blocks)
+        self.block_size = int(block_size)
+        self.tables: dict[object, list[int]] = {}
+
+    def blocks_for(self, num_tokens: int) -> int:
+        return -(-int(num_tokens) // self.block_size)
+
+    def can_allocate(self, num_tokens: int) -> bool:
+        return self.allocator.num_free >= self.blocks_for(num_tokens)
+
+    def allocate(self, seq_id, num_tokens: int) -> bool:
+        """Give ``seq_id`` a fresh table covering ``num_tokens`` tokens."""
+        if seq_id in self.tables:
+            raise ValueError(f"sequence {seq_id!r} already has a table")
+        blocks = self.allocator.alloc(self.blocks_for(num_tokens))
+        if blocks is None:
+            return False
+        self.tables[seq_id] = blocks
+        return True
+
+    def extend(self, seq_id, num_tokens: int) -> bool:
+        """Grow ``seq_id``'s table to cover ``num_tokens`` tokens; False on
+        pool exhaustion (nothing is allocated partially)."""
+        table = self.tables[seq_id]
+        need = self.blocks_for(num_tokens) - len(table)
+        if need <= 0:
+            return True
+        blocks = self.allocator.alloc(need)
+        if blocks is None:
+            return False
+        table.extend(blocks)
+        return True
+
+    def free_seq(self, seq_id):
+        self.allocator.free(self.tables.pop(seq_id))
+
+    def utilization(self) -> float:
+        return self.allocator.num_used / max(self.allocator.num_usable, 1)
+
+    def table_array(self, seq_ids, max_blocks: int) -> np.ndarray:
+        """Fixed-shape [len(seq_ids), max_blocks] int32 table; absent ids
+        and padding rows point at the scratch block."""
+        out = np.full((len(seq_ids), max_blocks), SCRATCH_BLOCK, np.int32)
+        for i, sid in enumerate(seq_ids):
+            if sid is None or sid not in self.tables:
+                continue
+            t = self.tables[sid]
+            out[i, :len(t)] = t
+        return out
+
+
+class PagedCacheView:
+    """Per-trace functional view of the pool, passed to the model as
+    ``cache=``. The model's attention layers call :meth:`attend` once per
+    layer; K/V writes are functional (``pool.at[...]``) and the updated pool
+    accumulates on ``self.pool`` — the jitted step returns it as an output.
+
+    Two modes, keyed on the query's token count:
+    - decode (S_new == 1): batched slots, one token each; writes the token's
+      K/V at position ``ctx_lens[s]`` through the block table, then runs the
+      ragged paged-attention kernel over ``ctx_lens + 1`` tokens.
+    - prefill (S_new > 1, batch 1): the padded prompt; scatters whole blocks
+      into the pool and attends densely (causal) within the prompt — no pool
+      reads, so concurrent sequences are untouched.
+    """
+
+    def __init__(self, pool, block_tables, ctx_lens, block_size):
+        self.pool = pool                      # [L, N, 2, H, bs, D]
+        self.block_tables = block_tables      # [S, M] int32
+        self.ctx_lens = ctx_lens              # [S] int32 (None for prefill)
+        self.block_size = int(block_size)
+
+    # the duck-typed hook LlamaAttention calls (raw arrays in/out)
+    def attend(self, layer_idx, q, k, v):
+        if q.shape[1] == 1:
+            return self._decode(layer_idx, q, k, v)
+        return self._prefill(layer_idx, q, k, v)
+
+    def _decode(self, layer_idx, q, k, v):
+        S = q.shape[0]
+        bs = self.block_size
+        pos = self.ctx_lens.astype(jnp.int32)           # new token's position
+        rows = jnp.arange(S, dtype=jnp.int32)
+        bidx = self.block_tables[rows, pos // bs]       # [S]
+        off = pos % bs
+        # mixed basic/advanced indexing: advanced dims (S) move to the front,
+        # so the target of the .set is [S, kv_heads, head_dim]
+        pool = self.pool.at[layer_idx, bidx, 0, :, off, :].set(k[:, 0])
+        pool = pool.at[layer_idx, bidx, 1, :, off, :].set(v[:, 0])
+        self.pool = pool
+
+        from ..kernels import paged_attention_impl
+
+        impl = paged_attention_impl()
+        out = impl(q[:, 0], pool[layer_idx], self.block_tables,
+                   pos + 1)                              # [S, Hq, D]
+        return out[:, None]                              # [S, 1, Hq, D]
+
+    def _prefill(self, layer_idx, q, k, v):
+        bs = self.block_size
+        P = k.shape[1]
+        if q.shape[0] != 1 or P % bs:
+            raise ValueError(
+                f"prefill expects batch 1 and a block-multiple length; got "
+                f"batch {q.shape[0]}, len {P}, block_size {bs}")
+        nb = P // bs
+        # [1, P, Hkv, D] -> [nb, Hkv, bs, D] block layout
+        kb = k[0].reshape(nb, bs, -1, k.shape[-1]).transpose(0, 2, 1, 3)
+        vb = v[0].reshape(nb, bs, -1, v.shape[-1]).transpose(0, 2, 1, 3)
+        bt = self.block_tables[0, :nb]
+        pool = self.pool.at[layer_idx, bt, 0].set(kb)
+        pool = pool.at[layer_idx, bt, 1].set(vb)
+        self.pool = pool
+        from ..nn.functional.attention import sdpa_ref
+
+        # causal within the prompt; padded tail positions produce garbage
+        # that never flows back (causality) and is never read (the engine
+        # takes logits at the last *valid* position)
+        return sdpa_ref(q, k, v, is_causal=True)
+
+
+class DenseKVCache:
+    """Concatenating KV cache (the classic ``past_kv``): layer i holds the
+    full [B, S_past, kv_heads, head_dim] K/V. Quadratic in memory across a
+    long decode — the paged cache replaces it in the engine — but it is the
+    simplest correct reference, used by the cached-decode parity tests."""
+
+    def __init__(self, num_layers: int):
+        self.layers: list = [None] * num_layers
+
+    @property
+    def seq_len(self) -> int:
+        kv = self.layers[0]
+        return 0 if kv is None else int(kv[0].shape[1])
+
+    def attend(self, layer_idx, q, k, v):
+        past = self.layers[layer_idx]
+        if past is not None:
+            k = jnp.concatenate([past[0], k], axis=1)
+            v = jnp.concatenate([past[1], v], axis=1)
+        self.layers[layer_idx] = (k, v)
+        from ..nn.functional.attention import sdpa_ref
+
+        Sq, Sk = q.shape[1], k.shape[1]
+        if Sq == Sk:
+            return sdpa_ref(q, k, v, is_causal=True)
+        # q token i sits at global position (Sk - Sq + i): attends j <= that
+        offset = Sk - Sq
+        qi = jnp.arange(Sq)[:, None]
+        kj = jnp.arange(Sk)[None, :]
+        mask = (kj <= qi + offset)[None, None]          # [1, 1, Sq, Sk]
+        return sdpa_ref(q, k, v, attn_mask=mask)

@@ -1,0 +1,189 @@
+"""Continuous-batching request scheduler.
+
+Requests queue in arrival order; the scheduler admits them into a fixed set
+of decode *slots* under admission control against the block pool (a request
+enters only when its prefill blocks plus one decode block of headroom are
+free). Running requests join the batched decode step; when one finishes its
+slot and blocks return immediately and the next waiting request takes over
+— join-on-finish, no batch-wide barrier.
+
+When the pool runs dry mid-decode (a running sequence crosses a block
+boundary with no free block), the latest-arrived *other* running request is
+preempted: its blocks are freed and it re-queues at the front with its
+generated tokens folded into the prompt, so its re-prefill resumes exactly
+where it left off. Sampling stays deterministic across preemption because
+the engine keys every sampled token by (request seed, output index), not by
+wall-clock step.
+"""
+from __future__ import annotations
+
+import enum
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+from .kv_cache import PagedKVCache
+
+__all__ = ["SamplingParams", "Request", "RequestState", "Scheduler"]
+
+
+@dataclass
+class SamplingParams:
+    """Per-request decode controls. ``temperature=0`` is greedy (argmax);
+    ``top_k=0`` / ``top_p=1.0`` disable those filters."""
+
+    max_new_tokens: int = 16
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int = 0
+
+
+class RequestState(enum.Enum):
+    WAITING = "waiting"
+    RUNNING = "running"
+    FINISHED = "finished"
+
+
+@dataclass
+class Request:
+    rid: int
+    prompt: list[int]
+    sampling: SamplingParams
+    on_token: object = None            # callable(req, token) per new token
+    state: RequestState = RequestState.WAITING
+    output_tokens: list[int] = field(default_factory=list)
+    arrival_time: float = field(default_factory=time.monotonic)
+    first_token_time: float | None = None
+    finish_time: float | None = None
+    num_preemptions: int = 0
+    finish_reason: str | None = None
+
+    @property
+    def prefill_tokens(self) -> list[int]:
+        """What a (re-)prefill must process: the prompt plus anything already
+        generated (non-empty only after preemption)."""
+        return self.prompt + self.output_tokens
+
+    @property
+    def total_len(self) -> int:
+        return len(self.prompt) + len(self.output_tokens)
+
+    @property
+    def ttft(self) -> float | None:
+        if self.first_token_time is None:
+            return None
+        return self.first_token_time - self.arrival_time
+
+    def emit(self, token: int):
+        self.output_tokens.append(int(token))
+        if self.first_token_time is None:
+            self.first_token_time = time.monotonic()
+        if self.on_token is not None:
+            self.on_token(self, int(token))
+
+
+class Scheduler:
+    """Slots + queues over a :class:`PagedKVCache`."""
+
+    def __init__(self, cache: PagedKVCache, max_slots: int,
+                 max_model_len: int):
+        self.cache = cache
+        self.max_slots = int(max_slots)
+        self.max_model_len = int(max_model_len)
+        self.waiting: deque[Request] = deque()
+        self.running: dict[int, Request] = {}       # slot -> request
+        self._free_slots = list(range(max_slots))
+        self.num_preemptions = 0
+
+    # -- intake -----------------------------------------------------------
+    def add(self, req: Request):
+        worst = len(req.prompt) + req.sampling.max_new_tokens
+        if worst > self.max_model_len:
+            raise ValueError(
+                f"request {req.rid}: prompt ({len(req.prompt)}) + "
+                f"max_new_tokens ({req.sampling.max_new_tokens}) exceeds "
+                f"max_model_len ({self.max_model_len})")
+        if self.cache.blocks_for(worst) > self.cache.allocator.num_usable:
+            raise ValueError(
+                f"request {req.rid} can never fit: needs "
+                f"{self.cache.blocks_for(worst)} blocks, pool has "
+                f"{self.cache.allocator.num_usable} usable")
+        self.waiting.append(req)
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self.waiting)
+
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    # -- admission --------------------------------------------------------
+    def admit(self) -> list[tuple[int, Request]]:
+        """Move waiting requests into free slots while the pool can hold
+        their prefill plus one block of decode headroom."""
+        admitted = []
+        while self.waiting and self._free_slots:
+            req = self.waiting[0]
+            need = self.cache.blocks_for(len(req.prefill_tokens)) + 1
+            if self.cache.allocator.num_free < need:
+                break
+            self.waiting.popleft()
+            slot = self._free_slots.pop(0)
+            ok = self.cache.allocate(req.rid, len(req.prefill_tokens))
+            assert ok, "admission checked free blocks"
+            req.state = RequestState.RUNNING
+            self.running[slot] = req
+            admitted.append((slot, req))
+        return admitted
+
+    # -- decode-time capacity ---------------------------------------------
+    def ensure_decode_capacity(self) -> list[Request]:
+        """Before a decode step, every running sequence must own the block
+        its next token writes into. On exhaustion, preempt the
+        latest-arrived other running request and retry; returns the
+        preempted requests (already re-queued)."""
+        preempted = []
+        for slot in sorted(self.running):
+            req = self.running.get(slot)
+            if req is None:  # preempted earlier in this very loop
+                continue
+            # the incoming token writes its K/V at position total_len - 1,
+            # so the table must cover total_len tokens
+            while not self.cache.extend(req.rid, req.total_len):
+                victim = self._pick_victim(exclude=req)
+                if victim is None:
+                    raise RuntimeError(
+                        f"request {req.rid} cannot obtain a KV block with "
+                        f"no victim left to preempt — pool too small "
+                        f"(usable={self.cache.allocator.num_usable})")
+                preempted.append(victim)
+                self._preempt(victim)
+        return preempted
+
+    def _pick_victim(self, exclude: Request):
+        cands = [r for r in self.running.values() if r is not exclude]
+        if not cands:
+            return None
+        return max(cands, key=lambda r: r.arrival_time)
+
+    def _preempt(self, victim: Request):
+        slot = next(s for s, r in self.running.items() if r is victim)
+        del self.running[slot]
+        self._free_slots.append(slot)
+        self._free_slots.sort()
+        self.cache.free_seq(victim.rid)
+        victim.state = RequestState.WAITING
+        victim.num_preemptions += 1
+        self.num_preemptions += 1
+        self.waiting.appendleft(victim)   # front: keep its progress hot
+
+    # -- completion -------------------------------------------------------
+    def finish(self, slot: int, reason: str = "length"):
+        req = self.running.pop(slot)
+        self._free_slots.append(slot)
+        self._free_slots.sort()
+        self.cache.free_seq(req.rid)
+        req.state = RequestState.FINISHED
+        req.finish_time = time.monotonic()
+        req.finish_reason = reason

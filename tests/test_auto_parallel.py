@@ -98,7 +98,14 @@ class TestAutoTuner:
             assert c["mp_degree"] in (1, 2)  # heads=2 prunes mp>2
             assert 8 % (c["dp_degree"] * c["sharding_degree"]) == 0
 
+    @pytest.mark.slow
     def test_tune_finds_runnable_config(self):
+        # SLOW/QUARANTINE: the sharding_stage=3 trial segfaults inside the
+        # XLA CPU runtime on this jax build (hard crash, not a python
+        # error), killing the whole in-process suite — every test file
+        # sorting after this one never ran in tier-1. Excluded from the
+        # fast tier until the trial runs in a spawned worker like the other
+        # crash-prone distributed tests.
         from paddle_tpu.distributed.mesh import set_hybrid_communicate_group
 
         def model_fn():

@@ -100,7 +100,8 @@ class TestPackaging:
     def test_pyproject_is_installable_metadata(self):
         # cheap structural check (full pip install -e is exercised by CI
         # tooling, not unit tests): the build backend can see the package
-        import tomllib
+        tomllib = pytest.importorskip(
+            "tomllib", reason="tomllib is stdlib only from python 3.11")
 
         with open(os.path.join(REPO, "pyproject.toml"), "rb") as f:
             meta = tomllib.load(f)

@@ -1,0 +1,121 @@
+"""KV-cache-aware Llama forward: cached single-token decode must reproduce
+the full-sequence forward (the ISSUE satellite for models/llama.py), and
+the inference Config error-path satellite."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+from paddle_tpu.models.llama import apply_rope, apply_rope_at
+from paddle_tpu.nn.layer import functional_call, functional_state
+from paddle_tpu.serving import DenseKVCache
+
+
+def _model(**kw):
+    paddle_tpu.seed(0)
+    cfg = llama_tiny(vocab=97, hidden=32, layers=3, heads=4, kv_heads=2,
+                     inter=64, seq=64, **kw)
+    return LlamaForCausalLM(cfg), cfg
+
+
+class TestCachedDecodeParity:
+    def test_cached_single_token_decode_matches_full_forward(self):
+        model, cfg = _model()
+        params, buffers = functional_state(model)
+        rng = np.random.RandomState(0)
+        x = rng.randint(0, 97, (1, 13)).astype(np.int64)
+
+        full, _ = functional_call(model, params, buffers, jnp.asarray(x),
+                                  training=False)
+
+        cache = DenseKVCache(cfg.num_hidden_layers)
+        pre, _ = functional_call(model, params, buffers,
+                                 jnp.asarray(x[:, :1]), cache=cache,
+                                 training=False)
+        np.testing.assert_allclose(np.asarray(pre[:, 0]),
+                                   np.asarray(full[:, 0]), atol=1e-5)
+        # feed the remaining tokens one at a time through the cache
+        for t in range(1, x.shape[1]):
+            step, _ = functional_call(
+                model, params, buffers, jnp.asarray(x[:, t:t + 1]),
+                cache=cache,
+                positions=jnp.asarray([[t]], jnp.int32), training=False)
+            np.testing.assert_allclose(np.asarray(step[:, 0]),
+                                       np.asarray(full[:, t]), atol=1e-5)
+        assert cache.seq_len == x.shape[1]
+
+    def test_chunked_prefill_then_decode(self):
+        """Prefix in one cache call, suffix token-by-token — same logits."""
+        model, cfg = _model()
+        params, buffers = functional_state(model)
+        rng = np.random.RandomState(1)
+        x = rng.randint(0, 97, (2, 10)).astype(np.int64)
+        full, _ = functional_call(model, params, buffers, jnp.asarray(x),
+                                  training=False)
+        cache = DenseKVCache(cfg.num_hidden_layers)
+        pre, _ = functional_call(model, params, buffers,
+                                 jnp.asarray(x[:, :7]), cache=cache,
+                                 training=False)
+        np.testing.assert_allclose(np.asarray(pre), np.asarray(full[:, :7]),
+                                   atol=1e-5)
+        step, _ = functional_call(
+            model, params, buffers, jnp.asarray(x[:, 7:]), cache=cache,
+            positions=jnp.asarray([[7, 8, 9]] * 2, jnp.int32),
+            training=False)
+        np.testing.assert_allclose(np.asarray(step), np.asarray(full[:, 7:]),
+                                   atol=1e-5)
+
+    def test_eager_tensor_path_also_works(self):
+        """The cache hook must work on the eager Tensor surface too (it
+        routes through no_grad internally)."""
+        model, cfg = _model()
+        rng = np.random.RandomState(2)
+        x = rng.randint(0, 97, (1, 6)).astype(np.int64)
+        full = model(paddle_tpu.to_tensor(x))
+        cache = DenseKVCache(cfg.num_hidden_layers)
+        out = model(paddle_tpu.to_tensor(x), cache=cache)
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   np.asarray(full.numpy()), atol=1e-5)
+
+    def test_rope_at_positions_matches_slice(self):
+        rng = np.random.RandomState(3)
+        x = jnp.asarray(rng.randn(1, 5, 2, 8).astype(np.float32))
+        model, cfg = _model()
+        cos = np.asarray(model.rope_cos.numpy())
+        sin = np.asarray(model.rope_sin.numpy())
+        whole = apply_rope(x, jnp.asarray(cos), jnp.asarray(sin))
+        at = apply_rope_at(x, jnp.asarray(cos), jnp.asarray(sin),
+                           jnp.arange(5, dtype=jnp.int32))
+        np.testing.assert_allclose(np.asarray(at), np.asarray(whole),
+                                   atol=1e-6)
+        # offset positions pick the shifted table rows
+        shifted = apply_rope_at(x, jnp.asarray(cos), jnp.asarray(sin),
+                                jnp.arange(3, 8, dtype=jnp.int32))
+        ref = apply_rope(
+            jnp.concatenate([jnp.zeros_like(x)[:, :3], x], axis=1),
+            jnp.asarray(cos), jnp.asarray(sin))[:, 3:]
+        np.testing.assert_allclose(np.asarray(shifted), np.asarray(ref),
+                                   atol=1e-6)
+
+
+class TestInferenceConfigErrors:
+    def test_empty_config_names_the_missing_pair(self):
+        from paddle_tpu import inference
+
+        with pytest.raises(ValueError) as ei:
+            inference.create_predictor(inference.Config())
+        msg = str(ei.value)
+        assert ".pdmodel" in msg and ".pdiparams" in msg
+        assert "set_prog_file" in msg
+
+    def test_nonexistent_files_named_in_error(self, tmp_path):
+        from paddle_tpu import inference
+
+        cfg = inference.Config(str(tmp_path / "nope.pdmodel"))
+        with pytest.raises(FileNotFoundError) as ei:
+            inference.create_predictor(cfg)
+        msg = str(ei.value)
+        assert str(tmp_path / "nope.pdmodel") in msg
+        assert str(tmp_path / "nope.pdiparams") in msg

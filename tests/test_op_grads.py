@@ -664,6 +664,7 @@ def _collect():
 class TestOpGradGate:
     """The live gate: every probed op's tape gradient must match FD."""
 
+    @pytest.mark.slow  # compile-heavy: keeps tier-1 inside its wall-clock budget
     def test_gradients_match_finite_differences(self):
         checked, unprobed = _collect()
         failures = []

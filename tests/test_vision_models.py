@@ -39,6 +39,7 @@ class TestVisionModels:
         wide = MobileNetV2(scale=1.4)
         assert _param_count(wide) > _param_count(MobileNetV2())
 
+    @pytest.mark.slow  # compile-heavy: keeps tier-1 inside its wall-clock budget
     def test_mobilenetv2_trains_a_step(self):
         paddle.seed(1)
         net = mobilenet_v2(scale=0.35, num_classes=4)
@@ -59,6 +60,7 @@ class TestVisionModels:
 
 
 class TestDenseSqueeze:
+    @pytest.mark.slow  # compile-heavy: keeps tier-1 inside its wall-clock budget
     def test_densenet121_params_and_forward(self):
         from paddle_tpu.vision.models import densenet121
 
@@ -97,6 +99,7 @@ class TestVisionZooRound5:
         x = paddle.to_tensor(np.random.rand(2, 3, 64, 64).astype(np.float32))
         assert net(x).shape == [2, 5]
 
+    @pytest.mark.slow  # compile-heavy: keeps tier-1 inside its wall-clock budget
     def test_mobilenet_v3_small_large(self):
         from paddle_tpu.vision.models import (
             MobileNetV3Large, MobileNetV3Small, mobilenet_v3_small)
