@@ -184,7 +184,16 @@ def main():
     ap.add_argument("--layers", type=int, default=None)
     ap.add_argument("--rung", type=str, default=None,
                     help="(internal) probe one rung in this process")
-    args = ap.parse_args()
+    ap.add_argument("--chaos", action="store_true",
+                    help="opt-in: run the serving chaos sweep "
+                         "(tools/chaos_run.py fault-plan battery) instead "
+                         "of the training bench")
+    args, chaos_argv = ap.parse_known_args()
+    if args.chaos:
+        from tools.chaos_run import main as chaos_main
+        return chaos_main(chaos_argv)
+    if chaos_argv:
+        ap.error(f"unrecognized arguments: {' '.join(chaos_argv)}")
     if args.rung:
         return _rung_worker(json.loads(args.rung))
 

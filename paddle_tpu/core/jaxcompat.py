@@ -17,7 +17,8 @@ from __future__ import annotations
 
 import jax
 
-__all__ = ["shard_map", "typeof", "shape_dtype_struct"]
+__all__ = ["shard_map", "typeof", "shape_dtype_struct",
+           "supports_partial_manual"]
 
 _HAS_NATIVE_SHARD_MAP = hasattr(jax, "shard_map")
 _HAS_TYPEOF = hasattr(jax, "typeof")
@@ -47,6 +48,16 @@ def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
     # and the callers' vma annotations (_pvary) are no-ops here anyway
     return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                check_rep=False, auto=auto)
+
+
+def supports_partial_manual() -> bool:
+    """Whether this jax can run partial-manual shard_map regions (some mesh
+    axes manual, the rest auto/GSPMD). The old experimental shard_map's
+    ``auto=`` path raises NotImplementedError for several collectives and
+    lowers ``axis_index`` to a PartitionId instruction that XLA's SPMD
+    partitioner rejects; native ``jax.shard_map`` (with ``axis_names``)
+    handles both. Tests that need partial-manual gate on this."""
+    return _HAS_NATIVE_SHARD_MAP
 
 
 def typeof(x):

@@ -34,6 +34,8 @@ from .auto_parallel import (  # noqa: F401
 )
 from .auto_tuner import AutoTuner  # noqa: F401
 from .checkpoint import (  # noqa: F401
+    Checkpoint,
+    CheckpointCorrupt,
     DistributedSaver,
     load_distributed_checkpoint,
     save_distributed_checkpoint,
@@ -81,7 +83,8 @@ __all__ = [
     "ppermute", "new_group", "shard_to_group", "unshard",
     "DistributedStrategy", "HybridCommunicateGroup", "build_mesh", "P",
     "DistributedEngine", "fleet", "collective",
-    "DistributedSaver", "save_distributed_checkpoint", "load_distributed_checkpoint",
+    "DistributedSaver", "Checkpoint", "CheckpointCorrupt",
+    "save_distributed_checkpoint", "load_distributed_checkpoint",
     "ProcessMesh", "Shard", "Replicate", "Partial", "shard_tensor", "reshard",
     "shard_layer", "dtensor_from_fn", "AutoTuner", "TCPStore",
     "Engine", "CostModel", "ModelSpec", "ClusterSpec",

@@ -14,32 +14,128 @@ files and ``jax.device_put``s them onto the *current* mesh's shardings —
 reshard-on-load is just a different device_put, no converter pass needed.
 Async mode hands the (already device_get) shards to a writer thread so the
 training loop never blocks on disk (the orbax async-checkpoint idea).
+
+Durability (docs/ROBUSTNESS.md): a checkpoint is only *real* if a kill at
+any byte offset of the write leaves either the previous snapshot or the new
+one — never a torn directory that loads garbage. Writes therefore go to a
+temp directory and are published with one atomic rename, a per-rank
+``manifest.N.json`` (written last) records a CRC32 per file so
+truncation/corruption is detectable, and :class:`Checkpoint` keeps N
+snapshots under one root with a
+``load()`` that walks newest-to-oldest, validates each, and falls back to
+the last good one — reporting exactly what was skipped and why. Chaos sites
+``ckpt.shard`` / ``ckpt.meta`` let ``tests/test_chaos.py`` kill the writer
+between files and prove the recovery path.
 """
 from __future__ import annotations
 
 import json
 import os
 import pickle
+import shutil
 import threading
+import zlib
 
 import numpy as np
 
 import jax
 
-__all__ = ["DistributedSaver", "save_distributed_checkpoint",
-           "load_distributed_checkpoint"]
+from ..utils import faults
+
+__all__ = ["DistributedSaver", "Checkpoint", "CheckpointCorrupt",
+           "save_distributed_checkpoint", "load_distributed_checkpoint"]
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A snapshot failed validation (missing files, checksum mismatch)."""
 
 # One in-flight async write per checkpoint directory, across saver instances
 # (engine.save_checkpoint creates a fresh saver per call).
 _PENDING_WRITES: dict[str, threading.Thread] = {}
+_PENDING_ERRORS: dict[str, BaseException] = {}
 _PENDING_LOCK = threading.Lock()
 
 
-def _wait_path(path):
+def _wait_path(path, reraise=False):
+    key = os.path.abspath(path)
     with _PENDING_LOCK:
-        t = _PENDING_WRITES.pop(os.path.abspath(path), None)
+        t = _PENDING_WRITES.pop(key, None)
     if t is not None:
         t.join()
+    with _PENDING_LOCK:
+        err = _PENDING_ERRORS.pop(key, None)
+    if err is not None and reraise:
+        raise RuntimeError(
+            f"async checkpoint write to '{path}' failed; the snapshot was "
+            f"NOT committed") from err
+
+
+def _crc32_file(fp: str) -> int:
+    crc = 0
+    with open(fp, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            crc = zlib.crc32(chunk, crc)
+    return crc
+
+
+def _atomic_write(fp: str, write_fn):
+    """Write via side file + rename: readers never see a partial file."""
+    tmp = fp + ".tmp"
+    with open(tmp, "wb") as f:
+        write_fn(f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, fp)
+
+
+def _manifest_name(rank: int) -> str:
+    return f"manifest.{rank}.json"
+
+
+def validate_checkpoint(path: str) -> list[str]:
+    """Best-effort integrity check of one checkpoint directory. Returns a
+    list of problems (empty = good). Checks: meta.json parses, and every
+    file listed by every per-rank manifest exists with the recorded
+    CRC32/size. Checkpoints predating manifests get a named problem (not a
+    crash) so fallback logic can skip them deliberately."""
+    problems = []
+    meta_fp = os.path.join(path, "meta.json")
+    if not os.path.isdir(path):
+        return [f"not a directory: {path}"]
+    try:
+        with open(meta_fp) as f:
+            json.load(f)
+    except FileNotFoundError:
+        problems.append("meta.json missing (torn or foreign directory)")
+        return problems
+    except (json.JSONDecodeError, OSError) as e:
+        problems.append(f"meta.json unreadable: {e}")
+        return problems
+    manifests = [fn for fn in os.listdir(path)
+                 if fn.startswith("manifest.") and fn.endswith(".json")]
+    if not manifests:
+        problems.append("no manifest.*.json (pre-manifest or torn write)")
+        return problems
+    for mf in sorted(manifests):
+        try:
+            with open(os.path.join(path, mf)) as f:
+                entries = json.load(f)["files"]
+        except (json.JSONDecodeError, OSError, KeyError) as e:
+            problems.append(f"{mf} unreadable: {e}")
+            continue
+        for fn, want in entries.items():
+            fp = os.path.join(path, fn)
+            if not os.path.exists(fp):
+                problems.append(f"{fn} listed in {mf} but missing")
+                continue
+            if os.path.getsize(fp) != want["size"]:
+                problems.append(
+                    f"{fn}: size {os.path.getsize(fp)} != recorded "
+                    f"{want['size']} (truncated write)")
+                continue
+            if _crc32_file(fp) != want["crc32"]:
+                problems.append(f"{fn}: CRC32 mismatch (corrupt)")
+    return problems
 
 
 def _spec_to_json(spec):
@@ -159,33 +255,72 @@ class DistributedSaver:
             for key, index, data in _shards_of(jarr):
                 shard_blobs.setdefault(name, {})[key] = data
 
-        _wait_path(path)  # one in-flight async write per directory
-        os.makedirs(path, exist_ok=True)
+        _wait_path(path, reraise=True)  # one in-flight async write per dir
+        final = os.path.abspath(path)
 
         def _write():
             rank = jax.process_index()
-            with open(os.path.join(path, f"shards.{rank}.pkl"), "wb") as f:
-                pickle.dump(shard_blobs, f, protocol=4)
-            if rank == 0:
-                with open(os.path.join(path, "meta.json"), "w") as f:
-                    json.dump(meta, f, indent=1)
-                with open(os.path.join(path, "extra.pkl"), "wb") as f:
-                    pickle.dump(extra or {}, f, protocol=4)
+            # stage everything in a temp dir, publish with ONE rename: a
+            # kill at any point leaves either no snapshot or a whole one.
+            # Multi-host ranks > 0 land their files into the (already
+            # published) directory with per-file atomic renames instead.
+            fresh = rank == 0 and not os.path.exists(final)
+            stage = final + f".tmp-{os.getpid()}" if fresh else final
+            os.makedirs(stage, exist_ok=True)
+            written = {}
+
+            def put(name, write_fn):
+                fp = os.path.join(stage, name)
+                _atomic_write(fp, write_fn)
+                written[name] = {"crc32": _crc32_file(fp),
+                                 "size": os.path.getsize(fp)}
+
+            try:
+                faults.inject("ckpt.shard", rank=rank, path=path)
+                put(f"shards.{rank}.pkl",
+                    lambda f: pickle.dump(shard_blobs, f, protocol=4))
+                if rank == 0:
+                    faults.inject("ckpt.meta", rank=rank, path=path)
+                    put("meta.json",
+                        lambda f: f.write(
+                            json.dumps(meta, indent=1).encode()))
+                    put("extra.pkl",
+                        lambda f: pickle.dump(extra or {}, f, protocol=4))
+                # manifest LAST: its presence certifies the files above
+                put(_manifest_name(rank),
+                    lambda f: f.write(json.dumps(
+                        {"files": dict(written)}, indent=1).encode()))
+                if fresh:
+                    os.replace(stage, final)
+            except BaseException:
+                if fresh:
+                    shutil.rmtree(stage, ignore_errors=True)
+                raise
 
         if async_save:
             # non-daemon: interpreter exit waits for the write, so a crash-free
             # shutdown can't truncate the checkpoint
-            t = threading.Thread(target=_write, daemon=False)
+
+            def _write_logged():
+                try:
+                    _write()
+                except BaseException as e:   # surfaced by wait()/_wait_path
+                    with _PENDING_LOCK:
+                        _PENDING_ERRORS[final] = e
+
+            t = threading.Thread(target=_write_logged, daemon=False)
             with _PENDING_LOCK:
-                _PENDING_WRITES[os.path.abspath(path)] = t
-            self._pending = (os.path.abspath(path), t)
+                _PENDING_WRITES[final] = t
+            self._pending = (final, t)
             t.start()
         else:
             _write()
 
     def wait(self):
+        """Join an in-flight async save; re-raises its failure (a crashed
+        writer must not be mistaken for a committed checkpoint)."""
         if self._pending is not None:
-            _wait_path(self._pending[0])
+            _wait_path(self._pending[0], reraise=True)
             self._pending = None
 
     # -- load -----------------------------------------------------------
@@ -196,7 +331,15 @@ class DistributedSaver:
 
         Returns (state_tree, extra).
         """
-        _wait_path(path)  # don't read a directory still being written
+        _wait_path(path, reraise=True)  # not a dir still being written
+        problems = validate_checkpoint(path)
+        # legacy dirs (pre-manifest) load as before; actual corruption
+        # (bad CRC, truncation, missing listed files) is refused loudly
+        problems = [p for p in problems if not p.startswith("no manifest")]
+        if problems:
+            raise CheckpointCorrupt(
+                f"checkpoint '{path}' failed validation: "
+                + "; ".join(problems))
         with open(os.path.join(path, "meta.json")) as f:
             meta = json.load(f)
         extra_path = os.path.join(path, "extra.pkl")
@@ -285,6 +428,110 @@ class DistributedSaver:
         eng._step_count = int(extra.get("step_count", eng._step_count))
         if eng.optimizer is not None and "optimizer_step_count" in extra:
             eng.optimizer._step_count = int(extra["optimizer_step_count"])
+
+
+class Checkpoint:
+    """Snapshot manager: numbered checkpoints under one root, atomic save,
+    and a load that auto-falls back to the last *good* snapshot.
+
+    ::
+
+        ckpt = Checkpoint(root, keep=3)
+        ckpt.save(state)                  # root/step-00000001 (atomic)
+        state, extra = ckpt.load()        # newest snapshot that validates
+        ckpt.last_load_report             # what was skipped, and why
+
+    ``save`` goes through :class:`DistributedSaver` (temp-dir + rename +
+    manifest), so a writer killed mid-snapshot leaves an unpublished temp
+    dir or a manifest-less tear — either way ``load`` skips it, loads the
+    previous snapshot, and records the skip in ``last_load_report``.
+    """
+
+    PREFIX = "step-"
+
+    def __init__(self, root: str, keep: int = 3, engine=None):
+        self.root = os.path.abspath(root)
+        self.keep = int(keep)
+        self.engine = engine
+        self.last_load_report: dict | None = None
+
+    # -- snapshot enumeration -------------------------------------------
+    def snapshots(self) -> list[tuple[int, str]]:
+        """[(step, path)] sorted oldest -> newest; ignores temp/foreign
+        entries."""
+        if not os.path.isdir(self.root):
+            return []
+        out = []
+        for name in os.listdir(self.root):
+            if not name.startswith(self.PREFIX) or ".tmp" in name:
+                continue
+            try:
+                step = int(name[len(self.PREFIX):])
+            except ValueError:
+                continue
+            out.append((step, os.path.join(self.root, name)))
+        return sorted(out)
+
+    def _path_for(self, step: int) -> str:
+        return os.path.join(self.root, f"{self.PREFIX}{step:08d}")
+
+    # -- save ------------------------------------------------------------
+    def save(self, state=None, specs=None, extra=None, step=None,
+             async_save=False) -> str:
+        """Write the next snapshot; returns its directory. Retention
+        applies after a successful publish (never before: a failed save
+        must not eat the snapshots that would save us)."""
+        if step is None:
+            snaps = self.snapshots()
+            step = (snaps[-1][0] + 1) if snaps else 1
+        os.makedirs(self.root, exist_ok=True)
+        path = self._path_for(step)
+        saver = DistributedSaver(self.engine)
+        saver.save(path, state=state, specs=specs, extra=extra,
+                   async_save=async_save)
+        if async_save:
+            self._saver = saver  # caller may .wait(); retention then
+        else:
+            self._retire()
+        return path
+
+    def wait(self):
+        saver = getattr(self, "_saver", None)
+        if saver is not None:
+            saver.wait()
+            self._retire()
+            self._saver = None
+
+    def _retire(self):
+        snaps = self.snapshots()
+        for _, path in snaps[:max(0, len(snaps) - self.keep)]:
+            shutil.rmtree(path, ignore_errors=True)
+
+    # -- load ------------------------------------------------------------
+    def load(self, mesh=None, specs=None):
+        """Load the newest snapshot that passes validation, walking back
+        through history past torn/corrupt ones. Returns (state, extra);
+        ``last_load_report`` records {"loaded": path, "skipped":
+        [(path, reason), ...]}. Raises CheckpointCorrupt when no snapshot
+        survives."""
+        skipped: list[tuple[str, str]] = []
+        for step, path in reversed(self.snapshots()):
+            problems = validate_checkpoint(path)
+            if problems:
+                skipped.append((path, "; ".join(problems)))
+                continue
+            try:
+                saver = DistributedSaver(self.engine)
+                state, extra = saver.load(path, mesh=mesh, specs=specs)
+            except Exception as e:  # unreadable despite manifest: skip too
+                skipped.append((path, f"load failed: {e}"))
+                continue
+            self.last_load_report = {"loaded": path, "skipped": skipped}
+            return state, extra
+        self.last_load_report = {"loaded": None, "skipped": skipped}
+        detail = "; ".join(f"{p}: {r}" for p, r in skipped) or "none found"
+        raise CheckpointCorrupt(
+            f"no loadable checkpoint under '{self.root}' — {detail}")
 
 
 def save_distributed_checkpoint(engine, path, async_save=False):

@@ -17,6 +17,7 @@ arrays for tests.
 from __future__ import annotations
 
 import functools
+import threading
 
 import numpy as np
 
@@ -26,13 +27,23 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..core.jaxcompat import shard_map as _shard_map
 
 from ..core.tensor import Tensor
+from ..framework.flags import flag_value
+from ..utils import faults
 from .mesh import HybridCommunicateGroup, get_hybrid_communicate_group
 
 __all__ = [
     "ReduceOp", "all_reduce", "all_gather", "reduce_scatter", "broadcast",
     "all_to_all", "alltoall", "reduce", "scatter", "barrier", "send", "recv",
     "ppermute", "shard_to_group", "unshard", "new_group", "get_group",
+    "CollectiveTimeoutError",
 ]
+
+
+class CollectiveTimeoutError(TimeoutError):
+    """A guarded collective did not complete within
+    ``FLAGS_collective_timeout_s``; the message names the op, the group
+    axis, its size, and this process's rank — the first thing an operator
+    needs when one host of a pod wedges."""
 
 
 class ReduceOp:
@@ -124,7 +135,15 @@ def unshard(t):
     return np.asarray(jax.device_get(_v(t)))
 
 
-def _shard_mapped(g: Group, fn, *arrays, in_specs=None, out_specs=None):
+def _rank_of(g: Group) -> int:
+    try:
+        return int(g.hcg._coord(g.axis))
+    except Exception:
+        return int(jax.process_index())
+
+
+def _shard_mapped(g: Group, fn, *arrays, in_specs=None, out_specs=None,
+                  op="collective"):
     mesh = g.hcg.mesh
     in_specs = in_specs if in_specs is not None else tuple(
         _axis_spec(a.ndim, g.axis) for a in arrays)
@@ -133,7 +152,49 @@ def _shard_mapped(g: Group, fn, *arrays, in_specs=None, out_specs=None):
         fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
         check_vma=False,
     )
-    return mapped(*arrays)
+
+    def invoke():
+        # chaos site inside the guarded region, so injected delays/errors
+        # exercise the watchdog exactly like a wedged transport would
+        faults.inject(f"collective.{op}", axis=g.axis)
+        return mapped(*arrays)
+
+    timeout = float(flag_value("FLAGS_collective_timeout_s") or 0.0)
+    if timeout <= 0:
+        return invoke()
+    return _guard_timeout(invoke, op, g, timeout)
+
+
+def _guard_timeout(invoke, op: str, g: Group, timeout: float):
+    """Run the collective on a worker thread and bound the wait. A stuck
+    collective (one rank dead, ICI wedge) otherwise hangs the host forever
+    with no attribution; here it becomes a CollectiveTimeoutError naming
+    op/group/rank. The worker thread cannot be killed — the caller is
+    expected to tear the process down (elastic restart), not resume."""
+    result: list = [None]
+    error: list = [None]
+    done = threading.Event()
+
+    def target():
+        try:
+            result[0] = invoke()
+        except BaseException as e:  # surfaced on the caller thread
+            error[0] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=target, daemon=True,
+                         name=f"collective-{op}")
+    t.start()
+    if not done.wait(timeout):
+        raise CollectiveTimeoutError(
+            f"collective '{op}' over group axis '{g.axis}' "
+            f"(nranks={g.nranks}, rank={_rank_of(g)}) did not complete "
+            f"within {timeout}s — a peer is stuck or the interconnect is "
+            f"wedged; the in-flight call cannot be cancelled")
+    if error[0] is not None:
+        raise error[0]
+    return result[0]
 
 
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
@@ -146,7 +207,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
         ReduceOp.AVG: jax.lax.pmean,
         ReduceOp.PROD: lambda x, n: jnp.exp(jax.lax.psum(jnp.log(x), n)),
     }[op]
-    out = _shard_mapped(g, lambda x: red(x, g.axis), arr)
+    out = _shard_mapped(g, lambda x: red(x, g.axis), arr, op="all_reduce")
     return _wrap_like(out, tensor)
 
 
@@ -165,7 +226,8 @@ def all_gather(tensor_list, tensor=None, group=None, sync_op=True, axis=0):
     spec_in = _axis_spec(arr.ndim, g.axis)
     # every rank holds the identical gathered stack -> replicated out spec
     out_spec = P(*([None] * (arr.ndim + 1)))
-    out = _shard_mapped(g, body, arr, in_specs=(spec_in,), out_specs=out_spec)
+    out = _shard_mapped(g, body, arr, in_specs=(spec_in,), out_specs=out_spec,
+                        op="all_gather")
     # out: [n, *local_shape] along leading axis
     got = jax.device_get(out)
     shards = [Tensor._wrap(jnp.asarray(got[i])) for i in range(n)]
@@ -182,7 +244,7 @@ def reduce_scatter(tensor, tensor_or_op=None, op=ReduceOp.SUM, group=None, sync_
     def body(x):
         return jax.lax.psum_scatter(x, g.axis, scatter_dimension=0, tiled=True)
 
-    out = _shard_mapped(g, body, arr)
+    out = _shard_mapped(g, body, arr, op="reduce_scatter")
     return _wrap_like(out, tensor)
 
 
@@ -196,7 +258,7 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
         full = jax.lax.all_gather(x, g.axis, axis=0, tiled=False)
         return full[src]
 
-    out = _shard_mapped(g, body, arr)
+    out = _shard_mapped(g, body, arr, op="broadcast")
     return _wrap_like(out, tensor)
 
 
@@ -219,7 +281,7 @@ def all_to_all(out_tensor_list, in_tensor_list=None, group=None, sync_op=True):
             swapped = jax.lax.all_to_all(xs, g.axis, 0, 0, tiled=False)
             return swapped.reshape(-1, *x.shape[1:])
 
-        out = _shard_mapped(g, body, arr)
+        out = _shard_mapped(g, body, arr, op="all_to_all")
         return Tensor._wrap(out)
     n = g.nranks
     if len(in_tensor_list) != n:
@@ -259,7 +321,7 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
             me = jax.lax.axis_index(g.axis)
             return jnp.where(me == dst, red, x)
 
-    return _wrap_like(_shard_mapped(g, body, arr), tensor)
+    return _wrap_like(_shard_mapped(g, body, arr, op="reduce"), tensor)
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
@@ -309,7 +371,7 @@ def ppermute(tensor, perm, group=None):
     def body(x):
         return jax.lax.ppermute(x, g.axis, perm)
 
-    out = _shard_mapped(g, body, arr)
+    out = _shard_mapped(g, body, arr, op="ppermute")
     return Tensor._wrap(out)
 
 

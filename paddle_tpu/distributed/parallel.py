@@ -14,7 +14,8 @@ import os
 import jax
 
 from ..nn.layer import Layer
-from .mesh import HybridCommunicateGroup, set_hybrid_communicate_group
+from .mesh import (HybridCommunicateGroup, get_hybrid_communicate_group,
+                   set_hybrid_communicate_group)
 from .strategy import DistributedStrategy
 
 __all__ = [
@@ -29,6 +30,19 @@ def init_parallel_env(strategy: DistributedStrategy | None = None):
     (driven by launch CLI env); single-host: build the mesh over local devices."""
     global _initialized
     if _initialized:
+        # the process-level bootstrap (jax.distributed.initialize) must run
+        # once, but a torn-down mesh (tests call
+        # set_hybrid_communicate_group(None) between modules) must be
+        # rebuilt — otherwise every later collective fails "call
+        # init_parallel_env first" even though the caller just did
+        if get_hybrid_communicate_group() is not None:
+            return ParallelEnv()
+        if strategy is None:
+            strategy = DistributedStrategy()
+            from .mesh import _device_pool
+
+            strategy.hybrid_configs.dp_degree = len(_device_pool(2))
+        set_hybrid_communicate_group(HybridCommunicateGroup(strategy))
         return ParallelEnv()
     coord = os.environ.get("PADDLE_TPU_COORDINATOR")
     nproc = int(os.environ.get("PADDLE_TPU_NUM_PROCESSES", "1"))

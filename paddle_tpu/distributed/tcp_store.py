@@ -5,20 +5,37 @@ hosts the table, workers set/get/add/wait to bootstrap and heartbeat).
 On TPU pods jax's own coordination service does job bootstrap; this store
 covers the remaining reference capabilities: barrier-style counters for the
 launch CLI, health heartbeats for elastic restart, and user-level rendezvous.
+
+Robustness (docs/ROBUSTNESS.md): rendezvous runs while the cluster is still
+assembling — the master may not be up yet, and transient resets are normal
+during elastic restarts. Connect and the request verbs therefore retry with
+exponential backoff (``retries`` / ``backoff_s``), and every terminal error
+names the endpoint, the key, and how long was spent, so a timeout reads as
+"could not reach 10.0.0.2:8765 after 4 attempts over 3.1s" instead of a
+bare errno. Chaos sites ``store.connect`` / ``store.get`` / ``store.set`` /
+``store.add`` / ``store.wait`` let ``paddle_tpu.utils.faults`` exercise the
+retry paths deterministically.
 """
 from __future__ import annotations
 
 import ctypes
 import threading
+import time
 
 from ..core import native
+from ..utils import faults
 
-__all__ = ["TCPStore"]
+__all__ = ["TCPStore", "StoreTimeout"]
+
+
+class StoreTimeout(TimeoutError):
+    """A store operation exhausted its retries; the message names the
+    endpoint, operation, attempts, and elapsed time."""
 
 
 class TCPStore:
     def __init__(self, host="127.0.0.1", port=0, is_master=False,
-                 timeout=30.0):
+                 timeout=30.0, retries=4, backoff_s=0.05):
         lib = native.load()
         if lib is None:
             raise RuntimeError(
@@ -27,6 +44,9 @@ class TCPStore:
         self._lib = lib
         self._server = None
         self.host = host
+        self.retries = max(1, int(retries))
+        self.backoff_s = float(backoff_s)
+        self.num_retries = 0        # total extra attempts across all verbs
         if is_master:
             self._server = lib.ts_server_start(int(port))
             if not self._server:
@@ -34,57 +54,116 @@ class TCPStore:
             self.port = lib.ts_server_port(self._server)
         else:
             self.port = int(port)
-        self._fd = lib.ts_connect(host.encode(), self.port,
-                                  int(timeout * 1000))
-        if self._fd < 0:
-            raise TimeoutError(
-                f"TCPStore could not reach {host}:{self.port}")
+        self._fd = self._connect_with_retry(timeout)
         # ctypes releases the GIL: one in-flight request per connection, or
         # interleaved partial writes corrupt the wire protocol (heartbeat
         # threads share the store with the main thread)
         self._io_lock = threading.Lock()
 
+    # -- retry machinery ---------------------------------------------------
+    def _connect_with_retry(self, timeout: float) -> int:
+        """Dial the master, retrying with exponential backoff: during
+        elastic bring-up the workers race the master's bind. The per-attempt
+        budget splits ``timeout`` so total wall time stays bounded."""
+        deadline = time.monotonic() + float(timeout)
+        per_attempt_ms = max(1, int(timeout * 1000 / self.retries))
+        t0 = time.monotonic()
+        for attempt in range(self.retries):
+            faults.inject("store.connect", host=self.host, port=self.port,
+                          attempt=attempt)
+            fd = self._lib.ts_connect(self.host.encode(), self.port,
+                                      per_attempt_ms)
+            if fd >= 0:
+                return fd
+            if attempt + 1 < self.retries:
+                self.num_retries += 1
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                time.sleep(min(self.backoff_s * (2 ** attempt), remaining))
+        raise StoreTimeout(
+            f"TCPStore could not reach {self.host}:{self.port} after "
+            f"{self.retries} connect attempts over "
+            f"{time.monotonic() - t0:.1f}s")
+
+    def _retrying(self, op: str, attempt_fn, key: str | None = None):
+        """Run ``attempt_fn()`` with retry + exponential backoff. The fn
+        returns a value or raises; only RuntimeError/FaultError (transient
+        wire failures) are retried — protocol-level negatives like a missing
+        key are returned, not retried."""
+        t0 = time.monotonic()
+        last = None
+        for attempt in range(self.retries):
+            try:
+                faults.inject(f"store.{op}", key=key, attempt=attempt)
+                return attempt_fn()
+            except (RuntimeError, faults.FaultError) as e:
+                last = e
+                if attempt + 1 < self.retries:
+                    self.num_retries += 1
+                    time.sleep(self.backoff_s * (2 ** attempt))
+        raise StoreTimeout(
+            f"TCPStore {op}({key!r}) against {self.host}:{self.port} failed "
+            f"after {self.retries} attempts over "
+            f"{time.monotonic() - t0:.1f}s: {last}") from last
+
     # -- reference API -----------------------------------------------------
     def set(self, key: str, value):
         v = value if isinstance(value, bytes) else str(value).encode()
         k = key.encode()
-        with self._io_lock:
-            r = self._lib.ts_set(self._fd, k, len(k), v, len(v))
-        if r != 0:
-            raise RuntimeError("TCPStore set failed")
+
+        def attempt():
+            with self._io_lock:
+                r = self._lib.ts_set(self._fd, k, len(k), v, len(v))
+            if r != 0:
+                raise RuntimeError("wire error on set")
+
+        return self._retrying("set", attempt, key)
 
     def get(self, key: str) -> bytes | None:
         k = key.encode()
-        cap = 1 << 20
-        while True:
-            buf = ctypes.create_string_buffer(cap)
-            with self._io_lock:
-                n = self._lib.ts_get(self._fd, k, len(k), buf, cap)
-            if n == -1:
-                return None
-            if n <= -3:
-                cap = -n - 3  # buffer was too small; value drained — retry
-                continue
-            if n < 0:
-                raise RuntimeError("TCPStore get failed")
-            return buf.raw[:n]
+
+        def attempt():
+            cap = 1 << 20
+            while True:
+                buf = ctypes.create_string_buffer(cap)
+                with self._io_lock:
+                    n = self._lib.ts_get(self._fd, k, len(k), buf, cap)
+                if n == -1:
+                    return None          # key absent: a result, not an error
+                if n <= -3:
+                    cap = -n - 3  # buffer was too small; value drained — retry
+                    continue
+                if n < 0:
+                    raise RuntimeError("wire error on get")
+                return buf.raw[:n]
+
+        return self._retrying("get", attempt, key)
 
     def add(self, key: str, amount: int = 1) -> int:
         k = key.encode()
-        with self._io_lock:
-            out = self._lib.ts_add(self._fd, k, len(k), int(amount))
-        if out == -(2 ** 63):
-            raise RuntimeError("TCPStore add failed")
-        return int(out)
+
+        def attempt():
+            with self._io_lock:
+                out = self._lib.ts_add(self._fd, k, len(k), int(amount))
+            if out == -(2 ** 63):
+                raise RuntimeError("wire error on add")
+            return int(out)
+
+        return self._retrying("add", attempt, key)
 
     def wait(self, key: str, timeout=None) -> bool:
         k = key.encode()
         ms = -1 if timeout is None else int(timeout * 1000)
-        with self._io_lock:
-            r = self._lib.ts_wait(self._fd, k, len(k), ms)
-        if r < 0:
-            raise RuntimeError("TCPStore wait failed")
-        return bool(r)
+
+        def attempt():
+            with self._io_lock:
+                r = self._lib.ts_wait(self._fd, k, len(k), ms)
+            if r < 0:
+                raise RuntimeError("wire error on wait")
+            return bool(r)
+
+        return self._retrying("wait", attempt, key)
 
     def delete_key(self, key: str) -> bool:
         k = key.encode()
@@ -101,7 +180,10 @@ class TCPStore:
             self.set(f"__barrier/{name}/done/{gen}", b"1")
         ok = self.wait(f"__barrier/{name}/done/{gen}", timeout)
         if not ok:
-            raise TimeoutError(f"barrier '{name}' timed out at {n}/{world_size}")
+            raise StoreTimeout(
+                f"barrier '{name}' timed out after {timeout}s at "
+                f"{n}/{world_size} arrivals (endpoint "
+                f"{self.host}:{self.port})")
 
     def close(self):
         if self._fd >= 0:

@@ -98,3 +98,11 @@ register_flag("FLAGS_cudnn_deterministic", False,
 register_flag("FLAGS_allocator_strategy", "auto_growth",
               "accepted for API parity; XLA's BFC allocator is the "
               "implementation either way")
+register_flag("FLAGS_fault_plan", "",
+              "chaos harness: ';'-separated fault specs "
+              "(site:kind[=arg][@start][xcount][%prob]) armed at every "
+              "paddle_tpu.utils.faults.inject site — see docs/ROBUSTNESS.md")
+register_flag("FLAGS_collective_timeout_s", 0.0,
+              "when > 0, every eager collective runs under a watchdog that "
+              "raises CollectiveTimeoutError naming the op/group/rank if the "
+              "call does not complete in this many seconds")

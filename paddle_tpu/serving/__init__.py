@@ -20,10 +20,20 @@ from .kv_cache import (  # noqa: F401
     PagedCacheView,
     PagedKVCache,
 )
-from .scheduler import Request, RequestState, SamplingParams, Scheduler  # noqa: F401
+from .scheduler import (  # noqa: F401
+    DeadlineExceeded,
+    EngineClosed,
+    PreemptionStorm,
+    QueueFull,
+    Request,
+    RequestState,
+    SamplingParams,
+    Scheduler,
+)
 
 __all__ = [
     "LLMEngine", "naive_generate", "BlockAllocator", "PagedKVCache",
     "PagedCacheView", "DenseKVCache", "Request", "RequestState",
-    "SamplingParams", "Scheduler",
+    "SamplingParams", "Scheduler", "EngineClosed", "QueueFull",
+    "DeadlineExceeded", "PreemptionStorm",
 ]

@@ -17,6 +17,19 @@ Sampling is seeded per (request, output index) — batch composition,
 preemption, and re-prefill cannot change a request's tokens, which is what
 makes continuous batching output-equivalent to one-at-a-time decoding.
 
+Failure containment (docs/ROBUSTNESS.md): every per-request step runs
+inside an isolation boundary — an exception during a request's prefill or
+decode marks *that request* ``FAILED`` with the error attached and returns
+its slot and blocks to the pool; the engine keeps serving everyone else and
+their token streams are unchanged (seeded sampling makes this provable,
+see ``tests/test_chaos.py``). Per-request deadlines and :meth:`cancel`
+bound tail latency; a bounded admission queue pushes back instead of
+buffering without limit; a watchdog counts slow decode steps and a stall
+detector fails the queue head rather than spinning when no progress is
+possible. Chaos sites (``serving.prefill``, ``serving.decode.slot``,
+``serving.decode``, ``serving.kv.alloc``, ``serving.admit``) let
+``paddle_tpu.utils.faults`` drive all of these paths deterministically.
+
 ``naive_generate`` is the uncached baseline (full re-prefill every step)
 used by the parity tests and ``tools/serving_bench.py``.
 """
@@ -31,8 +44,10 @@ import numpy as np
 from ..kernels import active_platform
 from ..nn.decode import sample_logits
 from ..nn.layer import functional_call, functional_state
+from ..utils import faults
 from .kv_cache import PagedCacheView, PagedKVCache
-from .scheduler import Request, RequestState, SamplingParams, Scheduler
+from .scheduler import (DeadlineExceeded, Request, RequestState,
+                        SamplingParams, Scheduler)
 
 __all__ = ["LLMEngine", "naive_generate"]
 
@@ -48,10 +63,20 @@ class LLMEngine:
     max_slots:     decode batch width (concurrent running requests)
     max_model_len: hard cap on prompt + generated tokens per request
     eos_token_id:  optional early-stop token
+    max_queue:     bound on the waiting queue; beyond it ``add_request``
+                   raises ``QueueFull`` (None = unbounded)
+    max_preemptions_per_request: requeue cap before a thrashing request is
+                   failed (preemption-storm protection)
+    watchdog_timeout_s: decode steps slower than this are counted as
+                   watchdog trips in ``stats()`` (None = off)
+    stall_limit:   consecutive no-progress engine steps tolerated before
+                   the queue head is failed instead of spinning forever
     """
 
     def __init__(self, model, *, block_size=16, num_blocks=None, max_slots=4,
-                 max_model_len=None, eos_token_id=None, kv_dtype=None):
+                 max_model_len=None, eos_token_id=None, kv_dtype=None,
+                 max_queue=None, max_preemptions_per_request=16,
+                 watchdog_timeout_s=None, stall_limit=8):
         cfg = model.config
         self.model = model
         self.block_size = int(block_size)
@@ -74,8 +99,10 @@ class LLMEngine:
         self.cache = PagedKVCache(
             cfg.num_hidden_layers, num_blocks, cfg.num_key_value_heads,
             self.block_size, cfg.head_dim, dtype=kv_dtype)
-        self.scheduler = Scheduler(self.cache, self.max_slots,
-                                   self.max_model_len)
+        self.scheduler = Scheduler(
+            self.cache, self.max_slots, self.max_model_len,
+            max_queue=max_queue,
+            max_preemptions_per_request=max_preemptions_per_request)
 
         self._next_rid = 0
         self._decode_fn = None
@@ -85,45 +112,95 @@ class LLMEngine:
         self._donate = (2,) if active_platform() == "tpu" else ()
 
         self.finished: list[Request] = []
+        self.failed: list[Request] = []
+        self.cancelled: list[Request] = []
+        self._failed_rids: set[int] = set()
+        self._requests: dict[int, Request] = {}   # rid -> handle
         self._total_generated = 0
         self._serve_start: float | None = None
+
+        self.watchdog_timeout_s = watchdog_timeout_s
+        self.watchdog_trips = 0
+        self.last_decode_s = 0.0
+        self.stall_limit = int(stall_limit)
+        self._stall_steps = 0
+        self._progressed = False
+        self.closed = False
 
     # ------------------------------------------------------------------
     # public API
     # ------------------------------------------------------------------
     def add_request(self, prompt, sampling: SamplingParams | None = None,
-                    on_token=None) -> Request:
+                    on_token=None, deadline_s: float | None = None) -> Request:
         """Queue a prompt (list/array of token ids); returns the live
         request handle (``output_tokens`` grows as the engine steps;
-        ``on_token(req, tok)`` streams each new token)."""
+        ``on_token(req, tok)`` streams each new token). ``deadline_s``
+        bounds the request's total wall time: past it, the request is
+        CANCELLED with :class:`DeadlineExceeded` attached."""
         req = Request(rid=self._next_rid, prompt=[int(t) for t in prompt],
                       sampling=sampling or SamplingParams(),
                       on_token=on_token)
+        if deadline_s is not None:
+            req.deadline = time.monotonic() + float(deadline_s)
         self._next_rid += 1
-        self.scheduler.add(req)
+        self.scheduler.add(req)           # raises EngineClosed / QueueFull
+        self._requests[req.rid] = req
         return req
 
+    def cancel(self, rid: int, reason: str = "cancelled") -> bool:
+        """Cancel a request by id wherever it is (waiting or running); its
+        blocks and slot return immediately. False if unknown/terminal."""
+        ok = self.scheduler.cancel(rid, reason=reason)
+        if ok:
+            self.cancelled.append(self._requests[rid])
+        return ok
+
+    def close(self):
+        """Shut down: cancel all pending requests (their handles end
+        CANCELLED with reason "shutdown") and reject future add_request
+        calls with ``EngineClosed``."""
+        if self.closed:
+            return
+        self.closed = True
+        self.cancelled.extend(self.scheduler.close(cancel_pending=True))
+
     def step(self) -> bool:
-        """One engine iteration: admit + prefill new requests, then one
-        batched decode step over the running slots. Returns True while
-        there is work left."""
+        """One engine iteration: sweep deadlines, admit + prefill new
+        requests (each inside its own failure boundary), then one batched
+        decode step over the running slots. Returns True while there is
+        work left."""
+        if self.closed:
+            return False
         if self._serve_start is None and self.scheduler.has_work():
             self._serve_start = time.monotonic()
+        had_work = self.scheduler.has_work()
+        self._progressed = False
+        self._sweep_deadlines()
         for slot, req in self.scheduler.admit():
-            self._run_prefill(slot, req)
+            self._progressed = True
+            try:
+                faults.inject("serving.prefill", rid=req.rid)
+                self._run_prefill(slot, req)
+            except Exception as e:          # isolate: fail ONE request
+                self._fail(slot, e)
         if self.scheduler.running:
             self.scheduler.ensure_decode_capacity()
+            self._collect_scheduler_failures()
+        if self.scheduler.running:
             self._run_decode()
+        self._check_stall(had_work)
         return self.scheduler.has_work()
 
     def run(self):
-        """Drive until every queued request has finished."""
+        """Drive until every queued request has reached a terminal state
+        (FINISHED, FAILED, or CANCELLED)."""
         while self.step():
             pass
 
     def generate(self, prompts, sampling=None):
         """Batch convenience: serve all ``prompts`` to completion, return
-        their output token lists in order."""
+        their output token lists in order (partial for failed/cancelled
+        requests — check the handles' ``state``/``error`` for those)."""
         if isinstance(sampling, (SamplingParams, type(None))):
             sampling = [sampling] * len(prompts)
         reqs = [self.add_request(p, s) for p, s in zip(prompts, sampling)]
@@ -139,7 +216,9 @@ class LLMEngine:
             while emitted < len(req.output_tokens):
                 yield req.output_tokens[emitted]
                 emitted += 1
-            if req.state is RequestState.FINISHED:
+            if req.state.is_terminal:
+                if req.state is RequestState.FAILED and req.error:
+                    raise req.error
                 return
             self.step()
 
@@ -152,6 +231,9 @@ class LLMEngine:
             "queue_depth": self.scheduler.queue_depth,
             "num_running": len(self.scheduler.running),
             "num_finished": len(self.finished),
+            "num_failed": len(self.failed),
+            "num_cancelled": len(self.cancelled),
+            "num_rejected": self.scheduler.num_rejected,
             "blocks_used": alloc.num_used,
             "blocks_free": alloc.num_free,
             "block_high_water": alloc.high_water,
@@ -163,7 +245,63 @@ class LLMEngine:
             "tokens_per_sec": (self._total_generated / elapsed
                                if elapsed > 0 else 0.0),
             "mean_ttft": float(np.mean(ttfts)) if ttfts else None,
+            "watchdog_trips": self.watchdog_trips,
+            "last_decode_s": self.last_decode_s,
         }
+
+    # ------------------------------------------------------------------
+    # degradation machinery
+    # ------------------------------------------------------------------
+    def _fail(self, slot: int, error: BaseException):
+        req = self.scheduler.running[slot]
+        self.scheduler.fail(slot, error)
+        self.failed.append(req)
+        self._failed_rids.add(req.rid)
+
+    def _collect_scheduler_failures(self):
+        """Requests the scheduler failed on its own (pool exhaustion,
+        preemption storm) still need to land in ``self.failed``."""
+        for req in self._requests.values():
+            if (req.state is RequestState.FAILED
+                    and req.rid not in self._failed_rids):
+                self.failed.append(req)
+                self._failed_rids.add(req.rid)
+
+    def _sweep_deadlines(self):
+        now = time.monotonic()
+        for req in list(self.scheduler.waiting) + list(
+                self.scheduler.running.values()):
+            if req.past_deadline(now):
+                err = DeadlineExceeded(
+                    f"request {req.rid} missed its deadline "
+                    f"({len(req.output_tokens)} of "
+                    f"{req.sampling.max_new_tokens} tokens generated)")
+                self.scheduler.cancel(req.rid, reason="deadline", error=err)
+                self.cancelled.append(req)
+
+    def _check_stall(self, had_work: bool):
+        """A step that had work but admitted nothing and emitted nothing is
+        a stall (e.g. injected allocator exhaustion keeps the queue head
+        out forever). After ``stall_limit`` consecutive stalls, fail the
+        head instead of spinning."""
+        if not had_work or self._progressed or self.scheduler.running:
+            self._stall_steps = 0
+            return
+        self._stall_steps += 1
+        if self._stall_steps >= self.stall_limit and self.scheduler.waiting:
+            req = self.scheduler.waiting.popleft()
+            req.state = RequestState.FAILED
+            req.finish_time = time.monotonic()
+            req.finish_reason = "stalled"
+            req.error = RuntimeError(
+                f"request {req.rid} failed after {self._stall_steps} engine "
+                f"steps with no progress (blocks free="
+                f"{self.cache.allocator.num_free}) — pool exhausted or "
+                f"allocator faulted")
+            self.scheduler.num_failed += 1
+            self.failed.append(req)
+            self._failed_rids.add(req.rid)
+            self._stall_steps = 0
 
     # ------------------------------------------------------------------
     # prefill
@@ -241,8 +379,17 @@ class LLMEngine:
         return self._decode_fn
 
     def _run_decode(self):
-        S = self.max_slots
+        # per-slot chaos boundary: a fault targeted at one request drops
+        # only that request from the batch (FAILED, error attached)
+        for slot, req in sorted(self.scheduler.running.items()):
+            try:
+                faults.inject("serving.decode.slot", rid=req.rid)
+            except Exception as e:
+                self._fail(slot, e)
         running = dict(self.scheduler.running)  # slot -> req snapshot
+        if not running:
+            return
+        S = self.max_slots
         tokens = np.zeros(S, np.int32)
         ctx = np.ones(S, np.int32)       # inactive: 1 garbage scratch token
         temps = np.zeros(S, np.float32)
@@ -263,11 +410,26 @@ class LLMEngine:
             steps[slot] = len(req.output_tokens)
         bt = self.cache.table_array(sids, self.max_blocks)
 
-        toks, pool = self._get_decode_fn()(
-            self.params, self.buffers, self.cache.pool,
-            jnp.asarray(tokens), jnp.asarray(bt), jnp.asarray(ctx),
-            jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps),
-            jnp.asarray(seeds), jnp.asarray(steps))
+        t0 = time.monotonic()
+        try:
+            faults.inject("serving.decode", batch=len(running))
+            toks, pool = self._get_decode_fn()(
+                self.params, self.buffers, self.cache.pool,
+                jnp.asarray(tokens), jnp.asarray(bt), jnp.asarray(ctx),
+                jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps),
+                jnp.asarray(seeds), jnp.asarray(steps))
+        except Exception as e:
+            # the fused step died: every request in the batch fails, the
+            # engine itself (and the waiting queue) survives
+            for slot in list(running):
+                if slot in self.scheduler.running:
+                    self._fail(slot, e)
+            return
+        finally:
+            self.last_decode_s = time.monotonic() - t0
+            if (self.watchdog_timeout_s is not None
+                    and self.last_decode_s > self.watchdog_timeout_s):
+                self.watchdog_trips += 1
         self.cache.pool = pool
         toks = np.asarray(toks)
         for slot, req in running.items():
@@ -275,6 +437,7 @@ class LLMEngine:
 
     def _emit(self, slot: int, req: Request, token: int):
         req.emit(token)
+        self._progressed = True
         self._total_generated += 1
         if (self.eos_token_id is not None and token == self.eos_token_id):
             self._finish(slot, "stop")

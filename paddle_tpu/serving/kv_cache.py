@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils import faults
+
 __all__ = ["BlockAllocator", "PagedKVCache", "PagedCacheView", "DenseKVCache",
            "SCRATCH_BLOCK"]
 
@@ -70,6 +72,10 @@ class BlockAllocator:
         cannot satisfy the request (caller preempts or queues)."""
         if n < 0:
             raise ValueError(f"alloc({n})")
+        # chaos site: an "exhaust" fault makes the pool look dry for this
+        # call, exercising the caller's preempt/queue/fail path
+        if faults.inject("serving.kv.alloc", n=n) == "exhaust":
+            return None
         if n > len(self._free):
             return None
         out = [self._free.pop() for _ in range(n)]

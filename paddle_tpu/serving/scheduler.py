@@ -14,6 +14,19 @@ generated tokens folded into the prompt, so its re-prefill resumes exactly
 where it left off. Sampling stays deterministic across preemption because
 the engine keys every sampled token by (request seed, output index), not by
 wall-clock step.
+
+Failure semantics (docs/ROBUSTNESS.md): a request can also leave the system
+as ``FAILED`` (an error during its prefill/decode, attached on
+``req.error``) or ``CANCELLED`` (explicit :meth:`Scheduler.cancel`, a missed
+deadline, or engine shutdown). Either way its slot and blocks return to the
+pool and the rest of the batch is untouched — one bad request never takes
+the engine down. The waiting queue is bounded (``max_queue``): beyond it
+:meth:`add` raises :class:`QueueFull` so callers see backpressure instead
+of unbounded memory growth, and ``num_rejected`` counts the pushback. A
+request preempted more than ``max_preemptions_per_request`` times is failed
+rather than requeued (preemption-storm protection: a pool thrashing under
+pressure must converge, not livelock). After :meth:`close`, :meth:`add`
+raises :class:`EngineClosed` instead of silently dropping the request.
 """
 from __future__ import annotations
 
@@ -22,9 +35,29 @@ import time
 from collections import deque
 from dataclasses import dataclass, field
 
+from ..utils import faults
 from .kv_cache import PagedKVCache
 
-__all__ = ["SamplingParams", "Request", "RequestState", "Scheduler"]
+__all__ = ["SamplingParams", "Request", "RequestState", "Scheduler",
+           "EngineClosed", "QueueFull", "DeadlineExceeded",
+           "PreemptionStorm"]
+
+
+class EngineClosed(RuntimeError):
+    """add() after shutdown — the request would otherwise vanish silently."""
+
+
+class QueueFull(RuntimeError):
+    """Bounded admission queue rejected the request (backpressure)."""
+
+
+class DeadlineExceeded(TimeoutError):
+    """The request's per-request deadline passed before it finished."""
+
+
+class PreemptionStorm(RuntimeError):
+    """Requeued more than max_preemptions_per_request times; failing the
+    request instead of livelocking the pool."""
 
 
 @dataclass
@@ -43,6 +76,13 @@ class RequestState(enum.Enum):
     WAITING = "waiting"
     RUNNING = "running"
     FINISHED = "finished"
+    FAILED = "failed"
+    CANCELLED = "cancelled"
+
+    @property
+    def is_terminal(self) -> bool:
+        return self in (RequestState.FINISHED, RequestState.FAILED,
+                        RequestState.CANCELLED)
 
 
 @dataclass
@@ -54,10 +94,12 @@ class Request:
     state: RequestState = RequestState.WAITING
     output_tokens: list[int] = field(default_factory=list)
     arrival_time: float = field(default_factory=time.monotonic)
+    deadline: float | None = None      # absolute monotonic() cutoff
     first_token_time: float | None = None
     finish_time: float | None = None
     num_preemptions: int = 0
     finish_reason: str | None = None
+    error: BaseException | None = None
 
     @property
     def prefill_tokens(self) -> list[int]:
@@ -75,6 +117,11 @@ class Request:
             return None
         return self.first_token_time - self.arrival_time
 
+    def past_deadline(self, now: float | None = None) -> bool:
+        return (self.deadline is not None
+                and (now if now is not None else time.monotonic())
+                > self.deadline)
+
     def emit(self, token: int):
         self.output_tokens.append(int(token))
         if self.first_token_time is None:
@@ -87,17 +134,35 @@ class Scheduler:
     """Slots + queues over a :class:`PagedKVCache`."""
 
     def __init__(self, cache: PagedKVCache, max_slots: int,
-                 max_model_len: int):
+                 max_model_len: int, max_queue: int | None = None,
+                 max_preemptions_per_request: int = 16):
         self.cache = cache
         self.max_slots = int(max_slots)
         self.max_model_len = int(max_model_len)
+        self.max_queue = None if max_queue is None else int(max_queue)
+        self.max_preemptions = int(max_preemptions_per_request)
         self.waiting: deque[Request] = deque()
         self.running: dict[int, Request] = {}       # slot -> request
         self._free_slots = list(range(max_slots))
         self.num_preemptions = 0
+        self.num_rejected = 0
+        self.num_failed = 0
+        self.num_cancelled = 0
+        self.closed = False
 
     # -- intake -----------------------------------------------------------
     def add(self, req: Request):
+        if self.closed:
+            raise EngineClosed(
+                f"request {req.rid} rejected: the engine has been shut down "
+                f"(close() was called); create a new engine or add requests "
+                f"before closing")
+        if self.max_queue is not None and len(self.waiting) >= self.max_queue:
+            self.num_rejected += 1
+            raise QueueFull(
+                f"request {req.rid} rejected: admission queue is full "
+                f"({len(self.waiting)}/{self.max_queue} waiting, "
+                f"{len(self.running)} running) — back off and retry")
         worst = len(req.prompt) + req.sampling.max_new_tokens
         if worst > self.max_model_len:
             raise ValueError(
@@ -125,13 +190,18 @@ class Scheduler:
         admitted = []
         while self.waiting and self._free_slots:
             req = self.waiting[0]
+            faults.inject("serving.admit", rid=req.rid)
             need = self.cache.blocks_for(len(req.prefill_tokens)) + 1
             if self.cache.allocator.num_free < need:
                 break
             self.waiting.popleft()
             slot = self._free_slots.pop(0)
-            ok = self.cache.allocate(req.rid, len(req.prefill_tokens))
-            assert ok, "admission checked free blocks"
+            if not self.cache.allocate(req.rid, len(req.prefill_tokens)):
+                # free-count check passed but alloc failed (injected
+                # exhaustion): put everything back and retry next step
+                self._free_slots.insert(0, slot)
+                self.waiting.appendleft(req)
+                break
             req.state = RequestState.RUNNING
             self.running[slot] = req
             admitted.append((slot, req))
@@ -142,21 +212,24 @@ class Scheduler:
         """Before a decode step, every running sequence must own the block
         its next token writes into. On exhaustion, preempt the
         latest-arrived other running request and retry; returns the
-        preempted requests (already re-queued)."""
+        preempted requests (already re-queued). A sequence that cannot get
+        a block even with no victims left is FAILED (not a crash): the
+        engine stays up for everyone else."""
         preempted = []
         for slot in sorted(self.running):
             req = self.running.get(slot)
-            if req is None:  # preempted earlier in this very loop
+            if req is None:  # preempted/failed earlier in this very loop
                 continue
             # the incoming token writes its K/V at position total_len - 1,
             # so the table must cover total_len tokens
             while not self.cache.extend(req.rid, req.total_len):
                 victim = self._pick_victim(exclude=req)
                 if victim is None:
-                    raise RuntimeError(
+                    self.fail(slot, RuntimeError(
                         f"request {req.rid} cannot obtain a KV block with "
-                        f"no victim left to preempt — pool too small "
-                        f"(usable={self.cache.allocator.num_usable})")
+                        f"no victim left to preempt — pool exhausted "
+                        f"(usable={self.cache.allocator.num_usable})"))
+                    break
                 preempted.append(victim)
                 self._preempt(victim)
         return preempted
@@ -169,6 +242,15 @@ class Scheduler:
 
     def _preempt(self, victim: Request):
         slot = next(s for s, r in self.running.items() if r is victim)
+        if victim.num_preemptions >= self.max_preemptions:
+            # preemption-storm protection: requeue count is capped; beyond
+            # it the request fails with the storm attached instead of
+            # bouncing between prefill and eviction forever
+            self.fail(slot, PreemptionStorm(
+                f"request {victim.rid} preempted {victim.num_preemptions} "
+                f"times (cap {self.max_preemptions}); failing instead of "
+                f"requeueing — pool too small for the offered load"))
+            return
         del self.running[slot]
         self._free_slots.append(slot)
         self._free_slots.sort()
@@ -178,12 +260,64 @@ class Scheduler:
         self.num_preemptions += 1
         self.waiting.appendleft(victim)   # front: keep its progress hot
 
-    # -- completion -------------------------------------------------------
-    def finish(self, slot: int, reason: str = "length"):
+    # -- completion / removal ---------------------------------------------
+    def _release_slot(self, slot: int) -> Request:
         req = self.running.pop(slot)
         self._free_slots.append(slot)
         self._free_slots.sort()
-        self.cache.free_seq(req.rid)
+        if req.rid in self.cache.tables:
+            self.cache.free_seq(req.rid)
+        return req
+
+    def finish(self, slot: int, reason: str = "length"):
+        req = self._release_slot(slot)
         req.state = RequestState.FINISHED
         req.finish_time = time.monotonic()
         req.finish_reason = reason
+
+    def fail(self, slot: int, error: BaseException):
+        """Error isolation: tear down ONE slot, attach the error, keep the
+        engine alive for every other request."""
+        req = self._release_slot(slot)
+        req.state = RequestState.FAILED
+        req.finish_time = time.monotonic()
+        req.finish_reason = "error"
+        req.error = error
+        self.num_failed += 1
+
+    def cancel(self, rid: int,
+               reason: str = "cancelled",
+               error: BaseException | None = None) -> bool:
+        """Cancel a waiting or running request by id. Returns False if the
+        request is unknown or already terminal."""
+        for i, req in enumerate(self.waiting):
+            if req.rid == rid:
+                del self.waiting[i]
+                req.state = RequestState.CANCELLED
+                req.finish_time = time.monotonic()
+                req.finish_reason = reason
+                req.error = error
+                self.num_cancelled += 1
+                return True
+        for slot, req in list(self.running.items()):
+            if req.rid == rid:
+                self._release_slot(slot)
+                req.state = RequestState.CANCELLED
+                req.finish_time = time.monotonic()
+                req.finish_reason = reason
+                req.error = error
+                self.num_cancelled += 1
+                return True
+        return False
+
+    def close(self, cancel_pending: bool = True) -> list[Request]:
+        """Shut the intake down. Pending (waiting + running) requests are
+        cancelled (default) so callers holding their handles see a terminal
+        state; returns whatever was cancelled."""
+        self.closed = True
+        dropped = []
+        if cancel_pending:
+            for req in list(self.waiting) + list(self.running.values()):
+                if self.cancel(req.rid, reason="shutdown"):
+                    dropped.append(req)
+        return dropped

@@ -1,5 +1,6 @@
-"""Utility surface: scalar/trace logging (the VisualDL role) and misc
-helpers."""
+"""Utility surface: scalar/trace logging (the VisualDL role), the chaos
+fault-injection registry, and misc helpers."""
 from .log_writer import LogWriter  # noqa: F401
+from .faults import FaultError, FaultPlan, inject  # noqa: F401
 
-__all__ = ["LogWriter"]
+__all__ = ["LogWriter", "FaultError", "FaultPlan", "inject"]

@@ -1,0 +1,25 @@
+"""Shared version-gate marks for tests that need newer-jax features.
+
+``needs_partial_manual_shard_map`` xfails (named reason, non-strict) the
+tests whose production code path requires native ``jax.shard_map`` with
+``axis_names`` (partial-manual regions). On the pinned 0.4.x jaxlib the
+fallback ``jax.experimental.shard_map(auto=...)`` raises
+NotImplementedError for several collectives and lowers ``axis_index`` to a
+PartitionId instruction that XLA's SPMD partitioner rejects — a jax
+limitation, not a regression in this repo. On a jax with native shard_map
+the mark disappears and the tests must pass, so real regressions stay
+visible.
+"""
+import pytest
+
+from paddle_tpu.core.jaxcompat import supports_partial_manual
+
+needs_partial_manual_shard_map = pytest.mark.xfail(
+    condition=not supports_partial_manual(),
+    reason="needs native jax.shard_map partial-manual (axis_names/auto) "
+           "regions: this jax's experimental shard_map raises "
+           "NotImplementedError for collectives in auto regions and lowers "
+           "axis_index to PartitionId, which XLA SPMD rejects "
+           "(see paddle_tpu.core.jaxcompat.supports_partial_manual)",
+    strict=False,
+)

@@ -1,0 +1,620 @@
+"""Chaos suite: fault plans driven end-to-end through serving, collectives,
+and checkpointing (ISSUE 3 acceptance gate).
+
+The contract under test, per docs/ROBUSTNESS.md:
+
+- with an active fault plan injecting prefill errors, decode delays, pool
+  exhaustion, store timeouts, and checkpoint kills, the engine completes
+  every non-targeted request token-for-token equal to uncached decode;
+- targeted requests end FAILED/CANCELLED with the error attached — never a
+  crashed engine;
+- ``Checkpoint.load`` recovers the last good snapshot past torn/corrupt
+  ones and reports what it skipped.
+
+All plans are deterministic (@k-th-call triggers), so every assertion below
+is exact, not probabilistic.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu.framework.flags import set_flags
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+from paddle_tpu.serving import (
+    DeadlineExceeded, EngineClosed, LLMEngine, PagedKVCache, PreemptionStorm,
+    QueueFull, RequestState, SamplingParams, naive_generate)
+from paddle_tpu.utils import faults
+from paddle_tpu.utils.faults import FaultError, FaultPlan
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """No plan or chaos flag may leak between tests."""
+    yield
+    faults.deactivate()
+    set_flags({"FLAGS_fault_plan": "", "FLAGS_collective_timeout_s": 0.0})
+
+
+def _tiny_model(vocab=61, hidden=32, layers=2, heads=4, kv_heads=2, seq=64):
+    paddle_tpu.seed(0)
+    cfg = llama_tiny(vocab=vocab, hidden=hidden, layers=layers, heads=heads,
+                     kv_heads=kv_heads, inter=2 * hidden, seq=seq)
+    return LlamaForCausalLM(cfg)
+
+
+# ---------------------------------------------------------------------------
+# the registry itself
+# ---------------------------------------------------------------------------
+
+class TestFaultPlan:
+    def test_parse_grammar(self):
+        p = FaultPlan.parse(
+            "serving.prefill:error@2;kv.alloc:exhaust@5x3;"
+            "store.get:delay=0.1x2;collective.all_reduce:error%0.5")
+        kinds = [(s.site, s.kind, s.start, s.count) for s in p.specs]
+        assert kinds[0] == ("serving.prefill", "error", 2, 1)
+        assert kinds[1] == ("kv.alloc", "exhaust", 5, 3)
+        assert kinds[2] == ("store.get", "delay", 1, 2)
+        assert p.specs[2].arg == 0.1
+        assert p.specs[3].prob == 0.5
+
+    def test_parse_rejects_garbage(self):
+        with pytest.raises(ValueError, match="bad fault spec"):
+            FaultPlan.parse("serving.prefill-no-kind")
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            faults.FaultSpec("x", "explode")
+
+    def test_nth_call_and_count_window(self):
+        with FaultPlan.parse("s:error@3x2") as p:
+            assert faults.inject("s") is None
+            assert faults.inject("s") is None
+            for _ in range(2):
+                with pytest.raises(FaultError):
+                    faults.inject("s")
+            assert faults.inject("s") is None
+        assert p.fired_at("s") == 2
+        assert p.calls["s"] == 5
+
+    def test_error_carries_site_and_hit(self):
+        with FaultPlan.parse("a.b:error@1"):
+            with pytest.raises(FaultError) as ei:
+                faults.inject("a.b", rid=7)
+        assert ei.value.site == "a.b" and ei.value.hit == 1
+
+    def test_probabilistic_is_seed_deterministic(self):
+        def run(seed):
+            plan = FaultPlan.parse("s:exhaust%0.5", seed=seed)
+            with plan:
+                return [faults.inject("s") for _ in range(64)]
+        assert run(1) == run(1)            # same seed -> same firings
+        assert run(1) != run(2)            # different seed -> different
+        assert "exhaust" in run(1)         # and it does fire sometimes
+
+    def test_flag_activation(self):
+        set_flags({"FLAGS_fault_plan": "flagged.site:exhaust@1"})
+        try:
+            assert faults.inject("flagged.site") == "exhaust"
+            assert faults.inject("flagged.site") is None  # @1 only
+        finally:
+            set_flags({"FLAGS_fault_plan": ""})
+        assert faults.inject("flagged.site") is None
+
+    def test_inject_is_noop_without_plan(self):
+        assert faults.inject("whatever", anything=1) is None
+
+
+# ---------------------------------------------------------------------------
+# engine under fault plans (the acceptance gate)
+# ---------------------------------------------------------------------------
+
+class TestEngineChaos:
+    def _refs(self, model, prompts, sp):
+        return [naive_generate(model, p, sp) for p in prompts]
+
+    def test_acceptance_multi_fault_plan(self):
+        """>=5 injected faults across prefill, decode, and the allocator:
+        targeted requests FAIL with the error attached, every other request
+        is token-for-token equal to uncached decode, and the engine drains
+        with all blocks returned."""
+        model = _tiny_model()
+        rng = np.random.RandomState(0)
+        prompts = [list(rng.randint(0, 61, n)) for n in (5, 9, 12, 7, 4)]
+        sp = SamplingParams(max_new_tokens=6, temperature=0.0)
+        refs = self._refs(model, prompts, sp)
+
+        plan = FaultPlan.parse(
+            "serving.prefill:error@2;"          # 2nd admission dies
+            "serving.decode.slot:error@9;"      # one running slot dies later
+            "serving.decode:delay=0.01@3;"      # a slow decode step
+            "serving.kv.alloc:exhaust@7;"       # one transient dry pool
+            "serving.admit:delay=0.005@1")      # a slow admission
+        eng = LLMEngine(model, block_size=8, max_slots=3, max_model_len=64,
+                        watchdog_timeout_s=0.005)
+        with plan:
+            reqs = [eng.add_request(p, sp) for p in prompts]
+            eng.run()
+
+        assert len(plan.fired) >= 5, plan.summary()
+        failed = [r for r in reqs if r.state is RequestState.FAILED]
+        finished = [r for r in reqs if r.state is RequestState.FINISHED]
+        assert len(failed) >= 1 and len(finished) >= 3
+        assert len(failed) + len(finished) == len(reqs)
+        for r in failed:
+            assert isinstance(r.error, FaultError)
+            assert r.finish_reason == "error"
+        for r in finished:
+            assert r.output_tokens == refs[r.rid], (
+                f"request {r.rid} diverged from uncached decode")
+        st = eng.stats()
+        assert st["blocks_used"] == 0            # everything returned
+        assert st["num_failed"] == len(failed)
+        assert st["watchdog_trips"] >= 1         # the delayed decode tripped
+
+    def test_prefill_fault_isolates_one_request(self):
+        model = _tiny_model()
+        rng = np.random.RandomState(1)
+        prompts = [list(rng.randint(0, 61, n)) for n in (6, 8, 5)]
+        sp = SamplingParams(max_new_tokens=4, temperature=0.0)
+        refs = self._refs(model, prompts, sp)
+        eng = LLMEngine(model, block_size=8, max_slots=3, max_model_len=64)
+        with FaultPlan.parse("serving.prefill:error@2"):
+            reqs = [eng.add_request(p, sp) for p in prompts]
+            eng.run()
+        assert reqs[1].state is RequestState.FAILED
+        assert isinstance(reqs[1].error, FaultError)
+        assert reqs[1].error.site == "serving.prefill"
+        for i in (0, 2):
+            assert reqs[i].state is RequestState.FINISHED
+            assert reqs[i].output_tokens == refs[i]
+        assert eng.stats()["blocks_used"] == 0
+
+    def test_decode_batch_failure_spares_waiting_requests(self):
+        """The fused decode call dying fails the in-flight batch but the
+        engine keeps serving the queue."""
+        model = _tiny_model()
+        rng = np.random.RandomState(2)
+        prompts = [list(rng.randint(0, 61, n)) for n in (5, 7, 6)]
+        sp = SamplingParams(max_new_tokens=4, temperature=0.0)
+        refs = self._refs(model, prompts, sp)
+        eng = LLMEngine(model, block_size=8, max_slots=2, max_model_len=64)
+        with FaultPlan.parse("serving.decode:error@1"):
+            reqs = [eng.add_request(p, sp) for p in prompts]
+            eng.run()
+        assert reqs[0].state is RequestState.FAILED
+        assert reqs[1].state is RequestState.FAILED
+        assert reqs[2].state is RequestState.FINISHED
+        assert reqs[2].output_tokens == refs[2]
+        assert eng.stats()["blocks_used"] == 0
+
+    def test_transient_pool_exhaustion_keeps_parity(self):
+        """Injected allocator exhaustion triggers the preempt/requeue path;
+        every request still completes with exact parity (the seeded-sampling
+        guarantee under churn)."""
+        model = _tiny_model()
+        rng = np.random.RandomState(3)
+        prompts = [list(rng.randint(0, 61, n)) for n in (10, 9, 11)]
+        sp = SamplingParams(max_new_tokens=8, temperature=0.0)
+        refs = self._refs(model, prompts, sp)
+        eng = LLMEngine(model, block_size=4, num_blocks=17, max_slots=3,
+                        max_model_len=48)
+        with FaultPlan.parse("serving.kv.alloc:exhaust@5x2") as plan:
+            outs = eng.generate(prompts, sp)
+        assert plan.fired_at("serving.kv.alloc") == 2
+        assert outs == refs
+        assert eng.stats()["blocks_used"] == 0
+
+
+class TestDeadlineAndCancel:
+    def test_deadline_cancels_with_error_attached(self):
+        model = _tiny_model()
+        eng = LLMEngine(model, block_size=8, max_slots=2, max_model_len=64)
+        # a decode step slower than the deadline: the request is cancelled
+        # mid-stream with partial output and DeadlineExceeded attached
+        with FaultPlan.parse("serving.decode:delay=0.08x*"):
+            req = eng.add_request([1, 2, 3], SamplingParams(max_new_tokens=8),
+                                  deadline_s=0.05)
+            eng.run()
+        assert req.state is RequestState.CANCELLED
+        assert req.finish_reason == "deadline"
+        assert isinstance(req.error, DeadlineExceeded)
+        assert len(req.output_tokens) < 8
+        assert eng.stats()["blocks_used"] == 0
+
+    def test_cancel_waiting_and_running(self):
+        model = _tiny_model()
+        sp = SamplingParams(max_new_tokens=5, temperature=0.0)
+        ref0 = naive_generate(model, [3, 4, 5], sp)
+        eng = LLMEngine(model, block_size=8, max_slots=1, max_model_len=64)
+        r0 = eng.add_request([3, 4, 5], sp)
+        r1 = eng.add_request([6, 7, 8], sp)       # waits behind r0
+        assert eng.cancel(r1.rid)
+        eng.run()
+        assert r0.state is RequestState.FINISHED
+        assert r0.output_tokens == ref0
+        assert r1.state is RequestState.CANCELLED
+        assert r1.output_tokens == []
+        assert not eng.cancel(r1.rid)             # already terminal
+        assert not eng.cancel(999)                # unknown
+        assert eng.stats()["num_cancelled"] == 1
+
+    def test_cancel_running_frees_blocks_immediately(self):
+        model = _tiny_model()
+        eng = LLMEngine(model, block_size=8, max_slots=2, max_model_len=64)
+        req = eng.add_request([1, 2, 3, 4],
+                              SamplingParams(max_new_tokens=10))
+        eng.step()                                # prefill done, running
+        assert req.state is RequestState.RUNNING
+        used_before = eng.stats()["blocks_used"]
+        assert used_before > 0
+        assert eng.cancel(req.rid)
+        assert eng.stats()["blocks_used"] == 0
+        assert req.state is RequestState.CANCELLED
+
+
+class TestBackpressureAndShutdown:
+    def test_bounded_queue_rejects_with_stats(self):
+        model = _tiny_model()
+        sp = SamplingParams(max_new_tokens=3, temperature=0.0)
+        eng = LLMEngine(model, block_size=8, max_slots=1, max_model_len=64,
+                        max_queue=2)
+        eng.add_request([1, 2], sp)
+        eng.add_request([3, 4], sp)
+        with pytest.raises(QueueFull, match="admission queue is full"):
+            eng.add_request([5, 6], sp)
+        assert eng.stats()["num_rejected"] == 1
+        eng.run()                                 # the admitted ones drain
+        assert eng.stats()["num_finished"] == 2
+
+    def test_add_after_close_raises_engine_closed(self):
+        """Satellite: no silent drop after shutdown."""
+        model = _tiny_model()
+        eng = LLMEngine(model, block_size=8, max_slots=2, max_model_len=64)
+        pending = eng.add_request([1, 2, 3], SamplingParams(max_new_tokens=4))
+        eng.close()
+        with pytest.raises(EngineClosed, match="shut down"):
+            eng.add_request([4, 5, 6])
+        assert pending.state is RequestState.CANCELLED
+        assert pending.finish_reason == "shutdown"
+        assert eng.step() is False
+        assert eng.stats()["blocks_used"] == 0
+
+    def test_stall_detector_fails_queue_head(self):
+        """Permanent allocator exhaustion must not spin forever: after
+        stall_limit no-progress steps the head request fails with a
+        diagnosis attached."""
+        model = _tiny_model()
+        eng = LLMEngine(model, block_size=8, max_slots=2, max_model_len=64,
+                        stall_limit=3)
+        with FaultPlan.parse("serving.kv.alloc:exhaust@1x*"):
+            req = eng.add_request([1, 2, 3], SamplingParams(max_new_tokens=4))
+            t0 = time.monotonic()
+            eng.run()
+            assert time.monotonic() - t0 < 30    # terminated, not livelocked
+        assert req.state is RequestState.FAILED
+        assert "no progress" in str(req.error)
+
+
+class TestPreemptionStorm:
+    def test_requeue_cap_fails_thrashing_request(self):
+        """A pool too small for the offered load with a requeue cap of 0
+        (no requeues tolerated): the first preemption attempt fails its
+        victim with PreemptionStorm instead of requeueing; the survivors
+        still match uncached decode exactly. (The same load with the
+        default cap completes everyone — test_serving.py covers that.)"""
+        model = _tiny_model()
+        rng = np.random.RandomState(4)
+        prompts = [list(rng.randint(0, 61, n)) for n in (10, 9, 11)]
+        sp = SamplingParams(max_new_tokens=12, temperature=0.0)
+        refs = [naive_generate(model, p, sp) for p in prompts]
+        eng = LLMEngine(model, block_size=4, num_blocks=9, max_slots=3,
+                        max_model_len=32, max_preemptions_per_request=0)
+        reqs = [eng.add_request(p, sp) for p in prompts]
+        eng.run()
+        stormed = [r for r in reqs if isinstance(r.error, PreemptionStorm)]
+        finished = [r for r in reqs if r.state is RequestState.FINISHED]
+        assert stormed, "cap of 1 under this load must trip"
+        assert finished, "the storm must not take everyone down"
+        for r in finished:
+            assert r.output_tokens == refs[r.rid]
+        assert eng.stats()["blocks_used"] == 0
+        # sanity: the same load WITHOUT the cap completes everyone (the
+        # baseline behavior test_serving.py::test_preemption_requeue covers)
+
+
+# ---------------------------------------------------------------------------
+# PagedKVCache free-list invariants (satellite: property test)
+# ---------------------------------------------------------------------------
+
+class TestKVCacheFreeListProperty:
+    """Randomized alloc/extend/free/preempt storms; after every operation
+    the allocator's books must balance exactly."""
+
+    def _check_invariants(self, cache, num_blocks):
+        alloc = cache.allocator
+        live = set(alloc._live)
+        free = set(alloc._free)
+        # no block both live and free; every block accounted for exactly once
+        assert not (live & free)
+        assert live | free == set(range(1, num_blocks))
+        assert len(alloc._free) == len(free), "duplicate ids in free list"
+        # tables own exactly the live blocks, each block exactly once
+        owned = [b for t in cache.tables.values() for b in t]
+        assert len(owned) == len(set(owned)), "block owned by two sequences"
+        assert set(owned) == live
+        # scratch block 0 is never handed out
+        assert 0 not in owned and 0 not in free
+        assert alloc.high_water <= alloc.num_usable
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_random_storm(self, seed):
+        rng = np.random.RandomState(seed)
+        num_blocks = int(rng.randint(5, 33))
+        cache = PagedKVCache(num_layers=1, num_blocks=num_blocks, kv_heads=1,
+                             block_size=4, head_dim=4)
+        next_sid = 0
+        live_sids: list[int] = []
+        for _ in range(300):
+            op = rng.choice(["alloc", "extend", "free", "preempt_all"],
+                            p=[0.4, 0.3, 0.25, 0.05])
+            if op == "alloc":
+                sid = next_sid
+                if cache.allocate(sid, int(rng.randint(1, 20))):
+                    live_sids.append(sid)
+                next_sid += 1
+            elif op == "extend" and live_sids:
+                sid = live_sids[rng.randint(len(live_sids))]
+                cur = len(cache.tables[sid]) * cache.block_size
+                cache.extend(sid, cur + int(rng.randint(0, 12)))
+            elif op == "free" and live_sids:
+                sid = live_sids.pop(rng.randint(len(live_sids)))
+                cache.free_seq(sid)
+            elif op == "preempt_all" and live_sids:
+                for sid in live_sids:
+                    cache.free_seq(sid)
+                live_sids.clear()
+            self._check_invariants(cache, num_blocks)
+        for sid in live_sids:                   # drain: no leak at the end
+            cache.free_seq(sid)
+        assert cache.allocator.num_used == 0
+        assert cache.allocator.num_free == cache.allocator.num_usable
+
+    def test_storm_with_injected_exhaustion(self):
+        """Exhaust faults must not corrupt the books either."""
+        cache = PagedKVCache(num_layers=1, num_blocks=9, kv_heads=1,
+                             block_size=4, head_dim=4)
+        with FaultPlan.parse("serving.kv.alloc:exhaust%0.3", seed=7):
+            rng = np.random.RandomState(7)
+            live = []
+            for i in range(200):
+                if rng.rand() < 0.6:
+                    if cache.allocate(i, int(rng.randint(1, 12))):
+                        live.append(i)
+                elif live:
+                    cache.free_seq(live.pop(rng.randint(len(live))))
+                self._check_invariants(cache, 9)
+        for sid in live:
+            cache.free_seq(sid)
+        assert cache.allocator.num_used == 0
+
+
+# ---------------------------------------------------------------------------
+# TCPStore retry/backoff under faults
+# ---------------------------------------------------------------------------
+
+def _native_available():
+    from paddle_tpu.core import native
+    return native.load() is not None
+
+
+@pytest.mark.skipif(not _native_available(),
+                    reason="native runtime (csrc/) not built")
+class TestStoreChaos:
+    def test_get_retries_through_transient_faults(self):
+        from paddle_tpu.distributed import TCPStore
+        master = TCPStore(is_master=True, retries=4, backoff_s=0.01)
+        try:
+            master.set("k", b"v")
+            with FaultPlan.parse("store.get:error@1x2") as plan:
+                assert master.get("k") == b"v"     # survives 2 injected fails
+            assert plan.fired_at("store.get") == 2
+            assert master.num_retries >= 2
+        finally:
+            master.close()
+
+    def test_exhausted_retries_raise_named_timeout(self):
+        from paddle_tpu.distributed import TCPStore
+        from paddle_tpu.distributed.tcp_store import StoreTimeout
+        master = TCPStore(is_master=True, retries=3, backoff_s=0.01)
+        try:
+            with FaultPlan.parse("store.get:error@1x*"):
+                with pytest.raises(StoreTimeout) as ei:
+                    master.get("k")
+            msg = str(ei.value)
+            assert "get('k')" in msg and "3 attempts" in msg
+            assert f"{master.host}:{master.port}" in msg
+        finally:
+            master.close()
+
+    def test_connect_retries_then_names_endpoint(self):
+        import socket
+
+        from paddle_tpu.distributed import TCPStore
+        from paddle_tpu.distributed.tcp_store import StoreTimeout
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()                              # nobody listening here now
+        t0 = time.monotonic()
+        with pytest.raises(StoreTimeout) as ei:
+            TCPStore(host="127.0.0.1", port=port, timeout=1.0, retries=2,
+                     backoff_s=0.01)
+        assert time.monotonic() - t0 < 10
+        msg = str(ei.value)
+        assert f"127.0.0.1:{port}" in msg and "2 connect attempts" in msg
+
+    def test_get_absent_key_is_none_not_retried(self):
+        from paddle_tpu.distributed import TCPStore
+        master = TCPStore(is_master=True, retries=3, backoff_s=0.01)
+        try:
+            before = master.num_retries
+            assert master.get("never-set") is None
+            assert master.num_retries == before   # absence != transience
+        finally:
+            master.close()
+
+
+# ---------------------------------------------------------------------------
+# collective timeout guard
+# ---------------------------------------------------------------------------
+
+class TestCollectiveChaos:
+    @pytest.fixture(autouse=True)
+    def _mesh(self):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.mesh import set_hybrid_communicate_group
+        dist.init_parallel_env()   # rebuilds the mesh if it was torn down
+        yield
+        set_hybrid_communicate_group(None)
+
+    def test_timeout_guard_names_op_group_rank(self):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.collective import CollectiveTimeoutError
+        t = dist.shard_to_group(
+            [np.full((2, 2), i, np.float32) for i in range(8)])
+        set_flags({"FLAGS_collective_timeout_s": 0.05})
+        with FaultPlan.parse("collective.all_reduce:delay=0.5@1"):
+            with pytest.raises(CollectiveTimeoutError) as ei:
+                dist.all_reduce(t)
+        msg = str(ei.value)
+        assert "all_reduce" in msg
+        assert "axis" in msg and "rank" in msg and "0.05" in msg
+
+    def test_guard_passes_results_and_errors_through(self):
+        import paddle_tpu.distributed as dist
+        t = dist.shard_to_group(
+            [np.full((2, 2), i, np.float32) for i in range(8)])
+        set_flags({"FLAGS_collective_timeout_s": 30.0})
+        out = dist.all_reduce(t)
+        assert np.allclose(dist.unshard(out), sum(range(8)))
+        # an injected error inside the guarded region surfaces as itself
+        t2 = dist.shard_to_group(
+            [np.full((2, 2), i, np.float32) for i in range(8)])
+        with FaultPlan.parse("collective.all_reduce:error@1"):
+            with pytest.raises(FaultError):
+                dist.all_reduce(t2)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint atomicity + fallback
+# ---------------------------------------------------------------------------
+
+def _state(step):
+    rng = np.random.RandomState(step)
+    return {"params": {"w": rng.rand(4, 3).astype(np.float32),
+                       "b": rng.rand(3).astype(np.float32)},
+            "opt": {"m": rng.rand(4, 3).astype(np.float32)}}
+
+
+def _assert_state_equal(a, b):
+    np.testing.assert_array_equal(a["params"]["w"], b["params"]["w"])
+    np.testing.assert_array_equal(a["params"]["b"], b["params"]["b"])
+    np.testing.assert_array_equal(a["opt"]["m"], b["opt"]["m"])
+
+
+class TestCheckpointChaos:
+    def test_kill_between_shard_writes_never_publishes_torn_snapshot(
+            self, tmp_path):
+        from paddle_tpu.distributed import Checkpoint
+        ckpt = Checkpoint(str(tmp_path / "ck"), keep=3)
+        ckpt.save(_state(1), extra={"step": 1})
+        with FaultPlan.parse("ckpt.meta:error@1"):   # dies between files
+            with pytest.raises(FaultError):
+                ckpt.save(_state(2), extra={"step": 2})
+        # the torn attempt left no snapshot behind
+        assert len(ckpt.snapshots()) == 1
+        state, extra = ckpt.load()
+        _assert_state_equal(state, _state(1))
+        assert extra["step"] == 1
+        assert ckpt.last_load_report["skipped"] == []
+
+    def test_load_falls_back_past_corrupt_snapshot_and_reports(
+            self, tmp_path):
+        from paddle_tpu.distributed import Checkpoint
+        ckpt = Checkpoint(str(tmp_path / "ck"), keep=3)
+        ckpt.save(_state(1), extra={"step": 1})
+        p2 = ckpt.save(_state(2), extra={"step": 2})
+        # corrupt the newest snapshot's shard file (simulated torn disk)
+        shard = os.path.join(p2, "shards.0.pkl")
+        with open(shard, "r+b") as f:
+            f.truncate(os.path.getsize(shard) // 2)
+        state, extra = ckpt.load()
+        _assert_state_equal(state, _state(1))
+        assert extra["step"] == 1
+        rep = ckpt.last_load_report
+        assert rep["loaded"].endswith("step-00000001")
+        [(skipped_path, reason)] = rep["skipped"]
+        assert skipped_path == p2 and "truncated" in reason
+
+    def test_all_snapshots_corrupt_raises_with_full_report(self, tmp_path):
+        from paddle_tpu.distributed import Checkpoint, CheckpointCorrupt
+        ckpt = Checkpoint(str(tmp_path / "ck"), keep=3)
+        p1 = ckpt.save(_state(1))
+        os.remove(os.path.join(p1, "meta.json"))
+        with pytest.raises(CheckpointCorrupt, match="no loadable"):
+            ckpt.load()
+        assert ckpt.last_load_report["loaded"] is None
+
+    def test_retention_keeps_newest_n(self, tmp_path):
+        from paddle_tpu.distributed import Checkpoint
+        ckpt = Checkpoint(str(tmp_path / "ck"), keep=2)
+        for i in range(1, 5):
+            ckpt.save(_state(i), extra={"step": i})
+        steps = [s for s, _ in ckpt.snapshots()]
+        assert steps == [3, 4]
+        state, extra = ckpt.load()
+        assert extra["step"] == 4
+        _assert_state_equal(state, _state(4))
+
+    def test_saver_refuses_checksum_mismatch(self, tmp_path):
+        from paddle_tpu.distributed.checkpoint import (CheckpointCorrupt,
+                                                       DistributedSaver)
+        path = str(tmp_path / "direct")
+        saver = DistributedSaver()
+        saver.save(path, state=_state(3))
+        shard = os.path.join(path, "shards.0.pkl")
+        data = open(shard, "rb").read()
+        with open(shard, "wb") as f:                 # same size, flipped byte
+            f.write(data[:-1] + bytes([data[-1] ^ 0xFF]))
+        with pytest.raises(CheckpointCorrupt, match="CRC32 mismatch"):
+            DistributedSaver().load(path)
+
+    def test_async_save_failure_surfaces_in_wait(self, tmp_path):
+        from paddle_tpu.distributed.checkpoint import DistributedSaver
+        path = str(tmp_path / "async")
+        saver = DistributedSaver()
+        plan = FaultPlan.parse("ckpt.shard:error@1")
+        faults.activate(plan)
+        try:
+            saver.save(path, state=_state(4), async_save=True)
+            with pytest.raises(RuntimeError, match="NOT committed"):
+                saver.wait()
+        finally:
+            faults.deactivate(plan)
+        assert not os.path.exists(path)          # nothing half-published
+
+    def test_legacy_manifestless_checkpoint_still_loads(self, tmp_path):
+        """Back-compat: checkpoints written before manifests existed load
+        (validation names the missing manifest but does not refuse)."""
+        from paddle_tpu.distributed.checkpoint import DistributedSaver
+        path = str(tmp_path / "legacy")
+        DistributedSaver().save(path, state=_state(5))
+        for fn in os.listdir(path):
+            if fn.startswith("manifest."):
+                os.remove(os.path.join(path, fn))
+        state, _ = DistributedSaver().load(path)
+        _assert_state_equal(state, _state(5))

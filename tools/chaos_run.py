@@ -1,0 +1,176 @@
+"""Chaos sweep over the serving bench: drive the continuous-batching engine
+through a battery of deterministic fault plans and report survival /
+degradation stats per plan.
+
+For every plan the same request fleet runs on a fresh engine; the fault-free
+run's outputs are the parity reference. A plan "survives" when the engine
+drains without crashing, every non-targeted request matches the reference
+token-for-token, every targeted request ends FAILED/CANCELLED with an error
+attached, and all KV blocks return to the pool.
+
+Usage:
+    python tools/chaos_run.py [--requests 6] [--prompt-len 24] [--max-new 16]
+        [--slots 3] [--block-size 8] [--plan NAME:SPEC ...] [--json OUT.json]
+
+    python bench.py --chaos        # same sweep as bench's opt-in mode
+
+Custom plans: ``--plan storm "serving.prefill:error@2;serving.kv.alloc:exhaust@5"``
+(repeatable) replaces the built-in battery.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import paddle_tpu  # noqa: E402
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny  # noqa: E402
+from paddle_tpu.serving import (  # noqa: E402
+    LLMEngine, RequestState, SamplingParams)
+from paddle_tpu.utils.faults import FaultPlan  # noqa: E402
+
+# the built-in battery: one plan per degradation path the runtime claims to
+# handle (docs/ROBUSTNESS.md), plus a combined storm
+DEFAULT_PLANS = [
+    ("baseline", ""),
+    ("prefill_error", "serving.prefill:error@2"),
+    ("decode_slot_error", "serving.decode.slot:error@5"),
+    ("decode_batch_error", "serving.decode:error@2"),
+    ("decode_delay", "serving.decode:delay=0.005@2x3"),
+    ("pool_exhaust", "serving.kv.alloc:exhaust@4x2"),
+    ("storm", "serving.prefill:error@3;serving.decode.slot:error@8;"
+              "serving.decode:delay=0.005@2;serving.kv.alloc:exhaust@6"),
+]
+
+
+def _build(args):
+    paddle_tpu.seed(0)
+    max_len = args.prompt_len + args.max_new
+    cfg = llama_tiny(vocab=args.vocab, hidden=args.hidden, layers=args.layers,
+                     heads=4, kv_heads=2, inter=2 * args.hidden,
+                     seq=2 * max_len)
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.RandomState(0)
+    prompts = [list(rng.randint(0, args.vocab, args.prompt_len))
+               for _ in range(args.requests)]
+    sp = SamplingParams(max_new_tokens=args.max_new, temperature=0.0)
+    return model, prompts, sp, max_len
+
+
+def _run_plan(model, prompts, sp, max_len, args, plan_text, reference=None):
+    eng = LLMEngine(model, block_size=args.block_size, max_slots=args.slots,
+                    max_model_len=max_len, watchdog_timeout_s=0.002)
+    plan = FaultPlan.parse(plan_text) if plan_text else FaultPlan()
+    t0 = time.perf_counter()
+    crashed = None
+    with plan:
+        try:
+            reqs = [eng.add_request(p, sp) for p in prompts]
+            eng.run()
+        except Exception as e:  # a crash = the robustness layer failed
+            crashed = f"{type(e).__name__}: {e}"
+            reqs = []
+    wall = time.perf_counter() - t0
+
+    finished = [r for r in reqs if r.state is RequestState.FINISHED]
+    failed = [r for r in reqs if r.state is RequestState.FAILED]
+    cancelled = [r for r in reqs if r.state is RequestState.CANCELLED]
+    parity_ok = (reference is None or all(
+        r.output_tokens == reference[r.rid] for r in finished))
+    errors_attached = all(r.error is not None for r in failed + cancelled)
+    st = eng.stats() if crashed is None else {}
+    survived = (crashed is None and parity_ok and errors_attached
+                and st.get("blocks_used") == 0
+                and len(finished) + len(failed) + len(cancelled) == len(reqs))
+    return {
+        "plan": plan_text or "(none)",
+        "survived": bool(survived),
+        "crashed": crashed,
+        "faults_fired": plan.summary(),
+        "finished": len(finished),
+        "failed": len(failed),
+        "cancelled": len(cancelled),
+        "survivor_parity_ok": bool(parity_ok),
+        "errors_attached": bool(errors_attached),
+        "blocks_leaked": int(st.get("blocks_used", -1)),
+        "num_preemptions": st.get("num_preemptions"),
+        "watchdog_trips": st.get("watchdog_trips"),
+        "generated_tokens": st.get("total_generated_tokens"),
+        "wall_sec": round(wall, 4),
+    }, [r.output_tokens for r in reqs] if reqs else None
+
+
+def run_sweep(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=6)
+    ap.add_argument("--prompt-len", type=int, default=24)
+    ap.add_argument("--max-new", type=int, default=16)
+    ap.add_argument("--slots", type=int, default=3)
+    ap.add_argument("--block-size", type=int, default=8)
+    ap.add_argument("--vocab", type=int, default=128)
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--plan", nargs=2, action="append", default=None,
+                    metavar=("NAME", "SPEC"),
+                    help="custom fault plan (repeatable; replaces battery)")
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args(argv)
+
+    model, prompts, sp, max_len = _build(args)
+    plans = args.plan if args.plan else DEFAULT_PLANS
+
+    # fault-free reference first (also warms the traces)
+    base_row, reference = _run_plan(model, prompts, sp, max_len, args, "")
+    base_wall = base_row["wall_sec"]
+
+    rows = []
+    for name, spec in plans:
+        if not spec:
+            row = dict(base_row)
+        else:
+            row, _ = _run_plan(model, prompts, sp, max_len, args, spec,
+                               reference=reference)
+        row["name"] = name
+        row["slowdown_vs_baseline"] = (
+            round(row["wall_sec"] / base_wall, 3) if base_wall > 0 else None)
+        rows.append(row)
+
+    survived = sum(1 for r in rows if r["survived"])
+    report = {
+        "config": {"requests": args.requests, "prompt_len": args.prompt_len,
+                   "max_new_tokens": args.max_new, "slots": args.slots,
+                   "block_size": args.block_size},
+        "plans_run": len(rows),
+        "plans_survived": survived,
+        "all_survived": survived == len(rows),
+        "baseline_wall_sec": base_wall,
+        "results": rows,
+    }
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=2)
+    return report
+
+
+def main(argv=None):
+    report = run_sweep(argv)
+    print(json.dumps(report, indent=2))
+    for r in report["results"]:
+        status = "OK " if r["survived"] else "DIED"
+        print(f"[{status}] {r['name']:<20} finished={r['finished']} "
+              f"failed={r['failed']} cancelled={r['cancelled']} "
+              f"parity={'yes' if r['survivor_parity_ok'] else 'NO'} "
+              f"slowdown={r['slowdown_vs_baseline']}x",
+              file=sys.stderr)
+    if not report["all_survived"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
