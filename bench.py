@@ -188,10 +188,23 @@ def main():
                     help="opt-in: run the serving chaos sweep "
                          "(tools/chaos_run.py fault-plan battery) instead "
                          "of the training bench")
+    ap.add_argument("--metrics-out", default=None,
+                    help="write the telemetry registry JSON snapshot here "
+                         "(next to the BENCH_*.json artifact)")
     args, chaos_argv = ap.parse_known_args()
+
+    def _write_metrics():
+        if args.metrics_out:
+            from paddle_tpu import telemetry
+            telemetry.registry().snapshot_json(args.metrics_out)
+            print(f"# metrics snapshot -> {args.metrics_out}",
+                  file=sys.stderr)
+
     if args.chaos:
         from tools.chaos_run import main as chaos_main
-        return chaos_main(chaos_argv)
+        rc = chaos_main(chaos_argv)
+        _write_metrics()
+        return rc
     if chaos_argv:
         ap.error(f"unrecognized arguments: {' '.join(chaos_argv)}")
     if args.rung:
@@ -340,6 +353,7 @@ def main():
                           "value": 0, "unit": "tokens/s/chip",
                           "vs_baseline": 0.0,
                           "extra": {"ladder": ladder_report}}))
+        _write_metrics()
         return 1
 
     # ---- phase 2: full windows over the top finalists ----
@@ -459,6 +473,7 @@ def main():
         },
     }
     print(json.dumps(result))
+    _write_metrics()
     return 0
 
 

@@ -34,13 +34,32 @@ import os
 import pickle
 import shutil
 import threading
+import time
 import zlib
 
 import numpy as np
 
 import jax
 
+from .. import telemetry
 from ..utils import faults
+
+
+def _ckpt_metrics():
+    reg = telemetry.registry()
+    return (
+        reg.histogram("ckpt_save_seconds",
+                      "checkpoint write wall time (staging to publish)"),
+        reg.histogram("ckpt_load_seconds",
+                      "checkpoint load wall time (validate to assemble)"),
+        reg.counter("ckpt_bytes_written_total",
+                    "bytes committed to published snapshots"),
+        reg.counter("ckpt_fallbacks_total",
+                    "torn/corrupt snapshots skipped during load"),
+    )
+
+
+_M_SAVE_S, _M_LOAD_S, _M_BYTES, _M_FALLBACKS = _ckpt_metrics()
 
 __all__ = ["DistributedSaver", "Checkpoint", "CheckpointCorrupt",
            "save_distributed_checkpoint", "load_distributed_checkpoint"]
@@ -259,6 +278,7 @@ class DistributedSaver:
         final = os.path.abspath(path)
 
         def _write():
+            t_start = time.monotonic()
             rank = jax.process_index()
             # stage everything in a temp dir, publish with ONE rename: a
             # kill at any point leaves either no snapshot or a whole one.
@@ -292,10 +312,20 @@ class DistributedSaver:
                         {"files": dict(written)}, indent=1).encode()))
                 if fresh:
                     os.replace(stage, final)
-            except BaseException:
+            except BaseException as e:
                 if fresh:
                     shutil.rmtree(stage, ignore_errors=True)
+                telemetry.record_event(
+                    "ckpt.save_failed", path=final, rank=rank,
+                    error=f"{type(e).__name__}: {e}")
                 raise
+            dur = time.monotonic() - t_start
+            nbytes = sum(w["size"] for w in written.values())
+            _M_SAVE_S.observe(dur)
+            _M_BYTES.inc(nbytes)
+            telemetry.record_event("ckpt.save", path=final, rank=rank,
+                                   bytes=nbytes, seconds=round(dur, 4),
+                                   async_save=async_save)
 
         if async_save:
             # non-daemon: interpreter exit waits for the write, so a crash-free
@@ -331,6 +361,7 @@ class DistributedSaver:
 
         Returns (state_tree, extra).
         """
+        t_start = time.monotonic()
         _wait_path(path, reraise=True)  # not a dir still being written
         problems = validate_checkpoint(path)
         # legacy dirs (pre-manifest) load as before; actual corruption
@@ -403,6 +434,11 @@ class DistributedSaver:
                     spec = _spec_from_json(meta["arrays"][name]["spec"])
                 flat[name] = jax.device_put(flat[name], NamedSharding(mesh, spec))
             state = _unflatten(flat)
+        dur = time.monotonic() - t_start
+        _M_LOAD_S.observe(dur)
+        telemetry.record_event("ckpt.load", path=os.path.abspath(path),
+                               arrays=len(meta["arrays"]),
+                               seconds=round(dur, 4))
         return state, extra
 
     def _restore_into_engine(self, state, extra):
@@ -519,12 +555,18 @@ class Checkpoint:
             problems = validate_checkpoint(path)
             if problems:
                 skipped.append((path, "; ".join(problems)))
+                _M_FALLBACKS.inc()
+                telemetry.record_event("ckpt.fallback", path=path,
+                                       reason="; ".join(problems)[:300])
                 continue
             try:
                 saver = DistributedSaver(self.engine)
                 state, extra = saver.load(path, mesh=mesh, specs=specs)
             except Exception as e:  # unreadable despite manifest: skip too
                 skipped.append((path, f"load failed: {e}"))
+                _M_FALLBACKS.inc()
+                telemetry.record_event("ckpt.fallback", path=path,
+                                       reason=f"load failed: {e}"[:300])
                 continue
             self.last_load_report = {"loaded": path, "skipped": skipped}
             return state, extra

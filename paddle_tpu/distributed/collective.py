@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import functools
 import threading
+import time
 
 import numpy as np
 
@@ -26,6 +27,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 from ..core.jaxcompat import shard_map as _shard_map
 
+from .. import telemetry
 from ..core.tensor import Tensor
 from ..framework.flags import flag_value
 from ..utils import faults
@@ -44,6 +46,25 @@ class CollectiveTimeoutError(TimeoutError):
     ``FLAGS_collective_timeout_s``; the message names the op, the group
     axis, its size, and this process's rank — the first thing an operator
     needs when one host of a pod wedges."""
+
+
+def _collective_metrics():
+    """Per-op telemetry families (get-or-create is idempotent; the labeled
+    child resolve below is one dict hit per call)."""
+    reg = telemetry.registry()
+    return (
+        reg.counter("collective_calls_total",
+                    "eager collective launches", ("op",)),
+        reg.counter("collective_bytes_total",
+                    "input bytes entering eager collectives", ("op",)),
+        reg.counter("collective_timeouts_total",
+                    "collectives killed by the timeout guard", ("op",)),
+        reg.histogram("collective_seconds",
+                      "wall time of one eager collective", ("op",)),
+    )
+
+
+_M_CALLS, _M_BYTES, _M_TIMEOUTS, _M_SECONDS = _collective_metrics()
 
 
 class ReduceOp:
@@ -159,10 +180,29 @@ def _shard_mapped(g: Group, fn, *arrays, in_specs=None, out_specs=None,
         faults.inject(f"collective.{op}", axis=g.axis)
         return mapped(*arrays)
 
+    nbytes = sum(int(getattr(a, "nbytes", 0)) for a in arrays)
+    telemetry.record_event("collective.launch", op=op, axis=g.axis,
+                           nranks=g.nranks, bytes=nbytes)
+    _M_CALLS.labels(op=op).inc()
+    _M_BYTES.labels(op=op).inc(nbytes)
+
     timeout = float(flag_value("FLAGS_collective_timeout_s") or 0.0)
-    if timeout <= 0:
-        return invoke()
-    return _guard_timeout(invoke, op, g, timeout)
+    t0 = time.monotonic()
+    try:
+        if timeout <= 0:
+            return invoke()
+        return _guard_timeout(invoke, op, g, timeout)
+    except CollectiveTimeoutError as e:
+        # the postmortem artifact: the ring's tail holds this launch, the
+        # fault (if injected) and everything leading up to the wedge
+        _M_TIMEOUTS.labels(op=op).inc()
+        telemetry.record_event("collective.timeout", op=op, axis=g.axis,
+                               nranks=g.nranks, rank=_rank_of(g),
+                               timeout_s=timeout)
+        telemetry.dump(reason=f"collective timeout: {op}", error=e)
+        raise
+    finally:
+        _M_SECONDS.labels(op=op).observe(time.monotonic() - t0)
 
 
 def _guard_timeout(invoke, op: str, g: Group, timeout: float):

@@ -22,10 +22,27 @@ import ctypes
 import threading
 import time
 
+from .. import telemetry
 from ..core import native
 from ..utils import faults
 
 __all__ = ["TCPStore", "StoreTimeout"]
+
+
+def _store_metrics():
+    reg = telemetry.registry()
+    return (
+        reg.counter("store_ops_total", "TCPStore verb calls", ("op",)),
+        reg.counter("store_retries_total",
+                    "extra attempts after transient failures", ("op",)),
+        reg.counter("store_timeouts_total",
+                    "operations that exhausted their retries", ("op",)),
+        reg.histogram("store_op_seconds",
+                      "TCPStore verb wall time incl. retries", ("op",)),
+    )
+
+
+_M_OPS, _M_RETRIES, _M_TIMEOUTS, _M_SECONDS = _store_metrics()
 
 
 class StoreTimeout(TimeoutError):
@@ -68,23 +85,34 @@ class TCPStore:
         deadline = time.monotonic() + float(timeout)
         per_attempt_ms = max(1, int(timeout * 1000 / self.retries))
         t0 = time.monotonic()
+        _M_OPS.labels(op="connect").inc()
         for attempt in range(self.retries):
             faults.inject("store.connect", host=self.host, port=self.port,
                           attempt=attempt)
             fd = self._lib.ts_connect(self.host.encode(), self.port,
                                       per_attempt_ms)
             if fd >= 0:
+                _M_SECONDS.labels(op="connect").observe(
+                    time.monotonic() - t0)
                 return fd
             if attempt + 1 < self.retries:
                 self.num_retries += 1
+                _M_RETRIES.labels(op="connect").inc()
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     break
                 time.sleep(min(self.backoff_s * (2 ** attempt), remaining))
-        raise StoreTimeout(
+        _M_TIMEOUTS.labels(op="connect").inc()
+        _M_SECONDS.labels(op="connect").observe(time.monotonic() - t0)
+        err = StoreTimeout(
             f"TCPStore could not reach {self.host}:{self.port} after "
             f"{self.retries} connect attempts over "
             f"{time.monotonic() - t0:.1f}s")
+        telemetry.record_event("store.timeout", op="connect",
+                               endpoint=f"{self.host}:{self.port}",
+                               attempts=self.retries)
+        telemetry.dump(reason="TCPStore connect timeout", error=err)
+        raise err
 
     def _retrying(self, op: str, attempt_fn, key: str | None = None):
         """Run ``attempt_fn()`` with retry + exponential backoff. The fn
@@ -93,19 +121,30 @@ class TCPStore:
         key are returned, not retried."""
         t0 = time.monotonic()
         last = None
-        for attempt in range(self.retries):
-            try:
-                faults.inject(f"store.{op}", key=key, attempt=attempt)
-                return attempt_fn()
-            except (RuntimeError, faults.FaultError) as e:
-                last = e
-                if attempt + 1 < self.retries:
-                    self.num_retries += 1
-                    time.sleep(self.backoff_s * (2 ** attempt))
-        raise StoreTimeout(
-            f"TCPStore {op}({key!r}) against {self.host}:{self.port} failed "
-            f"after {self.retries} attempts over "
-            f"{time.monotonic() - t0:.1f}s: {last}") from last
+        _M_OPS.labels(op=op).inc()
+        try:
+            for attempt in range(self.retries):
+                try:
+                    faults.inject(f"store.{op}", key=key, attempt=attempt)
+                    return attempt_fn()
+                except (RuntimeError, faults.FaultError) as e:
+                    last = e
+                    if attempt + 1 < self.retries:
+                        self.num_retries += 1
+                        _M_RETRIES.labels(op=op).inc()
+                        time.sleep(self.backoff_s * (2 ** attempt))
+            _M_TIMEOUTS.labels(op=op).inc()
+            err = StoreTimeout(
+                f"TCPStore {op}({key!r}) against {self.host}:{self.port} "
+                f"failed after {self.retries} attempts over "
+                f"{time.monotonic() - t0:.1f}s: {last}")
+            telemetry.record_event(
+                "store.timeout", op=op, key=key,
+                endpoint=f"{self.host}:{self.port}", attempts=self.retries)
+            telemetry.dump(reason=f"TCPStore {op} timeout", error=err)
+            raise err from last
+        finally:
+            _M_SECONDS.labels(op=op).observe(time.monotonic() - t0)
 
     # -- reference API -----------------------------------------------------
     def set(self, key: str, value):

@@ -21,6 +21,8 @@ from enum import Enum
 
 import jax
 
+from .. import telemetry
+
 __all__ = [
     "Profiler", "ProfilerTarget", "ProfilerState", "RecordEvent",
     "make_scheduler", "export_chrome_tracing", "Benchmark", "benchmark",
@@ -165,11 +167,15 @@ class Profiler:
             self._dir = self._trace_dir()
             jax.profiler.start_trace(self._dir)
             self._tracing = True
+            # telemetry spans now forward to jax TraceAnnotations, so host
+            # request/engine spans interleave with XLA events in this trace
+            telemetry.set_device_trace_active(True)
 
     def _stop_trace(self):
         if self._tracing:
             jax.profiler.stop_trace()
             self._tracing = False
+            telemetry.set_device_trace_active(False)
             self._last_export_dir = self._dir
             if self._on_trace_ready is not None:
                 self._on_trace_ready(self)
@@ -214,8 +220,26 @@ class Profiler:
         return self._benchmark.step_info(unit)
 
     def export(self, path=None, format="json"):
-        """jax traces are written at stop time; returns the trace dir."""
-        return self._last_export_dir
+        """jax traces are written at stop time. With ``path``, copy the
+        last trace directory there (the reference API contract: export
+        lands where the caller asked) and return ``path``; without it,
+        return the trace dir. Only chrome-trace ``format="json"`` exists
+        on this backend — anything else is an explicit error, not a
+        silent ignore."""
+        if format not in (None, "json"):
+            raise ValueError(
+                f"unsupported export format {format!r}: jax.profiler "
+                f"writes chrome-trace json (pass format='json')")
+        if path is None:
+            return self._last_export_dir
+        if self._last_export_dir is None:
+            raise RuntimeError(
+                "no trace to export: start()/stop() a recording window "
+                "first (timer_only profilers never record traces)")
+        import shutil
+
+        shutil.copytree(self._last_export_dir, path, dirs_exist_ok=True)
+        return path
 
     def summary(self, max_rows=10, print_table=True, **kwargs):
         """Throughput report + per-op time tables parsed from the exported
@@ -228,9 +252,15 @@ class Profiler:
             dev_rows, host_rows = parse_trace_op_times(self._last_export_dir)
             report["op_summary"] = dev_rows[:max_rows]
             report["host_summary"] = host_rows[:max_rows]
+            report["trace_files_seen"] = dev_rows.meta["files_seen"]
+            report["trace_files_skipped"] = dev_rows.meta["files_skipped"]
             if print_table and (dev_rows or host_rows):
                 print(format_op_table(dev_rows[:max_rows],
                                       host_rows[:max_rows]))
+            if print_table and dev_rows.meta["files_skipped"]:
+                print(f"!! {dev_rows.meta['files_skipped']} of "
+                      f"{dev_rows.meta['files_seen']} trace files could "
+                      f"not be parsed (see parse_trace_op_times(...).meta)")
         return report
 
 
@@ -336,6 +366,11 @@ class Benchmark:
     def reset(self):
         self.reader.reset()
         self.batch.reset()
+        # stale step anchors would make the first step() after a reset
+        # record the whole inter-reset gap as one bogus batch interval
+        self._reader_t0 = None
+        self._batch_t0 = None
+        self.num_samples = None
 
 
 # ---------------------------------------------------------------------------
@@ -343,12 +378,26 @@ class Benchmark:
 # (reference python/paddle/profiler/profiler_statistic.py:1)
 # ---------------------------------------------------------------------------
 
+class _OpRows(list):
+    """Row list with parse provenance attached: ``rows.meta`` counts the
+    trace files seen vs skipped (unreadable/corrupt), so an empty summary
+    is distinguishable from a summary whose inputs all failed to parse."""
+
+    def __init__(self, rows=(), meta=None):
+        super().__init__(rows)
+        self.meta = meta or {"files_seen": 0, "files_skipped": 0,
+                             "skipped": []}
+
+
 def parse_trace_op_times(trace_dir):
     """Aggregate the chrome trace jax.profiler exported under ``trace_dir``
     into (device_rows, host_rows): per-op name {calls, total_us, avg_us,
     pct} sorted by total time desc. Device rows come from ``/device:*``
     processes (TPU op execution); host rows are non-python-frame host spans
-    (RecordEvent annotations, dispatch)."""
+    (RecordEvent annotations, dispatch). Both returned lists carry a
+    ``.meta`` dict — {files_seen, files_skipped, skipped: [(path, error)]}
+    — naming every trace file that could not be parsed instead of silently
+    dropping it."""
     import collections
     import glob
     import gzip
@@ -357,13 +406,16 @@ def parse_trace_op_times(trace_dir):
 
     files = glob.glob(os.path.join(
         trace_dir, "plugins", "profile", "*", "*.trace.json.gz"))
+    meta = {"files_seen": len(files), "files_skipped": 0, "skipped": []}
     dev = collections.defaultdict(lambda: [0, 0.0])
     host = collections.defaultdict(lambda: [0, 0.0])
     for f in files:
         try:
             with gzip.open(f, "rt") as fh:
                 events = json.load(fh).get("traceEvents", [])
-        except Exception:
+        except Exception as e:
+            meta["files_skipped"] += 1
+            meta["skipped"].append((f, f"{type(e).__name__}: {e}"))
             continue
         pname = {}
         for e in events:
@@ -388,7 +440,7 @@ def parse_trace_op_times(trace_dir):
                 "pct": round(100.0 * t / total, 2)}
                for n, (c, t) in bucket.items()]
         out.sort(key=lambda r: -r["total_us"])
-        return out
+        return _OpRows(out, meta)
 
     return rows(dev), rows(host)
 

@@ -35,12 +35,15 @@ used by the parity tests and ``tools/serving_bench.py``.
 """
 from __future__ import annotations
 
+import itertools
 import time
+from types import SimpleNamespace
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import telemetry
 from ..kernels import active_platform
 from ..nn.decode import sample_logits
 from ..nn.layer import functional_call, functional_state
@@ -50,6 +53,66 @@ from .scheduler import (DeadlineExceeded, Request, RequestState,
                         SamplingParams, Scheduler)
 
 __all__ = ["LLMEngine", "naive_generate"]
+
+# distinguishes concurrent engines' series in the process-global registry
+_ENGINE_IDS = itertools.count()
+
+# TTFT/queue-time land in the default latency buckets; TPOT and decode steps
+# are per-token-scale, so give them sub-millisecond resolution too
+_TOKEN_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                  0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5)
+
+
+def _engine_metrics(label: str) -> SimpleNamespace:
+    """Resolve this engine's labeled children in the global registry once;
+    the hot paths touch only the returned handles."""
+    reg = telemetry.registry()
+    ls = ("engine",)
+
+    def C(name, help):
+        return reg.counter(name, help, ls).labels(engine=label)
+
+    def G(name, help):
+        return reg.gauge(name, help, ls).labels(engine=label)
+
+    def H(name, help, buckets=telemetry.DEFAULT_BUCKETS):
+        return reg.histogram(name, help, ls, buckets=buckets).labels(
+            engine=label)
+
+    return SimpleNamespace(
+        finished=C("serving_requests_finished_total",
+                   "requests that reached FINISHED"),
+        failed=C("serving_requests_failed_total",
+                 "requests that reached FAILED"),
+        cancelled=C("serving_requests_cancelled_total",
+                    "requests that reached CANCELLED"),
+        rejected=C("serving_requests_rejected_total",
+                   "requests rejected by the bounded admission queue"),
+        preemptions=C("serving_preemptions_total",
+                      "running requests preempted for pool pressure"),
+        tokens=C("serving_generated_tokens_total", "tokens emitted"),
+        watchdog=C("serving_watchdog_trips_total",
+                   "decode steps slower than watchdog_timeout_s"),
+        stalls=C("serving_stall_failures_total",
+                 "requests failed by the no-progress stall detector"),
+        queue_depth=G("serving_queue_depth", "requests waiting"),
+        running=G("serving_running_requests", "requests in decode slots"),
+        blocks_used=G("serving_kv_blocks_used", "live KV blocks"),
+        blocks_free=G("serving_kv_blocks_free", "free KV blocks"),
+        high_water=G("serving_kv_block_high_water",
+                     "peak live KV blocks this run"),
+        utilization=G("serving_cache_utilization",
+                      "live / usable KV block fraction"),
+        ttft=H("serving_ttft_seconds",
+               "request arrival to first emitted token"),
+        tpot=H("serving_tpot_seconds",
+               "mean inter-token time per finished request",
+               _TOKEN_BUCKETS),
+        queue_time=H("serving_queue_time_seconds",
+                     "request arrival to slot admission"),
+        decode_step=H("serving_decode_step_seconds",
+                      "wall time of one fused decode step", _TOKEN_BUCKETS),
+    )
 
 
 class LLMEngine:
@@ -99,10 +162,13 @@ class LLMEngine:
         self.cache = PagedKVCache(
             cfg.num_hidden_layers, num_blocks, cfg.num_key_value_heads,
             self.block_size, cfg.head_dim, dtype=kv_dtype)
+        self.engine_label = str(next(_ENGINE_IDS))
+        self._m = _engine_metrics(self.engine_label)
         self.scheduler = Scheduler(
             self.cache, self.max_slots, self.max_model_len,
             max_queue=max_queue,
-            max_preemptions_per_request=max_preemptions_per_request)
+            max_preemptions_per_request=max_preemptions_per_request,
+            on_event=self._on_sched_event)
 
         self._next_rid = 0
         self._decode_fn = None
@@ -153,6 +219,7 @@ class LLMEngine:
         ok = self.scheduler.cancel(rid, reason=reason)
         if ok:
             self.cancelled.append(self._requests[rid])
+            self._record_lifecycle(self._requests[rid])
         return ok
 
     def close(self):
@@ -162,7 +229,10 @@ class LLMEngine:
         if self.closed:
             return
         self.closed = True
-        self.cancelled.extend(self.scheduler.close(cancel_pending=True))
+        dropped = self.scheduler.close(cancel_pending=True)
+        self.cancelled.extend(dropped)
+        for req in dropped:
+            self._record_lifecycle(req)
 
     def step(self) -> bool:
         """One engine iteration: sweep deadlines, admit + prefill new
@@ -189,6 +259,7 @@ class LLMEngine:
         if self.scheduler.running:
             self._run_decode()
         self._check_stall(had_work)
+        self._sync_gauges()
         return self.scheduler.has_work()
 
     def run(self):
@@ -223,31 +294,121 @@ class LLMEngine:
             self.step()
 
     def stats(self) -> dict:
-        alloc = self.cache.allocator
+        """Serving counters, read back from this engine's registry series
+        (the dict shape predates the telemetry subsystem and is preserved;
+        the same numbers are scrapeable as ``serving_*{engine=...}`` via
+        ``telemetry.prometheus_text()``). With telemetry disabled the
+        registry stops updating, so the few live values (queue depth,
+        block gauges) fall back to direct reads."""
+        self._sync_gauges()
         elapsed = (time.monotonic() - self._serve_start
                    if self._serve_start else 0.0)
-        ttfts = [r.ttft for r in self.finished if r.ttft is not None]
+        m = self._m
+        alloc = self.cache.allocator
+        live = telemetry.enabled()
         return {
-            "queue_depth": self.scheduler.queue_depth,
-            "num_running": len(self.scheduler.running),
-            "num_finished": len(self.finished),
-            "num_failed": len(self.failed),
-            "num_cancelled": len(self.cancelled),
-            "num_rejected": self.scheduler.num_rejected,
-            "blocks_used": alloc.num_used,
-            "blocks_free": alloc.num_free,
-            "block_high_water": alloc.high_water,
-            "cache_utilization": self.cache.utilization(),
-            "num_preemptions": self.scheduler.num_preemptions,
+            "queue_depth": (int(m.queue_depth.value) if live
+                            else self.scheduler.queue_depth),
+            "num_running": (int(m.running.value) if live
+                            else len(self.scheduler.running)),
+            "num_finished": (int(m.finished.value) if live
+                             else len(self.finished)),
+            "num_failed": (int(m.failed.value) if live
+                           else len(self.failed)),
+            "num_cancelled": (int(m.cancelled.value) if live
+                              else len(self.cancelled)),
+            "num_rejected": (int(m.rejected.value) if live
+                             else self.scheduler.num_rejected),
+            "blocks_used": (int(m.blocks_used.value) if live
+                            else alloc.num_used),
+            "blocks_free": (int(m.blocks_free.value) if live
+                            else alloc.num_free),
+            "block_high_water": (int(m.high_water.value) if live
+                                 else alloc.high_water),
+            "cache_utilization": (m.utilization.value if live
+                                  else self.cache.utilization()),
+            "num_preemptions": (int(m.preemptions.value) if live
+                                else self.scheduler.num_preemptions),
             "decode_traces": self.decode_traces,
             "prefill_traces": dict(self.prefill_traces),
-            "total_generated_tokens": self._total_generated,
+            "total_generated_tokens": (int(m.tokens.value) if live
+                                       else self._total_generated),
             "tokens_per_sec": (self._total_generated / elapsed
                                if elapsed > 0 else 0.0),
-            "mean_ttft": float(np.mean(ttfts)) if ttfts else None,
-            "watchdog_trips": self.watchdog_trips,
+            "mean_ttft": m.ttft.mean if live else self._mean_ttft_direct(),
+            "watchdog_trips": (int(m.watchdog.value) if live
+                               else self.watchdog_trips),
             "last_decode_s": self.last_decode_s,
         }
+
+    def _mean_ttft_direct(self):
+        ttfts = [r.ttft for r in self.finished if r.ttft is not None]
+        return float(np.mean(ttfts)) if ttfts else None
+
+    # ------------------------------------------------------------------
+    # telemetry plumbing
+    # ------------------------------------------------------------------
+    def _on_sched_event(self, kind: str, rid=None, req=None):
+        """Scheduler decisions feed this engine's labeled registry series
+        (the flight-recorder events are recorded by the scheduler itself)."""
+        m = self._m
+        if kind == "finish":
+            m.finished.inc()
+        elif kind == "fail":
+            m.failed.inc()
+        elif kind == "cancel":
+            m.cancelled.inc()
+        elif kind == "reject":
+            m.rejected.inc()
+        elif kind == "preempt":
+            m.preemptions.inc()
+        elif kind == "admit" and req is not None:
+            m.queue_time.observe(req.admit_time - req.arrival_time)
+
+    def _sync_gauges(self):
+        alloc = self.cache.allocator
+        m = self._m
+        m.queue_depth.set(self.scheduler.queue_depth)
+        m.running.set(len(self.scheduler.running))
+        m.blocks_used.set(alloc.num_used)
+        m.blocks_free.set(alloc.num_free)
+        m.high_water.set(alloc.high_water)
+        m.utilization.set(self.cache.utilization())
+
+    def _record_lifecycle(self, req: Request):
+        """Emit the request's queued -> prefill -> decode lifecycle as
+        nested spans on its own virtual trace row, reconstructed from the
+        timestamps the scheduler stamped. Called once per terminal
+        request (at FINISHED / FAILED / CANCELLED)."""
+        if req.finish_time is None or getattr(req, "_spans_recorded", False):
+            return
+        req._spans_recorded = True
+        tr = telemetry.tracer()
+        tid = 100_000 + req.rid
+        tid_name = f"request-{req.rid}"
+        root = tr.emit(
+            "request", req.arrival_time, req.finish_time,
+            attrs={"rid": req.rid, "engine": self.engine_label,
+                   "state": req.state.value, "reason": req.finish_reason,
+                   "prompt_tokens": len(req.prompt),
+                   "output_tokens": len(req.output_tokens),
+                   "preemptions": req.num_preemptions},
+            tid=tid, tid_name=tid_name)
+        if root is None:          # telemetry disabled
+            return
+        queued_end = req.admit_time or req.finish_time
+        tr.emit("queued", req.arrival_time, queued_end,
+                attrs={"rid": req.rid}, parent_id=root.span_id, tid=tid)
+        if req.admit_time is not None:
+            prefill_end = req.first_token_time or req.finish_time
+            tr.emit("prefill", req.admit_time, prefill_end,
+                    attrs={"rid": req.rid, "tokens": len(req.prompt)},
+                    parent_id=root.span_id, tid=tid)
+        if req.first_token_time is not None:
+            tr.emit("decode", req.first_token_time, req.finish_time,
+                    attrs={"rid": req.rid,
+                           "tokens": len(req.output_tokens)},
+                    parent_id=root.span_id, tid=tid)
 
     # ------------------------------------------------------------------
     # degradation machinery
@@ -257,6 +418,7 @@ class LLMEngine:
         self.scheduler.fail(slot, error)
         self.failed.append(req)
         self._failed_rids.add(req.rid)
+        self._record_lifecycle(req)
 
     def _collect_scheduler_failures(self):
         """Requests the scheduler failed on its own (pool exhaustion,
@@ -266,6 +428,7 @@ class LLMEngine:
                     and req.rid not in self._failed_rids):
                 self.failed.append(req)
                 self._failed_rids.add(req.rid)
+                self._record_lifecycle(req)
 
     def _sweep_deadlines(self):
         now = time.monotonic()
@@ -278,6 +441,7 @@ class LLMEngine:
                     f"{req.sampling.max_new_tokens} tokens generated)")
                 self.scheduler.cancel(req.rid, reason="deadline", error=err)
                 self.cancelled.append(req)
+                self._record_lifecycle(req)
 
     def _check_stall(self, had_work: bool):
         """A step that had work but admitted nothing and emitted nothing is
@@ -302,6 +466,15 @@ class LLMEngine:
             self.failed.append(req)
             self._failed_rids.add(req.rid)
             self._stall_steps = 0
+            # postmortem: the stall's run-up (alloc attempts, admissions
+            # that bounced, injected faults) is exactly what the ring holds
+            self._m.failed.inc()
+            self._m.stalls.inc()
+            self._record_lifecycle(req)
+            telemetry.record_event(
+                "engine.stall", rid=req.rid, engine=self.engine_label,
+                blocks_free=self.cache.allocator.num_free)
+            telemetry.dump(reason="engine stall detector", error=req.error)
 
     # ------------------------------------------------------------------
     # prefill
@@ -344,12 +517,14 @@ class LLMEngine:
         padded[:L] = toks
         bt = self.cache.table_array([req.rid], P // self.block_size)[0]
         sp = req.sampling
-        tok, pool = self._get_prefill_fn(P)(
-            self.params, self.buffers, self.cache.pool,
-            jnp.asarray(padded), jnp.int32(L), jnp.asarray(bt),
-            jnp.float32(sp.temperature), jnp.int32(sp.top_k),
-            jnp.float32(sp.top_p), jnp.int32(sp.seed),
-            jnp.int32(len(req.output_tokens)))
+        with telemetry.span("engine.prefill", rid=req.rid, tokens=L,
+                            padded=P):
+            tok, pool = self._get_prefill_fn(P)(
+                self.params, self.buffers, self.cache.pool,
+                jnp.asarray(padded), jnp.int32(L), jnp.asarray(bt),
+                jnp.float32(sp.temperature), jnp.int32(sp.top_k),
+                jnp.float32(sp.top_p), jnp.int32(sp.seed),
+                jnp.int32(len(req.output_tokens)))
         self.cache.pool = pool
         self._emit(slot, req, int(tok))
 
@@ -412,12 +587,15 @@ class LLMEngine:
 
         t0 = time.monotonic()
         try:
-            faults.inject("serving.decode", batch=len(running))
-            toks, pool = self._get_decode_fn()(
-                self.params, self.buffers, self.cache.pool,
-                jnp.asarray(tokens), jnp.asarray(bt), jnp.asarray(ctx),
-                jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps),
-                jnp.asarray(seeds), jnp.asarray(steps))
+            with telemetry.span("engine.decode", batch=len(running),
+                                engine=self.engine_label):
+                faults.inject("serving.decode", batch=len(running))
+                toks, pool = self._get_decode_fn()(
+                    self.params, self.buffers, self.cache.pool,
+                    jnp.asarray(tokens), jnp.asarray(bt), jnp.asarray(ctx),
+                    jnp.asarray(temps), jnp.asarray(top_ks),
+                    jnp.asarray(top_ps), jnp.asarray(seeds),
+                    jnp.asarray(steps))
         except Exception as e:
             # the fused step died: every request in the batch fails, the
             # engine itself (and the waiting queue) survives
@@ -427,9 +605,15 @@ class LLMEngine:
             return
         finally:
             self.last_decode_s = time.monotonic() - t0
+            self._m.decode_step.observe(self.last_decode_s)
             if (self.watchdog_timeout_s is not None
                     and self.last_decode_s > self.watchdog_timeout_s):
                 self.watchdog_trips += 1
+                self._m.watchdog.inc()
+                telemetry.record_event(
+                    "engine.watchdog_trip", engine=self.engine_label,
+                    decode_s=self.last_decode_s,
+                    limit_s=self.watchdog_timeout_s)
         self.cache.pool = pool
         toks = np.asarray(toks)
         for slot, req in running.items():
@@ -439,6 +623,9 @@ class LLMEngine:
         req.emit(token)
         self._progressed = True
         self._total_generated += 1
+        self._m.tokens.inc()
+        if len(req.output_tokens) == 1:
+            self._m.ttft.observe(req.ttft)
         if (self.eos_token_id is not None and token == self.eos_token_id):
             self._finish(slot, "stop")
         elif len(req.output_tokens) >= req.sampling.max_new_tokens:
@@ -448,6 +635,11 @@ class LLMEngine:
         req = self.scheduler.running[slot]
         self.scheduler.finish(slot, reason)
         self.finished.append(req)
+        n = len(req.output_tokens)
+        if n > 1 and req.first_token_time is not None:
+            self._m.tpot.observe(
+                (req.finish_time - req.first_token_time) / (n - 1))
+        self._record_lifecycle(req)
 
 
 # ---------------------------------------------------------------------------

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import telemetry
 from ..utils import faults
 
 __all__ = ["BlockAllocator", "PagedKVCache", "PagedCacheView", "DenseKVCache",
@@ -75,20 +76,29 @@ class BlockAllocator:
         # chaos site: an "exhaust" fault makes the pool look dry for this
         # call, exercising the caller's preempt/queue/fail path
         if faults.inject("serving.kv.alloc", n=n) == "exhaust":
+            telemetry.record_event("kv.alloc", n=n, granted=False,
+                                   free=len(self._free), injected=True)
             return None
         if n > len(self._free):
+            telemetry.record_event("kv.alloc", n=n, granted=False,
+                                   free=len(self._free))
             return None
         out = [self._free.pop() for _ in range(n)]
         self._live.update(out)
         self.high_water = max(self.high_water, len(self._live))
+        telemetry.record_event("kv.alloc", n=n, granted=True,
+                               live=len(self._live), free=len(self._free))
         return out
 
     def free(self, blocks):
+        blocks = list(blocks)
         for b in blocks:
             if b not in self._live:
                 raise ValueError(f"double free / foreign block id {b}")
             self._live.discard(b)
             self._free.append(b)
+        telemetry.record_event("kv.free", n=len(blocks),
+                               live=len(self._live), free=len(self._free))
 
 
 class PagedKVCache:

@@ -35,6 +35,7 @@ import time
 from collections import deque
 from dataclasses import dataclass, field
 
+from .. import telemetry
 from ..utils import faults
 from .kv_cache import PagedKVCache
 
@@ -94,6 +95,7 @@ class Request:
     state: RequestState = RequestState.WAITING
     output_tokens: list[int] = field(default_factory=list)
     arrival_time: float = field(default_factory=time.monotonic)
+    admit_time: float | None = None    # first admission into a slot
     deadline: float | None = None      # absolute monotonic() cutoff
     first_token_time: float | None = None
     finish_time: float | None = None
@@ -135,8 +137,12 @@ class Scheduler:
 
     def __init__(self, cache: PagedKVCache, max_slots: int,
                  max_model_len: int, max_queue: int | None = None,
-                 max_preemptions_per_request: int = 16):
+                 max_preemptions_per_request: int = 16, on_event=None):
         self.cache = cache
+        # telemetry hook: the owning engine passes a callback(kind, **ctx)
+        # so scheduler decisions feed its labeled metrics; standalone
+        # schedulers (tests) run without one
+        self._on_event = on_event or (lambda kind, **ctx: None)
         self.max_slots = int(max_slots)
         self.max_model_len = int(max_model_len)
         self.max_queue = None if max_queue is None else int(max_queue)
@@ -159,6 +165,10 @@ class Scheduler:
                 f"before closing")
         if self.max_queue is not None and len(self.waiting) >= self.max_queue:
             self.num_rejected += 1
+            telemetry.record_event("scheduler.reject", rid=req.rid,
+                                   waiting=len(self.waiting),
+                                   running=len(self.running))
+            self._on_event("reject", rid=req.rid)
             raise QueueFull(
                 f"request {req.rid} rejected: admission queue is full "
                 f"({len(self.waiting)}/{self.max_queue} waiting, "
@@ -203,8 +213,15 @@ class Scheduler:
                 self.waiting.appendleft(req)
                 break
             req.state = RequestState.RUNNING
+            if req.admit_time is None:
+                req.admit_time = time.monotonic()
             self.running[slot] = req
             admitted.append((slot, req))
+            telemetry.record_event(
+                "scheduler.admit", rid=req.rid, slot=slot,
+                blocks=len(self.cache.tables.get(req.rid, ())),
+                queue_depth=len(self.waiting))
+            self._on_event("admit", rid=req.rid, req=req)
         return admitted
 
     # -- decode-time capacity ---------------------------------------------
@@ -259,6 +276,9 @@ class Scheduler:
         victim.num_preemptions += 1
         self.num_preemptions += 1
         self.waiting.appendleft(victim)   # front: keep its progress hot
+        telemetry.record_event("scheduler.preempt", rid=victim.rid,
+                               slot=slot, nth=victim.num_preemptions)
+        self._on_event("preempt", rid=victim.rid)
 
     # -- completion / removal ---------------------------------------------
     def _release_slot(self, slot: int) -> Request:
@@ -274,6 +294,7 @@ class Scheduler:
         req.state = RequestState.FINISHED
         req.finish_time = time.monotonic()
         req.finish_reason = reason
+        self._on_event("finish", rid=req.rid)
 
     def fail(self, slot: int, error: BaseException):
         """Error isolation: tear down ONE slot, attach the error, keep the
@@ -284,6 +305,9 @@ class Scheduler:
         req.finish_reason = "error"
         req.error = error
         self.num_failed += 1
+        telemetry.record_event("scheduler.fail", rid=req.rid, slot=slot,
+                               error=f"{type(error).__name__}: {error}")
+        self._on_event("fail", rid=req.rid)
 
     def cancel(self, rid: int,
                reason: str = "cancelled",
@@ -298,6 +322,7 @@ class Scheduler:
                 req.finish_reason = reason
                 req.error = error
                 self.num_cancelled += 1
+                self._on_event("cancel", rid=rid)
                 return True
         for slot, req in list(self.running.items()):
             if req.rid == rid:
@@ -307,6 +332,7 @@ class Scheduler:
                 req.finish_reason = reason
                 req.error = error
                 self.num_cancelled += 1
+                self._on_event("cancel", rid=rid)
                 return True
         return False
 

@@ -1,0 +1,81 @@
+"""paddle_tpu.telemetry — metrics, tracing, and the flight recorder.
+
+Three observability primitives, one process-global instance of each, shared
+by every built-in layer (serving engine, collectives, TCPStore, checkpoint
+writer, fault-injection registry) so a single serving process can produce a
+Prometheus exposition, a Chrome trace, and a crash postmortem from the same
+run (docs/OBSERVABILITY.md has the full tour):
+
+- :mod:`.metrics` — ``Counter`` / ``Gauge`` / ``Histogram`` families with
+  label sets in a :func:`registry`; Prometheus text exposition and JSON
+  snapshot export. Cheap enough for per-token hot paths.
+- :mod:`.tracing` — ``span(name, **attrs)`` context manager; in-process
+  span log with trace/span ids, Chrome ``trace.json`` export, and
+  forwarding into ``jax.profiler.TraceAnnotation`` while a device trace is
+  active so host spans interleave with XLA events.
+- :mod:`.flight_recorder` — bounded ring of recent runtime events
+  (collective launches, allocator traffic, scheduler decisions, fault
+  injections), dumped to disk on collective/store timeouts, engine stalls,
+  and uncaught exceptions.
+
+:func:`disable` flips one shared flag that every write path checks first —
+the guaranteed-cheap escape hatch for benchmarking the instrumentation
+itself (``tools/serving_bench.py --telemetry off``).
+"""
+from .metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    DEFAULT_BUCKETS,
+    registry,
+)
+from .metrics import ENABLED as _ENABLED
+from .tracing import (  # noqa: F401
+    Span,
+    Tracer,
+    device_trace_active,
+    set_device_trace_active,
+    span,
+    trace_id,
+    tracer,
+)
+from .flight_recorder import (  # noqa: F401
+    FlightRecorder,
+    dump,
+    flight,
+    install_excepthook,
+    record_event,
+)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "DEFAULT_BUCKETS", "registry", "Span", "Tracer", "span", "tracer",
+    "trace_id", "set_device_trace_active", "device_trace_active",
+    "FlightRecorder", "flight", "record_event", "dump", "install_excepthook",
+    "enable", "disable", "enabled", "prometheus_text", "snapshot",
+]
+
+
+def disable():
+    """Turn every telemetry write path into a single flag check (metrics,
+    spans, flight events all stop recording; reads keep working)."""
+    _ENABLED[0] = False
+
+
+def enable():
+    _ENABLED[0] = True
+
+
+def enabled() -> bool:
+    return _ENABLED[0]
+
+
+def prometheus_text() -> str:
+    """Exposition of the global registry (shorthand)."""
+    return registry().prometheus_text()
+
+
+def snapshot() -> dict:
+    """JSON snapshot of the global registry (shorthand)."""
+    return registry().snapshot()

@@ -200,6 +200,7 @@ class FaultPlan:
             spec.fired += 1
             self.fired.append(_Firing(site, idx, spec.kind, dict(ctx)))
             kind, arg = spec.kind, spec.arg
+        _emit_telemetry(site, kind, idx, ctx)
         # act outside the lock: delays must not serialize other sites
         if kind == "delay":
             time.sleep(float(arg))
@@ -216,6 +217,33 @@ class FaultPlan:
     def __exit__(self, *exc):
         deactivate(self)
         return False
+
+
+_FAULT_COUNTER = None
+
+
+def _emit_telemetry(site: str, kind: str, hit: int, ctx: dict):
+    """Every firing lands in the flight recorder + a labeled counter, so a
+    postmortem dump shows the injected fault right before the failure it
+    caused (telemetry import is lazy: faults loads very early in package
+    init). The private audit list on the plan stays authoritative for
+    tests."""
+    global _FAULT_COUNTER
+    try:
+        from .. import telemetry
+
+        if _FAULT_COUNTER is None:
+            _FAULT_COUNTER = telemetry.registry().counter(
+                "fault_injections_total", "chaos-harness faults fired",
+                ("site", "kind"))
+        _FAULT_COUNTER.labels(site=site, kind=kind).inc()
+        safe_ctx = {k: v for k, v in ctx.items()
+                    if k not in ("kind", "site", "hit")
+                    and isinstance(v, (int, float, str, bool))}
+        telemetry.record_event("fault.injected", site=site, fault=kind,
+                               hit=hit, **safe_ctx)
+    except Exception:
+        pass  # telemetry must never alter fault semantics
 
 
 _ACTIVE: FaultPlan | None = None

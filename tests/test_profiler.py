@@ -107,3 +107,76 @@ class TestOpSummary:
             [{"name": "fusion.1", "calls": 3, "total_us": 10.0,
               "avg_us": 3.33, "pct": 100.0}], [])
         assert "Device (TPU) op summary" in s and "fusion.1" in s
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 4 satellite fixes
+# ---------------------------------------------------------------------------
+
+def test_benchmark_reset_clears_step_anchors():
+    """The first step() after reset() must not record the whole inter-reset
+    gap as one bogus batch interval (the stale _batch_t0/_reader_t0 bug)."""
+    b = prof.Benchmark()
+    b.begin()
+    b.step(num_samples=1)
+    b.reset()
+    time.sleep(0.05)            # the would-be bogus interval
+    b.step(num_samples=1)       # first post-reset step: arms, records nothing
+    assert b.batch.count == 0
+    b.step(num_samples=1)       # second: records a real (tiny) interval
+    assert b.batch.count == 1
+    assert b.batch_average() < 0.05
+    # reader side: after_reader with a stale anchor must not record either
+    b.reset()
+    b.after_reader()
+    assert b.reader.count == 0
+
+
+def test_profiler_export_honors_path(tmp_path):
+    d = str(tmp_path / "trace")
+    p = prof.Profiler(on_trace_ready=prof.export_chrome_tracing(d))
+    p.start()
+    jax.block_until_ready(jnp.ones((4, 4)) @ jnp.ones((4, 4)))
+    p.step()
+    p.stop()
+    dest = str(tmp_path / "exported_copy")
+    assert p.export(path=dest) == dest
+    src_files = sorted(f for _, _, fs in os.walk(d) for f in fs)
+    dst_files = sorted(f for _, _, fs in os.walk(dest) for f in fs)
+    assert dst_files == src_files and dst_files
+    with np.testing.assert_raises(ValueError):
+        p.export(format="csv")
+
+
+def test_profiler_export_without_trace_raises():
+    p = prof.Profiler(timer_only=True)
+    p.start()
+    p.stop()
+    with np.testing.assert_raises(RuntimeError):
+        p.export(path="/tmp/nowhere")
+    assert p.export() is None   # no-path form still returns the (absent) dir
+
+
+def test_parse_trace_op_times_reports_skipped_files(tmp_path):
+    """Unreadable trace files are counted and named in rows.meta, so an
+    empty summary is distinguishable from a parse failure."""
+    import gzip
+    import json as _json
+
+    run = tmp_path / "plugins" / "profile" / "run1"
+    run.mkdir(parents=True)
+    good = {"traceEvents": [
+        {"ph": "M", "name": "process_name", "pid": 1,
+         "args": {"name": "/host:CPU"}},
+        {"ph": "X", "name": "my_op", "pid": 1, "dur": 5.0},
+    ]}
+    with gzip.open(run / "good.trace.json.gz", "wt") as f:
+        _json.dump(good, f)
+    (run / "corrupt.trace.json.gz").write_bytes(b"not gzip at all")
+    dev, host = prof.parse_trace_op_times(str(tmp_path))
+    assert host and host[0]["name"] == "my_op"
+    for rows in (dev, host):
+        assert rows.meta["files_seen"] == 2
+        assert rows.meta["files_skipped"] == 1
+        (skipped_path, err), = rows.meta["skipped"]
+        assert skipped_path.endswith("corrupt.trace.json.gz") and err

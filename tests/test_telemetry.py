@@ -1,0 +1,479 @@
+"""paddle_tpu.telemetry: metrics registry, span tracing, flight recorder,
+and the serving-engine integration (ISSUE 4 acceptance gate).
+
+The contract under test, per docs/OBSERVABILITY.md:
+
+- Counter/Gauge/Histogram semantics incl. label sets, exact under
+  concurrency, frozen under ``telemetry.disable()``;
+- Prometheus text exposition matches the format golden (bucket cumulation,
+  _sum/_count, label escaping);
+- ``span()`` nesting produces parent ids that survive a Chrome-trace
+  export round-trip;
+- the flight recorder ring evicts oldest-first and dumps a postmortem JSON
+  whose tail names the events leading up to the failure;
+- a multi-request ``LLMEngine`` run records TTFT/TPOT histograms agreeing
+  with ``stats()`` (which keeps its pre-telemetry dict shape) and one
+  nested queued→prefill→decode lifecycle per request;
+- an injected collective timeout leaves a dump whose last events include
+  the fault injection and the timed-out collective.
+"""
+import json
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu import telemetry
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+from paddle_tpu.serving import LLMEngine, SamplingParams
+from paddle_tpu.telemetry.flight_recorder import FlightRecorder
+from paddle_tpu.telemetry.metrics import MetricsRegistry
+from paddle_tpu.telemetry.tracing import Tracer
+from paddle_tpu.utils import faults
+from paddle_tpu.utils.faults import FaultPlan
+
+pytestmark = pytest.mark.telemetry
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_enabled():
+    """disable() must never leak between tests; neither may fault plans."""
+    telemetry.enable()
+    yield
+    telemetry.enable()
+    faults.deactivate()
+
+
+# ---------------------------------------------------------------------------
+# metrics: counter / gauge / histogram semantics
+# ---------------------------------------------------------------------------
+
+class TestMetrics:
+    def test_counter_monotonic_and_labels(self):
+        reg = MetricsRegistry()
+        c = reg.counter("reqs_total", "requests", labels=("op",))
+        c.labels(op="get").inc()
+        c.labels(op="get").inc(2.5)
+        c.labels(op="set").inc()
+        assert c.labels(op="get").value == 3.5
+        assert c.labels(op="set").value == 1.0
+        with pytest.raises(ValueError):
+            c.labels(op="get").inc(-1)
+        with pytest.raises(ValueError):            # wrong label names
+            c.labels(verb="get")
+
+    def test_unlabeled_shorthand(self):
+        reg = MetricsRegistry()
+        c = reg.counter("x_total")
+        c.inc(4)
+        assert c.value == 4.0
+        g = reg.gauge("g")
+        g.set(2.0)
+        g.inc()
+        g.dec(0.5)
+        assert g.value == 2.5
+
+    def test_histogram_buckets_sum_count_mean(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat", buckets=(0.01, 0.1, 1.0))
+        for v in (0.005, 0.01, 0.05, 0.5, 5.0):
+            h.observe(v)
+        ch = h.labels() if h.label_names else h._default
+        # le semantics: 0.01 lands in the 0.01 bucket
+        assert ch.counts == [2, 1, 1, 1]
+        assert ch.cumulative() == [2, 3, 4, 5]
+        assert h.count == 5
+        assert h.sum == pytest.approx(5.565)
+        assert h.mean == pytest.approx(5.565 / 5)
+
+    def test_get_or_create_identity_and_conflicts(self):
+        reg = MetricsRegistry()
+        a = reg.counter("n", "first", labels=("x",))
+        b = reg.counter("n", "second", labels=("x",))
+        assert a is b
+        with pytest.raises(ValueError):
+            reg.gauge("n")                         # kind conflict
+        with pytest.raises(ValueError):
+            reg.counter("n", labels=("y",))        # label-set conflict
+
+    def test_thread_safety_exact_counts(self):
+        reg = MetricsRegistry()
+        c = reg.counter("c_total", labels=("t",))
+        h = reg.histogram("h", buckets=(0.5,))
+        child = c.labels(t="all")
+        n_threads, n_iter = 8, 5000
+
+        def worker():
+            for _ in range(n_iter):
+                child.inc()
+                h.observe(0.25)
+
+        ts = [threading.Thread(target=worker) for _ in range(n_threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert child.value == n_threads * n_iter
+        assert h.count == n_threads * n_iter
+        assert h.sum == pytest.approx(0.25 * n_threads * n_iter)
+
+    def test_disable_freezes_writes(self):
+        reg = MetricsRegistry()
+        c = reg.counter("c")
+        g = reg.gauge("g")
+        h = reg.histogram("h")
+        c.inc()
+        telemetry.disable()
+        c.inc(100)
+        g.set(9)
+        h.observe(1.0)
+        telemetry.enable()
+        assert c.value == 1.0 and g.value == 0.0 and h.count == 0
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition format (golden)
+# ---------------------------------------------------------------------------
+
+class TestPrometheusExposition:
+    def test_golden_text(self):
+        reg = MetricsRegistry()
+        c = reg.counter("http_requests_total", "served requests",
+                        labels=("code",))
+        c.labels(code="200").inc(3)
+        c.labels(code="500").inc()
+        reg.gauge("queue_depth", "waiting").set(7)
+        h = reg.histogram("ttft_seconds", "first token",
+                          buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(0.5)
+        h.observe(2.0)
+        expected = "\n".join([
+            '# HELP http_requests_total served requests',
+            '# TYPE http_requests_total counter',
+            'http_requests_total{code="200"} 3',
+            'http_requests_total{code="500"} 1',
+            '# HELP queue_depth waiting',
+            '# TYPE queue_depth gauge',
+            'queue_depth 7',
+            '# HELP ttft_seconds first token',
+            '# TYPE ttft_seconds histogram',
+            'ttft_seconds_bucket{le="0.1"} 1',
+            'ttft_seconds_bucket{le="1"} 2',
+            'ttft_seconds_bucket{le="+Inf"} 3',
+            'ttft_seconds_sum 2.55',
+            'ttft_seconds_count 3',
+        ]) + "\n"
+        assert reg.prometheus_text() == expected
+
+    def test_label_escaping(self):
+        reg = MetricsRegistry()
+        c = reg.counter("c_total", labels=("path",))
+        c.labels(path='a"b\\c\nd').inc()
+        text = reg.prometheus_text()
+        assert 'path="a\\"b\\\\c\\nd"' in text
+
+    def test_snapshot_roundtrips_through_json(self):
+        reg = MetricsRegistry()
+        reg.counter("c", labels=("k",)).labels(k="v").inc(2)
+        reg.histogram("h", buckets=(1.0,)).observe(0.5)
+        snap = json.loads(json.dumps(reg.snapshot()))
+        assert snap["c"]["series"][0] == {"labels": {"k": "v"}, "value": 2.0}
+        assert snap["h"]["series"][0]["count"] == 1
+        assert snap["h"]["series"][0]["buckets"]["1"] == 1
+
+
+# ---------------------------------------------------------------------------
+# span tracing + Chrome export
+# ---------------------------------------------------------------------------
+
+class TestTracing:
+    def test_nesting_parent_ids(self):
+        tr = telemetry.tracer()
+        tr.clear()
+        with telemetry.span("outer", kind="test"):
+            with telemetry.span("middle"):
+                with telemetry.span("inner"):
+                    pass
+            with telemetry.span("sibling"):
+                pass
+        by_name = {s.name: s for s in tr.spans()}
+        assert by_name["outer"].parent_id is None
+        assert by_name["middle"].parent_id == by_name["outer"].span_id
+        assert by_name["inner"].parent_id == by_name["middle"].span_id
+        assert by_name["sibling"].parent_id == by_name["outer"].span_id
+        assert by_name["outer"].attrs == {"kind": "test"}
+        # children temporally contained in their parent
+        assert by_name["outer"].t0 <= by_name["inner"].t0
+        assert by_name["inner"].t1 <= by_name["outer"].t1
+
+    def test_chrome_export_roundtrip(self, tmp_path):
+        tr = Tracer()
+        t0 = 100.0
+        root = tr.emit("request", t0, t0 + 1.0, attrs={"rid": 7},
+                       tid=42, tid_name="request-7")
+        tr.emit("prefill", t0 + 0.1, t0 + 0.4, parent_id=root.span_id,
+                tid=42)
+        path = tr.export_chrome(str(tmp_path / "trace.json"))
+        doc = json.load(open(path))
+        evs = {e["name"]: e for e in doc["traceEvents"] if e["ph"] == "X"}
+        assert evs["prefill"]["args"]["parent_id"] == root.span_id
+        assert evs["request"]["args"]["rid"] == 7
+        # containment in exported microseconds
+        assert evs["request"]["ts"] <= evs["prefill"]["ts"]
+        assert (evs["prefill"]["ts"] + evs["prefill"]["dur"]
+                <= evs["request"]["ts"] + evs["request"]["dur"] + 1e-3)
+        meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+        assert any(m["args"]["name"] == "request-7" for m in meta)
+
+    def test_capacity_eviction(self):
+        tr = Tracer(capacity=3)
+        for i in range(7):
+            tr.emit(f"s{i}", 0.0, 1.0)
+        assert [s.name for s in tr.spans()] == ["s4", "s5", "s6"]
+        assert tr.dropped == 4
+
+    def test_disable_stops_recording(self):
+        tr = telemetry.tracer()
+        tr.clear()
+        telemetry.disable()
+        with telemetry.span("ghost"):
+            pass
+        assert tr.emit("ghost2", 0, 1) is None
+        telemetry.enable()
+        assert tr.spans() == []
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+class TestFlightRecorder:
+    def test_ring_eviction_oldest_first(self):
+        fr = FlightRecorder(capacity=4)
+        for i in range(10):
+            fr.record("tick", i=i)
+        evs = fr.events()
+        assert len(evs) == 4
+        assert [e["i"] for e in evs] == [6, 7, 8, 9]
+        assert [e["seq"] for e in evs] == [7, 8, 9, 10]
+
+    def test_dump_on_error_names_tail(self, tmp_path):
+        fr = FlightRecorder(capacity=8)
+        for i in range(20):
+            fr.record("step", i=i)
+        fr.record("fault.injected", site="collective.all_reduce")
+        err = TimeoutError("collective 'all_reduce' wedged")
+        path = fr.dump(path=str(tmp_path / "post.json"),
+                       reason="collective timeout", error=err)
+        assert path == fr.last_dump_path
+        doc = json.load(open(path))
+        assert doc["reason"] == "collective timeout"
+        assert "all_reduce" in doc["error"]
+        assert doc["events"][-1]["kind"] == "fault.injected"
+        assert doc["events_dropped"] == 21 - len(doc["events"])
+
+    def test_dump_never_raises(self):
+        fr = FlightRecorder()
+        fr.record("x")
+        assert fr.dump(path="/nonexistent-dir/deep/post.json") is None
+
+    def test_kind_filter_and_clear(self):
+        fr = FlightRecorder()
+        fr.record("a", v=1)
+        fr.record("b")
+        fr.record("a", v=2)
+        assert [e["v"] for e in fr.events("a")] == [1, 2]
+        fr.clear()
+        assert len(fr) == 0
+
+    def test_excepthook_dumps_on_fatal(self, tmp_path, capsys):
+        telemetry.install_excepthook()
+        fr = telemetry.flight()
+        fr.record("pre-crash", marker=123)
+        before = fr.num_dumps
+        try:
+            raise RuntimeError("boom")
+        except RuntimeError:
+            sys.excepthook(*sys.exc_info())
+        assert fr.num_dumps == before + 1
+        doc = json.load(open(fr.last_dump_path))
+        assert doc["reason"] == "uncaught exception"
+        assert any(e["kind"] == "fatal.exception" for e in doc["events"])
+        capsys.readouterr()                        # swallow the traceback
+
+
+# ---------------------------------------------------------------------------
+# fault injections emit telemetry
+# ---------------------------------------------------------------------------
+
+def test_fault_firing_lands_in_flight_recorder():
+    telemetry.flight().clear()
+    with FaultPlan.parse("my.site:error@1"):
+        with pytest.raises(faults.FaultError):
+            faults.inject("my.site", rid=3)
+    evs = telemetry.flight().events("fault.injected")
+    assert len(evs) == 1
+    assert evs[0]["site"] == "my.site" and evs[0]["fault"] == "error"
+    assert evs[0]["rid"] == 3
+    fam = telemetry.registry().get("fault_injections_total")
+    assert fam.labels(site="my.site", kind="error").value >= 1
+
+
+# ---------------------------------------------------------------------------
+# engine integration: histograms + lifecycle spans vs stats()
+# ---------------------------------------------------------------------------
+
+_STATS_KEYS = {
+    "queue_depth", "num_running", "num_finished", "num_failed",
+    "num_cancelled", "num_rejected", "blocks_used", "blocks_free",
+    "block_high_water", "cache_utilization", "num_preemptions",
+    "decode_traces", "prefill_traces", "total_generated_tokens",
+    "tokens_per_sec", "mean_ttft", "watchdog_trips", "last_decode_s",
+}
+
+
+def _tiny_engine(**kw):
+    paddle_tpu.seed(0)
+    cfg = llama_tiny(vocab=61, hidden=32, layers=2, heads=4, kv_heads=2,
+                     inter=64, seq=64)
+    return LLMEngine(LlamaForCausalLM(cfg), block_size=8, max_slots=2,
+                     max_model_len=48, **kw)
+
+
+class TestEngineIntegration:
+    def test_histograms_and_lifecycle_match_stats(self):
+        telemetry.tracer().clear()
+        eng = _tiny_engine()
+        prompts = [[1, 2, 3, 4], [5, 6, 7], [8, 9, 10, 11, 12]]
+        outs = eng.generate(prompts, SamplingParams(max_new_tokens=5))
+        assert all(len(o) == 5 for o in outs)
+        st = eng.stats()
+        assert set(st.keys()) == _STATS_KEYS   # dict shape preserved
+        assert st["num_finished"] == 3
+
+        m = eng._m
+        # one TTFT observation per request that emitted a first token,
+        # one TPOT observation per finished multi-token request
+        assert m.ttft.count == 3
+        assert m.tpot.count == 3
+        assert m.queue_time.count == 3
+        assert st["mean_ttft"] == pytest.approx(m.ttft.sum / m.ttft.count)
+        assert st["total_generated_tokens"] == 15
+        assert m.tokens.value == 15
+        assert m.decode_step.count > 0
+
+        # per-request lifecycle: one root span with nested phases
+        spans = telemetry.tracer().spans()
+        reqs = [s for s in spans if s.name == "request"
+                and s.attrs.get("engine") == eng.engine_label]
+        assert {s.attrs["rid"] for s in reqs} == {0, 1, 2}
+        for root in reqs:
+            kids = {s.name for s in spans
+                    if s.parent_id == root.span_id}
+            assert kids == {"queued", "prefill", "decode"}
+            assert root.attrs["state"] == "finished"
+            assert root.attrs["output_tokens"] == 5
+
+        # the same run is scrapeable as Prometheus text
+        text = telemetry.prometheus_text()
+        lab = f'engine="{eng.engine_label}"'
+        assert f'serving_ttft_seconds_count{{{lab}}} 3' in text
+        assert f'serving_requests_finished_total{{{lab}}} 3' in text
+        assert "serving_tpot_seconds_bucket" in text
+
+    def test_chrome_export_contains_lifecycle(self, tmp_path):
+        telemetry.tracer().clear()
+        eng = _tiny_engine()
+        eng.generate([[1, 2, 3]], SamplingParams(max_new_tokens=3))
+        path = telemetry.tracer().export_chrome(str(tmp_path / "t.json"))
+        doc = json.load(open(path))
+        names = [e["name"] for e in doc["traceEvents"] if e["ph"] == "X"]
+        for expect in ("request", "queued", "prefill", "decode",
+                       "engine.decode", "engine.prefill"):
+            assert expect in names, f"missing {expect} in chrome trace"
+
+    def test_stats_shape_survives_disable(self):
+        eng = _tiny_engine()
+        eng.generate([[1, 2, 3]], SamplingParams(max_new_tokens=2))
+        telemetry.disable()
+        try:
+            st = eng.stats()
+            assert set(st.keys()) == _STATS_KEYS
+            assert st["num_finished"] == 1
+            assert st["blocks_used"] == 0
+            assert st["mean_ttft"] is not None
+        finally:
+            telemetry.enable()
+
+    def test_failed_request_lifecycle_recorded(self):
+        telemetry.tracer().clear()
+        eng = _tiny_engine()
+        with FaultPlan.parse("serving.prefill:error@1"):
+            eng.generate([[1, 2, 3], [4, 5, 6]],
+                         SamplingParams(max_new_tokens=3))
+        st = eng.stats()
+        assert st["num_failed"] == 1 and st["num_finished"] == 1
+        states = {s.attrs["rid"]: s.attrs["state"]
+                  for s in telemetry.tracer().find("request")
+                  if s.attrs.get("engine") == eng.engine_label}
+        assert sorted(states.values()) == ["failed", "finished"]
+        assert int(eng._m.failed.value) == 1
+
+
+# ---------------------------------------------------------------------------
+# collective timeout -> postmortem dump (acceptance criterion 3)
+# ---------------------------------------------------------------------------
+
+class TestCollectiveTimeoutDump:
+    @pytest.fixture(autouse=True)
+    def _mesh(self):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.mesh import set_hybrid_communicate_group
+        from paddle_tpu.framework.flags import set_flags
+        dist.init_parallel_env()
+        yield
+        set_flags({"FLAGS_fault_plan": "",
+                   "FLAGS_collective_timeout_s": 0.0})
+        set_hybrid_communicate_group(None)
+
+    def test_dump_tail_names_fault_and_timeout(self, tmp_path,
+                                               monkeypatch):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.collective import CollectiveTimeoutError
+        from paddle_tpu.framework.flags import set_flags
+
+        monkeypatch.setenv("PADDLE_TPU_FLIGHT_DIR", str(tmp_path))
+        t = dist.shard_to_group(
+            [np.full((2, 2), i, np.float32) for i in range(8)])
+        dist.all_reduce(t)   # warm the compile so the wedged worker below
+        #                      finishes quickly once its delay elapses
+        fr = telemetry.flight()
+        fr.clear()
+        set_flags({"FLAGS_collective_timeout_s": 0.05})
+        with FaultPlan.parse("collective.all_reduce:delay=0.2@1"):
+            with pytest.raises(CollectiveTimeoutError):
+                dist.all_reduce(t)
+        # drain the guard's worker thread: a daemon still inside XLA at
+        # interpreter shutdown aborts the process (C++ terminate)
+        for th in threading.enumerate():
+            if th.name.startswith("collective-"):
+                th.join(timeout=30)
+        assert fr.last_dump_path is not None
+        assert fr.last_dump_path.startswith(str(tmp_path))
+        doc = json.load(open(fr.last_dump_path))
+        assert doc["reason"].startswith("collective timeout")
+        kinds = [e["kind"] for e in doc["events"]]
+        # the tail tells the whole story: launch, injected fault, timeout
+        assert "collective.launch" in kinds
+        assert "fault.injected" in kinds
+        assert kinds[-1] == "collective.timeout"
+        tm = [e for e in doc["events"] if e["kind"] == "collective.timeout"]
+        # nranks reflects whatever mesh topology the suite left active, so
+        # assert shape, not a fixed world size
+        assert tm[0]["op"] == "all_reduce" and tm[0]["nranks"] >= 2
+        fam = telemetry.registry().get("collective_timeouts_total")
+        assert fam.labels(op="all_reduce").value >= 1

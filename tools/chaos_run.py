@@ -29,6 +29,7 @@ import numpy as np
 sys.path.insert(0, ".")
 
 import paddle_tpu  # noqa: E402
+from paddle_tpu import telemetry  # noqa: E402
 from paddle_tpu.models import LlamaForCausalLM, llama_tiny  # noqa: E402
 from paddle_tpu.serving import (  # noqa: E402
     LLMEngine, RequestState, SamplingParams)
@@ -141,6 +142,10 @@ def run_sweep(argv=None):
         rows.append(row)
 
     survived = sum(1 for r in rows if r["survived"])
+    # the postmortem artifact: the ring's tail covers the last plans' fault
+    # injections, scheduler decisions, and allocator traffic — plus any
+    # dump a timeout/stall already wrote mid-sweep (last_dump_path)
+    dump_path = telemetry.dump(reason="chaos sweep complete")
     report = {
         "config": {"requests": args.requests, "prompt_len": args.prompt_len,
                    "max_new_tokens": args.max_new, "slots": args.slots,
@@ -149,6 +154,7 @@ def run_sweep(argv=None):
         "plans_survived": survived,
         "all_survived": survived == len(rows),
         "baseline_wall_sec": base_wall,
+        "flight_recorder_dump": dump_path,
         "results": rows,
     }
     if args.json:
@@ -158,6 +164,7 @@ def run_sweep(argv=None):
 
 
 def main(argv=None):
+    telemetry.install_excepthook()   # a crashed sweep still leaves a dump
     report = run_sweep(argv)
     print(json.dumps(report, indent=2))
     for r in report["results"]:

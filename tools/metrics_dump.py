@@ -1,0 +1,107 @@
+"""Pretty-print a telemetry registry snapshot JSON as tables.
+
+The snapshot is what ``--metrics-out`` (bench.py / tools/serving_bench.py)
+and ``telemetry.registry().snapshot_json(path)`` write — this tool turns it
+into something eyeballable next to a BENCH_*.json artifact:
+
+    python tools/metrics_dump.py METRICS.json [--filter serving_]
+
+Counters and gauges print one row per labeled series; histograms print
+count / sum / mean plus a p50/p90/p99 estimate interpolated from the
+cumulative bucket counts (estimates, bounded by bucket resolution —
+exactly what Prometheus's ``histogram_quantile`` would report).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _quantile(buckets: dict, count: int, q: float):
+    """Estimate the q-quantile from cumulative {le: count} buckets by
+    linear interpolation inside the containing bucket (the
+    histogram_quantile convention; +Inf-bucket hits clamp to the last
+    finite edge)."""
+    if not count:
+        return None
+    target = q * count
+    edges = sorted((float(e), c) for e, c in buckets.items())
+    prev_edge, prev_cum = 0.0, 0
+    for edge, cum in edges:
+        if cum >= target:
+            span = cum - prev_cum
+            frac = (target - prev_cum) / span if span else 1.0
+            return prev_edge + frac * (edge - prev_edge)
+        prev_edge, prev_cum = edge, cum
+    return edges[-1][0] if edges else None
+
+
+def _labelstr(labels: dict) -> str:
+    return ",".join(f"{k}={v}" for k, v in labels.items()) or "-"
+
+
+def format_snapshot(snap: dict, name_filter: str = "") -> str:
+    lines = []
+    scalars = []
+    hists = []
+    for name, fam in sorted(snap.items()):
+        if name_filter and name_filter not in name:
+            continue
+        for s in fam["series"]:
+            if fam["type"] == "histogram":
+                hists.append((name, s))
+            else:
+                scalars.append((name, fam["type"], s))
+    if scalars:
+        w = max(len(n) for n, _, _ in scalars)
+        lines.append(f"{'metric':<{w}}  {'type':<7} {'labels':<24} value")
+        lines.append("-" * (w + 46))
+        for name, kind, s in scalars:
+            v = s["value"]
+            vs = f"{v:.6g}" if isinstance(v, float) else str(v)
+            lines.append(
+                f"{name:<{w}}  {kind:<7} {_labelstr(s['labels']):<24} {vs}")
+    if hists:
+        if scalars:
+            lines.append("")
+        w = max(len(n) for n, _ in hists)
+        lines.append(f"{'histogram':<{w}}  {'labels':<24} {'count':>8} "
+                     f"{'mean':>12} {'p50':>12} {'p90':>12} {'p99':>12}")
+        lines.append("-" * (w + 86))
+        for name, s in hists:
+            cnt = s["count"]
+
+            def fmt(x):
+                return f"{x:.6g}" if x is not None else "-"
+
+            lines.append(
+                f"{name:<{w}}  {_labelstr(s['labels']):<24} {cnt:>8} "
+                f"{fmt(s.get('mean')):>12} "
+                f"{fmt(_quantile(s['buckets'], cnt, 0.5)):>12} "
+                f"{fmt(_quantile(s['buckets'], cnt, 0.9)):>12} "
+                f"{fmt(_quantile(s['buckets'], cnt, 0.99)):>12}")
+    if not lines:
+        lines.append("(no metrics matched)")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("snapshot", help="registry snapshot JSON (--metrics-out)")
+    ap.add_argument("--filter", default="",
+                    help="only metric names containing this substring")
+    args = ap.parse_args(argv)
+    try:
+        with open(args.snapshot) as f:
+            snap = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"cannot read snapshot {args.snapshot!r}: {e}",
+              file=sys.stderr)
+        return 1
+    print(format_snapshot(snap, args.filter))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

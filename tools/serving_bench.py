@@ -14,6 +14,14 @@ which is charged to it deliberately — that IS its cost model.
 Usage:
     python tools/serving_bench.py [--requests 8] [--prompt-len 32]
         [--max-new 32] [--slots 4] [--block-size 16] [--json OUT.json]
+        [--metrics-out METRICS.json] [--telemetry on|off]
+
+``--metrics-out`` writes the telemetry registry's JSON snapshot (TTFT/TPOT
+histograms, block-pool gauges, per-request counters) next to the bench
+artifact — pretty-print it with ``python tools/metrics_dump.py``.
+``--telemetry off`` flips the registry-disabled fast path, which is how the
+instrumentation overhead acceptance number (enabled within 3% of disabled)
+is measured.
 
 Runs on whatever backend is active (CPU uses the jnp mirror of the paged
 kernel; numbers are only meaningful on TPU, but the speedup *shape* shows
@@ -31,6 +39,7 @@ import numpy as np
 sys.path.insert(0, ".")
 
 import paddle_tpu  # noqa: E402
+from paddle_tpu import telemetry  # noqa: E402
 from paddle_tpu.models import LlamaForCausalLM, llama_tiny  # noqa: E402
 from paddle_tpu.serving import (  # noqa: E402
     LLMEngine, SamplingParams, naive_generate)
@@ -47,8 +56,16 @@ def main():
     ap.add_argument("--hidden", type=int, default=128)
     ap.add_argument("--layers", type=int, default=4)
     ap.add_argument("--json", default=None)
+    ap.add_argument("--metrics-out", default=None,
+                    help="write the telemetry registry JSON snapshot here")
+    ap.add_argument("--telemetry", choices=("on", "off"), default="on",
+                    help="off = registry-disabled fast path (overhead "
+                         "baseline for the <=3%% acceptance check)")
     args = ap.parse_args()
 
+    if args.telemetry == "off":
+        telemetry.disable()
+    telemetry.install_excepthook()
     paddle_tpu.seed(0)
     max_len = args.prompt_len + args.max_new
     cfg = llama_tiny(vocab=args.vocab, hidden=args.hidden, layers=args.layers,
@@ -94,11 +111,16 @@ def main():
         "prefill_traces": st["prefill_traces"],
         "block_high_water": st["block_high_water"],
         "num_preemptions": st["num_preemptions"],
+        "telemetry": args.telemetry,
+        "mean_ttft": st["mean_ttft"],
     }
     print(json.dumps(result, indent=2))
     if args.json:
         with open(args.json, "w") as f:
             json.dump(result, f, indent=2)
+    if args.metrics_out:
+        telemetry.registry().snapshot_json(args.metrics_out)
+        print(f"# metrics snapshot -> {args.metrics_out}", file=sys.stderr)
     if not match:
         raise SystemExit("engine outputs diverged from the naive baseline")
 
