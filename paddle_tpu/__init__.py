@@ -101,6 +101,7 @@ from .core.containers import (  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
 from . import telemetry  # noqa: F401,E402
 from . import serving  # noqa: F401,E402
+from . import resilience  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 
 bool = bool_  # paddle.bool

@@ -127,6 +127,12 @@ class GradScaler:
         self._found_inf = False
         self._unscaled = False
         self._stepped = False
+        # health-guard bookkeeping (resilience.HealthGuard): total steps the
+        # guard skipped for nonfinite loss/grads, and the CURRENT consecutive
+        # nonfinite streak — both checkpointed so a resumed run backs off
+        # exactly like an uninterrupted one
+        self._skip_count = 0
+        self._streak = 0
 
     def is_enable(self):
         return self._enable
@@ -170,20 +176,41 @@ class GradScaler:
             optimizer.step()
         self._stepped = True
 
+    def record_nonfinite(self, found_inf: bool):
+        """Feed an externally computed (jit-fused) per-step nonfinite verdict
+        into dynamic scaling — the health-guard path, where inf/nan detection
+        happened inside the compiled train step instead of ``unscale_``.
+        Counts skips, tracks the consecutive-bad streak, and runs the usual
+        ``update()`` backoff/growth policy."""
+        if not self._enable:
+            return
+        self._found_inf = bool(found_inf)
+        if found_inf:
+            self._skip_count += 1
+        self.update()
+
     def update(self):
         if not self._enable:
             return
         self._unscaled = False
         self._stepped = False
         if not self._dynamic:
+            self._streak = self._streak + 1 if self._found_inf else 0
             self._found_inf = False
             return
         if self._found_inf:
+            self._streak += 1
             self._bad_steps += 1
             self._good_steps = 0
             if self._bad_steps >= self._decr_every:
                 self._scale = max(self._scale * self._decr_ratio, 1.0)
                 self._bad_steps = 0
+        elif self._streak > 0:
+            # first finite step after a nonfinite streak: the streak cools
+            # off but the scale must NOT grow yet — growing straight out of
+            # a backoff re-triggers the overflow that caused it
+            self._streak = 0
+            self._bad_steps = 0
         else:
             self._good_steps += 1
             self._bad_steps = 0
@@ -203,9 +230,12 @@ class GradScaler:
 
     def state_dict(self):
         return {"scale": self._scale, "good_steps": self._good_steps,
-                "bad_steps": self._bad_steps}
+                "bad_steps": self._bad_steps, "skip_count": self._skip_count,
+                "streak": self._streak}
 
     def load_state_dict(self, state):
         self._scale = state.get("scale", self._scale)
         self._good_steps = state.get("good_steps", 0)
         self._bad_steps = state.get("bad_steps", 0)
+        self._skip_count = state.get("skip_count", 0)
+        self._streak = state.get("streak", 0)

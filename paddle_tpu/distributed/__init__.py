@@ -41,6 +41,7 @@ from .checkpoint import (  # noqa: F401
     save_distributed_checkpoint,
 )
 from .cost_model import ClusterSpec, CostModel, ModelSpec  # noqa: F401
+from .elastic import ElasticLevel, ElasticManager, Heartbeat  # noqa: F401
 from .engine import DistributedEngine  # noqa: F401
 from .mesh import (  # noqa: F401
     HybridCommunicateGroup,
@@ -88,6 +89,7 @@ __all__ = [
     "ProcessMesh", "Shard", "Replicate", "Partial", "shard_tensor", "reshard",
     "shard_layer", "dtensor_from_fn", "AutoTuner", "TCPStore",
     "Engine", "CostModel", "ModelSpec", "ClusterSpec",
+    "ElasticLevel", "ElasticManager", "Heartbeat",
     "VocabParallelEmbedding", "ColumnParallelLinear", "RowParallelLinear",
     "ParallelCrossEntropy", "mark_sharding",
     "RNGStatesTracker", "get_rng_state_tracker", "model_parallel_random_seed",

@@ -56,10 +56,13 @@ def _ckpt_metrics():
                     "bytes committed to published snapshots"),
         reg.counter("ckpt_fallbacks_total",
                     "torn/corrupt snapshots skipped during load"),
+        reg.gauge("ckpt_last_save_unixtime",
+                  "wall time of the last committed snapshot (checkpoint "
+                  "age = now - this; see docs/OBSERVABILITY.md)"),
     )
 
 
-_M_SAVE_S, _M_LOAD_S, _M_BYTES, _M_FALLBACKS = _ckpt_metrics()
+_M_SAVE_S, _M_LOAD_S, _M_BYTES, _M_FALLBACKS, _M_LAST_SAVE = _ckpt_metrics()
 
 __all__ = ["DistributedSaver", "Checkpoint", "CheckpointCorrupt",
            "save_distributed_checkpoint", "load_distributed_checkpoint"]
@@ -323,6 +326,7 @@ class DistributedSaver:
             nbytes = sum(w["size"] for w in written.values())
             _M_SAVE_S.observe(dur)
             _M_BYTES.inc(nbytes)
+            _M_LAST_SAVE.set(time.time())
             telemetry.record_event("ckpt.save", path=final, rank=rank,
                                    bytes=nbytes, seconds=round(dur, 4),
                                    async_save=async_save)

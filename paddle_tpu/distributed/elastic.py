@@ -61,7 +61,7 @@ class ElasticManager:
 
     def __init__(self, store, world_size, timeout=6.0, poll=1.0,
                  on_failure=None, level=ElasticLevel.FAULT_TOLERANT,
-                 min_world=1, max_world=None):
+                 min_world=1, max_world=None, join_grace=30.0):
         self.store = store
         self.world_size = int(world_size)
         self.timeout = timeout
@@ -70,11 +70,17 @@ class ElasticManager:
         self.level = level
         self.min_world = int(min_world)
         self.max_world = int(max_world or world_size)
+        # a rank with NO beat key yet may simply still be starting up (jax
+        # init, imports); only after join_grace seconds of silence is a
+        # never-registered rank declared dead
+        self.join_grace = float(join_grace)
         self._stop = threading.Event()
         self._thread = None
         self.dead: list[int] = []
+        self.failures: list[list[int]] = []  # every detection, in order
         # rank -> (last seen sequence, master-local time it changed)
         self._seen: dict[int, tuple[int, float]] = {}
+        self._grace_t0: float | None = None  # set on first check / re-arm
 
     def scale_plan(self, dead) -> int | None:
         """Next world size after losing ``dead`` ranks (reference
@@ -101,13 +107,19 @@ class ElasticManager:
     def check_once(self) -> list[int]:
         """Ranks whose heartbeat sequence hasn't advanced within the timeout
         (measured entirely on the master's clock — immune to cross-host
-        clock skew)."""
+        clock skew). A rank that never registered a beat is only dead once
+        the join grace period has expired — declaring it dead on the first
+        poll (before it could possibly register) would abort every cold
+        start."""
         now = time.monotonic()
+        if self._grace_t0 is None:
+            self._grace_t0 = now
         dead = []
         for r in range(self.world_size):
             raw = self.store.get(f"beat/{r}")
             if raw is None:
-                dead.append(r)
+                if now - self._grace_t0 > self.join_grace:
+                    dead.append(r)
                 continue
             seq = int(raw)
             last_seq, last_t = self._seen.get(r, (None, now))
@@ -117,15 +129,30 @@ class ElasticManager:
                 dead.append(r)
         return dead
 
+    def rearm(self, dead=None):
+        """Forget the heartbeat history of the given ranks (default: all)
+        and restart the join-grace window. Called after each failure so the
+        monitor can watch the RESTARTED pod: the dead ranks' stale beat
+        sequences must not instantly re-trip detection, and the relaunched
+        workers get a fresh grace period to register."""
+        for r in (dead if dead is not None else list(self._seen)):
+            self._seen.pop(r, None)
+        self._grace_t0 = time.monotonic()
+
     def start(self):
         def run():
+            # persistent watch: after a failure fires, re-arm and KEEP
+            # monitoring — a launcher with max_restarts>1 needs the second
+            # (and third...) failure detected too, not a thread that
+            # silently exited after the first
             while not self._stop.wait(self.poll):
                 dead = self.check_once()
                 if dead:
                     self.dead = dead
+                    self.failures.append(list(dead))
                     if self.on_failure is not None:
                         self.on_failure(dead)
-                    return
+                    self.rearm(dead)
 
         self._thread = threading.Thread(target=run, daemon=True)
         self._thread.start()

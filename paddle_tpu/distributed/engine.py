@@ -104,6 +104,7 @@ class DistributedEngine:
         self._label_specs = label_specs
         self._train_step = None
         self._train_step_outs = None
+        self._guarded_step = None
         self._grad_step = None
         self._grad_only_step = None
         self._apply_step = None
@@ -312,6 +313,57 @@ class DistributedEngine:
             out_shardings=(None, None, bshard, pshard, oshard),
             donate_argnums=(0, 2),
         )
+
+    def _build_guarded_step(self):
+        """Health-guarded SPMD step (hapi.Model.train_batch_guarded /
+        resilience.ResilientLoop): one scalar all-finite verdict over loss +
+        every grad leaf computed in-graph (the psum'd GLOBAL grads, so one
+        rank's NaN skips the step on every rank identically), and the
+        optimizer update suppressed by selecting old params/opt_state when
+        the verdict is bad. ``bad`` poisons this step's grads (the
+        optimizer.step:nan_grads chaos site) without retracing."""
+        opt = self.optimizer
+        forward_loss = self._forward_loss_outs()
+
+        def step(params, buffers, opt_state, lr, rng, bad, inputs, labels):
+            (loss, (new_buf, _)), grads = jax.value_and_grad(
+                forward_loss, has_aux=True)(
+                    params, buffers, rng, inputs, labels, True)
+            grads = jax.tree_util.tree_map(
+                lambda g: jnp.where(bad, jnp.asarray(jnp.nan, g.dtype), g)
+                if jnp.issubdtype(g.dtype, jnp.floating) else g, grads)
+            loss = jnp.where(bad, jnp.asarray(jnp.nan, loss.dtype), loss)
+            ok = jnp.isfinite(loss)
+            for g in jax.tree_util.tree_leaves(grads):
+                ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(g)))
+            new_params, new_opt = opt.apply_gradients(params, grads, opt_state, lr)
+            keep = lambda new, old: jnp.where(ok, new, old)
+            new_params = jax.tree_util.tree_map(keep, new_params, params)
+            new_opt = jax.tree_util.tree_map(keep, new_opt, opt_state)
+            new_buf = jax.tree_util.tree_map(keep, new_buf, buffers)
+            return loss, new_buf, new_params, new_opt, ok
+
+        pshard, bshard, oshard = self._shardings()
+        return jax.jit(
+            step,
+            in_shardings=(pshard, bshard, oshard, None, None, None, None, None),
+            out_shardings=(None, bshard, pshard, oshard, None),
+            donate_argnums=(0, 2),
+        )
+
+    def train_step_guarded(self, inputs, labels, poison_nan=False):
+        """One guarded step; returns (host loss, ok verdict). A bad step
+        leaves params/buffers/opt_state bit-identical on every rank."""
+        inputs, labels, lr, rng = self._prep_step(inputs, labels)
+        params, buffers, opt_state = self._state
+        if self._guarded_step is None:
+            self._guarded_step = self._build_guarded_step()
+        loss, new_buf, new_params, new_opt, ok = self._guarded_step(
+            params, buffers, opt_state, lr, rng,
+            jnp.asarray(bool(poison_nan)), inputs, labels)
+        self._state = (new_params, new_buf, new_opt)
+        self._step_count += 1
+        return loss, ok
 
     def _build_grad_step(self):
         """Gradient-only sharded step for hapi accumulate_grad_batches: grads

@@ -42,6 +42,15 @@ def _parse(argv):
                         "fleet/elastic/manager.py ElasticLevel)")
     p.add_argument("--min_procs", type=int, default=1,
                    help="elastic level-2 lower bound on workers per node")
+    p.add_argument("--restart_backoff", type=float, default=0.5,
+                   help="initial delay before a pod relaunch; doubles per "
+                        "attempt (exponential backoff)")
+    p.add_argument("--restart_backoff_max", type=float, default=30.0,
+                   help="backoff ceiling in seconds")
+    p.add_argument("--job_state", default=None,
+                   help="path of the job_state.json ledger (default: "
+                        "<log_dir>/job_state.json); workers see it as "
+                        "$PADDLE_JOB_STATE and record resume steps there")
     p.add_argument("--devices", default=None,
                    help="comma list forwarded as PADDLE_TPU_VISIBLE_DEVICES")
     p.add_argument("--backend", choices=["auto", "cpu", "tpu"], default="auto",
@@ -72,6 +81,9 @@ def _worker_env(args, master, local_rank):
         # instead of starting fresh (reference PADDLE_ELASTIC_* env family)
         "PADDLE_RESTART_ATTEMPT": str(getattr(args, "_attempt", 0)),
     })
+    if getattr(args, "_ledger_path", None):
+        # resilience.JobLedger.from_env(): workers append resume records
+        env["PADDLE_JOB_STATE"] = args._ledger_path
     if args.devices:
         env["PADDLE_TPU_VISIBLE_DEVICES"] = args.devices
     if args.backend == "cpu":
@@ -102,8 +114,8 @@ def _spawn(args, master):
 
 def _watch(procs, poll_s=0.2):
     """Reference watcher role (launch/controllers/watcher.py): first failure
-    aborts the pod; returns (rc, n_failed) — rc 0 only if every worker
-    exits 0."""
+    aborts the pod; returns (rc, n_failed, interrupted, dead_ranks) — rc 0
+    only if every worker exits 0."""
     try:
         while procs:
             alive, failed = [], []
@@ -133,11 +145,11 @@ def _watch(procs, poll_s=0.2):
                         p2.kill()
                     if not f2.closed:
                         f2.close()
-                return failed[0][1], len(failed), False
+                return failed[0][1], len(failed), False, [r for r, _ in failed]
             procs = alive
             if procs:
                 time.sleep(poll_s)
-        return 0, 0, False
+        return 0, 0, False, []
     except KeyboardInterrupt:
         # interrupted=True distinguishes the operator's Ctrl-C from a worker
         # that itself exited 130 (e.g. SIGINT preemption — that one SHOULD
@@ -147,43 +159,62 @@ def _watch(procs, poll_s=0.2):
         for proc, logf, _ in procs:
             proc.wait()
             logf.close()
-        return 130, 0, True
+        return 130, 0, True, []
 
 
 def launch(argv):
+    # the supervisor owns restart POLICY (budget, backoff, scale plan,
+    # job_state.json ledger); this loop stays the mechanism (spawn/watch)
+    from ...resilience.supervisor import ElasticSupervisor, JobLedger
+
     args = _parse(argv)
     master = args.master or f"127.0.0.1:{_free_port()}"
+    os.makedirs(args.log_dir, exist_ok=True)
+    ledger_path = args.job_state or os.path.join(args.log_dir,
+                                                 "job_state.json")
+    args._ledger_path = os.path.abspath(ledger_path)
+    sup = ElasticSupervisor(
+        args.nproc_per_node, max_restarts=args.max_restarts,
+        elastic_level=args.elastic_level, min_procs=args.min_procs,
+        backoff_s=args.restart_backoff,
+        backoff_max_s=args.restart_backoff_max,
+        ledger=JobLedger(args._ledger_path))
+    sup.ledger.record("start", world=args.nproc_per_node,
+                      max_restarts=args.max_restarts,
+                      elastic_level=args.elastic_level,
+                      script=args.training_script)
     attempt = 0
     while True:
         args._attempt = attempt
         procs = _spawn(args, master)
-        rc, n_failed, interrupted = _watch(procs)
-        # the operator's Ctrl-C is terminal, never retried
-        if rc == 0 or interrupted or attempt >= args.max_restarts:
-            return rc
-        attempt += 1
-        if args.elastic_level >= 2 and n_failed:
-            # ElasticLevel 2 (reference fleet/elastic/manager.py:219-256):
-            # relaunch at the surviving world size; workers see the new
-            # PADDLE_TRAINERS_NUM and resume from their checkpoints
-            # (sharded checkpoints reshard on load)
-            from ..elastic import ElasticLevel, ElasticManager
-
-            plan = ElasticManager(
-                None, args.nproc_per_node, level=ElasticLevel.ELASTIC,
-                min_world=args.min_procs).scale_plan(range(n_failed))
-            if plan is None:
+        rc, n_failed, interrupted, dead_ranks = _watch(procs)
+        decision = sup.decide(rc, n_failed, interrupted,
+                              world_size=args.nproc_per_node,
+                              dead_ranks=dead_ranks)
+        if decision["action"] != "restart":
+            if decision["reason"] == "below min_procs":
                 sys.stderr.write(
                     f"[launch] fewer than --min_procs={args.min_procs} "
                     "workers would survive; aborting\n")
-                return rc
-            if plan != args.nproc_per_node:
+            elif decision["action"] == "abort" and not interrupted:
                 sys.stderr.write(
-                    f"[launch] elastic scale-down: {args.nproc_per_node} "
-                    f"-> {plan} workers\n")
-                args.nproc_per_node = plan
+                    f"[launch] {decision['reason']}; giving up\n")
+            return rc
+        attempt += 1
+        if decision["world"] != args.nproc_per_node:
+            # ElasticLevel 2 (reference fleet/elastic/manager.py:219-256):
+            # relaunch at the surviving world size; workers see the new
+            # PADDLE_TRAINERS_NUM and resume from their (resharded on
+            # load) checkpoints
+            sys.stderr.write(
+                f"[launch] elastic scale-down: {args.nproc_per_node} "
+                f"-> {decision['world']} workers\n")
+            args.nproc_per_node = decision["world"]
         sys.stderr.write(
-            f"[launch] restarting pod (attempt {attempt}/{args.max_restarts})\n")
+            f"[launch] restarting pod (attempt {attempt}/"
+            f"{args.max_restarts}) after {decision['backoff_s']:.1f}s "
+            "backoff\n")
+        time.sleep(decision["backoff_s"])
         # a fresh coordinator port avoids stale-rendezvous collisions
         if args.master is None:
             master = f"127.0.0.1:{_free_port()}"

@@ -76,3 +76,41 @@ def next_key():
 
 def default_seed() -> int:
     return _global["seed"]
+
+
+def _key_data(key):
+    """Raw uint32 words of a PRNG key (typed keys included)."""
+    try:
+        return np.asarray(key)
+    except TypeError:  # new-style typed key array
+        return np.asarray(jax.random.key_data(key))
+
+
+def get_rng_state() -> dict:
+    """Picklable snapshot of every host-side RNG stream: the paddle.seed
+    value, the current global PRNG key (mutated by eager splits), and the
+    numpy host generator. Checkpointing this alongside params is what makes
+    resume *bit*-deterministic — a restarted process replays exactly the
+    random draws an uninterrupted one would have made."""
+    key = _global["key"]
+    rng = _np_state["rng"]
+    return {
+        "seed": _global["seed"],
+        "key": None if key is None else _key_data(key),
+        "np_state": None if rng is None else rng.get_state(),
+    }
+
+
+def set_rng_state(state: dict):
+    """Restore a :func:`get_rng_state` snapshot (checkpoint-resume path)."""
+    _global["seed"] = int(state.get("seed", 0))
+    key = state.get("key")
+    _global["key"] = None if key is None else jax.numpy.asarray(
+        np.asarray(key, np.uint32))
+    nps = state.get("np_state")
+    if nps is None:
+        _np_state["rng"] = None
+    else:
+        rng = np.random.RandomState()
+        rng.set_state(nps)
+        _np_state["rng"] = rng

@@ -63,6 +63,7 @@ class Model:
         self._opt_state = None
         self._grad_step_fn = None
         self._apply_step_fn = None
+        self._guarded_step_fn = None
         self._accum_grads = None
         self._engine = None
 
@@ -79,6 +80,7 @@ class Model:
         self._train_step_fn = None
         self._eval_step_fn = None
         self._predict_step_fn = None
+        self._guarded_step_fn = None
         self._opt_state = None  # drop any previous optimizer's accumulators
         self._engine = None
         # Under an active hybrid topology, fit/evaluate/predict route through
@@ -132,6 +134,72 @@ class Model:
             return loss, list(outs), new_buf, new_params, new_opt
 
         return jax.jit(step, donate_argnums=(0, 2))
+
+    def _build_guarded_train_step(self):
+        """Health-guarded fused step (resilience.HealthGuard). Same program
+        as the fast path plus ONE scalar all-finite verdict over loss and
+        every gradient leaf, computed in-graph: when the verdict is bad the
+        optimizer update is suppressed by selecting the OLD params and
+        opt_state, so a NaN/Inf batch leaves training state bit-identical —
+        no second device round-trip, the verdict travels home with the loss.
+        ``bad`` is a traced scalar driven by the ``optimizer.step:nan_grads``
+        fault site (poisons this step's grads without retracing)."""
+        opt = self._optimizer
+
+        def step(params, buffers, opt_state, lr, rng, bad, inputs, labels):
+            loss_of = self._make_loss_of((buffers, rng, inputs, labels))
+            (loss, (outs, new_buf)), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(params)
+            grads = jax.tree_util.tree_map(
+                lambda g: jnp.where(bad, jnp.asarray(jnp.nan, g.dtype), g)
+                if jnp.issubdtype(g.dtype, jnp.floating) else g, grads)
+            loss = jnp.where(bad, jnp.asarray(jnp.nan, loss.dtype), loss)
+            ok = jnp.isfinite(loss)
+            for g in jax.tree_util.tree_leaves(grads):
+                ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(g)))
+            new_params, new_opt = opt.apply_gradients(params, grads, opt_state, lr)
+            keep = lambda new, old: jnp.where(ok, new, old)
+            new_params = jax.tree_util.tree_map(keep, new_params, params)
+            new_opt = jax.tree_util.tree_map(keep, new_opt, opt_state)
+            # buffers too: running stats computed from a poisoned forward
+            # must not outlive the skipped step
+            new_buf = jax.tree_util.tree_map(keep, new_buf, buffers)
+            return loss, list(outs), new_buf, new_params, new_opt, ok
+
+        return jax.jit(step, donate_argnums=(0, 2))
+
+    def train_batch_guarded(self, inputs, labels=None, poison_nan=False):
+        """One health-guarded training step: returns ``([loss], ok)`` where
+        ``ok`` is the in-graph all-finite verdict. A bad step is a no-op on
+        params AND optimizer state (skip-don't-poison). Consults the
+        ``optimizer.step`` fault site; ``nan_grads`` poisons this step."""
+        from ..utils import faults
+
+        act = faults.inject("optimizer.step", step=self._optimizer._step_count)
+        poison = bool(poison_nan) or act == "nan_grads"
+        inputs = [_to_np(i) for i in _as_list(inputs)]
+        labels = [_to_np(l) for l in _as_list(labels)]
+        if self._engine is not None:
+            loss, ok = self._engine.train_step_guarded(
+                inputs, labels, poison_nan=poison)
+            self._optimizer._step_count += 1
+            return [float(np.asarray(loss))], bool(np.asarray(ok))
+        params, buffers = self._get_state()
+        opt_state = self._opt_state_tree(params)
+        lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
+        rng = jax.random.fold_in(
+            jax.random.PRNGKey(frandom.default_seed()),
+            self._optimizer._step_count,
+        )
+        if self._guarded_step_fn is None:
+            self._guarded_step_fn = self._build_guarded_train_step()
+        loss, outs, new_buf, new_params, new_opt, ok = self._guarded_step_fn(
+            params, buffers, opt_state, lr, rng, jnp.asarray(poison),
+            inputs, labels)
+        self._set_state(new_params, new_buf)
+        self._opt_state = new_opt
+        self._optimizer._step_count += 1
+        return [float(np.asarray(loss))], bool(np.asarray(ok))
 
     def _build_grad_step(self):
         """Gradient-only step for accumulation (reference dygraph semantics:

@@ -18,6 +18,7 @@ import threading
 import numpy as np
 
 from ..core.tensor import Tensor
+from ..utils import faults
 
 __all__ = [
     "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset", "ConcatDataset",
@@ -282,6 +283,24 @@ def default_collate_fn(batch):
     return batch
 
 
+def _poison_collated(batch):
+    """NaN-fill the floating leaves of a collated batch (the
+    ``dataloader.next:bad_batch`` chaos fault — a corrupt reader shard)."""
+    if isinstance(batch, Tensor):
+        arr = np.asarray(batch._value)
+        if np.issubdtype(arr.dtype, np.floating):
+            return Tensor(np.full_like(arr, np.nan))
+        return batch
+    if isinstance(batch, (list, tuple)):
+        return type(batch)(_poison_collated(b) for b in batch)
+    if isinstance(batch, dict):
+        return {k: _poison_collated(v) for k, v in batch.items()}
+    if isinstance(batch, np.ndarray) and np.issubdtype(batch.dtype,
+                                                       np.floating):
+        return np.full_like(batch, np.nan)
+    return batch
+
+
 class DataLoader:
     """Batched loader with optional background-thread prefetch
     (the reference's multi-worker loader role, dataloader_iter.py)."""
@@ -367,6 +386,17 @@ class DataLoader:
                 yield self.collate_fn([self.dataset[i] for i in idx_batch])
 
     def __iter__(self):
+        # dataloader.next chaos site (docs/ROBUSTNESS.md): per emitted
+        # batch; "bad_batch" NaN-poisons the floats (exercises the
+        # numerical-health guard), error/delay propagate as usual. One
+        # no-op inject call per batch when no plan is armed.
+        for i, batch in enumerate(self._iter_impl()):
+            act = faults.inject("dataloader.next", batch=i)
+            if act == "bad_batch":
+                batch = _poison_collated(batch)
+            yield batch
+
+    def _iter_impl(self):
         if self.num_workers > 0:
             # real worker PROCESSES + shared-memory ring (reference
             # dataloader_iter.py multi-process path) — python transform

@@ -1,0 +1,30 @@
+"""paddle_tpu.resilience — crash-and-resume training supervision.
+
+The training-side counterpart of the serving robustness layer
+(docs/ROBUSTNESS.md): PR 3 made a single process degrade gracefully; this
+package makes a training *job* survive process death and numerical
+divergence end-to-end:
+
+- :class:`ResilientLoop` (`loop.py`) — auto-checkpointed guarded training
+  with deterministic resume (params bit-identical to an uninterrupted run);
+- :class:`HealthGuard` / :class:`NumericalDivergence` (`health.py`) —
+  skip-and-log nonfinite steps, GradScaler backoff, circuit breaker;
+- :class:`ElasticSupervisor` / :class:`RestartBudget` / :class:`JobLedger`
+  (`supervisor.py`) — launcher-side restart policy with exponential
+  backoff, elastic scale planning, and the ``job_state.json`` ledger;
+- `demo.py` — the reference worker the acceptance tests and
+  ``tools/chaos_run.py --suite train`` drive under the launcher.
+"""
+from .health import HealthGuard, NumericalDivergence  # noqa: F401
+from .loop import ResilientLoop  # noqa: F401
+from .supervisor import (  # noqa: F401
+    LEDGER_ENV,
+    ElasticSupervisor,
+    JobLedger,
+    RestartBudget,
+)
+
+__all__ = [
+    "ResilientLoop", "HealthGuard", "NumericalDivergence",
+    "ElasticSupervisor", "RestartBudget", "JobLedger", "LEDGER_ENV",
+]

@@ -1,0 +1,90 @@
+"""Reference resilient-training worker.
+
+A tiny, fully deterministic linear-regression training job driven by
+:class:`~paddle_tpu.resilience.ResilientLoop` — the workload behind the
+crash-and-resume acceptance tests (`tests/test_resilience.py`) and the
+`tools/chaos_run.py --suite train` battery. Run it under the launcher::
+
+    python -m paddle_tpu.distributed.launch --nproc_per_node 1 \
+        --max_restarts 2 --backend cpu $(python -c \
+        'import paddle_tpu.resilience.demo as d; print(d.__file__)')
+
+Configuration via env (all optional except RESIL_DIR):
+
+    RESIL_DIR         checkpoint root (required)
+    RESIL_STEPS       total steps (default 20)
+    RESIL_CKPT_EVERY  snapshot every K steps (default 5)
+    RESIL_KILL_STEP   on attempt 0 only: SIGKILL self at this step (mid-run
+                      crash; the launcher restarts, the loop resumes)
+    RESIL_OUT         write final params as .npz here (bit-identity checks)
+    RESIL_SEED        paddle.seed (default 7)
+
+The data source is step-keyed (`data(step)`), so a resumed process replays
+exactly the batches the dead one would have seen.
+"""
+from __future__ import annotations
+
+import os
+import signal
+
+import numpy as np
+
+
+def _build_model(seed: int):
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+
+    paddle.seed(seed)
+    net = nn.Linear(4, 3)
+    model = paddle.Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.Momentum(
+            learning_rate=0.05, momentum=0.9, parameters=net.parameters()),
+        loss=nn.MSELoss())
+    return model, net
+
+
+def data_fn(step: int):
+    """Deterministic per-step batch (the resume-replay contract)."""
+    rng = np.random.RandomState(1000 + step)
+    x = rng.randn(8, 4).astype(np.float32)
+    w = np.arange(12, dtype=np.float32).reshape(4, 3) / 10.0
+    y = (x @ w + 0.01 * rng.randn(8, 3)).astype(np.float32)
+    return [x], [y]
+
+
+def main():
+    from paddle_tpu.resilience import HealthGuard, ResilientLoop
+
+    ckpt_dir = os.environ["RESIL_DIR"]
+    steps = int(os.environ.get("RESIL_STEPS", "20"))
+    every = int(os.environ.get("RESIL_CKPT_EVERY", "5"))
+    kill_step = int(os.environ.get("RESIL_KILL_STEP", "-1"))
+    seed = int(os.environ.get("RESIL_SEED", "7"))
+    attempt = int(os.environ.get("PADDLE_RESTART_ATTEMPT", "0"))
+
+    model, net = _build_model(seed)
+
+    def data(step):
+        # a mid-run SIGKILL, not a clean exit: the canonical crash the
+        # supervisor must survive (only the first incarnation dies)
+        if attempt == 0 and step == kill_step:
+            os.kill(os.getpid(), signal.SIGKILL)
+        return data_fn(step)
+
+    loop = ResilientLoop(
+        model, data, ckpt_dir=ckpt_dir, max_steps=steps,
+        ckpt_every_steps=every, health=HealthGuard(max_bad_streak=4),
+        save_final=False)
+    report = loop.run()
+
+    out = os.environ.get("RESIL_OUT")
+    if out:
+        params = {name: np.asarray(p._value)
+                  for name, p in net.named_parameters()}
+        np.savez(out, **params)
+    print("RESIL_REPORT", report)
+
+
+if __name__ == "__main__":
+    main()

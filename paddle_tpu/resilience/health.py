@@ -1,0 +1,116 @@
+"""Numerical-health guard: skip bad steps, back off, circuit-break.
+
+A single NaN batch must not poison the optimizer state forever, and a run
+that produces NOTHING but NaNs must not burn a cluster silently. The guard
+sits between the jit-fused per-step all-finite verdict (computed inside the
+guarded train step — `hapi.Model.train_batch_guarded` /
+`DistributedEngine.train_step_guarded` — so the happy path costs no extra
+device→host sync; the verdict travels home with the loss) and three
+host-side policies:
+
+1. **skip-and-log** — the compiled step already suppressed the update
+   (old params/opt_state selected in-graph); the guard counts it
+   (``bad_steps_total``), logs a flight-recorder event, and feeds the
+   verdict into the :class:`~paddle_tpu.amp.GradScaler` backoff
+   (``scaler.record_nonfinite``).
+2. **circuit breaker** — after ``max_bad_streak`` *consecutive* skipped
+   steps the run has diverged: the guard dumps the flight recorder and
+   raises :class:`NumericalDivergence` naming the streak and the dump.
+3. **rollback** (optional, driven by ResilientLoop) — on divergence the
+   loop can reload the last good checkpoint instead of dying.
+"""
+from __future__ import annotations
+
+from .. import telemetry
+
+__all__ = ["NumericalDivergence", "HealthGuard"]
+
+
+def _metrics():
+    reg = telemetry.registry()
+    return (
+        reg.counter("bad_steps_total",
+                    "training steps skipped for nonfinite loss/grads"),
+        reg.counter("train_divergences_total",
+                    "NumericalDivergence circuit-breaker trips"),
+    )
+
+
+_M_BAD, _M_DIVERGE = _metrics()
+
+
+class NumericalDivergence(RuntimeError):
+    """``max_bad_streak`` consecutive training steps produced nonfinite
+    loss/gradients — the run has diverged and skipping more steps cannot
+    save it. Carries the streak length, the step it tripped at, and the
+    flight-recorder dump written at trip time."""
+
+    def __init__(self, streak: int, step: int, dump_path: str | None = None):
+        self.streak = streak
+        self.step = step
+        self.dump_path = dump_path
+        msg = (f"{streak} consecutive nonfinite training steps "
+               f"(last at step {step}); training has diverged")
+        if dump_path:
+            msg += f" — flight recorder dumped to {dump_path}"
+        super().__init__(msg)
+
+
+class HealthGuard:
+    """Host-side policy over the per-step finite verdict.
+
+    ::
+
+        guard = HealthGuard(max_bad_streak=5, scaler=scaler)
+        loss, ok = model.train_batch_guarded(inputs, labels)
+        guard.observe(ok, step=step, loss=loss[0])   # may raise
+                                                     # NumericalDivergence
+
+    State (``state_dict``/``load_state_dict``) is checkpointed by
+    ResilientLoop so a resumed run continues the streak/skip accounting of
+    the run it replaces.
+    """
+
+    def __init__(self, max_bad_streak: int = 5, scaler=None):
+        self.max_bad_streak = int(max_bad_streak)
+        self.scaler = scaler
+        self.streak = 0          # current consecutive bad steps
+        self.bad_total = 0       # all skipped steps this run
+        self.last_bad_step = -1
+
+    def observe(self, ok: bool, step: int, loss=None) -> bool:
+        """Record one step's verdict. Returns True when the step was
+        skipped. Raises :class:`NumericalDivergence` when the consecutive
+        streak reaches ``max_bad_streak``."""
+        ok = bool(ok)
+        if self.scaler is not None:
+            self.scaler.record_nonfinite(not ok)
+        if ok:
+            self.streak = 0
+            return False
+        self.streak += 1
+        self.bad_total += 1
+        self.last_bad_step = int(step)
+        _M_BAD.inc()
+        telemetry.record_event(
+            "train.bad_step", step=int(step), streak=self.streak,
+            loss=None if loss is None else float(loss),
+            scale=(self.scaler.get_loss_scaling()
+                   if self.scaler is not None else None))
+        if self.streak >= self.max_bad_streak:
+            _M_DIVERGE.inc()
+            dump = telemetry.dump(
+                reason=f"numerical divergence: {self.streak} consecutive "
+                       f"nonfinite steps (step {step})")
+            raise NumericalDivergence(self.streak, int(step), dump)
+        return True
+
+    # -- checkpointable state -------------------------------------------
+    def state_dict(self) -> dict:
+        return {"streak": self.streak, "bad_total": self.bad_total,
+                "last_bad_step": self.last_bad_step}
+
+    def load_state_dict(self, state: dict):
+        self.streak = int(state.get("streak", 0))
+        self.bad_total = int(state.get("bad_total", 0))
+        self.last_bad_step = int(state.get("last_bad_step", -1))

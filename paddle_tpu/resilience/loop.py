@@ -1,0 +1,297 @@
+"""ResilientLoop: auto-checkpointed, deterministically resumable training.
+
+Closes the detect→restart→resume loop from the worker side. The launcher
+(`distributed/launch/main.py` + :class:`.supervisor.ElasticSupervisor`)
+restarts a crashed pod; this loop makes the restarted process *continue the
+same run*: it snapshots everything a step depends on — params / buffers /
+optimizer state (through `distributed.Checkpoint`: atomic, sharded,
+reshard-on-load), the global RNG streams (`framework.random`), GradScaler
+and HealthGuard counters, and the dataloader cursor — every K steps (and/or
+T seconds), and on (re)start resumes from the newest valid snapshot.
+
+Determinism contract (tests/test_resilience.py proves it bit-for-bit): with
+a step-keyed data source and the same seed, `crash at any step; relaunch;
+resume` produces final params **bit-identical** to an uninterrupted run —
+the replayed steps see the same batches (cursor), the same dropout/shuffle
+keys (RNG snapshot + step-folded keys), and the same optimizer state
+(exact-byte checkpoint).
+
+Data sources:
+
+- a callable ``data(step) -> (inputs, labels)`` — the preferred,
+  trivially-resumable form (step-keyed synthesis or an indexable dataset
+  behind a deterministic batch schedule);
+- any iterable of ``(inputs, labels)`` batches — re-iterated per epoch;
+  on resume the first ``step % len`` batches of the epoch are skipped, so
+  iteration order must be deterministic per epoch (seeded shuffle).
+"""
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+from .. import telemetry
+from ..distributed.checkpoint import Checkpoint
+from ..framework import random as frandom
+from ..utils import faults
+from .health import HealthGuard, NumericalDivergence
+from .supervisor import JobLedger
+
+__all__ = ["ResilientLoop"]
+
+
+def _loop_metrics():
+    reg = telemetry.registry()
+    return (
+        reg.counter("train_resumes_total",
+                    "times training resumed from an auto-checkpoint"),
+        reg.counter("train_steps_total", "guarded training steps executed"),
+        reg.gauge("train_ckpt_age_seconds",
+                  "seconds since the last committed auto-checkpoint"),
+        reg.gauge("train_last_ckpt_step",
+                  "global step of the last committed auto-checkpoint"),
+    )
+
+
+_M_RESUMES, _M_STEPS, _M_CKPT_AGE, _M_CKPT_STEP = _loop_metrics()
+
+
+def _poison_batch(batch):
+    """NaN-fill every floating array in a (possibly nested) batch — the
+    ``dataloader.next:bad_batch`` fault."""
+    if isinstance(batch, (list, tuple)):
+        return type(batch)(_poison_batch(b) for b in batch)
+    if isinstance(batch, dict):
+        return {k: _poison_batch(v) for k, v in batch.items()}
+    arr = np.asarray(batch)
+    if np.issubdtype(arr.dtype, np.floating):
+        return np.full_like(arr, np.nan)
+    return batch
+
+
+class ResilientLoop:
+    """Drive a prepared :class:`~paddle_tpu.hapi.Model` for ``max_steps``
+    guarded steps with automatic checkpoint/resume.
+
+    ::
+
+        model = paddle.Model(net); model.prepare(opt, loss)
+        loop = ResilientLoop(model, data_fn, ckpt_dir=root, max_steps=1000,
+                             ckpt_every_steps=50)
+        report = loop.run()     # resumes automatically if root has snapshots
+
+    Parameters
+    ----------
+    ckpt_every_steps / ckpt_every_s: snapshot cadence (whichever trips
+        first; either may be None).
+    health: a :class:`HealthGuard` (default: one with ``max_bad_streak=5``).
+    scaler: optional :class:`paddle_tpu.amp.GradScaler` whose dynamic-scale
+        state rides the checkpoint and backs off on skipped steps.
+    rollback_on_divergence: instead of dying on
+        :class:`NumericalDivergence`, reload the last checkpoint and keep
+        going (at most ``max_rollbacks`` times).
+    save_final: snapshot once more at ``max_steps`` (off in tests that
+        simulate a crash losing the steps since the last snapshot).
+    """
+
+    def __init__(self, model, data, *, ckpt_dir, max_steps,
+                 ckpt_every_steps=50, ckpt_every_s=None, keep=3,
+                 health: HealthGuard | None = None, scaler=None,
+                 async_save=False, rollback_on_divergence=False,
+                 max_rollbacks=1, save_final=True, ledger: JobLedger | None = None):
+        self.model = model
+        self.data = data
+        self.max_steps = int(max_steps)
+        self.ckpt_every_steps = ckpt_every_steps
+        self.ckpt_every_s = ckpt_every_s
+        self.scaler = scaler
+        self.health = health or HealthGuard(scaler=scaler)
+        if self.health.scaler is None:
+            self.health.scaler = scaler
+        self.async_save = bool(async_save)
+        self.rollback_on_divergence = bool(rollback_on_divergence)
+        self.max_rollbacks = int(max_rollbacks)
+        self.save_final = bool(save_final)
+        self.ledger = ledger if ledger is not None else JobLedger.from_env()
+        engine = getattr(model, "_engine", None)
+        self.ckpt = Checkpoint(ckpt_dir, keep=keep, engine=engine)
+        self.step = 0
+        self.resumed_from: str | None = None
+        self.resume_step: int | None = None
+        self.rollbacks = 0
+        self.checkpoints = 0
+        self._last_save_t = time.monotonic()
+        self._data_iter = None
+        self._epoch_len = None
+
+    # -- state capture ---------------------------------------------------
+    def _engine(self):
+        return getattr(self.model, "_engine", None)
+
+    def _extra(self) -> dict:
+        return {
+            "step": self.step,
+            "optimizer_step_count": self.model._optimizer._step_count,
+            "rng_state": frandom.get_rng_state(),
+            "scaler": None if self.scaler is None else self.scaler.state_dict(),
+            "health": self.health.state_dict(),
+            "cursor": {"step": self.step, "epoch_len": self._epoch_len},
+        }
+
+    def _save(self, final=False):
+        eng = self._engine()
+        if eng is not None:
+            path = self.ckpt.save(extra=self._extra(), step=self.step,
+                                  async_save=self.async_save)
+        else:
+            params, buffers = self.model._get_state()
+            opt_state = self.model._opt_state_tree(params)
+            path = self.ckpt.save(
+                state={"params": params, "buffers": buffers,
+                       "opt_state": opt_state},
+                extra=self._extra(), step=self.step,
+                async_save=self.async_save)
+        self.checkpoints += 1
+        self._last_save_t = time.monotonic()
+        _M_CKPT_AGE.set(0.0)
+        _M_CKPT_STEP.set(self.step)
+        telemetry.record_event("train.ckpt", step=self.step, path=path,
+                               final=final)
+        return path
+
+    def _restore(self) -> bool:
+        """Load the newest valid snapshot; returns True when one existed.
+        A torn newest snapshot falls back to the previous good one
+        (Checkpoint.load's walk); an empty root is a fresh start."""
+        if not self.ckpt.snapshots():
+            return False
+        state, extra = self.ckpt.load()   # raises CheckpointCorrupt if
+        # every snapshot is torn — that is an operator problem, not a
+        # silent fresh start
+        eng = self._engine()
+        if eng is None:
+            params = state.get("params", {})
+            buffers = state.get("buffers", {})
+            self.model._set_state(params, buffers)
+            # merge: params absent from the snapshot's opt_state flattening
+            # (stateless entries like SGD's {}) fall back to fresh init
+            full = self.model._optimizer.init_state_tree(params)
+            for name, st in state.get("opt_state", {}).items():
+                full[name] = st
+            self.model._opt_state = full
+        self.model._optimizer._step_count = int(
+            extra.get("optimizer_step_count", 0))
+        if eng is not None and eng.optimizer is not None:
+            eng.optimizer._step_count = int(
+                extra.get("optimizer_step_count", eng.optimizer._step_count))
+        if extra.get("rng_state") is not None:
+            frandom.set_rng_state(extra["rng_state"])
+        if self.scaler is not None and extra.get("scaler"):
+            self.scaler.load_state_dict(extra["scaler"])
+        if extra.get("health"):
+            self.health.load_state_dict(extra["health"])
+        self.step = int(extra.get("step", 0))
+        self.resumed_from = (self.ckpt.last_load_report or {}).get("loaded")
+        self.resume_step = self.step
+        _M_RESUMES.inc()
+        telemetry.record_event(
+            "train.resume", step=self.step, path=self.resumed_from,
+            skipped=len((self.ckpt.last_load_report or {}).get("skipped", [])))
+        if self.ledger is not None and _is_rank0():
+            self.ledger.record("resume", step=self.step,
+                               path=self.resumed_from or "")
+        return True
+
+    # -- data ------------------------------------------------------------
+    def _next_batch(self, step):
+        if callable(self.data):
+            batch = self.data(step)
+        else:
+            if self._data_iter is None:
+                self._reseek(step)
+            try:
+                batch = next(self._data_iter)
+            except StopIteration:
+                self._data_iter = iter(self.data)
+                batch = next(self._data_iter)
+        act = faults.inject("dataloader.next", step=step)
+        if act == "bad_batch":
+            batch = _poison_batch(batch)
+        return batch
+
+    def _reseek(self, step):
+        """Position an iterable data source at ``step``: skip the consumed
+        prefix of the current epoch (deterministic order required)."""
+        try:
+            self._epoch_len = len(self.data)
+        except TypeError:
+            self._epoch_len = None
+        self._data_iter = iter(self.data)
+        if self._epoch_len:
+            for _ in range(step % self._epoch_len):
+                next(self._data_iter)
+        elif step:
+            raise ValueError(
+                "cannot resume mid-run with a length-less iterable data "
+                "source; pass a callable data(step) or a sized loader")
+
+    # -- the loop --------------------------------------------------------
+    def run(self) -> dict:
+        self._restore()  # no-op on a fresh root; else self.step repositions
+        if not callable(self.data):
+            self._reseek(self.step)
+        while self.step < self.max_steps:
+            batch = self._next_batch(self.step)
+            inputs, labels = batch
+            try:
+                loss, ok = self.model.train_batch_guarded(inputs, labels)
+                self.health.observe(ok, step=self.step,
+                                    loss=loss[0] if loss else None)
+            except NumericalDivergence:
+                if (not self.rollback_on_divergence
+                        or self.rollbacks >= self.max_rollbacks
+                        or not self.ckpt.snapshots()):
+                    raise
+                self.rollbacks += 1
+                self.health.streak = 0
+                telemetry.record_event("train.rollback", step=self.step,
+                                       rollbacks=self.rollbacks)
+                self._restore()
+                if not callable(self.data):
+                    self._reseek(self.step)
+                continue
+            self.step += 1
+            _M_STEPS.inc()
+            _M_CKPT_AGE.set(time.monotonic() - self._last_save_t)
+            if self._should_snapshot():
+                self._save()
+        if self.save_final and (not self.ckpt.snapshots()
+                                or self.ckpt.snapshots()[-1][0] < self.step):
+            self._save(final=True)
+        if self.async_save:
+            self.ckpt.wait()
+        return {
+            "final_step": self.step,
+            "resumed_from": self.resumed_from,
+            "resume_step": self.resume_step,
+            "bad_steps": self.health.bad_total,
+            "rollbacks": self.rollbacks,
+            "checkpoints": self.checkpoints,
+        }
+
+    def _should_snapshot(self) -> bool:
+        if self.ckpt_every_steps and self.step % int(self.ckpt_every_steps) == 0:
+            return True
+        if (self.ckpt_every_s is not None
+                and time.monotonic() - self._last_save_t >= self.ckpt_every_s):
+            return True
+        return False
+
+
+def _is_rank0() -> bool:
+    try:
+        return int(os.environ.get("PADDLE_TRAINER_ID", "0")) == 0
+    except ValueError:
+        return True

@@ -1,0 +1,210 @@
+"""Elastic supervisor: the launcher-side detect→restart→resume policy.
+
+The launch CLI (`distributed/launch/main.py`) owns the mechanics (spawn,
+watch, env layout); this module owns the *decisions* and the *record*:
+
+- :class:`RestartBudget` — at most ``max_restarts`` pod relaunches, with
+  exponential backoff between attempts (a crash-looping job must not hammer
+  the scheduler at full speed).
+- :class:`ElasticSupervisor` — after each pod exit, decide: done / abort /
+  relaunch, and at what world size. Level 2 re-arms an
+  :class:`~paddle_tpu.distributed.elastic.ElasticManager` on every failure
+  and executes its ``scale_plan`` (relaunch at the surviving world size;
+  workers resume from the resharded checkpoint).
+- :class:`JobLedger` — ``job_state.json``: restarts, dead ranks, resume
+  steps, one appended event per lifecycle transition. Workers find it via
+  ``$PADDLE_JOB_STATE`` (ResilientLoop records its resume step there), and
+  flight-recorder dumps reference it so a postmortem links the crash to the
+  restart history.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from .. import telemetry
+from ..distributed.elastic import ElasticLevel, ElasticManager
+
+__all__ = ["RestartBudget", "JobLedger", "ElasticSupervisor",
+           "LEDGER_ENV"]
+
+# env var the launcher sets so workers (ResilientLoop) can find the ledger
+LEDGER_ENV = "PADDLE_JOB_STATE"
+
+
+def _restart_counter():
+    return telemetry.registry().counter(
+        "train_restarts_total", "pod relaunches executed by the supervisor")
+
+
+class RestartBudget:
+    """``max_restarts`` relaunches with exponential backoff:
+    ``backoff_s * 2^k`` capped at ``backoff_max_s``."""
+
+    def __init__(self, max_restarts: int, backoff_s: float = 0.5,
+                 backoff_max_s: float = 30.0):
+        self.max_restarts = int(max_restarts)
+        self.backoff_s = float(backoff_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.used = 0
+
+    def next_backoff(self) -> float | None:
+        """Consume one restart; returns the delay to sleep before it, or
+        None when the budget is exhausted."""
+        if self.used >= self.max_restarts:
+            return None
+        delay = min(self.backoff_s * (2 ** self.used), self.backoff_max_s)
+        self.used += 1
+        return delay
+
+    @property
+    def remaining(self) -> int:
+        return max(0, self.max_restarts - self.used)
+
+
+class JobLedger:
+    """Durable ``job_state.json``: the job's restart/resume history.
+
+    Multiple processes write it (the launcher records restarts, rank 0 of
+    each incarnation records resumes), so every record is a locked
+    read-modify-write published with an atomic rename."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    def _empty(self) -> dict:
+        return {"created": time.time(), "restarts": 0, "dead_ranks": [],
+                "resume_steps": [], "events": []}
+
+    def read(self) -> dict:
+        try:
+            with open(self.path) as f:
+                return json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
+            return self._empty()
+
+    def record(self, event: str, **fields) -> dict:
+        """Append one event and fold it into the summary counters. Returns
+        the updated document."""
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        lock_path = self.path + ".lock"
+        with open(lock_path, "w") as lk:
+            try:
+                import fcntl
+
+                fcntl.flock(lk, fcntl.LOCK_EX)
+            except (ImportError, OSError):
+                pass  # no flock (non-posix): atomic rename still bounds harm
+            doc = self.read()
+            doc["events"].append({"event": event, "t": time.time(), **fields})
+            if event == "restart":
+                doc["restarts"] = doc.get("restarts", 0) + 1
+                for r in fields.get("dead_ranks", ()):
+                    doc.setdefault("dead_ranks", []).append(r)
+            elif event == "resume" and "step" in fields:
+                doc.setdefault("resume_steps", []).append(fields["step"])
+            tmp = self.path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1)
+            os.replace(tmp, self.path)
+        safe = {k: v for k, v in fields.items()
+                if isinstance(v, (int, float, str, bool))}
+        telemetry.record_event(f"job.{event}", ledger=self.path, **safe)
+        return doc
+
+    @classmethod
+    def from_env(cls) -> "JobLedger | None":
+        """The ledger the launcher advertised to this worker, if any."""
+        path = os.environ.get(LEDGER_ENV)
+        return cls(path) if path else None
+
+
+class ElasticSupervisor:
+    """Decide what happens after a pod exits.
+
+    ``decide()`` returns a dict: ``{"action": "done"|"abort"|"restart",
+    "reason": str, "world": int, "backoff_s": float}``. On every failure it
+    re-arms the scale planner at the *current* world size, so a second
+    failure after a level-2 scale-down plans from the already-shrunk world
+    — the bug class where the first failure permanently blinded the
+    monitor is what :meth:`ElasticManager.rearm` + this re-arm fix.
+    """
+
+    def __init__(self, world_size: int, max_restarts: int = 0,
+                 elastic_level: int = ElasticLevel.FAULT_TOLERANT,
+                 min_procs: int = 1, backoff_s: float = 0.5,
+                 backoff_max_s: float = 30.0, ledger: JobLedger | None = None):
+        self.world_size = int(world_size)
+        self.elastic_level = int(elastic_level)
+        self.min_procs = int(min_procs)
+        self.budget = RestartBudget(max_restarts, backoff_s, backoff_max_s)
+        self.ledger = ledger
+        self.manager: ElasticManager | None = None
+
+    def _rearm_manager(self, world_size: int) -> ElasticManager:
+        """Fresh scale planner for the current world size (re-armed after
+        every failure, never reused across incarnations)."""
+        self.manager = ElasticManager(
+            None, world_size, level=self.elastic_level,
+            min_world=self.min_procs)
+        return self.manager
+
+    def monitor(self, store, world_size=None, timeout=6.0, poll=1.0,
+                join_grace=30.0) -> ElasticManager:
+        """Optional in-process heartbeat watch over a live store: detections
+        land in the ledger; the manager re-arms itself after each one."""
+        ledger = self.ledger
+
+        def on_failure(dead):
+            if ledger is not None:
+                ledger.record("heartbeat_failure", dead_ranks=list(dead))
+
+        mgr = ElasticManager(
+            store, world_size or self.world_size, timeout=timeout, poll=poll,
+            on_failure=on_failure, level=self.elastic_level,
+            min_world=self.min_procs, join_grace=join_grace)
+        self.manager = mgr
+        return mgr.start()
+
+    def decide(self, rc: int, n_failed: int, interrupted: bool,
+               world_size: int | None = None, dead_ranks=None) -> dict:
+        world = int(world_size if world_size is not None else self.world_size)
+        if rc == 0:
+            if self.ledger is not None:
+                self.ledger.record("done", world=world)
+            return {"action": "done", "reason": "clean exit",
+                    "world": world, "backoff_s": 0.0}
+        if interrupted:
+            if self.ledger is not None:
+                self.ledger.record("interrupted", world=world)
+            return {"action": "abort", "reason": "operator interrupt",
+                    "world": world, "backoff_s": 0.0}
+        backoff = self.budget.next_backoff()
+        if backoff is None:
+            if self.ledger is not None:
+                self.ledger.record("budget_exhausted", rc=rc, world=world)
+            return {"action": "abort",
+                    "reason": f"restart budget exhausted "
+                              f"({self.budget.max_restarts})",
+                    "world": world, "backoff_s": 0.0}
+        new_world = world
+        if self.elastic_level >= ElasticLevel.ELASTIC and n_failed:
+            plan = self._rearm_manager(world).scale_plan(range(n_failed))
+            if plan is None:
+                if self.ledger is not None:
+                    self.ledger.record("below_min_procs", rc=rc, world=world,
+                                       n_failed=n_failed)
+                return {"action": "abort", "reason": "below min_procs",
+                        "world": world, "backoff_s": 0.0}
+            new_world = plan
+        if self.ledger is not None:
+            self.ledger.record(
+                "restart", attempt=self.budget.used, rc=rc,
+                n_failed=n_failed, world=new_world, backoff_s=backoff,
+                dead_ranks=list(dead_ranks or []))
+        _restart_counter().inc()
+        telemetry.record_event("supervisor.restart", attempt=self.budget.used,
+                               world=new_world, backoff_s=backoff)
+        return {"action": "restart", "reason": f"pod exit rc={rc}",
+                "world": new_world, "backoff_s": backoff}

@@ -15,8 +15,9 @@ run (docs/OBSERVABILITY.md has the full tour):
   active so host spans interleave with XLA events.
 - :mod:`.flight_recorder` — bounded ring of recent runtime events
   (collective launches, allocator traffic, scheduler decisions, fault
-  injections), dumped to disk on collective/store timeouts, engine stalls,
-  and uncaught exceptions.
+  injections, training bad-steps/resumes/checkpoints), dumped to disk on
+  collective/store timeouts, engine stalls, numerical-divergence trips
+  (`resilience.HealthGuard`), and uncaught exceptions.
 
 :func:`disable` flips one shared flag that every write path checks first —
 the guaranteed-cheap escape hatch for benchmarking the instrumentation

@@ -33,10 +33,15 @@ Grammar (``;``-separated specs)::
 
     site:kind[=arg][@start][xcount][%prob]
 
-    kind   error    raise FaultError(arg or a default message)
-           delay    time.sleep(float(arg))  [default 0.05s]
-           exhaust  inject() returns "exhaust"; the site simulates
-                    running out of its resource
+    kind   error      raise FaultError(arg or a default message)
+           delay      time.sleep(float(arg))  [default 0.05s]
+           exhaust    inject() returns "exhaust"; the site simulates
+                      running out of its resource
+           nan_grads  inject() returns "nan_grads"; the guarded train
+                      step poisons this step's gradients with NaN
+                      (exercises the numerical-health guard)
+           bad_batch  inject() returns "bad_batch"; the dataloader
+                      replaces the batch's floats with NaN
     @start 1-based call index at which the spec starts firing (default 1)
     xcount how many consecutive calls fire (default 1; ``x*`` = forever)
     %prob  instead of @/x determinism, fire each call with probability
@@ -54,6 +59,10 @@ Known sites (see docs/ROBUSTNESS.md for the full table):
     collective.<op>       inside the timeout-guarded collective call
     ckpt.shard            checkpoint writer, before each shard file
     ckpt.meta             checkpoint writer, before metadata/manifest
+    optimizer.step        guarded train step, before the update
+                          (nan_grads => nonfinite grads this step)
+    dataloader.next       DataLoader, per emitted batch
+                          (bad_batch => the batch's floats become NaN)
 """
 from __future__ import annotations
 
@@ -80,7 +89,7 @@ class FaultError(RuntimeError):
 
 
 _SPEC_RE = re.compile(
-    r"^(?P<site>[\w.\-]+):(?P<kind>error|delay|exhaust)"
+    r"^(?P<site>[\w.\-]+):(?P<kind>error|delay|exhaust|nan_grads|bad_batch)"
     r"(?:=(?P<arg>[^@x%;]+))?"
     r"(?:@(?P<start>\d+))?"
     r"(?:x(?P<count>\d+|\*))?"
@@ -99,8 +108,13 @@ class FaultSpec:
     prob: float | None = None      # stochastic mode (overrides start/count)
     fired: int = 0
 
+    # "token" kinds: inject() hands the kind string back to the call site,
+    # which decides what the fault means there (exhaust => resource dry,
+    # nan_grads => poisoned gradients, bad_batch => NaN batch)
+    TOKEN_KINDS = ("exhaust", "nan_grads", "bad_batch")
+
     def __post_init__(self):
-        if self.kind not in ("error", "delay", "exhaust"):
+        if self.kind not in ("error", "delay") + self.TOKEN_KINDS:
             raise ValueError(f"unknown fault kind {self.kind!r}")
         if self.kind == "delay":
             self.arg = 0.05 if self.arg is None else float(self.arg)
@@ -180,8 +194,9 @@ class FaultPlan:
     # -- the hot path ------------------------------------------------------
     def consult(self, site: str, ctx: dict) -> str | None:
         """Advance the site's counter; fire at most one matching spec.
-        Returns "exhaust" for exhaust faults, None otherwise; raises
-        :class:`FaultError` / sleeps for error / delay faults."""
+        Returns the kind token for token kinds (exhaust/nan_grads/
+        bad_batch), None otherwise; raises :class:`FaultError` / sleeps for
+        error / delay faults."""
         with self._lock:
             idx = self.calls.get(site, 0) + 1
             self.calls[site] = idx
@@ -207,7 +222,7 @@ class FaultPlan:
             return None
         if kind == "error":
             raise FaultError(site, idx, arg)
-        return "exhaust"
+        return kind  # token kinds: the site interprets the string
 
     # -- activation --------------------------------------------------------
     def __enter__(self):

@@ -1,26 +1,38 @@
-"""Chaos sweep over the serving bench: drive the continuous-batching engine
-through a battery of deterministic fault plans and report survival /
-degradation stats per plan.
+"""Chaos sweep: drive the runtime through batteries of deterministic fault
+plans and report survival / degradation stats per plan.
 
-For every plan the same request fleet runs on a fresh engine; the fault-free
-run's outputs are the parity reference. A plan "survives" when the engine
-drains without crashing, every non-targeted request matches the reference
-token-for-token, every targeted request ends FAILED/CANCELLED with an error
-attached, and all KV blocks return to the pool.
+Two suites:
+
+``--suite serving`` (default) — the continuous-batching engine under fault
+plans. For every plan the same request fleet runs on a fresh engine; the
+fault-free run's outputs are the parity reference. A plan "survives" when
+the engine drains without crashing, every non-targeted request matches the
+reference token-for-token, every targeted request ends FAILED/CANCELLED
+with an error attached, and all KV blocks return to the pool.
+
+``--suite train`` — the resilient training loop (docs/ROBUSTNESS.md
+"Training resilience"): kill-worker (SIGKILL mid-run under the launcher,
+resume must be bit-identical), nan-injection (guarded step skips poisoned
+steps, GradScaler backs off, the run completes), and
+torn-checkpoint-on-resume (resume falls back past a torn newest snapshot).
+Reports per scenario: survival, restarts/resume steps, bad steps, fallback
+behavior.
 
 Usage:
-    python tools/chaos_run.py [--requests 6] [--prompt-len 24] [--max-new 16]
+    python tools/chaos_run.py [--suite serving|train]
+        [--requests 6] [--prompt-len 24] [--max-new 16]
         [--slots 3] [--block-size 8] [--plan NAME:SPEC ...] [--json OUT.json]
 
-    python bench.py --chaos        # same sweep as bench's opt-in mode
+    python bench.py --chaos        # serving sweep, via bench's opt-in mode
 
 Custom plans: ``--plan storm "serving.prefill:error@2;serving.kv.alloc:exhaust@5"``
-(repeatable) replaces the built-in battery.
+(repeatable) replaces the built-in serving battery.
 """
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -106,8 +118,146 @@ def _run_plan(model, prompts, sp, max_len, args, plan_text, reference=None):
     }, [r.output_tokens for r in reqs] if reqs else None
 
 
+# -- the train battery -----------------------------------------------------
+
+def _train_model(seed=7):
+    import paddle_tpu.nn as nn
+
+    paddle_tpu.seed(seed)
+    net = nn.Linear(4, 3)
+    model = paddle_tpu.Model(net)
+    model.prepare(
+        optimizer=paddle_tpu.optimizer.Momentum(
+            learning_rate=0.05, momentum=0.9, parameters=net.parameters()),
+        loss=nn.MSELoss())
+    return model, net
+
+
+def _train_kill_worker(workdir):
+    """SIGKILL one worker mid-run under the launcher; the relaunched pod
+    must resume from the auto-checkpoint and finish bit-identical to an
+    uninterrupted run."""
+    import subprocess
+
+    from paddle_tpu.resilience import demo
+
+    base = dict(os.environ, PYTHONPATH=".", JAX_PLATFORMS="cpu",
+                XLA_FLAGS="", RESIL_STEPS="16", RESIL_CKPT_EVERY="4")
+
+    def launch(env, extra):
+        return subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node", "1", "--backend", "cpu"] + extra
+            + [demo.__file__],
+            env=env, timeout=300, capture_output=True, text=True)
+
+    ref_env = dict(base, RESIL_DIR=os.path.join(workdir, "ckpt_ref"),
+                   RESIL_OUT=os.path.join(workdir, "ref.npz"))
+    r0 = launch(ref_env, ["--log_dir", os.path.join(workdir, "log_ref")])
+    kill_env = dict(base, RESIL_DIR=os.path.join(workdir, "ckpt_kill"),
+                    RESIL_OUT=os.path.join(workdir, "kill.npz"),
+                    RESIL_KILL_STEP="10")
+    r1 = launch(kill_env, ["--max_restarts", "2", "--restart_backoff", "0.1",
+                           "--log_dir", os.path.join(workdir, "log_kill")])
+    identical = False
+    ledger = {}
+    if r0.returncode == 0 and r1.returncode == 0:
+        ref = np.load(os.path.join(workdir, "ref.npz"))
+        kill = np.load(os.path.join(workdir, "kill.npz"))
+        identical = all(np.array_equal(ref[k], kill[k]) for k in ref.files)
+        with open(os.path.join(workdir, "log_kill", "job_state.json")) as f:
+            ledger = json.load(f)
+    return {
+        "scenario": "kill_worker",
+        "survived": bool(r0.returncode == 0 and r1.returncode == 0
+                         and identical and ledger.get("restarts") == 1),
+        "ref_rc": r0.returncode,
+        "kill_rc": r1.returncode,
+        "bit_identical": bool(identical),
+        "restarts": ledger.get("restarts"),
+        "resume_steps": ledger.get("resume_steps"),
+    }
+
+
+def _train_nan_injection(workdir):
+    """Poisoned-gradient steps must be skipped (scaler backed off, counters
+    up) without killing the run or corrupting optimizer state."""
+    from paddle_tpu.amp import GradScaler
+    from paddle_tpu.resilience import HealthGuard, ResilientLoop
+    from paddle_tpu.resilience.demo import data_fn
+
+    model, _ = _train_model()
+    scaler = GradScaler(init_loss_scaling=1024.0, decr_every_n_nan_or_inf=1)
+    with FaultPlan.parse("optimizer.step:nan_grads@3x2") as plan:
+        report = ResilientLoop(
+            model, data_fn, ckpt_dir=os.path.join(workdir, "nan"),
+            max_steps=10, ckpt_every_steps=4, scaler=scaler,
+            health=HealthGuard(max_bad_streak=4, scaler=scaler)).run()
+    return {
+        "scenario": "nan_injection",
+        "survived": bool(report["final_step"] == 10
+                         and report["bad_steps"] == 2
+                         and scaler.get_loss_scaling() < 1024.0),
+        "bad_steps": report["bad_steps"],
+        "final_step": report["final_step"],
+        "loss_scale_after": scaler.get_loss_scaling(),
+        "faults_fired": plan.summary(),
+    }
+
+
+def _train_torn_checkpoint(workdir):
+    """A torn newest snapshot (writer killed before the manifest) must be
+    skipped on resume: the loop falls back to the previous good one."""
+    from paddle_tpu.resilience import ResilientLoop
+    from paddle_tpu.resilience.demo import data_fn
+
+    root = os.path.join(workdir, "torn")
+    model, _ = _train_model()
+    ResilientLoop(model, data_fn, ckpt_dir=root, max_steps=6,
+                  ckpt_every_steps=2, save_final=False).run()
+    newest = sorted(os.listdir(root))[-1]
+    os.remove(os.path.join(root, newest, "manifest.0.json"))
+    model2, _ = _train_model()
+    loop = ResilientLoop(model2, data_fn, ckpt_dir=root, max_steps=8,
+                         ckpt_every_steps=4)
+    report = loop.run()
+    skipped = (loop.ckpt.last_load_report or {}).get("skipped", [])
+    return {
+        "scenario": "torn_checkpoint_on_resume",
+        "survived": bool(report["resume_step"] == 4
+                         and report["final_step"] == 8 and skipped),
+        "resume_step": report["resume_step"],
+        "final_step": report["final_step"],
+        "snapshots_skipped": [os.path.basename(p) for p, _ in skipped],
+    }
+
+
+def run_train_suite(workdir=None):
+    import tempfile
+
+    workdir = workdir or tempfile.mkdtemp(prefix="chaos-train-")
+    rows = [
+        _train_kill_worker(workdir),
+        _train_nan_injection(workdir),
+        _train_torn_checkpoint(workdir),
+    ]
+    survived = sum(1 for r in rows if r["survived"])
+    dump_path = telemetry.dump(reason="train chaos suite complete")
+    return {
+        "suite": "train",
+        "workdir": workdir,
+        "plans_run": len(rows),
+        "plans_survived": survived,
+        "all_survived": survived == len(rows),
+        "flight_recorder_dump": dump_path,
+        "results": rows,
+    }
+
+
 def run_sweep(argv=None):
     ap = argparse.ArgumentParser()
+    ap.add_argument("--suite", choices=["serving", "train"],
+                    default="serving")
     ap.add_argument("--requests", type=int, default=6)
     ap.add_argument("--prompt-len", type=int, default=24)
     ap.add_argument("--max-new", type=int, default=16)
@@ -121,6 +271,13 @@ def run_sweep(argv=None):
                     help="custom fault plan (repeatable; replaces battery)")
     ap.add_argument("--json", default=None)
     args = ap.parse_args(argv)
+
+    if args.suite == "train":
+        report = run_train_suite()
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(report, f, indent=2)
+        return report
 
     model, prompts, sp, max_len = _build(args)
     plans = args.plan if args.plan else DEFAULT_PLANS
@@ -169,11 +326,17 @@ def main(argv=None):
     print(json.dumps(report, indent=2))
     for r in report["results"]:
         status = "OK " if r["survived"] else "DIED"
-        print(f"[{status}] {r['name']:<20} finished={r['finished']} "
-              f"failed={r['failed']} cancelled={r['cancelled']} "
-              f"parity={'yes' if r['survivor_parity_ok'] else 'NO'} "
-              f"slowdown={r['slowdown_vs_baseline']}x",
-              file=sys.stderr)
+        if report.get("suite") == "train":
+            detail = " ".join(f"{k}={v}" for k, v in r.items()
+                              if k not in ("scenario", "survived"))
+            print(f"[{status}] {r['scenario']:<26} {detail}",
+                  file=sys.stderr)
+        else:
+            print(f"[{status}] {r['name']:<20} finished={r['finished']} "
+                  f"failed={r['failed']} cancelled={r['cancelled']} "
+                  f"parity={'yes' if r['survivor_parity_ok'] else 'NO'} "
+                  f"slowdown={r['slowdown_vs_baseline']}x",
+                  file=sys.stderr)
     if not report["all_survived"]:
         return 1
     return 0
