@@ -28,6 +28,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..core.jaxcompat import shard_map as _shard_map
 
 from .. import telemetry
+from ..telemetry import cluster as _cluster
 from ..core.tensor import Tensor
 from ..framework.flags import flag_value
 from ..utils import faults
@@ -188,6 +189,11 @@ def _shard_mapped(g: Group, fn, *arrays, in_specs=None, out_specs=None,
 
     timeout = float(flag_value("FLAGS_collective_timeout_s") or 0.0)
     t0 = time.monotonic()
+    # cluster heartbeat: when a RankPublisher is installed, every rank
+    # publishes (op, seq#, entered/exited stamps) to the store — the
+    # ClusterMonitor's straggler/desync/hang signal. One global load when
+    # no publisher is configured.
+    _cluster.collective_enter(op, axis=g.axis, nranks=g.nranks)
     try:
         if timeout <= 0:
             return invoke()
@@ -200,8 +206,13 @@ def _shard_mapped(g: Group, fn, *arrays, in_specs=None, out_specs=None,
                                nranks=g.nranks, rank=_rank_of(g),
                                timeout_s=timeout)
         telemetry.dump(reason=f"collective timeout: {op}", error=e)
+        # fleet-wide: ask EVERY rank for its flight dump + stacks, so the
+        # postmortem answers "who hung", not just "I timed out"
+        _cluster.trigger_postmortem(f"collective timeout: {op} "
+                                    f"(rank {_rank_of(g)})")
         raise
     finally:
+        _cluster.collective_exit(op)
         _M_SECONDS.labels(op=op).observe(time.monotonic() - t0)
 
 

@@ -18,7 +18,14 @@ from __future__ import annotations
 import threading
 import time
 
+from .. import telemetry
+
 __all__ = ["ElasticLevel", "ElasticManager", "Heartbeat"]
+
+
+def _death_counter():
+    return telemetry.registry().counter(
+        "elastic_deaths_total", "ranks declared dead by heartbeat watch")
 
 
 class ElasticLevel:
@@ -150,6 +157,12 @@ class ElasticManager:
                 if dead:
                     self.dead = dead
                     self.failures.append(list(dead))
+                    # the flight recorder + fleet counters see every
+                    # detection even if no callback is wired
+                    _death_counter().inc(len(dead))
+                    telemetry.record_event("elastic.death",
+                                           ranks=list(dead),
+                                           world=self.world_size)
                     if self.on_failure is not None:
                         self.on_failure(dead)
                     self.rearm(dead)

@@ -51,6 +51,15 @@ def _parse(argv):
                    help="path of the job_state.json ledger (default: "
                         "<log_dir>/job_state.json); workers see it as "
                         "$PADDLE_JOB_STATE and record resume steps there")
+    p.add_argument("--cluster_telemetry", action="store_true",
+                   help="host a telemetry TCPStore for the pod: workers "
+                        "that call telemetry.cluster.start_from_env() "
+                        "publish per-rank metrics/flight/heartbeats to it; "
+                        "the launcher answers clock-sync probes, writes a "
+                        "merged cluster_metrics.json into --log_dir at "
+                        "exit, and on a failed pod collects a postmortem "
+                        "bundle (every rank's flight dump + stacks) there, "
+                        "recording its path in the job ledger")
     p.add_argument("--devices", default=None,
                    help="comma list forwarded as PADDLE_TPU_VISIBLE_DEVICES")
     p.add_argument("--backend", choices=["auto", "cpu", "tpu"], default="auto",
@@ -84,6 +93,10 @@ def _worker_env(args, master, local_rank):
     if getattr(args, "_ledger_path", None):
         # resilience.JobLedger.from_env(): workers append resume records
         env["PADDLE_JOB_STATE"] = args._ledger_path
+    if getattr(args, "_telemetry_endpoint", None):
+        # telemetry.cluster.start_from_env(): workers publish per-rank
+        # telemetry to the launcher-hosted store
+        env["PADDLE_TELEMETRY_STORE"] = args._telemetry_endpoint
     if args.devices:
         env["PADDLE_TPU_VISIBLE_DEVICES"] = args.devices
     if args.backend == "cpu":
@@ -162,6 +175,24 @@ def _watch(procs, poll_s=0.2):
         return 130, 0, True, []
 
 
+def _start_telemetry_plane(args):
+    """Host the pod's telemetry store + clock responder in the launcher.
+    Returns (store, aggregator) or (None, None) — missing native runtime
+    degrades to no cluster telemetry, never a failed launch."""
+    try:
+        from ...telemetry.cluster import ClusterAggregator
+        from ..tcp_store import TCPStore
+
+        store = TCPStore(is_master=True)
+        args._telemetry_endpoint = f"127.0.0.1:{store.port}"
+        agg = ClusterAggregator(store, args.nproc_per_node)
+        agg.start_clock_responder()
+        return store, agg
+    except Exception as e:
+        sys.stderr.write(f"[launch] cluster telemetry unavailable: {e}\n")
+        return None, None
+
+
 def launch(argv):
     # the supervisor owns restart POLICY (budget, backoff, scale plan,
     # job_state.json ledger); this loop stays the mechanism (spawn/watch)
@@ -170,6 +201,9 @@ def launch(argv):
     args = _parse(argv)
     master = args.master or f"127.0.0.1:{_free_port()}"
     os.makedirs(args.log_dir, exist_ok=True)
+    tele_store, tele_agg = (None, None)
+    if args.cluster_telemetry:
+        tele_store, tele_agg = _start_telemetry_plane(args)
     ledger_path = args.job_state or os.path.join(args.log_dir,
                                                  "job_state.json")
     args._ledger_path = os.path.abspath(ledger_path)
@@ -188,6 +222,16 @@ def launch(argv):
         args._attempt = attempt
         procs = _spawn(args, master)
         rc, n_failed, interrupted, dead_ranks = _watch(procs)
+        if tele_agg is not None and rc != 0 and not interrupted:
+            # whole-job postmortem BEFORE the survivors get torn down:
+            # every publishing rank answers with its flight dump + stacks
+            bundle = tele_agg.collect_postmortem(
+                reason=f"pod exit rc={rc} (ranks {dead_ranks} failed)",
+                out_dir=args.log_dir, timeout_s=5.0)
+            if bundle:
+                sup.ledger.record("postmortem", bundle=bundle, rc=rc,
+                                  dead_ranks=list(dead_ranks))
+                sys.stderr.write(f"[launch] postmortem bundle: {bundle}\n")
         decision = sup.decide(rc, n_failed, interrupted,
                               world_size=args.nproc_per_node,
                               dead_ranks=dead_ranks)
@@ -199,6 +243,19 @@ def launch(argv):
             elif decision["action"] == "abort" and not interrupted:
                 sys.stderr.write(
                     f"[launch] {decision['reason']}; giving up\n")
+            if tele_agg is not None:
+                try:
+                    import json as _json
+
+                    path = os.path.join(args.log_dir,
+                                        "cluster_metrics.json")
+                    with open(path, "w") as f:
+                        _json.dump(tele_agg.merged_snapshot(), f, indent=1,
+                                   default=str)
+                except Exception:
+                    pass
+                tele_agg.stop()
+                tele_store.close()
             return rc
         attempt += 1
         if decision["world"] != args.nproc_per_node:

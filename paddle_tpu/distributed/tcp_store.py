@@ -19,6 +19,7 @@ retry paths deterministically.
 from __future__ import annotations
 
 import ctypes
+import json
 import threading
 import time
 
@@ -178,6 +179,16 @@ class TCPStore:
                 return buf.raw[:n]
 
         return self._retrying("get", attempt, key)
+
+    def set_json(self, key: str, obj) -> None:
+        """``set`` with JSON encoding — the cluster observability plane
+        (``telemetry.cluster``) publishes every document this way."""
+        self.set(key, json.dumps(obj, default=str).encode())
+
+    def get_json(self, key: str):
+        """``get`` with JSON decoding; None when the key is absent."""
+        raw = self.get(key)
+        return None if raw is None else json.loads(raw)
 
     def add(self, key: str, amount: int = 1) -> int:
         k = key.encode()

@@ -56,6 +56,16 @@ def data_fn(step: int):
 def main():
     from paddle_tpu.resilience import HealthGuard, ResilientLoop
 
+    # launched with --cluster_telemetry: publish this rank's metrics and
+    # flight-recorder tail to the launcher-hosted store (no-op otherwise)
+    pub = None
+    try:
+        from paddle_tpu.telemetry import cluster
+
+        pub = cluster.start_from_env()
+    except Exception:
+        pass
+
     ckpt_dir = os.environ["RESIL_DIR"]
     steps = int(os.environ.get("RESIL_STEPS", "20"))
     every = int(os.environ.get("RESIL_CKPT_EVERY", "5"))
@@ -83,6 +93,9 @@ def main():
         params = {name: np.asarray(p._value)
                   for name, p in net.named_parameters()}
         np.savez(out, **params)
+    if pub is not None:
+        pub.publish_once()   # final snapshot before exit
+        pub.stop()
     print("RESIL_REPORT", report)
 
 

@@ -151,14 +151,31 @@ class ElasticSupervisor:
         return self.manager
 
     def monitor(self, store, world_size=None, timeout=6.0, poll=1.0,
-                join_grace=30.0) -> ElasticManager:
+                join_grace=30.0, aggregator=None,
+                postmortem_dir=None) -> ElasticManager:
         """Optional in-process heartbeat watch over a live store: detections
-        land in the ledger; the manager re-arms itself after each one."""
+        land in the ledger; the manager re-arms itself after each one.
+
+        With ``aggregator`` (a :class:`telemetry.cluster.ClusterAggregator`
+        over the same store), each detection also collects a fleet
+        postmortem bundle — every still-alive rank's flight-recorder dump
+        and stack snapshot — into ``postmortem_dir`` and records its path
+        in the ledger, so the restart history links straight to the
+        whole-job evidence of *why* the pod died."""
         ledger = self.ledger
 
         def on_failure(dead):
+            bundle = None
+            if aggregator is not None:
+                bundle = aggregator.collect_postmortem(
+                    reason=f"elastic: ranks {sorted(dead)} lost heartbeat",
+                    out_dir=postmortem_dir, timeout_s=5.0)
             if ledger is not None:
-                ledger.record("heartbeat_failure", dead_ranks=list(dead))
+                ledger.record("heartbeat_failure", dead_ranks=list(dead),
+                              postmortem_bundle=bundle)
+            elif bundle is not None:
+                telemetry.record_event("supervisor.postmortem",
+                                       bundle=bundle)
 
         mgr = ElasticManager(
             store, world_size or self.world_size, timeout=timeout, poll=poll,

@@ -134,12 +134,19 @@ class LLMEngine:
                    watchdog trips in ``stats()`` (None = off)
     stall_limit:   consecutive no-progress engine steps tolerated before
                    the queue head is failed instead of spinning forever
+    slo_ttft_s / slo_tpot_s: latency SLOs for the rolling-window
+                   :class:`telemetry.SLOTracker`; ``stats()["slo"]``
+                   reports window p50/p95/p99, goodput (tokens within
+                   SLO), and the boolean admit/shed health signal a fleet
+                   gateway polls (None = track percentiles, never shed)
+    slo_window_s:  SLO observation window
     """
 
     def __init__(self, model, *, block_size=16, num_blocks=None, max_slots=4,
                  max_model_len=None, eos_token_id=None, kv_dtype=None,
                  max_queue=None, max_preemptions_per_request=16,
-                 watchdog_timeout_s=None, stall_limit=8):
+                 watchdog_timeout_s=None, stall_limit=8,
+                 slo_ttft_s=None, slo_tpot_s=None, slo_window_s=120.0):
         cfg = model.config
         self.model = model
         self.block_size = int(block_size)
@@ -164,6 +171,9 @@ class LLMEngine:
             self.block_size, cfg.head_dim, dtype=kv_dtype)
         self.engine_label = str(next(_ENGINE_IDS))
         self._m = _engine_metrics(self.engine_label)
+        self.slo = telemetry.SLOTracker(
+            ttft_slo_s=slo_ttft_s, tpot_slo_s=slo_tpot_s,
+            window_s=slo_window_s, engine_label=self.engine_label)
         self.scheduler = Scheduler(
             self.cache, self.max_slots, self.max_model_len,
             max_queue=max_queue,
@@ -339,6 +349,9 @@ class LLMEngine:
             "watchdog_trips": (int(m.watchdog.value) if live
                                else self.watchdog_trips),
             "last_decode_s": self.last_decode_s,
+            # rolling-window SLO view; "healthy"/"shed" is the admit
+            # signal the fleet gateway's router/load-shedder consumes
+            "slo": self.slo.summary(),
         }
 
     def _mean_ttft_direct(self):
@@ -365,6 +378,21 @@ class LLMEngine:
         elif kind == "admit" and req is not None:
             m.queue_time.observe(req.admit_time - req.arrival_time)
 
+    def _record_slo(self, req: Request):
+        """One rolling-window observation per terminal request: finished
+        requests contribute latency samples; failed/cancelled ones count
+        their (wasted) tokens against goodput."""
+        if req.state is RequestState.FINISHED:
+            n = len(req.output_tokens)
+            tpot = ((req.finish_time - req.first_token_time) / (n - 1)
+                    if n > 1 and req.first_token_time is not None else None)
+            queue_time = (req.admit_time - req.arrival_time
+                          if req.admit_time is not None else None)
+            self.slo.record_finished(ttft=req.ttft, tpot=tpot,
+                                     queue_time=queue_time, tokens=n)
+        else:
+            self.slo.record_failed(tokens=len(req.output_tokens))
+
     def _sync_gauges(self):
         alloc = self.cache.allocator
         m = self._m
@@ -383,6 +411,7 @@ class LLMEngine:
         if req.finish_time is None or getattr(req, "_spans_recorded", False):
             return
         req._spans_recorded = True
+        self._record_slo(req)
         tr = telemetry.tracer()
         tid = 100_000 + req.rid
         tid_name = f"request-{req.rid}"

@@ -19,6 +19,15 @@ run (docs/OBSERVABILITY.md has the full tour):
   collective/store timeouts, engine stalls, numerical-divergence trips
   (`resilience.HealthGuard`), and uncaught exceptions.
 
+Two cluster-scale layers sit on top (PR 6):
+
+- :mod:`.cluster` — the cross-rank plane: per-rank publishers over the
+  TCPStore, fleet aggregation, collective-heartbeat straggler/hang
+  diagnosis, multi-rank postmortem bundles, and clock-corrected Chrome
+  trace merging (``tools/cluster_status.py`` is the operator CLI).
+- :mod:`.slo` — rolling-window TTFT/TPOT/queue percentiles + goodput and
+  the admit/shed health signal on ``LLMEngine.stats()["slo"]``.
+
 :func:`disable` flips one shared flag that every write path checks first —
 the guaranteed-cheap escape hatch for benchmarking the instrumentation
 itself (``tools/serving_bench.py --telemetry off``).
@@ -48,6 +57,9 @@ from .flight_recorder import (  # noqa: F401
     install_excepthook,
     record_event,
 )
+from . import cluster  # noqa: F401  (cross-rank plane: publisher/monitor/
+#                                    aggregator/trace merge — see cluster.py)
+from .slo import SLOTracker  # noqa: F401
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
@@ -55,6 +67,7 @@ __all__ = [
     "trace_id", "set_device_trace_active", "device_trace_active",
     "FlightRecorder", "flight", "record_event", "dump", "install_excepthook",
     "enable", "disable", "enabled", "prometheus_text", "snapshot",
+    "cluster", "SLOTracker",
 ]
 
 

@@ -26,7 +26,9 @@ from __future__ import annotations
 
 import bisect
 import json
+import os
 import threading
+import time
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
@@ -320,8 +322,11 @@ class MetricsRegistry:
         return "\n".join(lines) + ("\n" if lines else "")
 
     def snapshot(self) -> dict:
-        """JSON-able registry dump: {name: {type, help, labels, series}}."""
-        out = {}
+        """JSON-able registry dump: {name: {type, help, labels, series}},
+        plus a ``__meta__`` entry stamping the capture time so two
+        snapshots diff into rates (``tools/metrics_dump.py --diff``).
+        Consumers iterating families must skip keys starting with ``__``."""
+        out = {"__meta__": {"wall_time": time.time(), "pid": os.getpid()}}
         for m in self.metrics():
             series = []
             for labeldict, ch in m.series():

@@ -29,7 +29,7 @@ import time
 
 from .metrics import ENABLED
 
-__all__ = ["Span", "Tracer", "tracer", "span", "trace_id",
+__all__ = ["Span", "Tracer", "tracer", "span", "trace_id", "epoch_unix",
            "set_device_trace_active", "device_trace_active"]
 
 _EPOCH = time.monotonic()
@@ -42,6 +42,14 @@ _TLS = threading.local()
 def trace_id() -> str:
     """This process's trace id (stamped on every exported span)."""
     return _TRACE_ID
+
+
+def epoch_unix() -> float:
+    """Wall-clock time corresponding to exported trace ``ts=0`` (the
+    module-load monotonic epoch). Cross-rank trace merge
+    (:func:`telemetry.cluster.merge_traces`) uses this plus a per-rank
+    clock offset to place every rank's events on one shared timeline."""
+    return time.time() - (time.monotonic() - _EPOCH)
 
 
 def set_device_trace_active(active: bool):
@@ -152,7 +160,8 @@ class Tracer:
         with open(path, "w") as f:
             json.dump({"traceEvents": events,
                        "displayTimeUnit": "ms",
-                       "otherData": {"trace_id": _TRACE_ID}},
+                       "otherData": {"trace_id": _TRACE_ID,
+                                     "epoch_unix": epoch_unix()}},
                       f, default=str)
         return path
 

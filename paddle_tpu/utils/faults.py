@@ -33,6 +33,13 @@ Grammar (``;``-separated specs)::
 
     site:kind[=arg][@start][xcount][%prob]
 
+    site   exact site name; a TOP-LEVEL (dot-free) site matches its whole
+           subtree: ``collective`` fires at every ``collective.<op>``,
+           ``store`` at every TCPStore verb — this is how one
+           ``collective:delay=0.3`` plan turns a whole rank into a
+           straggler for the cluster monitor to name. Dotted sites stay
+           exact (``serving.decode`` does not hit ``serving.decode.slot``)
+
     kind   error      raise FaultError(arg or a default message)
            delay      time.sleep(float(arg))  [default 0.05s]
            exhaust    inject() returns "exhaust"; the site simulates
@@ -74,7 +81,7 @@ import zlib
 from dataclasses import dataclass, field
 
 __all__ = ["FaultError", "FaultSpec", "FaultPlan", "inject", "activate",
-           "deactivate", "active_plan"]
+           "deactivate", "active_plan", "site_matches"]
 
 
 class FaultError(RuntimeError):
@@ -94,6 +101,17 @@ _SPEC_RE = re.compile(
     r"(?:@(?P<start>\d+))?"
     r"(?:x(?P<count>\d+|\*))?"
     r"(?:%(?P<prob>[0-9.]+))?$")
+
+
+def site_matches(spec_site: str, site: str) -> bool:
+    """Exact match, or — for a *top-level* (dot-free) spec site — subtree
+    match: ``collective`` fires at ``collective.all_reduce``, ``store`` at
+    every verb. Dotted spec sites stay exact (``serving.decode`` must not
+    also hit ``serving.decode.slot``), so every pre-existing plan keeps
+    its meaning."""
+    if spec_site == site:
+        return True
+    return "." not in spec_site and site.startswith(spec_site + ".")
 
 
 @dataclass
@@ -202,7 +220,7 @@ class FaultPlan:
             self.calls[site] = idx
             spec = None
             for s in self.specs:
-                if s.site != site:
+                if not site_matches(s.site, site):
                     continue
                 # crc32 keying: stable across processes (unlike hash())
                 rng = random.Random(

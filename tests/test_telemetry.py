@@ -333,6 +333,7 @@ _STATS_KEYS = {
     "block_high_water", "cache_utilization", "num_preemptions",
     "decode_traces", "prefill_traces", "total_generated_tokens",
     "tokens_per_sec", "mean_ttft", "watchdog_trips", "last_decode_s",
+    "slo",   # PR 6: rolling-window SLO block (tests/test_cluster_telemetry)
 }
 
 

@@ -1,7 +1,7 @@
 """Chaos sweep: drive the runtime through batteries of deterministic fault
 plans and report survival / degradation stats per plan.
 
-Two suites:
+Three suites:
 
 ``--suite serving`` (default) — the continuous-batching engine under fault
 plans. For every plan the same request fleet runs on a fresh engine; the
@@ -18,8 +18,19 @@ torn-checkpoint-on-resume (resume falls back past a torn newest snapshot).
 Reports per scenario: survival, restarts/resume steps, bad steps, fallback
 behavior.
 
+``--suite straggler`` — the cluster observability plane
+(docs/OBSERVABILITY.md "Cluster observability"): a 4-rank job over a real
+TCPStore where one rank carries a ``collective:delay`` fault plan.
+Scenario A (persistent straggler): the ClusterMonitor must *name* the
+delayed rank and the collective seq#s it lagged on, and the per-rank
+Chrome traces must merge (clock-offset corrected) into one
+``trace-merged.json`` with one row per rank. Scenario B (hang): a long
+delay wedges one rank mid-job; the monitor's hang diagnosis must name it
+as the suspect and a postmortem bundle must collect EVERY rank's flight
+recorder + stack snapshot.
+
 Usage:
-    python tools/chaos_run.py [--suite serving|train]
+    python tools/chaos_run.py [--suite serving|train|straggler]
         [--requests 6] [--prompt-len 24] [--max-new 16]
         [--slots 3] [--block-size 8] [--plan NAME:SPEC ...] [--json OUT.json]
 
@@ -232,6 +243,168 @@ def _train_torn_checkpoint(workdir):
     }
 
 
+# -- the straggler battery -------------------------------------------------
+
+def _spawn_demo_ranks(endpoint, world, steps, scenario, workdir,
+                      plans=None, skews=None):
+    """Spawn `world` telemetry.cluster.demo_worker subprocesses; returns
+    (procs, trace_paths)."""
+    import subprocess
+
+    procs, traces = [], {}
+    for r in range(world):
+        trace = os.path.join(workdir, f"trace-{scenario}-rank{r}.json")
+        traces[r] = trace
+        env = dict(os.environ, PYTHONPATH=".", JAX_PLATFORMS="cpu",
+                   PADDLE_TELEMETRY_STORE=endpoint,
+                   DEMO_RANK=str(r), DEMO_WORLD=str(world),
+                   DEMO_STEPS=str(steps), DEMO_SCENARIO=scenario,
+                   DEMO_TRACE_OUT=trace)
+        if skews and r in skews:
+            env["DEMO_CLOCK_SKEW"] = str(skews[r])
+        if plans and r in plans:
+            env["FLAGS_fault_plan"] = plans[r]
+        logf = open(os.path.join(workdir,
+                                 f"worker-{scenario}-{r}.log"), "w")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c",
+             "from paddle_tpu.telemetry.cluster import demo_worker; "
+             "demo_worker()"],
+            env=env, stdout=logf, stderr=subprocess.STDOUT))
+    return procs, traces
+
+
+def _straggler_scenario(store, workdir, world=4, steps=8, delayed_rank=2,
+                        delay_s=0.25):
+    """One rank persistently slow before each collective: the monitor must
+    name it, and the ranks' traces must merge into one timeline."""
+    from paddle_tpu.telemetry.cluster import (ClusterAggregator,
+                                              ClusterMonitor, merge_traces)
+
+    endpoint = f"127.0.0.1:{store.port}"
+    agg = ClusterAggregator(store, world)
+    agg.start_clock_responder()
+    mon = ClusterMonitor(store, world,
+                         straggler_threshold_s=delay_s / 2,
+                         straggler_min_seqs=3)
+    procs, traces = _spawn_demo_ranks(
+        endpoint, world, steps, "straggle", workdir,
+        plans={delayed_rank: f"collective:delay={delay_s}x*"},
+        skews={1: 3.0})   # prove offset correction with real skew too
+    report = None
+    try:
+        while any(p.poll() is None for p in procs):
+            report = mon.poll()
+            time.sleep(0.02)
+        report = mon.poll()
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        agg.stop()
+    view = agg.fleet_view()
+    bases = {r: (view["ranks"][r]["meta"] or {}).get("trace_epoch_unix")
+             for r in range(world)}
+    offs = {r: (view["ranks"][r]["meta"] or {}).get("clock_offset_s") or 0.0
+            for r in range(world)}
+    merged_path = os.path.join(workdir, "trace-merged.json")
+    merged = merge_traces(
+        {r: p for r, p in traces.items() if os.path.exists(p)},
+        out_path=merged_path, offsets_s=offs,
+        bases_unix={r: b for r, b in bases.items() if b is not None})
+    rows = {e["pid"] for e in merged["traceEvents"] if e.get("ph") == "X"}
+    named = (report or {}).get("straggler")
+    ok = (named is not None and named["rank"] == delayed_rank
+          and len(named["seqs"]) >= 3 and len(rows) == world
+          and all(p.returncode == 0 for p in procs))
+    return {
+        "scenario": "persistent_straggler",
+        "survived": bool(ok),
+        "delayed_rank": delayed_rank,
+        "straggler_named": named and named["rank"],
+        "straggle_seqs": named and named["seqs"],
+        "mean_lag_ms": named and round(named["mean_lag_s"] * 1e3, 1),
+        "clock_offset_rank1_s": round(offs.get(1, 0.0), 3),
+        "trace_merged": merged_path,
+        "trace_rows": len(rows),
+        "worker_rcs": [p.returncode for p in procs],
+    }
+
+
+def _hang_scenario(store, workdir, world=4, steps=8, hung_rank=1,
+                   hang_at_step=5):
+    """One rank wedges mid-job: the hang diagnosis must suspect it, and a
+    postmortem bundle must contain EVERY rank's flight dump + stacks."""
+    from paddle_tpu.telemetry.cluster import (ClusterAggregator,
+                                              ClusterMonitor)
+
+    endpoint = f"127.0.0.1:{store.port}"
+    agg = ClusterAggregator(store, world)
+    agg.start_clock_responder()
+    mon = ClusterMonitor(store, world, hang_threshold_s=1.0)
+    procs, _ = _spawn_demo_ranks(
+        endpoint, world, steps, "hang", workdir,
+        plans={hung_rank: f"collective:delay=120@{hang_at_step + 1}"})
+    report, bundle = None, None
+    deadline = time.time() + 60.0
+    try:
+        while time.time() < deadline:
+            report = mon.poll()
+            if report["hang"]["hung"]:
+                break
+            time.sleep(0.05)
+        bundle = agg.collect_postmortem(
+            reason=f"chaos hang: rank {hung_rank}", out_dir=workdir,
+            timeout_s=10.0)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        agg.stop()
+    manifest = {}
+    if bundle:
+        with open(os.path.join(bundle, "manifest.json")) as f:
+            manifest = json.load(f)
+    hang = (report or {}).get("hang", {})
+    ok = (hang.get("hung") and hang.get("suspect_ranks") == [hung_rank]
+          and manifest.get("ranks_collected") == list(range(world)))
+    return {
+        "scenario": "collective_hang",
+        "survived": bool(ok),
+        "hung_rank": hung_rank,
+        "suspect_ranks": hang.get("suspect_ranks"),
+        "waiting_ranks": hang.get("waiting_ranks"),
+        "waiting_seq": hang.get("waiting_seq"),
+        "bundle": bundle,
+        "bundle_ranks": manifest.get("ranks_collected"),
+        "bundle_missing": manifest.get("missing"),
+    }
+
+
+def run_straggler_suite(workdir=None):
+    import tempfile
+
+    from paddle_tpu.distributed.tcp_store import TCPStore
+
+    workdir = workdir or tempfile.mkdtemp(prefix="chaos-straggler-")
+    rows = []
+    for scenario in (_straggler_scenario, _hang_scenario):
+        store = TCPStore(is_master=True)
+        try:
+            rows.append(scenario(store, workdir))
+        finally:
+            store.close()
+    survived = sum(1 for r in rows if r["survived"])
+    return {
+        "suite": "straggler",
+        "workdir": workdir,
+        "plans_run": len(rows),
+        "plans_survived": survived,
+        "all_survived": survived == len(rows),
+        "results": rows,
+    }
+
+
 def run_train_suite(workdir=None):
     import tempfile
 
@@ -256,7 +429,7 @@ def run_train_suite(workdir=None):
 
 def run_sweep(argv=None):
     ap = argparse.ArgumentParser()
-    ap.add_argument("--suite", choices=["serving", "train"],
+    ap.add_argument("--suite", choices=["serving", "train", "straggler"],
                     default="serving")
     ap.add_argument("--requests", type=int, default=6)
     ap.add_argument("--prompt-len", type=int, default=24)
@@ -272,8 +445,9 @@ def run_sweep(argv=None):
     ap.add_argument("--json", default=None)
     args = ap.parse_args(argv)
 
-    if args.suite == "train":
-        report = run_train_suite()
+    if args.suite in ("train", "straggler"):
+        report = (run_train_suite() if args.suite == "train"
+                  else run_straggler_suite())
         if args.json:
             with open(args.json, "w") as f:
                 json.dump(report, f, indent=2)
@@ -326,7 +500,7 @@ def main(argv=None):
     print(json.dumps(report, indent=2))
     for r in report["results"]:
         status = "OK " if r["survived"] else "DIED"
-        if report.get("suite") == "train":
+        if report.get("suite") in ("train", "straggler"):
             detail = " ".join(f"{k}={v}" for k, v in r.items()
                               if k not in ("scenario", "survived"))
             print(f"[{status}] {r['scenario']:<26} {detail}",

@@ -1,0 +1,142 @@
+"""Attach to a running job's telemetry store and print the fleet view.
+
+The operator-side CLI for the cluster observability plane
+(``paddle_tpu.telemetry.cluster``): point it at the TCPStore endpoint the
+launcher advertised (``--cluster_telemetry`` prints it; workers see it as
+``$PADDLE_TELEMETRY_STORE``) and it renders, per rank: last publish age,
+collective heartbeat (op / seq# / entered-or-exited / how long), clock
+offset — plus the monitor's straggler / desync / hang diagnosis.
+
+    python tools/cluster_status.py --master 127.0.0.1:PORT --world 4
+        [--watch 1.0]              # refresh loop instead of one shot
+        [--prom fleet.prom]        # merged Prometheus exposition (rank=)
+        [--json fleet.json]        # merged snapshot + monitor report
+        [--postmortem DIR]         # force-collect a bundle right now
+        [--merge-traces OUT.json --trace R:PATH ...]   # one row per rank
+
+``--merge-traces`` aligns each rank's exported Chrome trace with the
+clock offsets the ranks published (their meta records), so a comm/compute
+overlap regression is visible as a picture — one timeline, one row per
+rank. Trace files must be reachable from this host (shared fs, or copied).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from paddle_tpu.telemetry.cluster import (  # noqa: E402
+    ClusterAggregator, ClusterMonitor, merge_traces)
+
+
+def _fmt_age(s):
+    return "-" if s is None else f"{s:7.2f}s"
+
+
+def render(report: dict) -> str:
+    lines = [f"fleet: {report['world_size']} ranks   "
+             f"seq spread={report['seq_spread']}"
+             f"{'  DESYNC' if report['desync'] else ''}"]
+    lines.append(f"{'rank':>4} {'seq':>6} {'op':<14} {'state':<10} "
+                 f"{'in-state':>9} {'pub-age':>9} {'clk-off':>9}")
+    for r, v in sorted(report["ranks"].items()):
+        off = v.get("clock_offset_s")
+        off_s = f"{off * 1e3:7.2f}ms" if off is not None else f"{'-':>9}"
+        lines.append(
+            f"{r:>4} {v['seq']:>6} {str(v['op'] or '-'):<14} "
+            f"{v['state']:<10} {_fmt_age(v['in_state_s']):>9} "
+            f"{_fmt_age(v['publish_age_s']):>9} {off_s}")
+    st = report["straggler"]
+    if st:
+        lines.append(f"STRAGGLER: rank {st['rank']} entered last by "
+                     f"{st['mean_lag_s'] * 1e3:.1f}ms mean on seqs "
+                     f"{st['seqs']} (latest seq# {st['last_seq']})")
+    hang = report["hang"]
+    if hang["hung"]:
+        lines.append(f"HANG: ranks {hang['waiting_ranks']} stuck in "
+                     f"'{hang['waiting_op']}' seq# {hang['waiting_seq']} "
+                     f"for {hang['stuck_for_s']:.1f}s — suspect rank(s) "
+                     f"{hang['suspect_ranks']}")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--master", required=True, help="telemetry store "
+                    "host:port (the launcher's --cluster_telemetry store)")
+    ap.add_argument("--world", type=int, required=True)
+    ap.add_argument("--watch", type=float, default=None,
+                    help="refresh every N seconds until interrupted")
+    ap.add_argument("--straggler-threshold-ms", type=float, default=200.0)
+    ap.add_argument("--hang-threshold-s", type=float, default=5.0)
+    ap.add_argument("--prom", default=None,
+                    help="write merged Prometheus exposition here")
+    ap.add_argument("--json", default=None,
+                    help="write merged snapshot + monitor report here")
+    ap.add_argument("--postmortem", default=None, metavar="DIR",
+                    help="collect a postmortem bundle from every rank now")
+    ap.add_argument("--merge-traces", default=None, metavar="OUT.json")
+    ap.add_argument("--trace", action="append", default=[],
+                    metavar="RANK:PATH", help="per-rank Chrome trace file "
+                    "for --merge-traces (repeatable)")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.distributed.tcp_store import TCPStore
+
+    host, _, port = args.master.rpartition(":")
+    store = TCPStore(host or "127.0.0.1", int(port))
+    agg = ClusterAggregator(store, args.world)
+    mon = ClusterMonitor(
+        store, args.world,
+        straggler_threshold_s=args.straggler_threshold_ms / 1e3,
+        hang_threshold_s=args.hang_threshold_s)
+
+    while True:
+        report = mon.poll()
+        print(render(report))
+        if args.watch is None:
+            break
+        time.sleep(args.watch)
+        print()
+
+    if args.prom:
+        with open(args.prom, "w") as f:
+            f.write(agg.prometheus_text())
+        print(f"# merged exposition -> {args.prom}", file=sys.stderr)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({"monitor": report,
+                       "metrics": agg.merged_snapshot()},
+                      f, indent=1, default=str)
+        print(f"# fleet json -> {args.json}", file=sys.stderr)
+    if args.postmortem:
+        bundle = agg.collect_postmortem("operator request",
+                                        out_dir=args.postmortem)
+        print(f"# postmortem bundle -> {bundle}", file=sys.stderr)
+    if args.merge_traces:
+        traces, bases, offs = {}, {}, {}
+        view = agg.fleet_view()
+        for spec in args.trace:
+            r, _, path = spec.partition(":")
+            traces[int(r)] = path
+            meta = view["ranks"].get(int(r), {}).get("meta") or {}
+            if meta.get("trace_epoch_unix") is not None:
+                bases[int(r)] = float(meta["trace_epoch_unix"])
+            if meta.get("clock_offset_s") is not None:
+                offs[int(r)] = float(meta["clock_offset_s"])
+        if not traces:
+            print("--merge-traces needs at least one --trace RANK:PATH",
+                  file=sys.stderr)
+            return 2
+        merge_traces(traces, out_path=args.merge_traces,
+                     offsets_s=offs, bases_unix=bases)
+        print(f"# merged trace ({len(traces)} ranks) -> "
+              f"{args.merge_traces}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

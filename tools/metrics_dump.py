@@ -1,15 +1,23 @@
-"""Pretty-print a telemetry registry snapshot JSON as tables.
+"""Pretty-print a telemetry registry snapshot JSON as tables, or diff two.
 
 The snapshot is what ``--metrics-out`` (bench.py / tools/serving_bench.py)
 and ``telemetry.registry().snapshot_json(path)`` write — this tool turns it
 into something eyeballable next to a BENCH_*.json artifact:
 
     python tools/metrics_dump.py METRICS.json [--filter serving_]
+    python tools/metrics_dump.py --diff A.json B.json [--filter store_]
 
 Counters and gauges print one row per labeled series; histograms print
 count / sum / mean plus a p50/p90/p99 estimate interpolated from the
 cumulative bucket counts (estimates, bounded by bucket resolution —
 exactly what Prometheus's ``histogram_quantile`` would report).
+
+``--diff`` prints counter/histogram deltas between two snapshots, plus
+per-second rates when both carry a ``__meta__.wall_time`` stamp (snapshots
+do since PR 6) — the way to read the periodic per-rank snapshots the
+cluster plane publishes (``telemetry.cluster``): grab two, diff them, and
+the deltas are that rank's traffic over the interval. Gauges print
+``a -> b``.
 """
 from __future__ import annotations
 
@@ -46,6 +54,8 @@ def format_snapshot(snap: dict, name_filter: str = "") -> str:
     scalars = []
     hists = []
     for name, fam in sorted(snap.items()):
+        if name.startswith("__"):        # __meta__ capture stamp
+            continue
         if name_filter and name_filter not in name:
             continue
         for s in fam["series"]:
@@ -86,20 +96,90 @@ def format_snapshot(snap: dict, name_filter: str = "") -> str:
     return "\n".join(lines)
 
 
+def _series_map(fam: dict) -> dict:
+    """{frozen label tuple: series} for positional-independent matching."""
+    return {tuple(sorted(s["labels"].items())): s for s in fam["series"]}
+
+
+def format_diff(a: dict, b: dict, name_filter: str = "") -> str:
+    """Counter/histogram deltas (and rates, when both snapshots carry
+    ``__meta__.wall_time``) from snapshot ``a`` to ``b``; gauges as
+    ``a -> b``. Series absent from ``a`` diff against zero; zero-delta
+    rows are suppressed."""
+    dt = None
+    try:
+        dt = (float(b["__meta__"]["wall_time"])
+              - float(a["__meta__"]["wall_time"]))
+        if dt <= 0:
+            dt = None
+    except (KeyError, TypeError, ValueError):
+        pass
+    lines = [f"interval: {dt:.3f}s" if dt else
+             "interval: unknown (no __meta__.wall_time; rates omitted)"]
+    rows = []
+    for name, fam in sorted(b.items()):
+        if name.startswith("__"):
+            continue
+        if name_filter and name_filter not in name:
+            continue
+        old = _series_map(a.get(name, {"series": []}))
+        for key, s in sorted(_series_map(fam).items()):
+            o = old.get(key)
+            lbl = _labelstr(dict(key))
+            if fam["type"] == "histogram":
+                dc = s["count"] - (o["count"] if o else 0)
+                ds = s["sum"] - (o["sum"] if o else 0.0)
+                if dc == 0 and ds == 0:
+                    continue
+                rate = f" {dc / dt:10.4g}/s" if dt else ""
+                mean = (f" mean={ds / dc:.6g}s" if dc
+                        else f" sum{ds:+.6g}s")
+                rows.append(f"{name:<40} {lbl:<28} +{dc:<10}{rate}{mean}")
+            elif fam["type"] == "counter":
+                dv = s["value"] - (o["value"] if o else 0.0)
+                if dv == 0:
+                    continue
+                rate = f" {dv / dt:10.4g}/s" if dt else ""
+                rows.append(f"{name:<40} {lbl:<28} +{dv:<10.6g}{rate}")
+            else:
+                va = o["value"] if o else None
+                if o is not None and va == s["value"]:
+                    continue
+                frm = f"{va:.6g}" if va is not None else "-"
+                rows.append(f"{name:<40} {lbl:<28} {frm} -> "
+                            f"{s['value']:.6g}")
+    lines.extend(rows or ["(no changed series matched)"])
+    return "\n".join(lines)
+
+
+def _load(path: str):
+    with open(path) as f:
+        return json.load(f)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
-    ap.add_argument("snapshot", help="registry snapshot JSON (--metrics-out)")
+    ap.add_argument("snapshot", nargs="?", default=None,
+                    help="registry snapshot JSON (--metrics-out)")
+    ap.add_argument("--diff", nargs=2, metavar=("A", "B"), default=None,
+                    help="print counter deltas and rates from snapshot A "
+                         "to snapshot B instead of pretty-printing one")
     ap.add_argument("--filter", default="",
                     help="only metric names containing this substring")
     args = ap.parse_args(argv)
-    try:
-        with open(args.snapshot) as f:
-            snap = json.load(f)
-    except (OSError, json.JSONDecodeError) as e:
-        print(f"cannot read snapshot {args.snapshot!r}: {e}",
+    if (args.snapshot is None) == (args.diff is None):
+        print("give exactly one of: a snapshot path, or --diff A B",
               file=sys.stderr)
+        return 2
+    try:
+        if args.diff:
+            print(format_diff(_load(args.diff[0]), _load(args.diff[1]),
+                              args.filter))
+        else:
+            print(format_snapshot(_load(args.snapshot), args.filter))
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"cannot read snapshot: {e}", file=sys.stderr)
         return 1
-    print(format_snapshot(snap, args.filter))
     return 0
 
 

@@ -15,6 +15,12 @@ Usage:
     python tools/serving_bench.py [--requests 8] [--prompt-len 32]
         [--max-new 32] [--slots 4] [--block-size 16] [--json OUT.json]
         [--metrics-out METRICS.json] [--telemetry on|off]
+        [--slo-ttft-ms 200 --slo-tpot-ms 50]
+
+``--slo-ttft-ms``/``--slo-tpot-ms`` arm the engine's rolling-window SLO
+tracker: the result JSON gains a ``slo`` block (TTFT/TPOT/queue p50/p95/
+p99, goodput = tokens within SLO, and the admit/shed health bit), so bench
+trajectories capture tail latency next to the tok/s headline.
 
 ``--metrics-out`` writes the telemetry registry's JSON snapshot (TTFT/TPOT
 histograms, block-pool gauges, per-request counters) next to the bench
@@ -61,6 +67,11 @@ def main():
     ap.add_argument("--telemetry", choices=("on", "off"), default="on",
                     help="off = registry-disabled fast path (overhead "
                          "baseline for the <=3%% acceptance check)")
+    ap.add_argument("--slo-ttft-ms", type=float, default=None,
+                    help="TTFT SLO in ms: bench reports goodput (tokens "
+                         "within SLO) and window p99s from the SLO tracker")
+    ap.add_argument("--slo-tpot-ms", type=float, default=None,
+                    help="TPOT SLO in ms (see --slo-ttft-ms)")
     args = ap.parse_args()
 
     if args.telemetry == "off":
@@ -82,8 +93,13 @@ def main():
                      max_model_len=max_len)
     warm.generate(prompts[:1], sp)
 
+    slo_kw = dict(
+        slo_ttft_s=(args.slo_ttft_ms / 1e3
+                    if args.slo_ttft_ms is not None else None),
+        slo_tpot_s=(args.slo_tpot_ms / 1e3
+                    if args.slo_tpot_ms is not None else None))
     eng = LLMEngine(model, block_size=args.block_size, max_slots=args.slots,
-                    max_model_len=max_len)
+                    max_model_len=max_len, **slo_kw)
     t0 = time.perf_counter()
     outs = eng.generate(prompts, sp)
     dt_engine = time.perf_counter() - t0
@@ -113,6 +129,9 @@ def main():
         "num_preemptions": st["num_preemptions"],
         "telemetry": args.telemetry,
         "mean_ttft": st["mean_ttft"],
+        # rolling-window latency/goodput so BENCH_*.json trajectories
+        # capture tail latency and SLO attainment, not just throughput
+        "slo": st["slo"],
     }
     print(json.dumps(result, indent=2))
     if args.json:
