@@ -3,15 +3,18 @@
 The three pillars (docs/SERVING.md has the full tour):
 
 - :mod:`.kv_cache` — the paged KV cache: one fixed-shape block pool, a
-  free-list allocator, per-sequence block tables, and the functional cache
-  views the jitted steps thread through the model.
+  refcounted free-list allocator, per-sequence block tables, the
+  content-addressed prefix cache (shared blocks, copy-on-write, LRU
+  eviction of completed prefixes), and the functional cache views the
+  jitted steps thread through the model.
 - :mod:`paddle_tpu.kernels.paged_attention` — the ragged paged-attention
   decode kernel (Pallas on TPU, jnp mirror on CPU).
 - :mod:`.scheduler` / :mod:`.engine` — continuous batching: admission
-  control against free blocks, join-on-finish decode slots,
+  control against *effective* free blocks (free + evictable cached
+  prefixes), prefix-hit tail-only prefill, join-on-finish decode slots,
   preempt-and-requeue on pool exhaustion, seeded sampling, streaming
   outputs, and serving counters (TTFT, tokens/s, queue depth, cache
-  utilization).
+  utilization, prefix-cache hit rate).
 """
 from .engine import LLMEngine, naive_generate  # noqa: F401
 from .kv_cache import (  # noqa: F401

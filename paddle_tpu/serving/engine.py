@@ -27,8 +27,19 @@ bound tail latency; a bounded admission queue pushes back instead of
 buffering without limit; a watchdog counts slow decode steps and a stall
 detector fails the queue head rather than spinning when no progress is
 possible. Chaos sites (``serving.prefill``, ``serving.decode.slot``,
-``serving.decode``, ``serving.kv.alloc``, ``serving.admit``) let
-``paddle_tpu.utils.faults`` drive all of these paths deterministically.
+``serving.decode``, ``serving.kv.alloc``, ``serving.kv.share``,
+``serving.kv.cow``, ``serving.admit``) let ``paddle_tpu.utils.faults``
+drive all of these paths deterministically.
+
+Prefix caching (on by default; ``prefix_cache=False`` disables): admission
+maps the longest cached block-aligned prefix of each prompt into the new
+sequence's table as refcounted shared blocks and prefills only the
+divergent tail with a positional offset (``_run_tail_prefill``); decode
+registers each block it fills, copy-on-write protects shared blocks, and
+completed prefixes linger in an evictable LRU pool (docs/SERVING.md).
+Token streams are unchanged — sampling stays keyed by (request seed,
+output index) and cached K/V is exactly what a full prefill would
+recompute.
 
 ``naive_generate`` is the uncached baseline (full re-prefill every step)
 used by the parity tests and ``tools/serving_bench.py``.
@@ -99,6 +110,8 @@ def _engine_metrics(label: str) -> SimpleNamespace:
         running=G("serving_running_requests", "requests in decode slots"),
         blocks_used=G("serving_kv_blocks_used", "live KV blocks"),
         blocks_free=G("serving_kv_blocks_free", "free KV blocks"),
+        blocks_cached=G("serving_kv_blocks_cached",
+                        "evictable cached prefix blocks (rc==0)"),
         high_water=G("serving_kv_block_high_water",
                      "peak live KV blocks this run"),
         utilization=G("serving_cache_utilization",
@@ -140,13 +153,21 @@ class LLMEngine:
                    SLO), and the boolean admit/shed health signal a fleet
                    gateway polls (None = track percentiles, never shed)
     slo_window_s:  SLO observation window
+    prefix_cache:  content-addressed KV-block prefix caching (refcounted
+                   shared blocks, copy-on-write, LRU eviction of
+                   unreferenced prefixes — docs/SERVING.md). Requests whose
+                   prompt shares a block-aligned prefix with anything
+                   previously served prefill only the divergent tail;
+                   token streams are unchanged (``stats()["prefix_cache"]``
+                   reports hits/blocks saved).
     """
 
     def __init__(self, model, *, block_size=16, num_blocks=None, max_slots=4,
                  max_model_len=None, eos_token_id=None, kv_dtype=None,
                  max_queue=None, max_preemptions_per_request=16,
                  watchdog_timeout_s=None, stall_limit=8,
-                 slo_ttft_s=None, slo_tpot_s=None, slo_window_s=120.0):
+                 slo_ttft_s=None, slo_tpot_s=None, slo_window_s=120.0,
+                 prefix_cache=True):
         cfg = model.config
         self.model = model
         self.block_size = int(block_size)
@@ -166,9 +187,11 @@ class LLMEngine:
         self.params, self.buffers = functional_state(model)
         if kv_dtype is None:
             kv_dtype = next(iter(self.params.values())).dtype
+        self.prefix_cache = bool(prefix_cache)
         self.cache = PagedKVCache(
             cfg.num_hidden_layers, num_blocks, cfg.num_key_value_heads,
-            self.block_size, cfg.head_dim, dtype=kv_dtype)
+            self.block_size, cfg.head_dim, dtype=kv_dtype,
+            prefix_cache=self.prefix_cache)
         self.engine_label = str(next(_ENGINE_IDS))
         self._m = _engine_metrics(self.engine_label)
         self.slo = telemetry.SLOTracker(
@@ -352,6 +375,9 @@ class LLMEngine:
             # rolling-window SLO view; "healthy"/"shed" is the admit
             # signal the fleet gateway's router/load-shedder consumes
             "slo": self.slo.summary(),
+            # prefix-cache effectiveness: hit rate, blocks/tokens saved,
+            # CoW copies, evictions, and the evictable-pool size
+            "prefix_cache": self.cache.prefix_stats(),
         }
 
     def _mean_ttft_direct(self):
@@ -400,6 +426,7 @@ class LLMEngine:
         m.running.set(len(self.scheduler.running))
         m.blocks_used.set(alloc.num_used)
         m.blocks_free.set(alloc.num_free)
+        m.blocks_cached.set(alloc.num_cached)
         m.high_water.set(alloc.high_water)
         m.utilization.set(self.cache.utilization())
 
@@ -538,8 +565,43 @@ class LLMEngine:
         self._prefill_fns[P] = fn
         return fn
 
+    def _get_tail_prefill_fn(self, P: int, NPB: int):
+        """Tail-only prefill after a prefix-cache hit: same contract as the
+        plain prefill function plus the (padded, static-width) prefix block
+        table and the true prefix length; traces are keyed ``(P, NPB)`` —
+        both power-of-two bucketed, so the count stays O(log^2 max_len)."""
+        key = (P, NPB)
+        fn = self._prefill_fns.get(key)
+        if fn is not None:
+            return fn
+        model = self.model
+
+        def tail_prefill(params, buffers, pool, tokens, length, bt, pbt,
+                         prefix_len, temp, top_k, top_p, seed, step_idx):
+            self.prefill_traces[key] = self.prefill_traces.get(key, 0) + 1
+            view = PagedCacheView(
+                pool, bt[None, :], None, self.block_size,
+                prefix_block_tables=pbt[None, :], prefix_len=prefix_len)
+            positions = (prefix_len
+                         + jnp.arange(P, dtype=jnp.int32))[None]
+            logits, _ = functional_call(
+                model, params, buffers, tokens[None], cache=view,
+                positions=positions, training=False)
+            last = logits[0, length - 1].astype(jnp.float32)
+            k = jax.random.fold_in(jax.random.PRNGKey(seed), step_idx)
+            tok = sample_logits(last, temp, top_k, top_p, k)
+            return tok, view.pool
+
+        fn = jax.jit(tail_prefill, donate_argnums=self._donate)
+        self._prefill_fns[key] = fn
+        return fn
+
     def _run_prefill(self, slot: int, req: Request):
         toks = req.prefill_tokens
+        cached = req.cached_tokens if self.prefix_cache else 0
+        if cached:
+            self._run_tail_prefill(slot, req, toks, cached)
+            return
         L = len(toks)
         P = self._bucket(L)
         padded = np.zeros(P, np.int32)
@@ -555,6 +617,40 @@ class LLMEngine:
                 jnp.float32(sp.top_p), jnp.int32(sp.seed),
                 jnp.int32(len(req.output_tokens)))
         self.cache.pool = pool
+        self.cache.commit_prefix(req.rid, toks)
+        self._emit(slot, req, int(tok))
+
+    def _run_tail_prefill(self, slot: int, req: Request, toks, cached: int):
+        """Prefill only the tokens past the matched prefix: the cached
+        blocks are already mapped (shared) into the request's table, so the
+        jitted step gathers their K/V, writes the tail's, and samples from
+        the last valid position — positionally offset by the hit length."""
+        bs = self.block_size
+        npb = cached // bs                      # matched blocks (full)
+        tail = toks[cached:]
+        L = len(tail)
+        P = self._bucket(L)
+        NPB = 1 << (npb - 1).bit_length()       # pad to power of two
+        table = self.cache.tables[req.rid]
+        pbt = np.zeros(NPB, np.int32)
+        pbt[:npb] = table[:npb]
+        bt = np.zeros(P // bs, np.int32)
+        tail_blocks = table[npb:npb + P // bs]
+        bt[:len(tail_blocks)] = tail_blocks
+        padded = np.zeros(P, np.int32)
+        padded[:L] = tail
+        sp = req.sampling
+        with telemetry.span("engine.prefill", rid=req.rid, tokens=L,
+                            padded=P, cached=cached):
+            tok, pool = self._get_tail_prefill_fn(P, NPB)(
+                self.params, self.buffers, self.cache.pool,
+                jnp.asarray(padded), jnp.int32(L), jnp.asarray(bt),
+                jnp.asarray(pbt), jnp.int32(cached),
+                jnp.float32(sp.temperature), jnp.int32(sp.top_k),
+                jnp.float32(sp.top_p), jnp.int32(sp.seed),
+                jnp.int32(len(req.output_tokens)))
+        self.cache.pool = pool
+        self.cache.commit_prefix(req.rid, toks)
         self._emit(slot, req, int(tok))
 
     # ------------------------------------------------------------------
@@ -644,6 +740,13 @@ class LLMEngine:
                     decode_s=self.last_decode_s,
                     limit_s=self.watchdog_timeout_s)
         self.cache.pool = pool
+        if self.prefix_cache:
+            # a decode write that just filled its block completes another
+            # full token-block: index it so later admissions can share it
+            for slot, req in running.items():
+                if (slot in self.scheduler.running
+                        and req.total_len % self.block_size == 0):
+                    self.cache.commit_prefix(req.rid, req.prefill_tokens)
         toks = np.asarray(toks)
         for slot, req in running.items():
             self._emit(slot, req, int(toks[slot]))

@@ -15,15 +15,32 @@ Block 0 is a reserved scratch block: inactive decode slots carry all-zero
 tables, so their (masked-out) K/V writes land in scratch instead of a live
 sequence's block. The allocator therefore hands out ids 1..num_blocks-1.
 
-Host side: :class:`BlockAllocator` (free list + high-water mark) and
-:class:`PagedKVCache` (pool + per-sequence tables). Trace side:
-:class:`PagedCacheView`, the per-step functional view the jitted engine
-functions thread through ``LlamaForCausalLM.forward(cache=...)`` — it
-scatters new K/V into the pool and attends through the ragged
-paged-attention kernel. :class:`DenseKVCache` is the simple concatenating
-(HF ``past_kv``-style) cache used for parity testing and one-off decode.
+Host side: :class:`BlockAllocator` (refcounted free-list) and
+:class:`PagedKVCache` (pool + per-sequence tables + the prefix cache).
+Trace side: :class:`PagedCacheView`, the per-step functional view the
+jitted engine functions thread through
+``LlamaForCausalLM.forward(cache=...)`` — it scatters new K/V into the
+pool and attends through the ragged paged-attention kernel.
+:class:`DenseKVCache` is the simple concatenating (HF ``past_kv``-style)
+cache used for parity testing and one-off decode.
+
+Prefix caching (``PagedKVCache(prefix_cache=True)``, docs/SERVING.md):
+blocks carry refcounts, full token-blocks are content-addressed through a
+hash chain (dict keyed on ``(parent_hash, block_tokens)``), admission maps
+the longest cached block-aligned prefix into the new sequence's table as
+*shared* blocks (rc += 1) so only the divergent tail is prefilled, and a
+first write into a shared block triggers copy-on-write. Unreferenced
+completed prefixes (rc == 0 but still indexed) sit in an LRU pool that is
+evicted on demand — the scheduler admits against *effective* free blocks
+(free + evictable). The ragged paged-attention kernel gathers K/V through
+per-sequence block tables, so shared blocks are purely host-side
+bookkeeping: no kernel change.
 """
 from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
+from types import SimpleNamespace
 
 import jax
 import jax.numpy as jnp
@@ -38,11 +55,54 @@ __all__ = ["BlockAllocator", "PagedKVCache", "PagedCacheView", "DenseKVCache",
 SCRATCH_BLOCK = 0  # reserved: masked writes from inactive slots land here
 
 
-class BlockAllocator:
-    """Free-list allocator over the pool's block ids (1..num_blocks-1).
+# prefix-cache metric families (process-global; per-engine gauges live on
+# the engine's labeled series). Lazy so importing serving never forces the
+# registry up before package init finishes.
+_PM = None
 
-    Tracks a high-water mark so tests can assert the pool never overflows
-    and the engine can report peak cache pressure.
+
+def _prefix_metrics() -> SimpleNamespace:
+    global _PM
+    if _PM is None:
+        reg = telemetry.registry()
+        _PM = SimpleNamespace(
+            hits=reg.counter("kv_prefix_hits_total",
+                             "admissions that matched a cached prefix"),
+            misses=reg.counter("kv_prefix_misses_total",
+                               "admissions that matched nothing"),
+            blocks_saved=reg.counter(
+                "kv_prefix_blocks_saved_total",
+                "KV blocks mapped shared instead of re-prefilled"),
+            tokens_saved=reg.counter(
+                "kv_prefix_tokens_saved_total",
+                "prompt tokens whose prefill was skipped via prefix hits"),
+            cow=reg.counter("kv_prefix_cow_copies_total",
+                            "copy-on-write private block copies"),
+            evictions=reg.counter(
+                "kv_prefix_evictions_total",
+                "cached prefix blocks reclaimed from the LRU pool"),
+            stale=reg.counter(
+                "kv_prefix_stale_drops_total",
+                "prefix matches dropped whole (stale/corrupt index)"),
+            cached=reg.gauge("kv_prefix_cached_blocks",
+                             "blocks held rc==0 in the evictable LRU pool"),
+        )
+    return _PM
+
+
+class BlockAllocator:
+    """Refcounted free-list allocator over the pool's block ids
+    (1..num_blocks-1).
+
+    Every allocated block carries a refcount: ``alloc`` hands it out with
+    rc=1, :meth:`share` maps it into another table (rc += 1), and
+    :meth:`free` decrements — only an rc==0 block returns to the free
+    list. :meth:`release` is the prefix-cache variant of the last
+    dereference: instead of the free list, the block parks in the *cached*
+    set (content retained, evictable) until :meth:`share` promotes it back
+    or :meth:`reclaim` evicts it. Tracks a high-water mark so tests can
+    assert the pool never overflows and the engine can report peak cache
+    pressure.
     """
 
     def __init__(self, num_blocks: int, reserved: int = 1):
@@ -53,7 +113,8 @@ class BlockAllocator:
         self.reserved = reserved
         # pop() takes from the end: hand out low ids first
         self._free = list(range(num_blocks - 1, reserved - 1, -1))
-        self._live: set[int] = set()
+        self._rc: dict[int, int] = {}     # allocated blocks (cached: rc==0)
+        self._cached: set[int] = set()    # rc==0, content retained
         self.high_water = 0
 
     @property
@@ -66,11 +127,31 @@ class BlockAllocator:
 
     @property
     def num_used(self) -> int:
-        return len(self._live)
+        """Blocks referenced by at least one table (rc >= 1)."""
+        return len(self._rc) - len(self._cached)
+
+    @property
+    def num_cached(self) -> int:
+        """Evictable blocks: rc == 0 but content retained for prefix hits."""
+        return len(self._cached)
+
+    @property
+    def num_effective_free(self) -> int:
+        """What admission control sees: free plus evictable."""
+        return len(self._free) + len(self._cached)
+
+    @property
+    def _live(self) -> set[int]:
+        """The rc>=1 block set (kept as a view for the invariant tests)."""
+        return {b for b, rc in self._rc.items() if rc > 0}
+
+    def refcount(self, block: int) -> int:
+        return self._rc.get(block, 0)
 
     def alloc(self, n: int = 1):
-        """Allocate ``n`` blocks; returns their ids, or None if the pool
-        cannot satisfy the request (caller preempts or queues)."""
+        """Allocate ``n`` blocks at rc=1; returns their ids, or None if the
+        free list cannot satisfy the request (caller evicts, preempts, or
+        queues)."""
         if n < 0:
             raise ValueError(f"alloc({n})")
         # chaos site: an "exhaust" fault makes the pool look dry for this
@@ -84,69 +165,384 @@ class BlockAllocator:
                                    free=len(self._free))
             return None
         out = [self._free.pop() for _ in range(n)]
-        self._live.update(out)
-        self.high_water = max(self.high_water, len(self._live))
+        for b in out:
+            self._rc[b] = 1
+        self.high_water = max(self.high_water, self.num_used)
         telemetry.record_event("kv.alloc", n=n, granted=True,
-                               live=len(self._live), free=len(self._free))
+                               live=self.num_used, free=len(self._free))
         return out
 
-    def free(self, blocks):
+    def share(self, blocks):
+        """Add one reference per block (mapping it into another table). A
+        cached (rc==0) block is promoted back to live."""
         blocks = list(blocks)
         for b in blocks:
-            if b not in self._live:
+            if b not in self._rc:
+                raise ValueError(f"share of unallocated block id {b}")
+        for b in blocks:
+            self._cached.discard(b)
+            self._rc[b] += 1
+        self.high_water = max(self.high_water, self.num_used)
+        telemetry.record_event("kv.share", n=len(blocks),
+                               live=self.num_used, cached=len(self._cached))
+
+    def free(self, blocks):
+        """Drop one reference per block; blocks reaching rc==0 return to
+        the free list."""
+        blocks = list(blocks)
+        for b in blocks:
+            if self._rc.get(b, 0) <= 0:
                 raise ValueError(f"double free / foreign block id {b}")
-            self._live.discard(b)
-            self._free.append(b)
+        for b in blocks:
+            self._rc[b] -= 1
+            if self._rc[b] == 0:
+                del self._rc[b]
+                self._free.append(b)
         telemetry.record_event("kv.free", n=len(blocks),
-                               live=len(self._live), free=len(self._free))
+                               live=self.num_used, free=len(self._free))
+
+    def release(self, blocks) -> list[int]:
+        """Drop one reference per block, parking rc==0 blocks in the cached
+        set instead of the free list (their K/V stays valid for prefix
+        hits). Returns the blocks that became cached."""
+        blocks = list(blocks)
+        for b in blocks:
+            if self._rc.get(b, 0) <= 0:
+                raise ValueError(f"double free / foreign block id {b}")
+        became = []
+        for b in blocks:
+            self._rc[b] -= 1
+            if self._rc[b] == 0:
+                self._cached.add(b)
+                became.append(b)
+        return became
+
+    def reclaim(self, blocks):
+        """Evict cached blocks back to the free list (the cache removed
+        their index entries first). Never touches referenced blocks."""
+        for b in blocks:
+            if b not in self._cached:
+                raise ValueError(
+                    f"reclaim of non-cached block id {b} (rc="
+                    f"{self._rc.get(b, 0)})")
+            self._cached.discard(b)
+            del self._rc[b]
+            self._free.append(b)
+
+
+def _chain_hash(parent_hash: str, block_tokens) -> str:
+    """Content address of a full token-block given its prefix's hash: the
+    chain makes a block's hash identify the *entire* token prefix ending at
+    it, so equal hashes mean equal K/V content (decode is deterministic in
+    the token prefix)."""
+    payload = parent_hash + "|" + ",".join(str(int(t)) for t in block_tokens)
+    return hashlib.sha1(payload.encode()).hexdigest()
 
 
 class PagedKVCache:
-    """The block pool plus per-sequence block tables (host bookkeeping)."""
+    """The block pool plus per-sequence block tables (host bookkeeping).
+
+    With ``prefix_cache=True`` the cache additionally maintains the
+    content-addressed prefix index, the LRU pool of unreferenced
+    completed prefixes, and copy-on-write; see the module docstring.
+    """
 
     def __init__(self, num_layers, num_blocks, kv_heads, block_size,
-                 head_dim, dtype=jnp.float32):
+                 head_dim, dtype=jnp.float32, prefix_cache: bool = False):
         self.pool = jnp.zeros(
             (num_layers, num_blocks, 2, kv_heads, block_size, head_dim),
             dtype)
         self.allocator = BlockAllocator(num_blocks)
         self.block_size = int(block_size)
         self.tables: dict[object, list[int]] = {}
+        self.prefix_cache = bool(prefix_cache)
+        # content-addressed index: (parent_hash, block_tokens) -> block id
+        self._index: dict[tuple[str, tuple[int, ...]], int] = {}
+        self._block_key: dict[int, tuple] = {}   # registered block -> key
+        self._block_hash: dict[int, str] = {}    # registered block -> hash
+        self._lru: OrderedDict[int, None] = OrderedDict()  # rc==0, evictable
+        self._seq_hashes: dict[object, list[str]] = {}   # committed chain
+        self.seq_cached_tokens: dict[object, int] = {}   # last admission hit
+        # running totals (prefix_stats(); the telemetry counters mirror them)
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.prefix_blocks_saved = 0
+        self.prefix_tokens_saved = 0
+        self.cow_copies = 0
+        self.prefix_evictions = 0
+        self.stale_drops = 0
 
     def blocks_for(self, num_tokens: int) -> int:
         return -(-int(num_tokens) // self.block_size)
 
-    def can_allocate(self, num_tokens: int) -> bool:
-        return self.allocator.num_free >= self.blocks_for(num_tokens)
+    @property
+    def num_effective_free(self) -> int:
+        return self.allocator.num_effective_free
 
-    def allocate(self, seq_id, num_tokens: int) -> bool:
-        """Give ``seq_id`` a fresh table covering ``num_tokens`` tokens."""
+    def can_allocate(self, num_tokens: int) -> bool:
+        return self.allocator.num_effective_free >= self.blocks_for(
+            num_tokens)
+
+    def _table(self, seq_id) -> list[int]:
+        try:
+            return self.tables[seq_id]
+        except KeyError:
+            raise ValueError(
+                f"unknown sequence {seq_id!r}: no block table (never "
+                f"allocated or already freed)") from None
+
+    # -- prefix index ------------------------------------------------------
+    def match_prefix(self, tokens):
+        """Longest cached block-aligned prefix of ``tokens``: returns
+        ``(blocks, hashes)`` walking the hash chain from the root. Capped
+        at ``len(tokens) - 1`` so at least one token always prefills (the
+        first sampled token needs the last position's logits)."""
+        blocks: list[int] = []
+        hashes: list[str] = []
+        if not self.prefix_cache:
+            return blocks, hashes
+        # chaos site (consulted once per match attempt, so @k plans index
+        # admissions): a stale_hash fault models index corruption — an
+        # entry whose block no longer holds the content its key promises;
+        # the graceful path drops the whole match and prefills from scratch
+        if faults.inject("serving.kv.share", tokens=len(tokens)) \
+                == "stale_hash":
+            self.stale_drops += 1
+            _prefix_metrics().stale.inc()
+            telemetry.record_event("kv.share", stale=True,
+                                   tokens=len(tokens))
+            return [], []
+        if not self._index:
+            return blocks, hashes
+        bs = self.block_size
+        limit = (len(tokens) - 1) // bs     # block-aligned, < len(tokens)
+        parent = ""
+        for i in range(limit):
+            toks = tuple(int(t) for t in tokens[i * bs:(i + 1) * bs])
+            b = self._index.get((parent, toks))
+            if b is None:
+                break
+            blocks.append(b)
+            h = self._block_hash.get(b)
+            parent = h if h is not None else _chain_hash(parent, toks)
+            hashes.append(parent)
+        return blocks, hashes
+
+    def _register(self, block: int, parent: str, toks: tuple) -> None:
+        """Idempotent index insert. If the key is already taken (another
+        sequence registered equal content first) the duplicate block simply
+        stays unregistered and frees normally at rc==0 — the chain hash is
+        content-derived, so children registered under it still resolve."""
+        key = (parent, toks)
+        if key in self._index or block in self._block_key:
+            return
+        self._index[key] = block
+        self._block_key[block] = key
+        self._block_hash[block] = _chain_hash(parent, toks)
+
+    def commit_prefix(self, seq_id, tokens) -> None:
+        """Register every *full* block of ``tokens`` whose K/V the pool now
+        holds (called after prefill and whenever decode fills a block).
+        Catch-up style: blocks already committed for this sequence are
+        skipped via the per-sequence hash chain."""
+        if not self.prefix_cache:
+            return
+        table = self._table(seq_id)
+        hashes = self._seq_hashes.setdefault(seq_id, [])
+        bs = self.block_size
+        n_full = len(tokens) // bs
+        for i in range(len(hashes), n_full):
+            parent = hashes[-1] if hashes else ""
+            toks = tuple(int(t) for t in tokens[i * bs:(i + 1) * bs])
+            self._register(table[i], parent, toks)
+            hashes.append(_chain_hash(parent, toks))
+
+    def _evict_one(self) -> int:
+        """Reclaim the least-recently-released cached block: drop its index
+        entry, return it to the free list. Only rc==0 blocks live in the
+        LRU, so eviction can never touch a referenced block."""
+        block, _ = self._lru.popitem(last=False)
+        key = self._block_key.pop(block, None)
+        if key is not None and self._index.get(key) == block:
+            del self._index[key]
+        self._block_hash.pop(block, None)
+        self.allocator.reclaim([block])
+        self.prefix_evictions += 1
+        pm = _prefix_metrics()
+        pm.evictions.inc()
+        pm.cached.set(self.allocator.num_cached)
+        telemetry.record_event("kv.evict", block=block,
+                               cached=self.allocator.num_cached)
+        return block
+
+    def _alloc_evict(self, n: int):
+        """Allocate ``n`` fresh blocks, evicting LRU cached prefixes on
+        demand — this is what makes cached blocks *effectively* free."""
+        if n <= 0:
+            return []
+        out = self.allocator.alloc(n)
+        while out is None and self._lru:
+            self._evict_one()
+            out = self.allocator.alloc(n)
+        return out
+
+    # -- sequence lifecycle ------------------------------------------------
+    def allocate(self, seq_id, num_tokens: int, tokens=None) -> bool:
+        """Give ``seq_id`` a table covering ``num_tokens`` tokens. With the
+        prefix cache on and the token ids supplied, the longest cached
+        block-aligned prefix is mapped in as shared blocks and only the
+        tail is freshly allocated; ``seq_cached_tokens[seq_id]`` records
+        the hit for the caller's tail-only prefill."""
         if seq_id in self.tables:
             raise ValueError(f"sequence {seq_id!r} already has a table")
-        blocks = self.allocator.alloc(self.blocks_for(num_tokens))
-        if blocks is None:
+        matched: list[int] = []
+        hashes: list[str] = []
+        if self.prefix_cache and tokens is not None:
+            matched, hashes = self.match_prefix(tokens)
+        if matched:
+            self.allocator.share(matched)        # promotes cached ones
+            for b in matched:
+                self._lru.pop(b, None)
+        need = self.blocks_for(num_tokens) - len(matched)
+        tail = self._alloc_evict(need)
+        if tail is None:
+            # roll back the shares; registered blocks park back in the LRU
+            if matched:
+                for b in self.allocator.release(matched):
+                    self._lru[b] = None
+                _prefix_metrics().cached.set(self.allocator.num_cached)
             return False
-        self.tables[seq_id] = blocks
+        self.tables[seq_id] = matched + tail
+        self._seq_hashes[seq_id] = list(hashes)
+        cached_tokens = len(matched) * self.block_size
+        self.seq_cached_tokens[seq_id] = cached_tokens
+        if self.prefix_cache and tokens is not None:
+            pm = _prefix_metrics()
+            if matched:
+                self.prefix_hits += 1
+                self.prefix_blocks_saved += len(matched)
+                self.prefix_tokens_saved += cached_tokens
+                pm.hits.inc()
+                pm.blocks_saved.inc(len(matched))
+                pm.tokens_saved.inc(cached_tokens)
+                pm.cached.set(self.allocator.num_cached)
+                telemetry.record_event(
+                    "kv.share", seq=str(seq_id), blocks=len(matched),
+                    cached_tokens=cached_tokens)
+            else:
+                self.prefix_misses += 1
+                pm.misses.inc()
         return True
 
     def extend(self, seq_id, num_tokens: int) -> bool:
         """Grow ``seq_id``'s table to cover ``num_tokens`` tokens; False on
         pool exhaustion (nothing is allocated partially)."""
-        table = self.tables[seq_id]
+        table = self._table(seq_id)
         need = self.blocks_for(num_tokens) - len(table)
         if need <= 0:
             return True
-        blocks = self.allocator.alloc(need)
+        blocks = self._alloc_evict(need)
         if blocks is None:
             return False
         table.extend(blocks)
         return True
 
+    def ensure_writable(self, seq_id, position: int) -> bool:
+        """Copy-on-write guard: the next K/V write for ``seq_id`` lands at
+        ``position``. If that block is shared (rc > 1), allocate a private
+        block, copy the pool slice, and patch the table; if it is this
+        sequence's sole reference but still *indexed*, unregister it (the
+        write would make the index entry lie about its content). False when
+        the CoW allocation fails — the caller preempts or fails the
+        sequence, never writes a shared block."""
+        if position < 0:
+            return True
+        table = self._table(seq_id)
+        idx = position // self.block_size
+        block = table[idx]
+        # chaos site: "exhaust" models the CoW allocation failing mid-decode
+        if faults.inject("serving.kv.cow", seq=str(seq_id),
+                         block=block) == "exhaust":
+            telemetry.record_event("kv.cow", seq=str(seq_id), block=block,
+                                   granted=False, injected=True)
+            return False
+        rc = self.allocator.refcount(block)
+        if rc <= 1:
+            if block in self._block_key:
+                key = self._block_key.pop(block)
+                if self._index.get(key) == block:
+                    del self._index[key]
+                self._block_hash.pop(block, None)
+            return True
+        new = self._alloc_evict(1)
+        if new is None:
+            telemetry.record_event("kv.cow", seq=str(seq_id), block=block,
+                                   granted=False)
+            return False
+        [new_block] = new
+        self.pool = self.pool.at[:, new_block].set(self.pool[:, block])
+        self.allocator.free([block])             # rc > 1: pure decrement
+        table[idx] = new_block
+        self.cow_copies += 1
+        _prefix_metrics().cow.inc()
+        telemetry.record_event("kv.cow", seq=str(seq_id), src=block,
+                               dst=new_block)
+        return True
+
+    def fork(self, parent_id, child_id) -> None:
+        """Give ``child_id`` a table sharing every one of ``parent_id``'s
+        blocks (rc += 1 each) — the foundation for parallel sampling /
+        best-of-n. The first divergent write on either side goes through
+        :meth:`ensure_writable`'s copy-on-write."""
+        if child_id in self.tables:
+            raise ValueError(f"sequence {child_id!r} already has a table")
+        table = self._table(parent_id)
+        self.allocator.share(table)
+        self.tables[child_id] = list(table)
+        self._seq_hashes[child_id] = list(self._seq_hashes.get(parent_id, []))
+        self.seq_cached_tokens[child_id] = 0
+
     def free_seq(self, seq_id):
-        self.allocator.free(self.tables.pop(seq_id))
+        """Drop ``seq_id``'s references. Indexed blocks whose rc reaches 0
+        park in the LRU pool instead of the free list (their K/V stays
+        valid for prefix hits). Registration itself only ever happens at
+        :meth:`commit_prefix` — the points where the caller *knows* the
+        K/V is in the pool — so a sequence torn down after a failed
+        prefill can never poison the index with unwritten blocks."""
+        if seq_id not in self.tables:
+            raise ValueError(
+                f"unknown sequence {seq_id!r}: no block table (never "
+                f"allocated or already freed)")
+        table = self.tables.pop(seq_id)
+        self._seq_hashes.pop(seq_id, None)
+        self.seq_cached_tokens.pop(seq_id, None)
+        registered = [b for b in table if b in self._block_key]
+        plain = [b for b in table if b not in self._block_key]
+        if plain:
+            self.allocator.free(plain)
+        if registered:
+            for b in self.allocator.release(registered):
+                self._lru[b] = None              # newest end of the LRU
+            _prefix_metrics().cached.set(self.allocator.num_cached)
 
     def utilization(self) -> float:
         return self.allocator.num_used / max(self.allocator.num_usable, 1)
+
+    def prefix_stats(self) -> dict:
+        hits, misses = self.prefix_hits, self.prefix_misses
+        return {
+            "enabled": self.prefix_cache,
+            "hits": hits,
+            "misses": misses,
+            "hit_rate": hits / (hits + misses) if hits + misses else 0.0,
+            "blocks_saved": self.prefix_blocks_saved,
+            "tokens_saved": self.prefix_tokens_saved,
+            "cow_copies": self.cow_copies,
+            "evictions": self.prefix_evictions,
+            "stale_drops": self.stale_drops,
+            "cached_blocks": self.allocator.num_cached,
+            "indexed_blocks": len(self._block_key),
+        }
 
     def table_array(self, seq_ids, max_blocks: int) -> np.ndarray:
         """Fixed-shape [len(seq_ids), max_blocks] int32 table; absent ids
@@ -166,20 +562,28 @@ class PagedCacheView:
     layer; K/V writes are functional (``pool.at[...]``) and the updated pool
     accumulates on ``self.pool`` — the jitted step returns it as an output.
 
-    Two modes, keyed on the query's token count:
+    Three modes, keyed on the query's token count and the prefix args:
     - decode (S_new == 1): batched slots, one token each; writes the token's
       K/V at position ``ctx_lens[s]`` through the block table, then runs the
       ragged paged-attention kernel over ``ctx_lens + 1`` tokens.
     - prefill (S_new > 1, batch 1): the padded prompt; scatters whole blocks
       into the pool and attends densely (causal) within the prompt — no pool
       reads, so concurrent sequences are untouched.
+    - tail prefill (S_new > 1 with ``prefix_block_tables``): the divergent
+      tail of a prefix-cache hit; scatters the tail like prefill, then
+      attends over [gathered cached prefix K/V ++ tail K/V] with the
+      causal mask offset by ``prefix_len`` — the cached blocks are read,
+      never written.
     """
 
-    def __init__(self, pool, block_tables, ctx_lens, block_size):
+    def __init__(self, pool, block_tables, ctx_lens, block_size,
+                 prefix_block_tables=None, prefix_len=None):
         self.pool = pool                      # [L, N, 2, H, bs, D]
         self.block_tables = block_tables      # [S, M] int32
         self.ctx_lens = ctx_lens              # [S] int32 (None for prefill)
         self.block_size = int(block_size)
+        self.prefix_block_tables = prefix_block_tables  # [1, NPB] or None
+        self.prefix_len = prefix_len          # int32 scalar (valid tokens)
 
     # the duck-typed hook LlamaAttention calls (raw arrays in/out)
     def attend(self, layer_idx, q, k, v):
@@ -207,13 +611,10 @@ class PagedCacheView:
                    pos + 1)                              # [S, Hq, D]
         return out[:, None]                              # [S, 1, Hq, D]
 
-    def _prefill(self, layer_idx, q, k, v):
+    def _write_prompt_blocks(self, layer_idx, k, v):
+        """Scatter a batch-1 block-multiple prompt segment into the pool."""
         bs = self.block_size
         P = k.shape[1]
-        if q.shape[0] != 1 or P % bs:
-            raise ValueError(
-                f"prefill expects batch 1 and a block-multiple length; got "
-                f"batch {q.shape[0]}, len {P}, block_size {bs}")
         nb = P // bs
         # [1, P, Hkv, D] -> [nb, Hkv, bs, D] block layout
         kb = k[0].reshape(nb, bs, -1, k.shape[-1]).transpose(0, 2, 1, 3)
@@ -222,12 +623,40 @@ class PagedCacheView:
         pool = self.pool.at[layer_idx, bt, 0].set(kb)
         pool = pool.at[layer_idx, bt, 1].set(vb)
         self.pool = pool
+
+    def _prefill(self, layer_idx, q, k, v):
+        bs = self.block_size
+        P = k.shape[1]
+        if q.shape[0] != 1 or P % bs:
+            raise ValueError(
+                f"prefill expects batch 1 and a block-multiple length; got "
+                f"batch {q.shape[0]}, len {P}, block_size {bs}")
+        self._write_prompt_blocks(layer_idx, k, v)
         from ..nn.functional.attention import sdpa_ref
 
-        # causal within the prompt; padded tail positions produce garbage
-        # that never flows back (causality) and is never read (the engine
-        # takes logits at the last *valid* position)
-        return sdpa_ref(q, k, v, is_causal=True)
+        if self.prefix_block_tables is None:
+            # causal within the prompt; padded tail positions produce
+            # garbage that never flows back (causality) and is never read
+            # (the engine takes logits at the last *valid* position)
+            return sdpa_ref(q, k, v, is_causal=True)
+
+        # tail prefill: gather the cached prefix K/V through its block
+        # table (padding entries point at scratch and are masked off by
+        # prefix_len) and attend causally over [prefix ++ tail]
+        pbt = self.prefix_block_tables[0]                # [NPB]
+        spfx = pbt.shape[0] * bs
+        pkv = self.pool[layer_idx, pbt]                  # [NPB, 2, H, bs, D]
+        pk = pkv[:, 0].transpose(0, 2, 1, 3).reshape(
+            spfx, -1, k.shape[-1])[None]                 # [1, Spfx, Hkv, D]
+        pv = pkv[:, 1].transpose(0, 2, 1, 3).reshape(
+            spfx, -1, v.shape[-1])[None]
+        k_full = jnp.concatenate([pk, k], axis=1)
+        v_full = jnp.concatenate([pv, v], axis=1)
+        qi = jnp.arange(P, dtype=jnp.int32)[:, None]
+        kj = jnp.arange(spfx + P, dtype=jnp.int32)[None, :]
+        mask = jnp.where(kj < spfx, kj < self.prefix_len,
+                         (kj - spfx) <= qi)              # [P, Spfx + P]
+        return sdpa_ref(q, k_full, v_full, attn_mask=mask[None, None])
 
 
 class DenseKVCache:

@@ -94,6 +94,8 @@ class Request:
     on_token: object = None            # callable(req, token) per new token
     state: RequestState = RequestState.WAITING
     output_tokens: list[int] = field(default_factory=list)
+    cached_tokens: int = 0             # prefix-cache hit at last admission
+    cached_tokens_total: int = 0       # summed across (re-)admissions
     arrival_time: float = field(default_factory=time.monotonic)
     admit_time: float | None = None    # first admission into a slot
     deadline: float | None = None      # absolute monotonic() cutoff
@@ -196,22 +198,29 @@ class Scheduler:
     # -- admission --------------------------------------------------------
     def admit(self) -> list[tuple[int, Request]]:
         """Move waiting requests into free slots while the pool can hold
-        their prefill plus one block of decode headroom."""
+        their prefill plus one block of decode headroom. Admission is
+        checked against *effective* free blocks (free + evictable cached
+        prefixes) — a pool full of unreferenced completed prefixes is not
+        a full pool, and any cached prefix the request matches shrinks its
+        real footprint further."""
         admitted = []
         while self.waiting and self._free_slots:
             req = self.waiting[0]
             faults.inject("serving.admit", rid=req.rid)
             need = self.cache.blocks_for(len(req.prefill_tokens)) + 1
-            if self.cache.allocator.num_free < need:
+            if self.cache.num_effective_free < need:
                 break
             self.waiting.popleft()
             slot = self._free_slots.pop(0)
-            if not self.cache.allocate(req.rid, len(req.prefill_tokens)):
-                # free-count check passed but alloc failed (injected
+            if not self.cache.allocate(req.rid, len(req.prefill_tokens),
+                                       tokens=req.prefill_tokens):
+                # effective-free check passed but alloc failed (injected
                 # exhaustion): put everything back and retry next step
                 self._free_slots.insert(0, slot)
                 self.waiting.appendleft(req)
                 break
+            req.cached_tokens = self.cache.seq_cached_tokens.get(req.rid, 0)
+            req.cached_tokens_total += req.cached_tokens
             req.state = RequestState.RUNNING
             if req.admit_time is None:
                 req.admit_time = time.monotonic()
@@ -220,6 +229,7 @@ class Scheduler:
             telemetry.record_event(
                 "scheduler.admit", rid=req.rid, slot=slot,
                 blocks=len(self.cache.tables.get(req.rid, ())),
+                cached_tokens=req.cached_tokens,
                 queue_depth=len(self.waiting))
             self._on_event("admit", rid=req.rid, req=req)
         return admitted
@@ -238,13 +248,22 @@ class Scheduler:
             if req is None:  # preempted/failed earlier in this very loop
                 continue
             # the incoming token writes its K/V at position total_len - 1,
-            # so the table must cover total_len tokens
-            while not self.cache.extend(req.rid, req.total_len):
+            # so the table must cover total_len tokens AND the block it
+            # writes into must be privately owned (copy-on-write if it is
+            # shared with another sequence or the prefix index)
+            while True:
+                ok = self.cache.extend(req.rid, req.total_len)
+                if ok:
+                    ok = self.cache.ensure_writable(req.rid,
+                                                    req.total_len - 1)
+                if ok:
+                    break
                 victim = self._pick_victim(exclude=req)
                 if victim is None:
                     self.fail(slot, RuntimeError(
-                        f"request {req.rid} cannot obtain a KV block with "
-                        f"no victim left to preempt — pool exhausted "
+                        f"request {req.rid} cannot obtain a KV block "
+                        f"(extend or copy-on-write) with no victim left to "
+                        f"preempt — pool exhausted "
                         f"(usable={self.cache.allocator.num_usable})"))
                     break
                 preempted.append(victim)

@@ -49,6 +49,9 @@ Grammar (``;``-separated specs)::
                       (exercises the numerical-health guard)
            bad_batch  inject() returns "bad_batch"; the dataloader
                       replaces the batch's floats with NaN
+           stale_hash inject() returns "stale_hash"; the prefix index
+                      behaves as if it resolved a wrong-content block
+                      (the cache drops the whole match: no-share fallback)
     @start 1-based call index at which the spec starts firing (default 1)
     xcount how many consecutive calls fire (default 1; ``x*`` = forever)
     %prob  instead of @/x determinism, fire each call with probability
@@ -60,6 +63,10 @@ Known sites (see docs/ROBUSTNESS.md for the full table):
     serving.decode.slot   per running request, before each decode step
     serving.decode        once per batched decode step
     serving.kv.alloc      BlockAllocator.alloc (exhaust => pool dry)
+    serving.kv.share      prefix-index match on admission
+                          (stale_hash => drop to no-share, full prefill)
+    serving.kv.cow        copy-on-write guard before a shared-block write
+                          (exhaust => CoW alloc fails; caller preempts)
     serving.admit         per admission attempt
     store.connect         each TCPStore connect attempt
     store.get             each TCPStore get attempt
@@ -96,7 +103,8 @@ class FaultError(RuntimeError):
 
 
 _SPEC_RE = re.compile(
-    r"^(?P<site>[\w.\-]+):(?P<kind>error|delay|exhaust|nan_grads|bad_batch)"
+    r"^(?P<site>[\w.\-]+):"
+    r"(?P<kind>error|delay|exhaust|nan_grads|bad_batch|stale_hash)"
     r"(?:=(?P<arg>[^@x%;]+))?"
     r"(?:@(?P<start>\d+))?"
     r"(?:x(?P<count>\d+|\*))?"
@@ -128,8 +136,9 @@ class FaultSpec:
 
     # "token" kinds: inject() hands the kind string back to the call site,
     # which decides what the fault means there (exhaust => resource dry,
-    # nan_grads => poisoned gradients, bad_batch => NaN batch)
-    TOKEN_KINDS = ("exhaust", "nan_grads", "bad_batch")
+    # nan_grads => poisoned gradients, bad_batch => NaN batch,
+    # stale_hash => prefix index resolved wrong content)
+    TOKEN_KINDS = ("exhaust", "nan_grads", "bad_batch", "stale_hash")
 
     def __post_init__(self):
         if self.kind not in ("error", "delay") + self.TOKEN_KINDS:

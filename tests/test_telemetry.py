@@ -334,6 +334,8 @@ _STATS_KEYS = {
     "decode_traces", "prefill_traces", "total_generated_tokens",
     "tokens_per_sec", "mean_ttft", "watchdog_trips", "last_decode_s",
     "slo",   # PR 6: rolling-window SLO block (tests/test_cluster_telemetry)
+    "prefix_cache",   # PR 8: prefix-cache hit/CoW/eviction block
+                      # (tests/test_prefix_cache.py)
 }
 
 

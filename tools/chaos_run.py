@@ -1,7 +1,7 @@
 """Chaos sweep: drive the runtime through batteries of deterministic fault
 plans and report survival / degradation stats per plan.
 
-Three suites:
+Four suites:
 
 ``--suite serving`` (default) — the continuous-batching engine under fault
 plans. For every plan the same request fleet runs on a fresh engine; the
@@ -9,6 +9,17 @@ fault-free run's outputs are the parity reference. A plan "survives" when
 the engine drains without crashing, every non-targeted request matches the
 reference token-for-token, every targeted request ends FAILED/CANCELLED
 with an error attached, and all KV blocks return to the pool.
+
+``--suite prefix`` — the prefix cache (docs/SERVING.md) under its own
+fault battery on a shared-prefix fleet (``--prefix-share`` of every prompt
+is one common template). The parity reference is a fault-free
+prefix-cache-OFF engine, so survival additionally proves cache-on ==
+cache-off token streams under faults: ``serving.kv.share:stale_hash``
+(index corruption -> the match is dropped, full prefill), and
+``serving.kv.cow:exhaust`` (copy-on-write allocation fails mid-decode ->
+preempt/fail that request, never a corrupted shared block), plus allocator
+exhaustion with eviction in play. The baseline plan must also show a real
+cache hit rate.
 
 ``--suite train`` — the resilient training loop (docs/ROBUSTNESS.md
 "Training resilience"): kill-worker (SIGKILL mid-run under the launcher,
@@ -71,8 +82,23 @@ DEFAULT_PLANS = [
               "serving.decode:delay=0.005@2;serving.kv.alloc:exhaust@6"),
 ]
 
+# the prefix-cache battery: every degradation path the prefix cache claims
+# (stale index -> no-share fallback, CoW exhaustion -> preempt, allocator
+# exhaustion with the evictable pool in play), plus a combined storm
+PREFIX_PLANS = [
+    ("baseline_prefix", ""),
+    ("stale_hash", "serving.kv.share:stale_hash@3x2"),
+    ("stale_hash_storm", "serving.kv.share:stale_hash%0.5"),
+    ("cow_exhaust", "serving.kv.cow:exhaust@3"),
+    ("cow_exhaust_storm", "serving.kv.cow:exhaust@2x6"),
+    ("alloc_exhaust", "serving.kv.alloc:exhaust@4x2"),
+    ("prefix_storm", "serving.kv.share:stale_hash@2;"
+                     "serving.kv.cow:exhaust@5x2;"
+                     "serving.kv.alloc:exhaust@7"),
+]
 
-def _build(args):
+
+def _build(args, prefix_share=None):
     paddle_tpu.seed(0)
     max_len = args.prompt_len + args.max_new
     cfg = llama_tiny(vocab=args.vocab, hidden=args.hidden, layers=args.layers,
@@ -80,15 +106,24 @@ def _build(args):
                      seq=2 * max_len)
     model = LlamaForCausalLM(cfg)
     rng = np.random.RandomState(0)
-    prompts = [list(rng.randint(0, args.vocab, args.prompt_len))
-               for _ in range(args.requests)]
+    if prefix_share:
+        n_shared = int(args.prompt_len * prefix_share)
+        shared = list(rng.randint(0, args.vocab, n_shared))
+        prompts = [shared + list(rng.randint(
+            0, args.vocab, args.prompt_len - n_shared))
+            for _ in range(args.requests)]
+    else:
+        prompts = [list(rng.randint(0, args.vocab, args.prompt_len))
+                   for _ in range(args.requests)]
     sp = SamplingParams(max_new_tokens=args.max_new, temperature=0.0)
     return model, prompts, sp, max_len
 
 
-def _run_plan(model, prompts, sp, max_len, args, plan_text, reference=None):
+def _run_plan(model, prompts, sp, max_len, args, plan_text, reference=None,
+              prefix_cache=True):
     eng = LLMEngine(model, block_size=args.block_size, max_slots=args.slots,
-                    max_model_len=max_len, watchdog_timeout_s=0.002)
+                    max_model_len=max_len, watchdog_timeout_s=0.002,
+                    prefix_cache=prefix_cache)
     plan = FaultPlan.parse(plan_text) if plan_text else FaultPlan()
     t0 = time.perf_counter()
     crashed = None
@@ -125,8 +160,53 @@ def _run_plan(model, prompts, sp, max_len, args, plan_text, reference=None):
         "num_preemptions": st.get("num_preemptions"),
         "watchdog_trips": st.get("watchdog_trips"),
         "generated_tokens": st.get("total_generated_tokens"),
+        "prefix": st.get("prefix_cache"),
         "wall_sec": round(wall, 4),
     }, [r.output_tokens for r in reqs] if reqs else None
+
+
+# -- the prefix-cache battery ----------------------------------------------
+
+def run_prefix_suite(args):
+    """Shared-prefix fleet through the PREFIX_PLANS battery. The parity
+    reference is a fault-free *prefix-cache-off* engine, so every surviving
+    plan also proves cache-on == cache-off token streams under faults."""
+    model, prompts, sp, max_len = _build(args,
+                                         prefix_share=args.prefix_share)
+    base_row, reference = _run_plan(model, prompts, sp, max_len, args, "",
+                                    prefix_cache=False)
+    base_wall = base_row["wall_sec"]
+    rows = []
+    for name, spec in PREFIX_PLANS:
+        row, _ = _run_plan(model, prompts, sp, max_len, args, spec,
+                           reference=reference, prefix_cache=True)
+        row["name"] = name
+        pc = row.get("prefix") or {}
+        row["hit_rate"] = pc.get("hit_rate")
+        if name == "baseline_prefix":
+            # the fault-free plan must actually *hit*: a dead cache that
+            # never shares would vacuously pass every degradation check
+            row["survived"] = bool(row["survived"]
+                                   and pc.get("hits", 0) > 0
+                                   and pc.get("blocks_saved", 0) > 0)
+        row["slowdown_vs_baseline"] = (
+            round(row["wall_sec"] / base_wall, 3) if base_wall > 0 else None)
+        rows.append(row)
+    survived = sum(1 for r in rows if r["survived"])
+    dump_path = telemetry.dump(reason="prefix chaos suite complete")
+    return {
+        "suite": "prefix",
+        "config": {"requests": args.requests, "prompt_len": args.prompt_len,
+                   "max_new_tokens": args.max_new, "slots": args.slots,
+                   "block_size": args.block_size,
+                   "prefix_share": args.prefix_share},
+        "plans_run": len(rows),
+        "plans_survived": survived,
+        "all_survived": survived == len(rows),
+        "baseline_wall_sec": base_wall,
+        "flight_recorder_dump": dump_path,
+        "results": rows,
+    }
 
 
 # -- the train battery -----------------------------------------------------
@@ -429,8 +509,12 @@ def run_train_suite(workdir=None):
 
 def run_sweep(argv=None):
     ap = argparse.ArgumentParser()
-    ap.add_argument("--suite", choices=["serving", "train", "straggler"],
+    ap.add_argument("--suite",
+                    choices=["serving", "prefix", "train", "straggler"],
                     default="serving")
+    ap.add_argument("--prefix-share", type=float, default=0.75,
+                    help="--suite prefix: fraction of every prompt that is "
+                         "the common template")
     ap.add_argument("--requests", type=int, default=6)
     ap.add_argument("--prompt-len", type=int, default=24)
     ap.add_argument("--max-new", type=int, default=16)
@@ -445,9 +529,10 @@ def run_sweep(argv=None):
     ap.add_argument("--json", default=None)
     args = ap.parse_args(argv)
 
-    if args.suite in ("train", "straggler"):
+    if args.suite in ("train", "straggler", "prefix"):
         report = (run_train_suite() if args.suite == "train"
-                  else run_straggler_suite())
+                  else run_straggler_suite() if args.suite == "straggler"
+                  else run_prefix_suite(args))
         if args.json:
             with open(args.json, "w") as f:
                 json.dump(report, f, indent=2)
@@ -506,10 +591,12 @@ def main(argv=None):
             print(f"[{status}] {r['scenario']:<26} {detail}",
                   file=sys.stderr)
         else:
+            hit = (f" hit_rate={r['hit_rate']:.2f}"
+                   if r.get("hit_rate") is not None else "")
             print(f"[{status}] {r['name']:<20} finished={r['finished']} "
                   f"failed={r['failed']} cancelled={r['cancelled']} "
                   f"parity={'yes' if r['survivor_parity_ok'] else 'NO'} "
-                  f"slowdown={r['slowdown_vs_baseline']}x",
+                  f"slowdown={r['slowdown_vs_baseline']}x{hit}",
                   file=sys.stderr)
     if not report["all_survived"]:
         return 1

@@ -16,6 +16,20 @@ Usage:
         [--max-new 32] [--slots 4] [--block-size 16] [--json OUT.json]
         [--metrics-out METRICS.json] [--telemetry on|off]
         [--slo-ttft-ms 200 --slo-tpot-ms 50]
+        [--prefix-share 0.9]
+
+``--prefix-share <frac>`` switches to the shared-prefix workload: every
+prompt starts with the same ``frac * prompt_len`` tokens (the "system
+prompt") followed by a per-request unique tail, and the same fleet runs on
+two engines — prefix cache on and off — after a priming request warms the
+cache and the traces. The result JSON gains a ``prefix`` block with the
+cache hit rate, blocks/tokens saved, CoW copies, and cache-warm TTFT for
+both engines (``ttft_speedup`` is the on/off ratio); outputs must match
+token-for-token across the two engines or the bench exits nonzero. In this
+mode ``--prompt-len`` defaults to 256 (long mostly-shared prompts are what
+prefix caching is for), ``--slots`` defaults to ``--requests`` so warm
+TTFT measures prefill work rather than queue position, and the O(T^2)
+naive baseline is skipped.
 
 ``--slo-ttft-ms``/``--slo-tpot-ms`` arm the engine's rolling-window SLO
 tracker: the result JSON gains a ``slo`` block (TTFT/TPOT/queue p50/p95/
@@ -51,12 +65,107 @@ from paddle_tpu.serving import (  # noqa: E402
     LLMEngine, SamplingParams, naive_generate)
 
 
+def _mean(xs):
+    xs = [x for x in xs if x is not None]
+    return float(np.mean(xs)) if xs else None
+
+
+def run_prefix_bench(args, slo_kw):
+    """Shared-prefix workload: same fleet through a prefix-cache-on and a
+    prefix-cache-off engine, cache-warm TTFT compared head to head."""
+    paddle_tpu.seed(0)
+    plen = args.prompt_len if args.prompt_len is not None else 256
+    slots = args.slots if args.slots is not None else args.requests
+    max_len = plen + args.max_new
+    cfg = llama_tiny(vocab=args.vocab, hidden=args.hidden, layers=args.layers,
+                     heads=4, kv_heads=2, inter=2 * args.hidden,
+                     seq=2 * max_len)
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.RandomState(0)
+    n_shared = int(plen * args.prefix_share)
+    shared = list(rng.randint(0, args.vocab, n_shared))
+    prompts = [shared + list(rng.randint(0, args.vocab, plen - n_shared))
+               for _ in range(args.requests)]
+    primers = [shared + list(rng.randint(0, args.vocab, plen - n_shared))
+               for _ in range(2)]
+    sp = SamplingParams(max_new_tokens=args.max_new, temperature=0.0)
+
+    sides = {}
+    for mode in (True, False):
+        eng = LLMEngine(model, block_size=args.block_size,
+                        max_slots=slots, max_model_len=max_len,
+                        prefix_cache=mode, **slo_kw)
+        # primer 1 seeds the cache (and compiles full prefill + decode);
+        # primer 2 takes the tail-prefill path, compiling it too — the
+        # timed fleet below is steady-state, cache-warm traffic
+        eng.generate([primers[0]], sp)
+        eng.generate([primers[1]], sp)
+        t0 = time.perf_counter()
+        reqs = [eng.add_request(p, sp) for p in prompts]
+        eng.run()
+        dt = time.perf_counter() - t0
+        st = eng.stats()
+        sides[mode] = {
+            "engine_sec": dt,
+            "tok_per_sec": sum(len(r.output_tokens) for r in reqs) / dt,
+            "ttft_warm_s": _mean([r.ttft for r in reqs]),
+            "cached_tokens_mean": _mean(
+                [r.cached_tokens_total for r in reqs]),
+            "outputs": [r.output_tokens for r in reqs],
+            "stats": st,
+        }
+    on, off = sides[True], sides[False]
+    match = on["outputs"] == off["outputs"]
+    pc = on["stats"]["prefix_cache"]
+    result = {
+        "mode": "prefix",
+        "requests": args.requests,
+        "prompt_len": plen,
+        "max_new_tokens": args.max_new,
+        "telemetry": args.telemetry,
+        "prefix": {
+            "prefix_share": args.prefix_share,
+            "shared_tokens": n_shared,
+            "hit_rate": pc["hit_rate"],
+            "hits": pc["hits"],
+            "misses": pc["misses"],
+            "blocks_saved": pc["blocks_saved"],
+            "tokens_saved": pc["tokens_saved"],
+            "cow_copies": pc["cow_copies"],
+            "evictions": pc["evictions"],
+            "cached_tokens_mean": on["cached_tokens_mean"],
+            "ttft_warm_on_s": on["ttft_warm_s"],
+            "ttft_warm_off_s": off["ttft_warm_s"],
+            "ttft_speedup": (off["ttft_warm_s"] / on["ttft_warm_s"]
+                             if on["ttft_warm_s"] else None),
+            "engine_on_sec": on["engine_sec"],
+            "engine_off_sec": off["engine_sec"],
+            "tok_per_sec_on": on["tok_per_sec"],
+            "tok_per_sec_off": off["tok_per_sec"],
+        },
+        "outputs_match_cache_off": match,
+        "slo": on["stats"]["slo"],
+    }
+    print(json.dumps(result, indent=2))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(result, f, indent=2)
+    if args.metrics_out:
+        telemetry.registry().snapshot_json(args.metrics_out)
+        print(f"# metrics snapshot -> {args.metrics_out}", file=sys.stderr)
+    if not match:
+        raise SystemExit(
+            "prefix-cache-on outputs diverged from prefix-cache-off")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=8)
-    ap.add_argument("--prompt-len", type=int, default=32)
+    ap.add_argument("--prompt-len", type=int, default=None,
+                    help="default 32 (128 with --prefix-share)")
     ap.add_argument("--max-new", type=int, default=32)
-    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--slots", type=int, default=None,
+                    help="default 4 (= --requests with --prefix-share)")
     ap.add_argument("--block-size", type=int, default=16)
     ap.add_argument("--vocab", type=int, default=256)
     ap.add_argument("--hidden", type=int, default=128)
@@ -72,11 +181,28 @@ def main():
                          "within SLO) and window p99s from the SLO tracker")
     ap.add_argument("--slo-tpot-ms", type=float, default=None,
                     help="TPOT SLO in ms (see --slo-ttft-ms)")
+    ap.add_argument("--prefix-share", type=float, default=None,
+                    help="shared-prefix workload: this fraction of every "
+                         "prompt is one common prefix; benches the prefix "
+                         "cache on vs off (hit rate, blocks saved, warm "
+                         "TTFT)")
     args = ap.parse_args()
 
     if args.telemetry == "off":
         telemetry.disable()
     telemetry.install_excepthook()
+    slo_kw = dict(
+        slo_ttft_s=(args.slo_ttft_ms / 1e3
+                    if args.slo_ttft_ms is not None else None),
+        slo_tpot_s=(args.slo_tpot_ms / 1e3
+                    if args.slo_tpot_ms is not None else None))
+    if args.prefix_share is not None:
+        run_prefix_bench(args, slo_kw)
+        return
+    if args.prompt_len is None:
+        args.prompt_len = 32
+    if args.slots is None:
+        args.slots = 4
     paddle_tpu.seed(0)
     max_len = args.prompt_len + args.max_new
     cfg = llama_tiny(vocab=args.vocab, hidden=args.hidden, layers=args.layers,
@@ -93,11 +219,6 @@ def main():
                      max_model_len=max_len)
     warm.generate(prompts[:1], sp)
 
-    slo_kw = dict(
-        slo_ttft_s=(args.slo_ttft_ms / 1e3
-                    if args.slo_ttft_ms is not None else None),
-        slo_tpot_s=(args.slo_tpot_ms / 1e3
-                    if args.slo_tpot_ms is not None else None))
     eng = LLMEngine(model, block_size=args.block_size, max_slots=args.slots,
                     max_model_len=max_len, **slo_kw)
     t0 = time.perf_counter()
