@@ -438,12 +438,17 @@ def main():
     mfu = prof.mfu(tok_per_sec, flops_tok, platform) if flops_tok else 0.0
     mfu_6n = prof.mfu(tok_per_sec, flops_tok_6n, platform) if flops_tok_6n else 0.0
 
+    from paddle_tpu.telemetry import perf as _perf
+
     # north star: >=45% MFU (BASELINE.md config #4)
     result = {
         "metric": "llama_train_tokens_per_sec_per_chip",
         "value": round(tok_per_sec, 2),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.45, 4),
+        # provenance stamp: tools/perf_gate.py refuses to compare results
+        # across platforms/configs instead of silently passing
+        "__meta__": _perf.run_meta(),
         "extra": {
             "mfu": round(mfu, 4),
             "mfu_6n_convention": round(mfu_6n, 4),

@@ -34,6 +34,12 @@ def _is_tensor(x):
 # import cycle and keeps the non-amp fast path free of any check but `is None`)
 _amp_cast = None
 
+# set by telemetry.perf.watch_dispatch(): called with (op_name, tensor
+# leaves) so the CompileWatcher sees eager-dispatch signature churn (eager
+# jax caches per-shape exactly like jit). None keeps the hot path at one
+# `is None` check.
+_perf_watch = None
+
 
 def _amp_precast(op_name, args, kwargs):
     """Cast Tensor args per amp policy via dtype-cast ops (autograd-visible)."""
@@ -72,6 +78,12 @@ def apply(fn, *args, op_name="op", **kwargs):
 
     leaves, treedef = tree_util.tree_flatten((args, kwargs), is_leaf=_is_tensor)
     tensor_pos = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
+
+    if _perf_watch is not None:
+        try:
+            _perf_watch(op_name, [leaves[i] for i in tensor_pos])
+        except Exception:
+            pass   # observability must never break dispatch
 
     record = _recording() and any(
         not leaves[i].stop_gradient and _diffable(leaves[i]._value.dtype)

@@ -29,6 +29,7 @@ from ..core.jaxcompat import shard_map as _shard_map
 
 from .. import telemetry
 from ..telemetry import cluster as _cluster
+from ..telemetry import perf as _perf
 from ..core.tensor import Tensor
 from ..framework.flags import flag_value
 from ..utils import faults
@@ -213,7 +214,12 @@ def _shard_mapped(g: Group, fn, *arrays, in_specs=None, out_specs=None,
         raise
     finally:
         _cluster.collective_exit(op)
-        _M_SECONDS.labels(op=op).observe(time.monotonic() - t0)
+        dt = time.monotonic() - t0
+        _M_SECONDS.labels(op=op).observe(dt)
+        # step-time attribution: when a StepTimeline step is open on this
+        # thread (train loop / decode loop), this collective's wall time
+        # lands in its "collective" phase — one TLS check when none is
+        _perf.note_phase("collective", dt)
 
 
 def _guard_timeout(invoke, op: str, g: Group, timeout: float):

@@ -797,6 +797,12 @@ def flash_attention_pallas(q, k, v, attn_mask=None, dropout_p=0.0,
     dropout inside the fused kernel the same way)."""
     B, Sq, Hq, D = q.shape
     Sk, Hk = k.shape[1], k.shape[2]
+    # trace-time only: one record per Pallas kernel build (CompileWatcher)
+    from ..telemetry import perf as _perf
+
+    _perf.compile_watcher().record_call(
+        "pallas.flash_attention",
+        _perf.abstract_signature((q, k, v), ("q", "k", "v")))
     if Hk != Hq:
         rep = Hq // Hk
         k = jnp.repeat(k, rep, axis=2)

@@ -141,6 +141,16 @@ def paged_attention_pallas(q, kv_pool, block_tables, context_lens, *,
     M = block_tables.shape[1]
     rep = Hq // Hkv
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
+    # this body runs at TRACE time (the args are tracers inside the engine's
+    # jitted step), so one record here is one Pallas kernel build — the
+    # CompileWatcher's "kernel build" jit entry point
+    from ..telemetry import perf as _perf
+
+    _perf.compile_watcher().record_call(
+        "pallas.paged_attention",
+        _perf.abstract_signature(
+            (q, kv_pool, block_tables, context_lens),
+            ("q", "kv_pool", "block_tables", "context_lens")))
     if interpret is None:
         interpret = _interpret_mode()
     bt = block_tables.astype(jnp.int32)

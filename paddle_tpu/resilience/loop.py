@@ -237,36 +237,65 @@ class ResilientLoop:
                 "cannot resume mid-run with a length-less iterable data "
                 "source; pass a callable data(step) or a sized loader")
 
+    # -- memory accounting (telemetry.perf) ------------------------------
+    def _register_memory(self):
+        """Stamp the ``params`` / ``opt_state`` tags of the process
+        MemoryMonitor from the model's state so peak attribution and the
+        per-rank cluster snapshots know where training memory went."""
+        try:
+            import jax
+
+            mm = telemetry.memory_monitor()
+            params, buffers = self.model._get_state()
+            pb = sum(int(np.asarray(v).nbytes)
+                     for v in list(params.values()) + list(buffers.values()))
+            mm.set("params", pb)
+            opt = self.model._opt_state_tree(params)
+            ob = sum(int(np.asarray(leaf).nbytes)
+                     for leaf in jax.tree_util.tree_leaves(opt))
+            mm.set("opt_state", ob)
+        except Exception:
+            pass     # engine-backed models keep their own accounting
+
     # -- the loop --------------------------------------------------------
     def run(self) -> dict:
         self._restore()  # no-op on a fresh root; else self.step repositions
         if not callable(self.data):
             self._reseek(self.step)
+        self._register_memory()
+        tl = telemetry.step_timeline("train")
+        mm = telemetry.memory_monitor()
         while self.step < self.max_steps:
-            batch = self._next_batch(self.step)
-            inputs, labels = batch
-            try:
-                loss, ok = self.model.train_batch_guarded(inputs, labels)
-                self.health.observe(ok, step=self.step,
-                                    loss=loss[0] if loss else None)
-            except NumericalDivergence:
-                if (not self.rollback_on_divergence
-                        or self.rollbacks >= self.max_rollbacks
-                        or not self.ckpt.snapshots()):
-                    raise
-                self.rollbacks += 1
-                self.health.streak = 0
-                telemetry.record_event("train.rollback", step=self.step,
-                                       rollbacks=self.rollbacks)
-                self._restore()
-                if not callable(self.data):
-                    self._reseek(self.step)
-                continue
-            self.step += 1
-            _M_STEPS.inc()
-            _M_CKPT_AGE.set(time.monotonic() - self._last_save_t)
-            if self._should_snapshot():
-                self._save()
+            with tl.step():
+                with tl.phase("data"):
+                    batch = self._next_batch(self.step)
+                inputs, labels = batch
+                try:
+                    with tl.phase("compute"):
+                        loss, ok = self.model.train_batch_guarded(inputs,
+                                                                  labels)
+                    self.health.observe(ok, step=self.step,
+                                        loss=loss[0] if loss else None)
+                except NumericalDivergence:
+                    if (not self.rollback_on_divergence
+                            or self.rollbacks >= self.max_rollbacks
+                            or not self.ckpt.snapshots()):
+                        raise
+                    self.rollbacks += 1
+                    self.health.streak = 0
+                    telemetry.record_event("train.rollback", step=self.step,
+                                           rollbacks=self.rollbacks)
+                    self._restore()
+                    if not callable(self.data):
+                        self._reseek(self.step)
+                    continue
+                self.step += 1
+                _M_STEPS.inc()
+                _M_CKPT_AGE.set(time.monotonic() - self._last_save_t)
+                if self._should_snapshot():
+                    with tl.phase("update"):
+                        self._save()
+            mm.note_step()   # leak sentinel: end-of-step watermarks
         if self.save_final and (not self.ckpt.snapshots()
                                 or self.ckpt.snapshots()[-1][0] < self.step):
             self._save(final=True)

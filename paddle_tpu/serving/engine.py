@@ -28,8 +28,9 @@ buffering without limit; a watchdog counts slow decode steps and a stall
 detector fails the queue head rather than spinning when no progress is
 possible. Chaos sites (``serving.prefill``, ``serving.decode.slot``,
 ``serving.decode``, ``serving.kv.alloc``, ``serving.kv.share``,
-``serving.kv.cow``, ``serving.admit``) let ``paddle_tpu.utils.faults``
-drive all of these paths deterministically.
+``serving.kv.cow``, ``serving.admit``, ``serving.compile`` — the last
+fires once per new prefill/decode trace creation) let
+``paddle_tpu.utils.faults`` drive all of these paths deterministically.
 
 Prefix caching (on by default; ``prefix_cache=False`` disables): admission
 maps the longest cached block-aligned prefix of each prompt into the new
@@ -210,6 +211,20 @@ class LLMEngine:
         self.prefill_traces: dict[int, int] = {}
         self._donate = (2,) if active_platform() == "tpu" else ()
 
+        # performance observability (telemetry.perf): compile watching on
+        # the bucketed prefill/decode traces, per-tag memory accounting,
+        # and the decode StepTimeline feeding stats()["perf"]
+        self._watcher = telemetry.compile_watcher()
+        self._mm = telemetry.memory_monitor()
+        self._decode_tl = telemetry.step_timeline("decode")
+        self._params_bytes = sum(
+            int(getattr(v, "nbytes", 0)) for v in self.params.values()
+        ) + sum(int(getattr(v, "nbytes", 0)) for v in self.buffers.values())
+        self._pool_bytes = int(self.cache.pool.nbytes)
+        self._block_bytes = self._pool_bytes // max(num_blocks, 1)
+        self._mm.add("params", self._params_bytes)
+        self._mm.add("kv_pool", self._pool_bytes)
+
         self.finished: list[Request] = []
         self.failed: list[Request] = []
         self.cancelled: list[Request] = []
@@ -262,6 +277,8 @@ class LLMEngine:
         if self.closed:
             return
         self.closed = True
+        self._mm.sub("params", self._params_bytes)
+        self._mm.sub("kv_pool", self._pool_bytes)
         dropped = self.scheduler.close(cancel_pending=True)
         self.cancelled.extend(dropped)
         for req in dropped:
@@ -293,6 +310,12 @@ class LLMEngine:
             self._run_decode()
         self._check_stall(had_work)
         self._sync_gauges()
+        # steady-state watermark: stamp only when no request is mid-decode
+        # (blocks legitimately grow while sequences do) — blocks that never
+        # return to the pool across drains show up as monotonic "kv_blocks"
+        # growth and trip the leak sentinel
+        if not self.scheduler.running:
+            self._mm.note_step()
         return self.scheduler.has_work()
 
     def run(self):
@@ -378,6 +401,24 @@ class LLMEngine:
             # prefix-cache effectiveness: hit rate, blocks/tokens saved,
             # CoW copies, evictions, and the evictable-pool size
             "prefix_cache": self.cache.prefix_stats(),
+            # performance observability (telemetry.perf): compile/retrace
+            # counts per engine callable (+ any active storm with its
+            # signature diff), the decode step's phase breakdown, and the
+            # per-tag memory accounting incl. the leak sentinel
+            "perf": self._perf_block(),
+        }
+
+    def _perf_block(self) -> dict:
+        storms = [s for s in self._watcher.storms()
+                  if s["callable"].startswith(("engine.", "pallas."))]
+        return {
+            "compiles": self._watcher.summary(prefix="engine."),
+            "storms": storms,
+            "explain_recompile": (
+                self._watcher.explain(storms[0]["callable"])
+                if storms else None),
+            "decode_step": self._decode_tl.report(),
+            "memory": self._mm.snapshot(),
         }
 
     def _mean_ttft_direct(self):
@@ -429,6 +470,7 @@ class LLMEngine:
         m.blocks_cached.set(alloc.num_cached)
         m.high_water.set(alloc.high_water)
         m.utilization.set(self.cache.utilization())
+        self._mm.set("kv_blocks", alloc.num_used * self._block_bytes)
 
     def _record_lifecycle(self, req: Request):
         """Emit the request's queued -> prefill -> decode lifecycle as
@@ -542,10 +584,23 @@ class LLMEngine:
         nb = 1 << (nb - 1).bit_length()
         return min(nb, self.max_blocks) * self.block_size
 
+    def _act_estimate(self, tokens: int) -> int:
+        """Rough live-activation bytes for a forward over ``tokens`` tokens
+        (residual stream + one layer's MLP working set, f32): the
+        "activations_estimate" memory tag is an attribution aid, not an
+        allocator truth — XLA owns the real numbers
+        (``memory_monitor().device_stats()`` when the backend exposes
+        them)."""
+        cfg = self.model.config
+        width = cfg.hidden_size + getattr(cfg, "intermediate_size",
+                                          4 * cfg.hidden_size)
+        return int(tokens) * width * 4
+
     def _get_prefill_fn(self, P: int):
         fn = self._prefill_fns.get(P)
         if fn is not None:
             return fn
+        faults.inject("serving.compile", callable="engine.prefill", P=P)
         model = self.model
 
         def prefill(params, buffers, pool, tokens, length, bt,
@@ -574,6 +629,8 @@ class LLMEngine:
         fn = self._prefill_fns.get(key)
         if fn is not None:
             return fn
+        faults.inject("serving.compile", callable="engine.prefill",
+                      P=P, NPB=NPB)
         model = self.model
 
         def tail_prefill(params, buffers, pool, tokens, length, bt, pbt,
@@ -608,6 +665,9 @@ class LLMEngine:
         padded[:L] = toks
         bt = self.cache.table_array([req.rid], P // self.block_size)[0]
         sp = req.sampling
+        new_trace = P not in self._prefill_fns
+        self._mm.set("activations_estimate", self._act_estimate(P))
+        t0 = time.monotonic()
         with telemetry.span("engine.prefill", rid=req.rid, tokens=L,
                             padded=P):
             tok, pool = self._get_prefill_fn(P)(
@@ -616,6 +676,11 @@ class LLMEngine:
                 jnp.float32(sp.temperature), jnp.int32(sp.top_k),
                 jnp.float32(sp.top_p), jnp.int32(sp.seed),
                 jnp.int32(len(req.output_tokens)))
+        self._watcher.record_call(
+            "engine.prefill",
+            (("tokens", (P,), "int32"),
+             ("block_table", (P // self.block_size,), "int32")),
+            wall_s=time.monotonic() - t0 if new_trace else None)
         self.cache.pool = pool
         self.cache.commit_prefix(req.rid, toks)
         self._emit(slot, req, int(tok))
@@ -640,6 +705,9 @@ class LLMEngine:
         padded = np.zeros(P, np.int32)
         padded[:L] = tail
         sp = req.sampling
+        new_trace = (P, NPB) not in self._prefill_fns
+        self._mm.set("activations_estimate", self._act_estimate(P))
+        t0 = time.monotonic()
         with telemetry.span("engine.prefill", rid=req.rid, tokens=L,
                             padded=P, cached=cached):
             tok, pool = self._get_tail_prefill_fn(P, NPB)(
@@ -649,6 +717,12 @@ class LLMEngine:
                 jnp.float32(sp.temperature), jnp.int32(sp.top_k),
                 jnp.float32(sp.top_p), jnp.int32(sp.seed),
                 jnp.int32(len(req.output_tokens)))
+        self._watcher.record_call(
+            "engine.prefill",
+            (("tokens", (P,), "int32"),
+             ("block_table", (P // bs,), "int32"),
+             ("prefix_table", (NPB,), "int32")),
+            wall_s=time.monotonic() - t0 if new_trace else None)
         self.cache.pool = pool
         self.cache.commit_prefix(req.rid, toks)
         self._emit(slot, req, int(tok))
@@ -659,6 +733,7 @@ class LLMEngine:
     def _get_decode_fn(self):
         if self._decode_fn is not None:
             return self._decode_fn
+        faults.inject("serving.compile", callable="engine.decode")
         model = self.model
 
         def decode(params, buffers, pool, tokens, bt, ctx,
@@ -690,6 +765,10 @@ class LLMEngine:
         if not running:
             return
         S = self.max_slots
+        # decode StepTimeline: host batch assembly is the "data" phase, the
+        # fused jitted call the "compute" phase (recorded in the finally
+        # below so failed steps are attributed too)
+        t_step0 = time.monotonic()
         tokens = np.zeros(S, np.int32)
         ctx = np.ones(S, np.int32)       # inactive: 1 garbage scratch token
         temps = np.zeros(S, np.float32)
@@ -709,7 +788,10 @@ class LLMEngine:
             seeds[slot] = req.sampling.seed
             steps[slot] = len(req.output_tokens)
         bt = self.cache.table_array(sids, self.max_blocks)
+        data_s = time.monotonic() - t_step0
 
+        new_trace = self._decode_fn is None
+        self._mm.set("activations_estimate", self._act_estimate(S))
         t0 = time.monotonic()
         try:
             with telemetry.span("engine.decode", batch=len(running),
@@ -730,6 +812,14 @@ class LLMEngine:
             return
         finally:
             self.last_decode_s = time.monotonic() - t0
+            self._decode_tl.record_step(
+                time.monotonic() - t_step0,
+                {"data": data_s, "compute": self.last_decode_s})
+            self._watcher.record_call(
+                "engine.decode",
+                (("tokens", (S,), "int32"),
+                 ("block_tables", (S, self.max_blocks), "int32")),
+                wall_s=self.last_decode_s if new_trace else None)
             self._m.decode_step.observe(self.last_decode_s)
             if (self.watchdog_timeout_s is not None
                     and self.last_decode_s > self.watchdog_timeout_s):

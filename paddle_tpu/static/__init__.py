@@ -296,13 +296,41 @@ class InferenceProgram:
         return sel
 
 
+_EXEC_METRICS = None
+
+
+def _exec_metrics():
+    """Executor-cache counters (lazy: static is importable without forcing
+    the telemetry registry up mid-package-init). Hits/misses were
+    previously visible only through the ``_trace_count`` test hook; now
+    they are scrapeable and land in ``tools/metrics_dump.py``."""
+    global _EXEC_METRICS
+    if _EXEC_METRICS is None:
+        from .. import telemetry
+
+        reg = telemetry.registry()
+        _EXEC_METRICS = (
+            reg.counter("static_executor_cache_hits_total",
+                        "Executor.run served from the compiled-trace cache"),
+            reg.counter("static_executor_cache_misses_total",
+                        "Executor.run (re)compiles (cache miss or cache "
+                        "bypassed)"),
+        )
+    return _EXEC_METRICS
+
+
 class Executor:
     """paddle.static.Executor: compiles the program's replay graph once per
     (program, feed names, feed signature, fetch set) and caches the compiled
     callable — the reference's ``Executor.run`` -> ``_ExecutorCache`` ->
     StandaloneExecutor pipeline (executor.py:843,666). ``_trace_count``
     increments only when a cache entry traces, so tests can prove the second
-    run executes the compiled program without re-tracing."""
+    run executes the compiled program without re-tracing; the same events
+    are exported as ``static_executor_cache_{hits,misses}_total`` metrics,
+    and every compile reports its feed signature to the
+    ``telemetry.perf.CompileWatcher`` (callable ``static.Executor``), so a
+    feed whose shape churns across runs shows up as a recompilation storm
+    with the offending feed named by ``explain_recompile()``."""
 
     def __init__(self, place=None):
         self.place = place
@@ -360,13 +388,24 @@ class Executor:
             tuple((tuple(arrays[n].shape), str(arrays[n].dtype)) for n in feed_names),
             tuple(id(f) for f in fetch_ts),
         )
+        import time as _time
+
+        from ..telemetry import perf as _perf
+
         entry = self._cache.get(key) if use_program_cache else None
+        compiled = entry is None
+        trace_s = 0.0
         if entry is None:
+            _exec_metrics()[1].inc()
+            _t0 = _time.monotonic()
             entry = self._compile(program, feed_names, param_names, fetch_ts,
                                   tuple(arrays[n] for n in feed_names),
                                   tuple(param_vals))
+            trace_s = _time.monotonic() - _t0
             if use_program_cache:
                 self._cache[key] = entry
+        else:
+            _exec_metrics()[0].inc()
 
         jitted, needed = entry
         missing = sorted(n for n in needed if n not in feed)
@@ -374,8 +413,16 @@ class Executor:
             raise ValueError(
                 f"Executor.run: fetch targets depend on placeholder(s) "
                 f"{missing} which are not in the feed")
+        _t0 = _time.monotonic()
         out_vals = jitted(
             tuple(arrays[n] for n in feed_names), tuple(param_vals))
+        # the compile watcher sees one signature per (feed shapes/dtypes);
+        # wall time = trace + first jitted call (which pays backend compile)
+        _perf.compile_watcher().record_call(
+            "static.Executor",
+            tuple((n, tuple(arrays[n].shape), str(arrays[n].dtype))
+                  for n in feed_names),
+            wall_s=(trace_s + _time.monotonic() - _t0) if compiled else None)
         out_map = {id(t): v for t, v in zip(fetch_ts, out_vals)}
         outs = []
         for f in fetch_list:

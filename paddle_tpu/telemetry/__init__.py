@@ -28,6 +28,16 @@ Two cluster-scale layers sit on top (PR 6):
 - :mod:`.slo` — rolling-window TTFT/TPOT/queue percentiles + goodput and
   the admit/shed health signal on ``LLMEngine.stats()["slo"]``.
 
+And the performance layer (PR 9):
+
+- :mod:`.perf` — why did it recompile (``CompileWatcher`` over every jit
+  entry point, recompilation-storm detection, ``explain_recompile()``
+  signature diffs), where did the memory go (``MemoryMonitor`` per-tag
+  live/peak accounting, peak attribution, leak sentinel), and which phase
+  got slower (``StepTimeline`` per-phase percentiles + regression
+  culprit naming); ``tools/perf_gate.py`` enforces the bench trajectory
+  against ``BASELINE.json``.
+
 :func:`disable` flips one shared flag that every write path checks first —
 the guaranteed-cheap escape hatch for benchmarking the instrumentation
 itself (``tools/serving_bench.py --telemetry off``).
@@ -60,6 +70,14 @@ from .flight_recorder import (  # noqa: F401
 from . import cluster  # noqa: F401  (cross-rank plane: publisher/monitor/
 #                                    aggregator/trace merge — see cluster.py)
 from .slo import SLOTracker  # noqa: F401
+from . import perf  # noqa: F401  (performance observability: CompileWatcher /
+#                                  MemoryMonitor / StepTimeline — see perf.py)
+from .perf import (  # noqa: F401
+    compile_watcher,
+    explain_recompile,
+    memory_monitor,
+    step_timeline,
+)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
@@ -67,7 +85,8 @@ __all__ = [
     "trace_id", "set_device_trace_active", "device_trace_active",
     "FlightRecorder", "flight", "record_event", "dump", "install_excepthook",
     "enable", "disable", "enabled", "prometheus_text", "snapshot",
-    "cluster", "SLOTracker",
+    "cluster", "SLOTracker", "perf", "compile_watcher", "memory_monitor",
+    "step_timeline", "explain_recompile",
 ]
 
 

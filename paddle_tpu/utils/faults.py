@@ -68,6 +68,10 @@ Known sites (see docs/ROBUSTNESS.md for the full table):
     serving.kv.cow        copy-on-write guard before a shared-block write
                           (exhaust => CoW alloc fails; caller preempts)
     serving.admit         per admission attempt
+    serving.compile       once per NEW prefill/decode trace creation
+                          (error => compile fails; isolation boundary
+                          fails the request / in-flight batch, engine
+                          survives)
     store.connect         each TCPStore connect attempt
     store.get             each TCPStore get attempt
     collective.<op>       inside the timeout-guarded collective call

@@ -336,6 +336,8 @@ _STATS_KEYS = {
     "slo",   # PR 6: rolling-window SLO block (tests/test_cluster_telemetry)
     "prefix_cache",   # PR 8: prefix-cache hit/CoW/eviction block
                       # (tests/test_prefix_cache.py)
+    "perf",  # PR 9: compile/memory/step-phase observability block
+             # (tests/test_perf_observability.py)
 }
 
 

@@ -29,6 +29,18 @@ torn-checkpoint-on-resume (resume falls back past a torn newest snapshot).
 Reports per scenario: survival, restarts/resume steps, bad steps, fallback
 behavior.
 
+``--suite perf`` — the performance-observability layer
+(docs/OBSERVABILITY.md "Performance observability"): a deliberately
+shape-unstable fleet (one prompt per power-of-two prefill bucket) must
+trip the recompilation-storm detector with ``explain_recompile()`` naming
+the churning ``tokens`` argument; the same churn under
+``serving.compile:error`` + ``serving.kv.alloc:exhaust`` must degrade
+gracefully (targeted requests FAILED with errors attached, no block
+leak, storm still reported); the memory leak sentinel must flag a
+simulated block leak while a clean drain stays quiet; and an
+instrumentation-overhead ratio is measured (the precise instrument is
+``serving_bench --telemetry on|off``).
+
 ``--suite straggler`` — the cluster observability plane
 (docs/OBSERVABILITY.md "Cluster observability"): a 4-rank job over a real
 TCPStore where one rank carries a ``collective:delay`` fault plan.
@@ -323,6 +335,169 @@ def _train_torn_checkpoint(workdir):
     }
 
 
+# -- the perf battery ------------------------------------------------------
+
+def _perf_fleet(args, lengths, plan_text="", **engine_kw):
+    """Serve one request per prompt length on a fresh tiny engine; returns
+    (engine, requests, crashed)."""
+    paddle_tpu.seed(0)
+    max_len = max(lengths) + args.max_new
+    cfg = llama_tiny(vocab=args.vocab, hidden=args.hidden, layers=args.layers,
+                     heads=4, kv_heads=2, inter=2 * args.hidden,
+                     seq=2 * max_len)
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.RandomState(0)
+    prompts = [list(rng.randint(0, args.vocab, n)) for n in lengths]
+    sp = SamplingParams(max_new_tokens=args.max_new, temperature=0.0)
+    eng = LLMEngine(model, block_size=4, max_slots=args.slots,
+                    max_model_len=max_len, **engine_kw)
+    plan = FaultPlan.parse(plan_text) if plan_text else FaultPlan()
+    crashed = None
+    with plan:
+        try:
+            reqs = [eng.add_request(p, sp) for p in prompts]
+            eng.run()
+        except Exception as e:
+            crashed = f"{type(e).__name__}: {e}"
+            reqs = []
+    return eng, reqs, crashed, plan
+
+
+def run_perf_suite(args):
+    """Performance-observability battery (docs/OBSERVABILITY.md
+    "Performance observability"): a deliberately shape-unstable workload
+    must trip the recompilation-storm detector with the churning argument
+    *named* by ``explain_recompile()``, the same workload must degrade
+    gracefully under ``serving.kv``/``serving.compile`` faults, and the
+    leak sentinel must flag a real block leak while staying quiet on a
+    clean drain."""
+    from paddle_tpu.telemetry import perf
+
+    perf.reset()
+    watcher = perf.compile_watcher()
+    old_n = watcher.storm_threshold
+    watcher.storm_threshold = 4     # tiny workload: storm at 4 signatures
+    rows = []
+    # one prompt per power-of-two bucket (block_size 4): every admission
+    # retraces engine.prefill with a new `tokens` signature — the storm
+    telemetry.flight().clear()
+    lengths = [3, 6, 11, 21, 43, 85]
+    try:
+        # -- scenario 1: the storm is detected and *explained* ------------
+        eng, reqs, crashed, _ = _perf_fleet(args, lengths)
+        storms = [s for s in watcher.storms()
+                  if s["callable"] == "engine.prefill"]
+        explain = perf.explain_recompile("engine.prefill")
+        named = bool(explain and any(
+            c["arg"] == "tokens" and c["field"] == "shape"
+            for c in explain["changed_args"]))
+        st = eng.stats()
+        rows.append({
+            "scenario": "recompile_storm",
+            "survived": bool(crashed is None and storms and named
+                             and len(eng.finished) == len(reqs)),
+            "crashed": crashed,
+            "storm_detected": bool(storms),
+            "distinct_signatures": (storms[0]["distinct_signatures"]
+                                    if storms else 0),
+            "explained": explain["text"] if explain else None,
+            "offending_arg_named": named,
+            "storm_in_stats": bool(st["perf"]["storms"]),
+            "storm_flight_events": len(
+                telemetry.flight().events("compile.storm")),
+        })
+        eng.close()
+
+        # -- scenario 2: same churn under kv/compile faults ---------------
+        perf.reset()
+        watcher.storm_threshold = 4
+        eng, reqs, crashed, plan = _perf_fleet(
+            args, lengths,
+            plan_text="serving.compile:error@2;serving.kv.alloc:exhaust@5x2")
+        finished = [r for r in reqs if r.state is RequestState.FINISHED]
+        failed = [r for r in reqs if r.state is RequestState.FAILED]
+        errors_attached = all(r.error is not None for r in failed)
+        st = eng.stats() if crashed is None else {}
+        storms = [s for s in watcher.storms()
+                  if s["callable"] == "engine.prefill"]
+        rows.append({
+            "scenario": "storm_under_faults",
+            "survived": bool(
+                crashed is None and errors_attached and storms
+                and st.get("blocks_used") == 0 and failed
+                and len(finished) + len(failed) == len(reqs)),
+            "crashed": crashed,
+            "finished": len(finished),
+            "failed": len(failed),
+            "errors_attached": bool(errors_attached),
+            "blocks_leaked": int(st.get("blocks_used", -1)),
+            "storm_still_detected": bool(storms),
+            "faults_fired": plan.summary(),
+        })
+        eng.close()
+
+        # -- scenario 3: leak sentinel — real leak flagged, clean drain
+        # stays quiet -----------------------------------------------------
+        perf.reset()
+        mm = perf.memory_monitor()
+        clean_leaks = dict(mm.leak_report())
+        # simulate a block leak: watermark climbs every "drain"
+        for i in range(mm.leak_window + 1):
+            mm.set("kv_blocks", 4096 * (i + 1))
+            mm.note_step()
+        leak = mm.leak_report()
+        rows.append({
+            "scenario": "leak_sentinel",
+            "survived": bool("kv_blocks" in leak and not clean_leaks),
+            "clean_drain_flags": clean_leaks,
+            "leak_flagged": list(leak),
+            "leak_growth_bytes": (leak.get("kv_blocks") or {}).get(
+                "growth_bytes"),
+            "leak_flight_events": len(
+                telemetry.flight().events("memory.leak")),
+        })
+
+        # -- scenario 4: observability overhead (informational gate) ------
+        perf.reset()
+        stable = [16] * args.requests
+        t0 = time.perf_counter()
+        eng, reqs, crashed, _ = _perf_fleet(args, stable)
+        on_s = time.perf_counter() - t0
+        eng.close()
+        telemetry.disable()
+        try:
+            t0 = time.perf_counter()
+            eng, reqs2, crashed2, _ = _perf_fleet(args, stable)
+            off_s = time.perf_counter() - t0
+            eng.close()
+        finally:
+            telemetry.enable()
+        ratio = on_s / off_s if off_s > 0 else None
+        rows.append({
+            "scenario": "overhead",
+            # generous bound: jit compiles dominate this tiny fleet and a
+            # shared CI host is noisy; serving_bench --telemetry on|off is
+            # the precise overhead instrument
+            "survived": bool(crashed is None and crashed2 is None
+                             and ratio is not None and ratio < 2.0),
+            "enabled_sec": round(on_s, 4),
+            "disabled_sec": round(off_s, 4),
+            "ratio": round(ratio, 3) if ratio else None,
+        })
+    finally:
+        watcher.storm_threshold = old_n
+    survived = sum(1 for r in rows if r["survived"])
+    dump_path = telemetry.dump(reason="perf chaos suite complete")
+    return {
+        "suite": "perf",
+        "plans_run": len(rows),
+        "plans_survived": survived,
+        "all_survived": survived == len(rows),
+        "flight_recorder_dump": dump_path,
+        "results": rows,
+    }
+
+
 # -- the straggler battery -------------------------------------------------
 
 def _spawn_demo_ranks(endpoint, world, steps, scenario, workdir,
@@ -510,7 +685,8 @@ def run_train_suite(workdir=None):
 def run_sweep(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--suite",
-                    choices=["serving", "prefix", "train", "straggler"],
+                    choices=["serving", "prefix", "train", "straggler",
+                             "perf"],
                     default="serving")
     ap.add_argument("--prefix-share", type=float, default=0.75,
                     help="--suite prefix: fraction of every prompt that is "
@@ -529,9 +705,10 @@ def run_sweep(argv=None):
     ap.add_argument("--json", default=None)
     args = ap.parse_args(argv)
 
-    if args.suite in ("train", "straggler", "prefix"):
+    if args.suite in ("train", "straggler", "prefix", "perf"):
         report = (run_train_suite() if args.suite == "train"
                   else run_straggler_suite() if args.suite == "straggler"
+                  else run_perf_suite(args) if args.suite == "perf"
                   else run_prefix_suite(args))
         if args.json:
             with open(args.json, "w") as f:
@@ -585,7 +762,7 @@ def main(argv=None):
     print(json.dumps(report, indent=2))
     for r in report["results"]:
         status = "OK " if r["survived"] else "DIED"
-        if report.get("suite") in ("train", "straggler"):
+        if report.get("suite") in ("train", "straggler", "perf"):
             detail = " ".join(f"{k}={v}" for k, v in r.items()
                               if k not in ("scenario", "survived"))
             print(f"[{status}] {r['scenario']:<26} {detail}",

@@ -16,8 +16,10 @@ exactly what Prometheus's ``histogram_quantile`` would report).
 per-second rates when both carry a ``__meta__.wall_time`` stamp (snapshots
 do since PR 6) — the way to read the periodic per-rank snapshots the
 cluster plane publishes (``telemetry.cluster``): grab two, diff them, and
-the deltas are that rank's traffic over the interval. Gauges print
-``a -> b``.
+the deltas are that rank's traffic over the interval. Gauges print the
+last-value transition with its signed delta, ``a -> b (+d)`` — how a
+memory watermark (``memory_live_bytes{tag=...}``) or queue depth moved
+over the interval, not just where it ended.
 """
 from __future__ import annotations
 
@@ -104,8 +106,9 @@ def _series_map(fam: dict) -> dict:
 def format_diff(a: dict, b: dict, name_filter: str = "") -> str:
     """Counter/histogram deltas (and rates, when both snapshots carry
     ``__meta__.wall_time``) from snapshot ``a`` to ``b``; gauges as
-    ``a -> b``. Series absent from ``a`` diff against zero; zero-delta
-    rows are suppressed."""
+    ``a -> b (+delta)``. Series absent from ``a`` diff against zero
+    (counters/histograms) or show ``-`` (gauges); zero-delta rows are
+    suppressed."""
     dt = None
     try:
         dt = (float(b["__meta__"]["wall_time"])
@@ -142,12 +145,17 @@ def format_diff(a: dict, b: dict, name_filter: str = "") -> str:
                 rate = f" {dv / dt:10.4g}/s" if dt else ""
                 rows.append(f"{name:<40} {lbl:<28} +{dv:<10.6g}{rate}")
             else:
+                # gauges: last-value transition + signed delta (a series
+                # absent from A shows "-" and no delta — nothing to
+                # subtract from)
                 va = o["value"] if o else None
                 if o is not None and va == s["value"]:
                     continue
                 frm = f"{va:.6g}" if va is not None else "-"
+                dlt = (f" ({s['value'] - va:+.6g})"
+                       if va is not None else "")
                 rows.append(f"{name:<40} {lbl:<28} {frm} -> "
-                            f"{s['value']:.6g}")
+                            f"{s['value']:.6g}{dlt}")
     lines.extend(rows or ["(no changed series matched)"])
     return "\n".join(lines)
 

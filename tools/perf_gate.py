@@ -1,0 +1,234 @@
+"""Perf regression gate: compare a bench JSON against BASELINE.json.
+
+The repo's bench artifacts (``bench.py``, ``tools/serving_bench.py``) have
+so far been an ad-hoc trajectory — numbers land in BENCH_*.json and drift
+is noticed (or not) by a human. This gate makes the trajectory enforced:
+
+    python tools/perf_gate.py RESULT.json                 # compare
+    python tools/perf_gate.py RESULT.json --update-baseline   # (re)record
+
+``RESULT.json`` is any artifact the benches emit; its kind is inferred
+from its shape (training bench / serving bench / prefix-mode serving
+bench). The gate extracts the comparable metrics, looks up the recorded
+baseline for that kind in ``BASELINE.json`` (stored under a ``"perf"``
+key so the file's existing provenance content is preserved), and fails
+with a **named metric** when any regresses beyond its tolerance:
+
+- higher-is-better metrics (tok/s, MFU, speedups) regress when
+  ``new < base * (1 - tol)``;
+- lower-is-better metrics (TTFT, p99s) regress when
+  ``new > base * (1 + tol)``.
+
+Default tolerance is 15% (bench noise on a shared host); override per
+metric with ``--tolerance engine_tok_per_sec=0.25`` (repeatable) or
+globally with ``--default-tolerance``.
+
+Cross-platform honesty: both the result and the recorded baseline carry a
+``__meta__`` stamp (git sha, jax version, platform — see
+``telemetry.perf.run_meta``). A platform mismatch (CPU result vs TPU
+baseline) is refused with exit code 2 instead of silently passing;
+``--allow-cross-platform`` overrides for exploratory diffs.
+
+Exit codes: 0 pass / baseline updated; 1 regression (named); 2 refused
+(platform mismatch); 3 no baseline recorded for this bench kind yet
+(run with --update-baseline to seed it); 4 unusable input.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BASELINE = os.path.join(REPO, "BASELINE.json")
+
+# metric name -> direction ("higher" / "lower" is better)
+DIRECTIONS = {
+    "train_tok_per_sec": "higher",
+    "mfu": "higher",
+    "engine_tok_per_sec": "higher",
+    "naive_speedup": "higher",
+    "mean_ttft_s": "lower",
+    "slo_ttft_p99_s": "lower",
+    "slo_tpot_p99_s": "lower",
+    "prefix_ttft_warm_s": "lower",
+    "prefix_ttft_speedup": "higher",
+    "prefix_tok_per_sec": "higher",
+    "prefix_hit_rate": "higher",
+}
+
+
+def extract_metrics(doc: dict) -> tuple[str, dict]:
+    """(bench kind, {metric: value}) from any repo bench artifact."""
+    metrics = {}
+
+    def put(name, value):
+        if isinstance(value, (int, float)) and value == value and value > 0:
+            metrics[name] = float(value)
+
+    if doc.get("metric") == "llama_train_tokens_per_sec_per_chip":
+        put("train_tok_per_sec", doc.get("value"))
+        put("mfu", (doc.get("extra") or {}).get("mfu"))
+        return "train", metrics
+    if doc.get("mode") == "prefix" or isinstance(doc.get("prefix"), dict):
+        p = doc.get("prefix") or {}
+        put("prefix_ttft_warm_s", p.get("ttft_warm_on_s"))
+        put("prefix_ttft_speedup", p.get("ttft_speedup"))
+        put("prefix_tok_per_sec", p.get("tok_per_sec_on"))
+        put("prefix_hit_rate", p.get("hit_rate"))
+        return "serving_prefix", metrics
+    if "engine_tok_per_sec" in doc:
+        put("engine_tok_per_sec", doc.get("engine_tok_per_sec"))
+        put("naive_speedup", doc.get("speedup"))
+        put("mean_ttft_s", doc.get("mean_ttft"))
+        slo = doc.get("slo") or {}
+        ttft = (slo.get("ttft") or {})
+        tpot = (slo.get("tpot") or {})
+        put("slo_ttft_p99_s", ttft.get("p99"))
+        put("slo_tpot_p99_s", tpot.get("p99"))
+        return "serving", metrics
+    return "unknown", metrics
+
+
+def compare(kind: str, metrics: dict, base_entry: dict, result_meta: dict,
+            tolerances: dict, default_tol: float,
+            allow_cross_platform: bool) -> tuple[int, list[str]]:
+    """(exit code, report lines) for one result vs its recorded baseline."""
+    lines = []
+    base_meta = base_entry.get("meta") or {}
+    plat_new = (result_meta or {}).get("platform")
+    plat_base = base_meta.get("platform")
+    if plat_new and plat_base and plat_new != plat_base:
+        msg = (f"REFUSED: result platform '{plat_new}' != baseline platform "
+               f"'{plat_base}' (recorded at {base_meta.get('git_sha')}) — "
+               "cross-platform numbers are not comparable; re-baseline with "
+               "--update-baseline on this platform or pass "
+               "--allow-cross-platform")
+        if not allow_cross_platform:
+            return 2, [msg]
+        lines.append("WARNING " + msg)
+    base_metrics = base_entry.get("metrics") or {}
+    regressed = []
+    width = max((len(n) for n in metrics), default=6)
+    for name, new in sorted(metrics.items()):
+        base = base_metrics.get(name)
+        if base is None:
+            lines.append(f"{name:<{width}}  new={new:.6g}  (no baseline — "
+                         "recorded on next --update-baseline)")
+            continue
+        tol = tolerances.get(name, default_tol)
+        direction = DIRECTIONS.get(name, "higher")
+        if direction == "higher":
+            bad = new < base * (1.0 - tol)
+            delta = (new - base) / base
+        else:
+            bad = new > base * (1.0 + tol)
+            delta = (base - new) / base       # positive = improved
+        verdict = "REGRESSED" if bad else "ok"
+        lines.append(
+            f"{name:<{width}}  base={base:.6g}  new={new:.6g}  "
+            f"{'+' if delta >= 0 else ''}{delta * 100:.1f}%  "
+            f"(tol {tol * 100:.0f}%, {direction} is better)  {verdict}")
+        if bad:
+            regressed.append(name)
+    if regressed:
+        lines.append(f"FAIL: regressed metric(s): {', '.join(regressed)}")
+        return 1, lines
+    lines.append("PASS: all metrics within tolerance")
+    return 0, lines
+
+
+def update_baseline(path: str, kind: str, metrics: dict, meta: dict) -> dict:
+    """Merge this result into BASELINE.json's ``perf`` block, preserving
+    everything else the file holds (it predates this gate)."""
+    doc = {}
+    if os.path.exists(path):
+        with open(path) as f:
+            doc = json.load(f)
+    perf = doc.setdefault("perf", {})
+    perf[kind] = {"metrics": metrics, "meta": meta}
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    return doc
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="gate a bench JSON against BASELINE.json")
+    ap.add_argument("result", help="bench artifact "
+                    "(bench.py / tools/serving_bench.py output)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help=f"baseline file (default {DEFAULT_BASELINE})")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="record this result as the new baseline for its "
+                         "bench kind instead of gating")
+    ap.add_argument("--default-tolerance", type=float, default=0.15,
+                    help="relative tolerance for every metric (default 0.15)")
+    ap.add_argument("--tolerance", action="append", default=[],
+                    metavar="METRIC=FRAC",
+                    help="per-metric tolerance override (repeatable), e.g. "
+                         "--tolerance mean_ttft_s=0.3")
+    ap.add_argument("--allow-cross-platform", action="store_true",
+                    help="compare despite a platform mismatch (downgraded "
+                         "to a warning)")
+    args = ap.parse_args(argv)
+
+    tolerances = {}
+    for spec in args.tolerance:
+        name, _, frac = spec.partition("=")
+        try:
+            tolerances[name] = float(frac)
+        except ValueError:
+            print(f"bad --tolerance {spec!r} (want METRIC=FRAC)",
+                  file=sys.stderr)
+            return 4
+
+    try:
+        with open(args.result) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"cannot read result: {e}", file=sys.stderr)
+        return 4
+    kind, metrics = extract_metrics(doc)
+    if kind == "unknown" or not metrics:
+        print(f"no comparable metrics found in {args.result} "
+              f"(kind={kind}); is it a bench.py / serving_bench.py "
+              "artifact?", file=sys.stderr)
+        return 4
+    meta = doc.get("__meta__") or {}
+
+    if args.update_baseline:
+        update_baseline(args.baseline, kind, metrics, meta)
+        print(f"baseline[{kind}] <- {args.result}: "
+              + ", ".join(f"{k}={v:.6g}" for k, v in sorted(metrics.items()))
+              + f"  (platform={meta.get('platform')}, "
+                f"sha={meta.get('git_sha')})")
+        return 0
+
+    base_doc = {}
+    if os.path.exists(args.baseline):
+        try:
+            with open(args.baseline) as f:
+                base_doc = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"cannot read baseline: {e}", file=sys.stderr)
+            return 4
+    entry = (base_doc.get("perf") or {}).get(kind)
+    if not entry:
+        print(f"no perf baseline recorded for bench kind '{kind}' in "
+              f"{args.baseline}; seed it:\n"
+              f"    python tools/perf_gate.py {args.result} "
+              "--update-baseline", file=sys.stderr)
+        return 3
+
+    rc, lines = compare(kind, metrics, entry, meta, tolerances,
+                        args.default_tolerance, args.allow_cross_platform)
+    print(f"perf_gate [{kind}] vs {args.baseline}")
+    print("\n".join(lines))
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

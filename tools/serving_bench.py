@@ -60,6 +60,7 @@ sys.path.insert(0, ".")
 
 import paddle_tpu  # noqa: E402
 from paddle_tpu import telemetry  # noqa: E402
+from paddle_tpu.telemetry import perf as _perf  # noqa: E402
 from paddle_tpu.models import LlamaForCausalLM, llama_tiny  # noqa: E402
 from paddle_tpu.serving import (  # noqa: E402
     LLMEngine, SamplingParams, naive_generate)
@@ -145,6 +146,8 @@ def run_prefix_bench(args, slo_kw):
         },
         "outputs_match_cache_off": match,
         "slo": on["stats"]["slo"],
+        # provenance stamp: perf_gate refuses cross-platform comparisons
+        "__meta__": _perf.run_meta(),
     }
     print(json.dumps(result, indent=2))
     if args.json:
@@ -253,6 +256,9 @@ def main():
         # rolling-window latency/goodput so BENCH_*.json trajectories
         # capture tail latency and SLO attainment, not just throughput
         "slo": st["slo"],
+        # provenance stamp (git sha, jax version, platform, wall time):
+        # tools/perf_gate.py keys its regression gate on this
+        "__meta__": _perf.run_meta(),
     }
     print(json.dumps(result, indent=2))
     if args.json:
