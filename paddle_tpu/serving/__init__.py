@@ -15,13 +15,35 @@ The three pillars (docs/SERVING.md has the full tour):
   preempt-and-requeue on pool exhaustion, seeded sampling, streaming
   outputs, and serving counters (TTFT, tokens/s, queue depth, cache
   utilization, prefix-cache hit rate).
+
+Above the single engine sits the fleet plane (docs/SERVING.md
+"Fleet serving"):
+
+- :mod:`.router` — :class:`FleetRouter` over N engine replicas
+  (:class:`LocalReplica` threads or SIGKILL-able :class:`ProcReplica`
+  child processes): health probes, replay-and-suppress failover,
+  prefix-affinity + power-of-two-choices placement, priority load
+  shedding, and drain/restart under the ElasticSupervisor.
+- :mod:`.gateway` — the asyncio HTTP front door: OpenAI-compatible
+  ``/v1/completions`` + ``/v1/chat/completions`` with SSE token
+  streaming, deadline budgets, and 429/503 backpressure.
 """
 from .engine import LLMEngine, naive_generate  # noqa: F401
+from .gateway import Gateway  # noqa: F401
 from .kv_cache import (  # noqa: F401
     BlockAllocator,
     DenseKVCache,
     PagedCacheView,
     PagedKVCache,
+)
+from .router import (  # noqa: F401
+    FleetRouter,
+    LocalReplica,
+    NoHealthyReplica,
+    ProcReplica,
+    ReplicaState,
+    RouterRequest,
+    RouterShed,
 )
 from .scheduler import (  # noqa: F401
     DeadlineExceeded,
@@ -39,4 +61,6 @@ __all__ = [
     "PagedCacheView", "DenseKVCache", "Request", "RequestState",
     "SamplingParams", "Scheduler", "EngineClosed", "QueueFull",
     "DeadlineExceeded", "PreemptionStorm",
+    "FleetRouter", "LocalReplica", "ProcReplica", "ReplicaState",
+    "RouterRequest", "RouterShed", "NoHealthyReplica", "Gateway",
 ]

@@ -263,25 +263,36 @@ class LLMEngine:
 
     def cancel(self, rid: int, reason: str = "cancelled") -> bool:
         """Cancel a request by id wherever it is (waiting or running); its
-        blocks and slot return immediately. False if unknown/terminal."""
+        blocks and slot return immediately. Idempotent: cancelling an
+        unknown or already-terminal request (including one that just
+        finished, failed, or was already cancelled) returns False instead
+        of raising, so a fleet router can fan out cancels without racing
+        the engine's own terminal transitions."""
         ok = self.scheduler.cancel(rid, reason=reason)
         if ok:
-            self.cancelled.append(self._requests[rid])
-            self._record_lifecycle(self._requests[rid])
+            req = self._requests.get(rid)
+            if req is not None:
+                self.cancelled.append(req)
+                self._record_lifecycle(req)
         return ok
 
     def close(self):
-        """Shut down: cancel all pending requests (their handles end
-        CANCELLED with reason "shutdown") and reject future add_request
-        calls with ``EngineClosed``."""
+        """Shut down: still-queued (never-prefilled) requests end FAILED
+        with ``EngineClosed`` attached, running ones end CANCELLED (reason
+        "shutdown") — every handle reaches a terminal state a router can
+        act on; future add_request calls raise ``EngineClosed``."""
         if self.closed:
             return
         self.closed = True
         self._mm.sub("params", self._params_bytes)
         self._mm.sub("kv_pool", self._pool_bytes)
         dropped = self.scheduler.close(cancel_pending=True)
-        self.cancelled.extend(dropped)
         for req in dropped:
+            if req.state is RequestState.FAILED:
+                self.failed.append(req)
+                self._failed_rids.add(req.rid)
+            else:
+                self.cancelled.append(req)
             self._record_lifecycle(req)
 
     def step(self) -> bool:

@@ -1,0 +1,453 @@
+"""Async serving gateway: the fleet's HTTP front door.
+
+A dependency-free asyncio HTTP/1.1 server exposing OpenAI-compatible
+endpoints over a :class:`~paddle_tpu.serving.router.FleetRouter`
+(docs/SERVING.md "Fleet serving" has the full API contract):
+
+- ``POST /v1/completions`` and ``POST /v1/chat/completions`` — prompts are
+  token-id lists (the repo has no tokenizer; a string prompt is parsed as
+  whitespace-separated ids). ``"stream": true`` answers Server-Sent Events
+  with one chunk per decoded token *as the engine produces it* and a final
+  ``data: [DONE]``; replica failover happens mid-stream without the client
+  seeing a seam (the router replays and suppresses already-sent tokens).
+- Per-request **deadline budget**: ``deadline_ms`` in the body (or an
+  ``x-deadline-ms`` header) rides the dispatch into the engine's
+  per-request deadline; a missed deadline ends the request with
+  ``finish_reason: "deadline"`` and whatever tokens made it out.
+- **Load shedding**: a :class:`~paddle_tpu.serving.router.RouterShed`
+  becomes ``429 Too Many Requests`` with a ``Retry-After`` header;
+  :class:`~paddle_tpu.serving.router.NoHealthyReplica` becomes ``503``.
+  ``priority`` in the body (int, default 0, higher = keep longer) feeds
+  the router's shed-lowest-first policy.
+- Operations: ``GET /healthz`` (fleet health; 503 when no replica is
+  healthy), ``GET /metrics`` (Prometheus text exposition of the global
+  registry), ``GET /stats`` (the router's JSON fleet view),
+  ``GET /v1/models``.
+
+The server runs on a daemon thread with its own event loop so synchronous
+tools (``tools/serving_bench.py --fleet``, the chaos suite, tests) can
+``start()``/``stop()`` it around plain-socket clients. Chaos site:
+``gateway.request`` fires per parsed request (an injected error answers
+500 — the connection layer survives).
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import math
+import threading
+import time
+from types import SimpleNamespace
+
+from .. import telemetry
+from ..utils import faults
+from .router import NoHealthyReplica, RouterShed
+
+__all__ = ["Gateway"]
+
+_SERVER = "paddle-tpu-gateway"
+
+
+def _gateway_metrics() -> SimpleNamespace:
+    reg = telemetry.registry()
+    return SimpleNamespace(
+        requests=reg.counter(
+            "gateway_requests_total", "HTTP requests by route", ("route",)),
+        responses=reg.counter(
+            "gateway_responses_total", "HTTP responses by status code",
+            ("code",)),
+        shed=reg.counter(
+            "gateway_shed_total", "requests answered 429 (load shed)"),
+        tokens=reg.counter(
+            "gateway_streamed_tokens_total", "tokens written to clients"),
+        active=reg.gauge(
+            "gateway_active_streams", "SSE streams currently open"),
+        latency=reg.histogram(
+            "gateway_request_seconds",
+            "wall time from request parse to response end"),
+    )
+
+
+def _parse_tokens(v, what: str) -> list[int]:
+    if isinstance(v, str):
+        v = v.split()
+    if not isinstance(v, (list, tuple)):
+        raise ValueError(f"{what} must be a token-id list (or a string of "
+                         f"whitespace-separated ids)")
+    try:
+        return [int(t) for t in v]
+    except (TypeError, ValueError):
+        raise ValueError(f"{what} contains a non-integer token id")
+
+
+class _HTTPError(Exception):
+    def __init__(self, status: int, message: str, headers=()):
+        super().__init__(message)
+        self.status = status
+        self.headers = list(headers)
+
+
+_REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found",
+            405: "Method Not Allowed", 408: "Request Timeout",
+            429: "Too Many Requests", 500: "Internal Server Error",
+            503: "Service Unavailable"}
+
+
+class Gateway:
+    """HTTP front door over a started :class:`FleetRouter`.
+
+    host/port:          bind address (port 0 = ephemeral; read ``.port``
+                        after :meth:`start`).
+    default_deadline_s: applied when a request names no deadline (None =
+                        unbounded).
+    max_body_bytes:     request-body bound (413-by-400 beyond it).
+    """
+
+    def __init__(self, router, host: str = "127.0.0.1", port: int = 0, *,
+                 default_deadline_s: float | None = None,
+                 max_body_bytes: int = 1 << 20,
+                 model_name: str = "paddle-tpu"):
+        self.router = router
+        self.host = host
+        self.port = int(port)
+        self.default_deadline_s = default_deadline_s
+        self.max_body_bytes = int(max_body_bytes)
+        self.model_name = model_name
+        self._m = _gateway_metrics()
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._server: asyncio.AbstractServer | None = None
+        self._thread: threading.Thread | None = None
+        self._ready = threading.Event()
+        self._startup_error: BaseException | None = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self, timeout: float = 10.0) -> "Gateway":
+        """Bind and serve on a daemon thread; returns once listening."""
+        self._thread = threading.Thread(
+            target=self._run, name="gateway", daemon=True)
+        self._thread.start()
+        if not self._ready.wait(timeout):
+            raise TimeoutError("gateway failed to start listening")
+        if self._startup_error is not None:
+            raise self._startup_error
+        return self
+
+    def stop(self, timeout: float = 10.0):
+        if self._loop is None:
+            return
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def _run(self):
+        loop = self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        try:
+            server = loop.run_until_complete(asyncio.start_server(
+                self._serve_conn, self.host, self.port))
+        except BaseException as e:                  # bind failure
+            self._startup_error = e
+            self._ready.set()
+            return
+        self._server = server
+        self.port = server.sockets[0].getsockname()[1]
+        self._ready.set()
+        try:
+            loop.run_forever()
+        finally:
+            server.close()
+            loop.run_until_complete(server.wait_closed())
+            tasks = asyncio.all_tasks(loop)
+            for t in tasks:
+                t.cancel()
+            if tasks:
+                loop.run_until_complete(
+                    asyncio.gather(*tasks, return_exceptions=True))
+            loop.close()
+
+    # -- HTTP plumbing -----------------------------------------------------
+    async def _serve_conn(self, reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter):
+        try:
+            while True:
+                try:
+                    req = await self._read_request(reader)
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    break
+                if req is None:
+                    break
+                keep = await self._handle(req, writer)
+                if not keep:
+                    break
+        except Exception:
+            pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def _read_request(self, reader):
+        line = await reader.readline()
+        if not line:
+            return None
+        try:
+            method, path, _ = line.decode("latin-1").split(None, 2)
+        except ValueError:
+            raise _HTTPError(400, "malformed request line")
+        headers = {}
+        while True:
+            hl = await reader.readline()
+            if hl in (b"\r\n", b"\n", b""):
+                break
+            name, _, value = hl.decode("latin-1").partition(":")
+            headers[name.strip().lower()] = value.strip()
+        length = int(headers.get("content-length", 0) or 0)
+        if length > self.max_body_bytes:
+            raise _HTTPError(400, f"body too large ({length} bytes)")
+        body = await reader.readexactly(length) if length else b""
+        return SimpleNamespace(method=method.upper(), path=path.split("?")[0],
+                               headers=headers, body=body)
+
+    async def _write_response(self, writer, status: int, payload: dict,
+                              headers=()):
+        body = json.dumps(payload).encode()
+        head = [f"HTTP/1.1 {status} {_REASONS.get(status, 'OK')}",
+                f"Server: {_SERVER}",
+                "Content-Type: application/json",
+                f"Content-Length: {len(body)}"]
+        head += [f"{k}: {v}" for k, v in headers]
+        writer.write(("\r\n".join(head) + "\r\n\r\n").encode() + body)
+        await writer.drain()
+        self._m.responses.labels(code=str(status)).inc()
+
+    # -- routing -----------------------------------------------------------
+    async def _handle(self, req, writer) -> bool:
+        """Serve one request; returns True to keep the connection alive."""
+        t0 = time.monotonic()
+        route = f"{req.method} {req.path}"
+        self._m.requests.labels(route=route).inc()
+        try:
+            faults.inject("gateway.request", route=route)
+            if req.path == "/healthz":
+                return await self._route_healthz(writer)
+            if req.path == "/metrics":
+                return await self._route_metrics(writer)
+            if req.path == "/stats":
+                await self._write_response(writer, 200, self.router.stats())
+                return True
+            if req.path == "/v1/models":
+                await self._write_response(writer, 200, {
+                    "object": "list",
+                    "data": [{"id": self.model_name, "object": "model",
+                              "owned_by": "paddle_tpu"}]})
+                return True
+            if req.path in ("/v1/completions", "/v1/chat/completions"):
+                if req.method != "POST":
+                    raise _HTTPError(405, "POST only")
+                return await self._route_completions(
+                    req, writer, chat=req.path.endswith("chat/completions"))
+            raise _HTTPError(404, f"no route {req.path}")
+        except _HTTPError as e:
+            await self._write_response(
+                writer, e.status, {"error": {"message": str(e),
+                                             "type": "invalid_request_error"
+                                             if e.status < 500 else
+                                             "server_error"}},
+                headers=e.headers)
+            return e.status < 500
+        except RouterShed as e:
+            self._m.shed.inc()
+            retry = max(1, math.ceil(e.retry_after_s))
+            await self._write_response(
+                writer, 429,
+                {"error": {"message": str(e), "type": "overloaded_error",
+                           "retry_after_s": e.retry_after_s}},
+                headers=[("Retry-After", str(retry))])
+            return True
+        except NoHealthyReplica as e:
+            await self._write_response(
+                writer, 503, {"error": {"message": str(e),
+                                        "type": "server_error"}})
+            return True
+        except Exception as e:
+            await self._write_response(
+                writer, 500,
+                {"error": {"message": f"{type(e).__name__}: {e}",
+                           "type": "server_error"}})
+            return False
+        finally:
+            self._m.latency.observe(time.monotonic() - t0)
+
+    async def _route_healthz(self, writer) -> bool:
+        st = self.router.stats()
+        healthy = st["healthy"] > 0
+        await self._write_response(
+            writer, 200 if healthy else 503,
+            {"status": "ok" if healthy else "no healthy replica",
+             "healthy_replicas": st["healthy"],
+             "replicas": {r: v["state"] for r, v in st["replicas"].items()},
+             "inflight": st["inflight"]})
+        return True
+
+    async def _route_metrics(self, writer) -> bool:
+        body = telemetry.prometheus_text().encode()
+        head = (f"HTTP/1.1 200 OK\r\nServer: {_SERVER}\r\n"
+                f"Content-Type: text/plain; version=0.0.4\r\n"
+                f"Content-Length: {len(body)}\r\n\r\n")
+        writer.write(head.encode() + body)
+        await writer.drain()
+        self._m.responses.labels(code="200").inc()
+        return True
+
+    # -- completions -------------------------------------------------------
+    def _parse_body(self, req, chat: bool) -> dict:
+        try:
+            doc = json.loads(req.body.decode() or "{}")
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            raise _HTTPError(400, f"body is not JSON: {e}")
+        if not isinstance(doc, dict):
+            raise _HTTPError(400, "body must be a JSON object")
+        try:
+            if chat:
+                msgs = doc.get("messages")
+                if not isinstance(msgs, list) or not msgs:
+                    raise ValueError("chat needs a non-empty messages list")
+                prompt = []
+                for i, m in enumerate(msgs):
+                    prompt += _parse_tokens(
+                        (m or {}).get("content", []),
+                        f"messages[{i}].content")
+            else:
+                prompt = _parse_tokens(doc.get("prompt", []), "prompt")
+            if not prompt:
+                raise ValueError("empty prompt")
+        except ValueError as e:
+            raise _HTTPError(400, str(e))
+        deadline_ms = doc.get("deadline_ms",
+                              req.headers.get("x-deadline-ms"))
+        deadline_s = (float(deadline_ms) / 1e3 if deadline_ms is not None
+                      else self.default_deadline_s)
+        sampling = {
+            "max_new_tokens": int(doc.get("max_tokens", 16)),
+            "temperature": float(doc.get("temperature", 0.0)),
+            "top_k": int(doc.get("top_k", 0)),
+            "top_p": float(doc.get("top_p", 1.0)),
+            "seed": int(doc.get("seed", 0)),
+        }
+        return {"prompt": prompt, "sampling": sampling,
+                "stream": bool(doc.get("stream", False)),
+                "priority": int(doc.get("priority", 0)),
+                "deadline_s": deadline_s}
+
+    async def _route_completions(self, req, writer, chat: bool) -> bool:
+        p = self._parse_body(req, chat)
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue()
+
+        def on_token(rr, tok):
+            loop.call_soon_threadsafe(q.put_nowait, ("tok", tok))
+
+        def on_finish(rr):
+            loop.call_soon_threadsafe(q.put_nowait, ("done", None))
+
+        # RouterShed / NoHealthyReplica propagate to _handle's mapping
+        rr = self.router.submit(
+            p["prompt"], p["sampling"], priority=p["priority"],
+            deadline_s=p["deadline_s"], on_token=on_token,
+            on_finish=on_finish)
+        rid = f"{'chatcmpl' if chat else 'cmpl'}-{rr.gid}"
+        if p["stream"]:
+            return await self._stream(writer, rr, rid, q, chat)
+        while True:                       # non-streaming: drain to terminal
+            kind, _ = await q.get()
+            if kind == "done":
+                break
+        return await self._finish_response(writer, rr, rid, chat,
+                                           len(p["prompt"]))
+
+    async def _finish_response(self, writer, rr, rid, chat, n_prompt) -> bool:
+        if rr.state == "failed":
+            await self._write_response(
+                writer, 500,
+                {"error": {"message": rr.error or "request failed",
+                           "type": "server_error",
+                           "finish_reason": rr.finish_reason}})
+            return True
+        text = " ".join(str(t) for t in rr.tokens)
+        finish = (rr.finish_reason if rr.state == "finished"
+                  else (rr.finish_reason or "cancelled"))
+        if chat:
+            choice = {"index": 0,
+                      "message": {"role": "assistant", "content": text},
+                      "token_ids": rr.tokens, "finish_reason": finish}
+            obj = "chat.completion"
+        else:
+            choice = {"index": 0, "text": text, "token_ids": rr.tokens,
+                      "finish_reason": finish}
+            obj = "text_completion"
+        self._m.tokens.inc(len(rr.tokens))
+        await self._write_response(writer, 200, {
+            "id": rid, "object": obj, "created": int(time.time()),
+            "model": self.model_name, "choices": [choice],
+            "usage": {"prompt_tokens": n_prompt,
+                      "completion_tokens": len(rr.tokens),
+                      "total_tokens": n_prompt + len(rr.tokens)},
+            "paddle_tpu": {"replica": rr.replica,
+                           "failovers": rr.failovers,
+                           "retries": rr.retries}})
+        return True
+
+    async def _stream(self, writer, rr, rid, q, chat) -> bool:
+        """SSE: one chunk per token as it decodes; failover is invisible
+        (the router only forwards post-suppression tokens)."""
+        head = (f"HTTP/1.1 200 OK\r\nServer: {_SERVER}\r\n"
+                "Content-Type: text/event-stream\r\n"
+                "Cache-Control: no-cache\r\nConnection: close\r\n\r\n")
+        writer.write(head.encode())
+        await writer.drain()
+        self._m.responses.labels(code="200").inc()
+        self._m.active.inc()
+        obj = "chat.completion.chunk" if chat else "text_completion.chunk"
+
+        def chunk(tok=None, finish=None, error=None):
+            if chat:
+                delta = {"content": f"{tok} "} if tok is not None else {}
+                c = {"index": 0, "delta": delta, "finish_reason": finish}
+            else:
+                c = {"index": 0, "text": f"{tok} " if tok is not None
+                     else "", "finish_reason": finish}
+            if tok is not None:
+                c["token_ids"] = [tok]
+            doc = {"id": rid, "object": obj, "model": self.model_name,
+                   "choices": [c]}
+            if error is not None:
+                doc["error"] = {"message": error, "type": "server_error"}
+            return f"data: {json.dumps(doc)}\n\n".encode()
+
+        try:
+            while True:
+                kind, tok = await q.get()
+                if kind == "tok":
+                    self._m.tokens.inc()
+                    writer.write(chunk(tok=tok))
+                    await writer.drain()
+                    continue
+                break                                    # done
+            finish = (rr.finish_reason or rr.state)
+            writer.write(chunk(finish=finish,
+                               error=rr.error if rr.state == "failed"
+                               else None))
+            writer.write(b"data: [DONE]\n\n")
+            await writer.drain()
+        except (ConnectionError, asyncio.CancelledError):
+            # client hung up mid-stream: release the engine work
+            self.router.cancel(rr.gid)
+        finally:
+            self._m.active.dec()
+        return False                        # Connection: close

@@ -1,0 +1,183 @@
+"""Engine replica child process (``python -m paddle_tpu.serving.replica_worker``).
+
+One :class:`~paddle_tpu.serving.engine.LLMEngine` behind a newline-JSON
+pipe protocol, spawned and owned by a
+:class:`~paddle_tpu.serving.router.ProcReplica`. The model/engine spec
+arrives in ``$PADDLE_REPLICA_SPEC`` (JSON) so every replica of a fleet
+builds **bit-identical weights** (same seed, same config) — the property
+that makes failover replay token-for-token exact:
+
+    {"seed": 0,
+     "llama_tiny": {"vocab": 128, "hidden": 64, ...},   # model config
+     "engine": {"block_size": 8, "max_slots": 3, ...},  # LLMEngine kwargs
+     "stats_interval_s": 0.1}
+
+Protocol (one JSON object per line):
+
+    stdin  <- {"op": "add", "gid": 7, "prompt": [...],
+               "sampling": {...}, "deadline_s": 1.5 | null}
+              {"op": "cancel", "gid": 7}
+              {"op": "close"}
+    stdout -> {"ev": "hello", "pid": 1234}
+              {"ev": "token", "gid": 7, "tok": 42, "i": 0}
+              {"ev": "done", "gid": 7, "state": "finished",
+               "reason": "length", "error": null, "n": 16}
+              {"ev": "stats", "stats": {... replica_stats() ...}}
+              {"ev": "bye"}
+
+Anything that is not protocol (import-time warnings, stray prints) fails
+JSON parsing on the router side and is ignored; diagnostics belong on
+stderr. Fault plans arm per replica through ``FLAGS_fault_plan`` in the
+child environment — this is how ``chaos_run.py --suite serve-fleet`` turns
+one replica into a compile-error or delay-storm victim while its siblings
+stay clean. A SIGKILL needs no cooperation from this file at all; the
+router sees the pipe EOF.
+"""
+from __future__ import annotations
+
+import json
+import os
+import queue
+import sys
+import threading
+import time
+
+
+def build_model(spec: dict):
+    """The deterministic model build both the worker and any in-process
+    parity reference must share: seed first, then config, then weights."""
+    import paddle_tpu
+    from ..models import LlamaForCausalLM, llama_tiny
+
+    paddle_tpu.seed(int(spec.get("seed", 0)))
+    cfg = llama_tiny(**(spec.get("llama_tiny") or {}))
+    return LlamaForCausalLM(cfg)
+
+
+def main() -> int:
+    spec = json.loads(os.environ["PADDLE_REPLICA_SPEC"])
+    # starved-host guard (same as tests/conftest.py): XLA CPU's
+    # multi-threaded Eigen kernels crash on 1-2 core hosts — must be set
+    # before jax imports, which is why it lives up here
+    flags = os.environ.get("XLA_FLAGS", "")
+    if (os.cpu_count() or 1) <= 2 and \
+            "xla_cpu_multi_thread_eigen" not in flags:
+        os.environ["XLA_FLAGS"] = \
+            flags + " --xla_cpu_multi_thread_eigen=false"
+    if spec.get("jax_cache_dir"):
+        # share one persistent compilation cache across the fleet: every
+        # replica compiles the same traces, only the first should pay XLA
+        try:
+            import jax
+
+            jax.config.update("jax_compilation_cache_dir",
+                              spec["jax_cache_dir"])
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.5)
+        except Exception:
+            pass
+    from .engine import LLMEngine
+    from .router import replica_stats, sampling_from_dict
+
+    model = build_model(spec)
+    engine = LLMEngine(model, **(spec.get("engine") or {}))
+    stats_interval = float(spec.get("stats_interval_s", 0.1))
+    warmup = spec.get("warmup")
+    if warmup:
+        # compile the prefill bucket + decode traces before reporting
+        # ready: the router's liveness timeout starts at the first
+        # heartbeat, and a first-compile stall must not look like a hang
+        from .scheduler import SamplingParams
+
+        engine.generate([list(warmup)],
+                        SamplingParams(max_new_tokens=2, temperature=0.0))
+
+    out_lock = threading.Lock()
+
+    def emit(ev: dict):
+        with out_lock:
+            sys.stdout.write(json.dumps(ev) + "\n")
+            sys.stdout.flush()
+
+    cmds: queue.Queue = queue.Queue()
+
+    def read_stdin():
+        for line in sys.stdin:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                cmds.put(json.loads(line))
+            except json.JSONDecodeError:
+                print(f"replica_worker: bad command line {line!r}",
+                      file=sys.stderr)
+        cmds.put({"op": "close"})          # router hung up
+
+    threading.Thread(target=read_stdin, daemon=True).start()
+    emit({"ev": "hello", "pid": os.getpid()})
+
+    tracked: dict[int, object] = {}        # gid -> engine Request
+
+    def on_token(gid: int):
+        def cb(req, tok):
+            emit({"ev": "token", "gid": gid, "tok": int(tok),
+                  "i": len(req.output_tokens) - 1})
+        return cb
+
+    def sweep():
+        for gid, req in list(tracked.items()):
+            if req.state.is_terminal:
+                del tracked[gid]
+                emit({"ev": "done", "gid": gid, "state": req.state.value,
+                      "reason": req.finish_reason,
+                      "error": (f"{type(req.error).__name__}: {req.error}"
+                                if req.error is not None else None),
+                      "n": len(req.output_tokens)})
+
+    last_pub = 0.0
+    closing = False
+    while not closing:
+        try:
+            has_work = engine.scheduler.has_work()
+            cmd = cmds.get(block=not has_work, timeout=0.02)
+        except queue.Empty:
+            cmd = None
+        if cmd is not None:
+            op = cmd.get("op")
+            if op == "close":
+                closing = True
+            elif op == "add":
+                gid = cmd["gid"]
+                try:
+                    tracked[gid] = engine.add_request(
+                        cmd["prompt"],
+                        sampling_from_dict(cmd.get("sampling")),
+                        on_token=on_token(gid),
+                        deadline_s=cmd.get("deadline_s"))
+                except Exception as e:
+                    emit({"ev": "done", "gid": gid, "state": "failed",
+                          "reason": "add_failed",
+                          "error": f"{type(e).__name__}: {e}", "n": 0})
+            elif op == "cancel":
+                req = tracked.get(cmd["gid"])
+                if req is not None:
+                    engine.cancel(req.rid)
+        if closing:
+            break
+        if engine.scheduler.has_work():
+            engine.step()
+        sweep()
+        now = time.monotonic()
+        if now - last_pub >= stats_interval:
+            last_pub = now
+            emit({"ev": "stats", "stats": replica_stats(engine)})
+
+    engine.close()
+    sweep()
+    emit({"ev": "stats", "stats": replica_stats(engine)})
+    emit({"ev": "bye"})
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

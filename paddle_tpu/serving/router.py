@@ -1,0 +1,1109 @@
+"""Engine fleet router: health-checked replicas, failover, shedding, drain.
+
+A single :class:`~paddle_tpu.serving.engine.LLMEngine` is a single point of
+failure — one stuck decode, one dead process, and every in-flight stream
+dies with it. :class:`FleetRouter` puts N engine replicas behind one
+placement/health plane (docs/SERVING.md "Fleet serving"):
+
+- **Replica lifecycle.** Each replica is either in-process
+  (:class:`LocalReplica`: a driver thread stepping its own engine) or a
+  real child process (:class:`ProcReplica`: ``python -m
+  paddle_tpu.serving.replica_worker`` speaking line-JSON over its pipes —
+  the thing a SIGKILL can take out mid-decode). A probe loop watches
+  heartbeats: a replica is UNHEALTHY on process/thread death, a stale
+  heartbeat (probe timeout — a decode wedged by a ``collective:delay``
+  storm stops heartbeating), or an engine stall-detector trip.
+- **Failover (replay-and-suppress).** When a replica goes UNHEALTHY, every
+  request in flight on it is re-dispatched to a healthy replica with the
+  *original* prompt and sampling params. Sampling is keyed by
+  ``(seed, output index)``, so the new replica regenerates the exact same
+  stream from index 0; the router suppresses the first ``k`` already-
+  delivered tokens (verifying each equals what was streamed — a mismatch is
+  a parity violation and fails the request rather than corrupting the
+  stream) and the client stream continues token-for-token correct.
+- **Placement.** Prefix affinity first: the hash of the prompt's
+  block-aligned prefix names a preferred replica, so shared-prefix traffic
+  keeps hitting the same engine's prefix cache. If the preferred replica is
+  unhealthy, shedding, or clearly overloaded, fall back to
+  power-of-two-choices on in-flight load.
+- **Load shedding.** Layered on the signals the engines already export: a
+  replica "sheds" when its rolling-window SLO tracker says so
+  (``stats()["slo"]["shed"]``) or its router-side in-flight count hits
+  ``max_inflight_per_replica`` (the bounded-admission analogue). A new
+  request is rejected (:class:`RouterShed` → HTTP 429 + Retry-After at the
+  gateway) only when *every* healthy replica sheds and the request's
+  priority is below ``shed_bypass_priority`` — lowest priority sheds
+  first, and an in-flight stream is **never** shed (failover dispatches
+  bypass shedding entirely).
+- **Drain / restart.** :meth:`drain` stops placement to a replica, waits
+  for its in-flight work up to a budget, fails over the stragglers, and
+  stops it; :meth:`restart` brings it back through the
+  :class:`~paddle_tpu.resilience.ElasticSupervisor`'s restart budget and
+  ledger, so replica churn shows up in the same ``job_state.json`` record
+  as training restarts.
+
+Chaos sites: ``router.submit`` (per submission), ``router.dispatch`` (per
+dispatch attempt; an injected error is treated as a failed dispatch and the
+request tries another replica), ``router.probe`` (per health probe; an
+injected error marks the replica unhealthy). ``tools/chaos_run.py --suite
+serve-fleet`` drives the whole plane: SIGKILL mid-stream, compile-error and
+delay storms, shed, and drain/restart — zero lost requests, token parity.
+"""
+from __future__ import annotations
+
+import enum
+import hashlib
+import itertools
+import json
+import os
+import queue
+import random
+import signal
+import subprocess
+import sys
+import threading
+import time
+from types import SimpleNamespace
+
+from .. import telemetry
+from ..utils import faults
+from .scheduler import SamplingParams
+
+__all__ = [
+    "FleetRouter", "RouterRequest", "ReplicaState", "LocalReplica",
+    "ProcReplica", "RouterShed", "NoHealthyReplica", "ReplayMismatch",
+    "sampling_to_dict", "sampling_from_dict",
+]
+
+
+class RouterShed(RuntimeError):
+    """The router refused a new request (every healthy replica is shedding
+    and the request's priority does not bypass). Carries ``retry_after_s``
+    so the gateway can answer 429 + Retry-After."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+
+
+class NoHealthyReplica(RuntimeError):
+    """No replica is in a placeable state (HTTP 503 at the gateway)."""
+
+
+class ReplayMismatch(RuntimeError):
+    """A failover replay produced a token different from one already
+    streamed to the client — the determinism contract broke; the request
+    fails rather than silently forking the stream."""
+
+
+def sampling_to_dict(sp: SamplingParams | None) -> dict:
+    sp = sp or SamplingParams()
+    return {"max_new_tokens": sp.max_new_tokens,
+            "temperature": sp.temperature, "top_k": sp.top_k,
+            "top_p": sp.top_p, "seed": sp.seed}
+
+
+def sampling_from_dict(d: dict | None) -> SamplingParams:
+    return SamplingParams(**(d or {}))
+
+
+class ReplicaState(enum.Enum):
+    STARTING = "starting"      # launched, no heartbeat yet
+    HEALTHY = "healthy"        # heartbeating; placement target
+    DRAINING = "draining"      # no new placement; in-flight finishing
+    UNHEALTHY = "unhealthy"    # probe failed / dead; in-flight failed over
+    STOPPED = "stopped"        # intentionally down (post-drain / abort)
+
+
+# errors that are deterministic properties of the request itself — a second
+# replica would fail identically, so the router surfaces them instead of
+# retrying (everything else, e.g. an injected compile error or an allocator
+# faulted dry, is worth one try elsewhere)
+_NON_RETRYABLE = ("ValueError",)
+
+
+class RouterRequest:
+    """The router-side handle for one client stream.
+
+    ``tokens`` is exactly what the client has been shown, no matter how many
+    replicas served it; ``failovers``/``retries`` count re-dispatches after
+    replica death / engine-reported failure. Terminal ``state`` is one of
+    "finished" / "failed" / "cancelled" (string, not the engine enum — the
+    engine request living in another process is not this object)."""
+
+    def __init__(self, gid: int, prompt, sampling: dict, *, priority=0,
+                 deadline: float | None = None, on_token=None,
+                 on_finish=None):
+        self.gid = gid
+        self.prompt = [int(t) for t in prompt]
+        self.sampling = dict(sampling)
+        self.priority = int(priority)
+        self.deadline = deadline            # absolute time.monotonic()
+        self.on_token = on_token            # callable(rr, token)
+        self.on_finish = on_finish          # callable(rr)
+        self.tokens: list[int] = []
+        self.state = "queued"
+        self.finish_reason: str | None = None
+        self.error: str | None = None
+        self.replica: str | None = None     # current owner's rid
+        self.suppress = 0                   # replayed tokens to swallow
+        self.failovers = 0
+        self.retries = 0
+        self.dispatches = 0
+        self.cancel_requested = False
+        self.arrival_time = time.monotonic()
+        self.first_token_time: float | None = None
+        self.finish_time: float | None = None
+        self._done = threading.Event()
+
+    @property
+    def terminal(self) -> bool:
+        return self.state in ("finished", "failed", "cancelled")
+
+    @property
+    def ttft(self) -> float | None:
+        if self.first_token_time is None:
+            return None
+        return self.first_token_time - self.arrival_time
+
+    def wait(self, timeout: float | None = None) -> bool:
+        """Block until terminal; True if it reached a terminal state."""
+        return self._done.wait(timeout)
+
+    def _finish(self, state: str, reason: str | None, error: str | None):
+        self.state = state
+        self.finish_reason = reason
+        self.error = error
+        self.finish_time = time.monotonic()
+        self._done.set()
+        if self.on_finish is not None:
+            self.on_finish(self)
+
+
+# ---------------------------------------------------------------------------
+# replica handles
+# ---------------------------------------------------------------------------
+
+def replica_stats(engine) -> dict:
+    """The light health snapshot a replica heartbeats (full ``stats()`` is
+    a registry sweep + perf block — too heavy per beat). ``stalls`` feeds
+    the router's stall-trip health check."""
+    return {
+        "queue_depth": engine.scheduler.queue_depth,
+        "num_running": len(engine.scheduler.running),
+        "num_finished": len(engine.finished),
+        "num_failed": len(engine.failed),
+        "num_cancelled": len(engine.cancelled),
+        "stalls": sum(1 for r in engine.failed
+                      if r.finish_reason == "stalled"),
+        "watchdog_trips": engine.watchdog_trips,
+        "blocks_used": engine.cache.allocator.num_used,
+        "generated_tokens": engine._total_generated,
+        "slo": engine.slo.summary(),
+        "prefix_cache": engine.cache.prefix_stats(),
+    }
+
+
+# LocalReplica drivers build their engines under one lock: the factory
+# seeds the *global* RNG then draws weights from it, and two replicas
+# building concurrently would interleave draws and end up with different
+# weights — silently breaking failover replay parity (ProcReplica is
+# immune: each child process owns its RNG).
+_BUILD_LOCK = threading.Lock()
+
+
+class LocalReplica:
+    """In-process replica: one engine, one driver thread, the same event
+    protocol a :class:`ProcReplica` speaks. ``kill()`` simulates abrupt
+    process death — the driver abandons the engine mid-flight and every
+    event after the kill is dropped (a dead process cannot speak).
+
+    ``engine_factory`` must build a **private model instance** for its
+    engine (seed → config → weights, exactly like
+    ``replica_worker.build_model``): ``functional_call`` temporarily swaps
+    state into the model object, so two replica threads sharing one Layer
+    corrupt each other's jit traces. Identical seeds give identical
+    weights, which is what makes failover replay token-for-token exact."""
+
+    kind = "local"
+
+    def __init__(self, rid: str, engine_factory, *,
+                 stats_interval_s: float = 0.05, warmup=None):
+        self.rid = str(rid)
+        self.engine_factory = engine_factory
+        self.stats_interval_s = float(stats_interval_s)
+        # tokens served before the first heartbeat so the prefill bucket +
+        # decode traces compile while the replica is still STARTING (the
+        # router's liveness timeout only starts once it reports ready)
+        self.warmup = list(warmup) if warmup else None
+        self.state = ReplicaState.STOPPED
+        self.engine = None
+        self.stats: dict = {}
+        self.last_heartbeat = 0.0
+        self.pid = os.getpid()
+        self._gen = 0                     # incarnation counter
+        self._on_event = None
+        self._inbox: queue.Queue | None = None
+        self._thread: threading.Thread | None = None
+        self._killed = False
+        self._stopping = False
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self, on_event):
+        self._on_event = on_event
+        self._gen += 1
+        self._killed = False
+        self._stopping = False
+        self.state = ReplicaState.STARTING
+        self._inbox = queue.Queue()
+        self._thread = threading.Thread(
+            target=self._drive, args=(self._gen, self._inbox),
+            name=f"replica-{self.rid}", daemon=True)
+        self._thread.start()
+
+    @property
+    def alive(self) -> bool:
+        return (not self._killed and self._thread is not None
+                and self._thread.is_alive())
+
+    def send(self, cmd: dict):
+        if self._killed or self._inbox is None:
+            raise BrokenPipeError(f"replica {self.rid} is dead")
+        self._inbox.put(cmd)
+
+    def stop(self, graceful: bool = True, timeout: float = 10.0):
+        self._stopping = True
+        if self._inbox is not None:
+            self._inbox.put({"op": "close" if graceful else "abort"})
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def kill(self):
+        """Abrupt death: the engine is abandoned wherever it is; any token
+        its final step still produces never reaches the router."""
+        self._killed = True
+
+    # -- the driver thread -------------------------------------------------
+    def _emit(self, gen: int, ev: dict):
+        if self._killed or gen != self._gen:
+            return                        # a dead incarnation cannot speak
+        self._on_event(self, ev)
+
+    def _drive(self, gen: int, inbox: queue.Queue):
+        try:
+            with _BUILD_LOCK:
+                engine = self.engine = self.engine_factory()
+            if self.warmup:
+                engine.generate([self.warmup], SamplingParams(
+                    max_new_tokens=2, temperature=0.0))
+        except Exception as e:
+            self._emit(gen, {"ev": "dead",
+                             "error": f"{type(e).__name__}: {e}"})
+            return
+        self._emit(gen, {"ev": "hello", "pid": self.pid})
+        tracked: dict[int, object] = {}    # gid -> engine Request
+        last_pub = 0.0
+        closing = False
+
+        def on_token(gid):
+            def cb(req, tok):
+                self._emit(gen, {"ev": "token", "gid": gid, "tok": int(tok),
+                                 "i": len(req.output_tokens) - 1})
+            return cb
+
+        while not self._killed and gen == self._gen:
+            # 1) commands (non-blocking while the engine has work; short
+            #    block when idle so the thread doesn't spin)
+            try:
+                has_work = engine.scheduler.has_work()
+                cmd = inbox.get(block=not has_work, timeout=0.02)
+            except queue.Empty:
+                cmd = None
+            if cmd is not None:
+                op = cmd.get("op")
+                if op in ("close", "abort"):
+                    closing = True
+                elif op == "add":
+                    gid = cmd["gid"]
+                    try:
+                        req = engine.add_request(
+                            cmd["prompt"],
+                            sampling_from_dict(cmd.get("sampling")),
+                            on_token=on_token(gid),
+                            deadline_s=cmd.get("deadline_s"))
+                        tracked[gid] = req
+                    except Exception as e:
+                        self._emit(gen, {
+                            "ev": "done", "gid": gid, "state": "failed",
+                            "reason": "add_failed",
+                            "error": f"{type(e).__name__}: {e}", "n": 0})
+                elif op == "cancel":
+                    req = tracked.get(cmd["gid"])
+                    if req is not None:
+                        engine.cancel(req.rid)
+            # 2) one engine iteration
+            if closing:
+                break
+            if engine.scheduler.has_work():
+                try:
+                    engine.step()
+                except Exception as e:     # engine itself died
+                    self._emit(gen, {"ev": "dead",
+                                     "error": f"{type(e).__name__}: {e}"})
+                    return
+            # 3) terminal sweeps + heartbeat
+            self._sweep(gen, tracked)
+            now = time.monotonic()
+            if now - last_pub >= self.stats_interval_s:
+                last_pub = now
+                self._emit(gen, {"ev": "stats",
+                                 "stats": replica_stats(engine)})
+        if self._killed or gen != self._gen:
+            return                         # abandoned, simulating SIGKILL
+        engine.close()                     # graceful: terminal-ize leftovers
+        self._sweep(gen, tracked)
+        self._emit(gen, {"ev": "stats", "stats": replica_stats(engine)})
+        self._emit(gen, {"ev": "bye"})
+
+    def _sweep(self, gen: int, tracked: dict):
+        for gid, req in list(tracked.items()):
+            if req.state.is_terminal:
+                del tracked[gid]
+                self._emit(gen, {
+                    "ev": "done", "gid": gid, "state": req.state.value,
+                    "reason": req.finish_reason,
+                    "error": (f"{type(req.error).__name__}: {req.error}"
+                              if req.error is not None else None),
+                    "n": len(req.output_tokens)})
+
+
+class ProcReplica:
+    """Child-process replica: spawns ``python -m
+    paddle_tpu.serving.replica_worker`` with a model/engine spec in its
+    environment and speaks newline-JSON over its stdin/stdout. This is the
+    replica a chaos suite can really SIGKILL mid-decode; the router sees
+    EOF/ESRCH and fails its streams over."""
+
+    kind = "proc"
+
+    def __init__(self, rid: str, spec: dict, *, env: dict | None = None,
+                 log_path: str | None = None):
+        self.rid = str(rid)
+        self.spec = dict(spec)
+        self.extra_env = dict(env or {})
+        self.log_path = log_path
+        self.state = ReplicaState.STOPPED
+        self.stats: dict = {}
+        self.last_heartbeat = 0.0
+        self.pid: int | None = None
+        self.proc: subprocess.Popen | None = None
+        self._on_event = None
+        self._gen = 0
+        self._stopping = False
+        self._wlock = threading.Lock()
+
+    def start(self, on_event):
+        self._on_event = on_event
+        self._gen += 1
+        self._stopping = False
+        self.state = ReplicaState.STARTING
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        pythonpath = os.pathsep.join(
+            p for p in (repo_root, os.environ.get("PYTHONPATH")) if p)
+        env = dict(os.environ,
+                   PADDLE_REPLICA_SPEC=json.dumps(self.spec),
+                   PYTHONPATH=pythonpath)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env.update(self.extra_env)
+        stderr = (open(self.log_path, "ab") if self.log_path
+                  else subprocess.DEVNULL)
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.serving.replica_worker"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, stderr=stderr,
+            env=env, text=True, bufsize=1)
+        self.pid = self.proc.pid
+        threading.Thread(target=self._read, args=(self._gen, self.proc),
+                         name=f"replica-{self.rid}-reader",
+                         daemon=True).start()
+
+    @property
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def send(self, cmd: dict):
+        if not self.alive:
+            raise BrokenPipeError(f"replica {self.rid} process is dead")
+        line = json.dumps(cmd)
+        with self._wlock:
+            try:
+                self.proc.stdin.write(line + "\n")
+                self.proc.stdin.flush()
+            except (BrokenPipeError, OSError, ValueError) as e:
+                raise BrokenPipeError(
+                    f"replica {self.rid}: write failed: {e}") from e
+
+    def stop(self, graceful: bool = True, timeout: float = 15.0):
+        self._stopping = True
+        if self.proc is None:
+            return
+        if graceful and self.alive:
+            try:
+                self.send({"op": "close"})
+            except BrokenPipeError:
+                pass
+        elif self.alive:                  # a wedged worker won't cooperate
+            self.proc.kill()
+        try:
+            self.proc.wait(timeout)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait(5)
+
+    def kill(self):
+        """The real thing: SIGKILL, no goodbye."""
+        if self.proc is not None and self.alive:
+            os.kill(self.proc.pid, signal.SIGKILL)
+
+    def _read(self, gen: int, proc: subprocess.Popen):
+        for line in proc.stdout:
+            if gen != self._gen:
+                return
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except json.JSONDecodeError:
+                continue                  # stray stdout noise, not protocol
+            if isinstance(ev, dict) and "ev" in ev:
+                self._on_event(self, ev)
+        # EOF: the process is gone (SIGKILL, crash, or clean exit)
+        if gen == self._gen and not self._stopping:
+            self._on_event(self, {"ev": "dead",
+                                  "error": f"pipe EOF (pid {self.pid})"})
+
+
+# ---------------------------------------------------------------------------
+# the router
+# ---------------------------------------------------------------------------
+
+def _router_metrics() -> SimpleNamespace:
+    reg = telemetry.registry()
+    return SimpleNamespace(
+        dispatches=reg.counter(
+            "router_dispatches_total",
+            "request dispatches to replicas", ("replica",)),
+        failovers=reg.counter(
+            "router_failovers_total",
+            "in-flight requests re-dispatched after replica failure"),
+        retries=reg.counter(
+            "router_retries_total",
+            "requests re-dispatched after an engine-reported failure"),
+        shed=reg.counter(
+            "router_shed_total",
+            "new requests rejected by the load shedder (429)"),
+        affinity_hits=reg.counter(
+            "router_affinity_hits_total",
+            "placements that landed on the prefix-affinity replica"),
+        suppressed=reg.counter(
+            "router_replay_suppressed_total",
+            "replayed tokens suppressed during failover"),
+        mismatches=reg.counter(
+            "router_replay_mismatch_total",
+            "failover replays that diverged from the streamed tokens"),
+        drains=reg.counter(
+            "router_drains_total", "replica drains executed"),
+        restarts=reg.counter(
+            "router_replica_restarts_total",
+            "replica restarts executed (supervisor-budgeted)"),
+        deaths=reg.counter(
+            "router_replica_deaths_total",
+            "replicas marked UNHEALTHY (death/probe/stall)"),
+        inflight=reg.gauge(
+            "router_inflight_requests", "requests currently dispatched"),
+        healthy=reg.gauge(
+            "router_replicas_healthy", "replicas in the HEALTHY state"),
+    )
+
+
+class FleetRouter:
+    """Placement, health, failover, shedding, and drain over N replicas.
+
+    replicas:       :class:`LocalReplica` / :class:`ProcReplica` handles
+                    (anything with their duck-typed surface works).
+    probe_interval_s / probe_timeout_s: health-probe cadence and the
+                    heartbeat staleness past which a replica is UNHEALTHY.
+    max_inflight_per_replica: router-side admission bound per replica
+                    (the bounded-admission analogue; None = only the SLO
+                    shed signal gates).
+    shed_bypass_priority: priority at or above which a request is admitted
+                    even when every healthy replica sheds ("sheds lowest
+                    priority first").
+    affinity_block_size: block alignment for the prefix-affinity hash —
+                    match the engines' ``block_size`` so affinity keys are
+                    exactly the shareable prefixes.
+    max_retries:    re-dispatches after an engine-reported failure (replica
+                    deaths are always failed over and not counted here).
+    supervisor:     optional :class:`~paddle_tpu.resilience.ElasticSupervisor`
+                    whose restart budget/ledger governs replica restarts.
+    auto_restart:   restart UNHEALTHY replicas automatically (through the
+                    supervisor when one is set).
+    """
+
+    def __init__(self, replicas, *, probe_interval_s: float = 0.25,
+                 probe_timeout_s: float = 2.0,
+                 max_inflight_per_replica: int | None = None,
+                 shed_bypass_priority: int = 1,
+                 retry_after_s: float = 1.0,
+                 max_retries: int = 1,
+                 affinity_block_size: int = 16,
+                 supervisor=None, auto_restart: bool = False,
+                 verify_replay: bool = True, rng_seed: int = 0,
+                 retain_terminal: int = 4096):
+        self.replicas: dict[str, object] = {r.rid: r for r in replicas}
+        self._order = [r.rid for r in replicas]
+        self.probe_interval_s = float(probe_interval_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.max_inflight = max_inflight_per_replica
+        self.shed_bypass_priority = int(shed_bypass_priority)
+        self.retry_after_s = float(retry_after_s)
+        self.max_retries = int(max_retries)
+        self.affinity_block_size = int(affinity_block_size)
+        self.supervisor = supervisor
+        self.auto_restart = bool(auto_restart)
+        self.verify_replay = bool(verify_replay)
+        self._rng = random.Random(rng_seed)
+        self._lock = threading.RLock()
+        self._gids = itertools.count()
+        self._requests: dict[int, RouterRequest] = {}
+        # terminal handles are kept for introspection but bounded — a
+        # long-lived gateway must not grow memory per served request
+        self._retain_terminal = int(retain_terminal)
+        self._inflight: dict[str, set[int]] = {r: set() for r in self._order}
+        self._stall_seen: dict[str, int] = {r: 0 for r in self._order}
+        self._restart_at: dict[str, float] = {}
+        self._m = _router_metrics()
+        # per-router counts for stats(): the registry families above are
+        # process-global (shared by every router in the process), so the
+        # fleet view must not read totals back from them
+        self._c = {k: 0 for k in (
+            "dispatches", "failovers", "retries", "shed", "affinity_hits",
+            "replay_suppressed", "replay_mismatches", "drains",
+            "replica_restarts", "replica_deaths")}
+        self._probe_thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self.closed = False
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self, wait_healthy_s: float | None = None) -> "FleetRouter":
+        """Start every replica and the probe loop; optionally block until
+        all replicas report a first heartbeat (or the timeout passes)."""
+        for rep in self.replicas.values():
+            rep.start(self._on_event)
+        self._stop.clear()
+        self._probe_thread = threading.Thread(
+            target=self._probe_loop, name="router-probe", daemon=True)
+        self._probe_thread.start()
+        if wait_healthy_s:
+            deadline = time.monotonic() + wait_healthy_s
+            while time.monotonic() < deadline:
+                if all(r.state is ReplicaState.HEALTHY
+                       for r in self.replicas.values()):
+                    break
+                time.sleep(0.01)
+        return self
+
+    def close(self):
+        """Stop the probe loop, cancel what's still in flight, and stop
+        every replica gracefully."""
+        with self._lock:
+            if self.closed:
+                return
+            self.closed = True
+        self._stop.set()
+        if self._probe_thread is not None:
+            self._probe_thread.join(5)
+        with self._lock:
+            live = [rr for rr in self._requests.values() if not rr.terminal]
+        for rr in live:
+            self.cancel(rr.gid)
+        for rep in self.replicas.values():
+            if rep.state is not ReplicaState.STOPPED:
+                # an UNHEALTHY replica may be wedged mid-step (that is WHY
+                # it is unhealthy); don't wait politely on it
+                rep.stop(graceful=rep.state is not ReplicaState.UNHEALTHY)
+                rep.state = ReplicaState.STOPPED
+        with self._lock:
+            for rr in self._requests.values():
+                if not rr.terminal:
+                    rr._finish("cancelled", "router_closed", None)
+
+    # -- submission --------------------------------------------------------
+    def submit(self, prompt, sampling: SamplingParams | dict | None = None,
+               *, priority: int = 0, deadline_s: float | None = None,
+               on_token=None, on_finish=None) -> RouterRequest:
+        """Place and dispatch one request; returns the live
+        :class:`RouterRequest`. Raises :class:`RouterShed` (shed — retry
+        later) or :class:`NoHealthyReplica` (no capacity at all)."""
+        if self.closed:
+            raise NoHealthyReplica("router is closed")
+        faults.inject("router.submit", priority=priority)
+        if not isinstance(sampling, dict):
+            sampling = sampling_to_dict(sampling)
+        deadline = (time.monotonic() + float(deadline_s)
+                    if deadline_s is not None else None)
+        rr = RouterRequest(next(self._gids), prompt, sampling,
+                           priority=priority, deadline=deadline,
+                           on_token=on_token, on_finish=on_finish)
+        with self._lock:
+            rep = self._place(rr.prompt, rr.priority)
+            self._prune_terminal()
+            self._requests[rr.gid] = rr
+            self._dispatch(rr, rep)
+        return rr
+
+    def _prune_terminal(self):
+        """Bound the request map (under the lock): oldest terminal handles
+        go first; live requests are never dropped."""
+        if len(self._requests) < self._retain_terminal:
+            return
+        for gid in list(self._requests):
+            rr = self._requests[gid]
+            if rr.terminal:
+                del self._requests[gid]
+                if len(self._requests) < self._retain_terminal:
+                    break
+
+    def cancel(self, gid: int) -> bool:
+        """Cancel a routed request wherever it currently runs. Idempotent —
+        unknown/terminal gids return False."""
+        with self._lock:
+            rr = self._requests.get(gid)
+            if rr is None or rr.terminal:
+                return False
+            rr.cancel_requested = True
+            rep = self.replicas.get(rr.replica)
+        if rep is not None:
+            try:
+                rep.send({"op": "cancel", "gid": gid})
+                return True
+            except BrokenPipeError:
+                pass
+        with self._lock:
+            if not rr.terminal:
+                self._untrack(rr)
+                rr._finish("cancelled", "cancelled", None)
+        return True
+
+    # -- placement ---------------------------------------------------------
+    def _load(self, rid: str) -> int:
+        return len(self._inflight.get(rid, ()))
+
+    def _is_shedding(self, rep) -> bool:
+        if self.max_inflight is not None and \
+                self._load(rep.rid) >= self.max_inflight:
+            return True
+        slo = (rep.stats or {}).get("slo") or {}
+        return bool(slo.get("shed"))
+
+    def _affinity_key(self, prompt) -> int | None:
+        bs = self.affinity_block_size
+        nb = max(0, (len(prompt) - 1) // bs)   # full, shareable blocks only
+        if nb == 0:
+            return None
+        h = hashlib.sha1(
+            b"|".join(str(int(t)).encode() for t in prompt[:nb * bs]))
+        return int.from_bytes(h.digest()[:8], "big")
+
+    def _place(self, prompt, priority: int, exclude=(),
+               bypass_shed: bool = False):
+        """Pick a replica. Called under the lock."""
+        healthy = [self.replicas[r] for r in self._order
+                   if self.replicas[r].state is ReplicaState.HEALTHY
+                   and r not in exclude]
+        if not healthy:
+            raise NoHealthyReplica(
+                f"no healthy replica "
+                f"({ {r: self.replicas[r].state.value for r in self._order} })")
+        eligible = [r for r in healthy if not self._is_shedding(r)]
+        if not eligible:
+            if bypass_shed or priority >= self.shed_bypass_priority:
+                eligible = healthy      # in-flight / high-priority: admit
+            else:
+                self._m.shed.inc()
+                self._c["shed"] += 1
+                telemetry.record_event("router.shed", priority=priority,
+                                       healthy=len(healthy))
+                raise RouterShed(
+                    f"all {len(healthy)} healthy replicas are shedding "
+                    f"(priority {priority} < bypass "
+                    f"{self.shed_bypass_priority}); retry after "
+                    f"{self.retry_after_s:.1f}s",
+                    retry_after_s=self.retry_after_s)
+        # prefix affinity: a stable hash over the block-aligned prefix
+        # names the preferred replica so shared prefixes keep hitting the
+        # same engine's prefix cache
+        key = self._affinity_key(prompt)
+        if key is not None:
+            preferred = self.replicas[self._order[key % len(self._order)]]
+            min_load = min(self._load(r.rid) for r in eligible)
+            if preferred in eligible and \
+                    self._load(preferred.rid) <= min_load + 2:
+                self._m.affinity_hits.inc()
+                self._c["affinity_hits"] += 1
+                return preferred
+        # power-of-two-choices on load
+        if len(eligible) == 1:
+            return eligible[0]
+        a, b = self._rng.sample(eligible, 2)
+        return a if self._load(a.rid) <= self._load(b.rid) else b
+
+    def _dispatch(self, rr: RouterRequest, rep, *, exclude=None):
+        """Send the request to ``rep`` (under the lock). A failed send (or
+        an injected ``router.dispatch`` fault) falls through to the next
+        candidate; with none left the request fails."""
+        exclude = set(exclude or ())
+        while True:
+            try:
+                faults.inject("router.dispatch", replica=rep.rid,
+                              gid=rr.gid)
+                deadline_s = (rr.deadline - time.monotonic()
+                              if rr.deadline is not None else None)
+                rep.send({"op": "add", "gid": rr.gid, "prompt": rr.prompt,
+                          "sampling": rr.sampling, "deadline_s": deadline_s})
+            except (BrokenPipeError, faults.FaultError) as e:
+                exclude.add(rep.rid)
+                try:
+                    rep2 = self._place(rr.prompt, rr.priority,
+                                       exclude=exclude, bypass_shed=True)
+                except NoHealthyReplica:
+                    self._untrack(rr)
+                    rr._finish("failed", "dispatch_failed",
+                               f"{type(e).__name__}: {e}")
+                    return
+                rep = rep2
+                continue
+            break
+        rr.replica = rep.rid
+        rr.state = "running"
+        rr.dispatches += 1
+        self._inflight.setdefault(rep.rid, set()).add(rr.gid)
+        self._m.dispatches.labels(replica=rep.rid).inc()
+        self._c["dispatches"] += 1
+        self._m.inflight.set(sum(len(s) for s in self._inflight.values()))
+        telemetry.record_event("router.dispatch", gid=rr.gid,
+                               replica=rep.rid, attempt=rr.dispatches,
+                               suppress=rr.suppress)
+
+    def _untrack(self, rr: RouterRequest):
+        if rr.replica is not None:
+            self._inflight.get(rr.replica, set()).discard(rr.gid)
+        self._m.inflight.set(sum(len(s) for s in self._inflight.values()))
+
+    # -- replica events ----------------------------------------------------
+    def _on_event(self, rep, ev: dict):
+        kind = ev.get("ev")
+        if kind == "token":
+            self._on_token(rep, ev["gid"], ev["tok"], ev["i"])
+        elif kind == "done":
+            self._on_done(rep, ev)
+        elif kind == "stats":
+            self._on_stats(rep, ev.get("stats") or {})
+        elif kind == "hello":
+            rep.pid = ev.get("pid", rep.pid)
+            rep.last_heartbeat = time.monotonic()
+        elif kind == "dead":
+            self._mark_unhealthy(rep, ev.get("error") or "process death")
+
+    def _on_stats(self, rep, stats: dict):
+        rep.stats = stats
+        rep.last_heartbeat = time.monotonic()
+        with self._lock:
+            if rep.state is ReplicaState.STARTING:
+                rep.state = ReplicaState.HEALTHY
+                self._sync_health_gauge()
+            # an engine stall-detector trip is a health event: the replica
+            # is failing requests it cannot serve
+            stalls = int(stats.get("stalls") or 0)
+            if stalls > self._stall_seen.get(rep.rid, 0):
+                self._stall_seen[rep.rid] = stalls
+                if rep.state in (ReplicaState.HEALTHY, ReplicaState.DRAINING):
+                    unhealthy = True
+                else:
+                    unhealthy = False
+            else:
+                unhealthy = False
+        if unhealthy:
+            self._mark_unhealthy(rep, "engine stall-detector trip")
+
+    def _on_token(self, rep, gid: int, tok: int, i: int):
+        cb = None
+        with self._lock:
+            rr = self._requests.get(gid)
+            if rr is None or rr.terminal or rr.replica != rep.rid:
+                return                      # stale incarnation / other owner
+            if i < rr.suppress:
+                # replay of an already-streamed token: verify + swallow
+                self._m.suppressed.inc()
+                self._c["replay_suppressed"] += 1
+                if self.verify_replay and rr.tokens[i] != tok:
+                    self._m.mismatches.inc()
+                    self._c["replay_mismatches"] += 1
+                    self._untrack(rr)
+                    rr._finish(
+                        "failed", "replay_mismatch",
+                        f"ReplayMismatch: token {i} replayed as {tok}, "
+                        f"client already saw {rr.tokens[i]}")
+                return
+            if i != len(rr.tokens):
+                return                      # duplicate/out-of-order: drop
+            rr.tokens.append(int(tok))
+            if rr.first_token_time is None:
+                rr.first_token_time = time.monotonic()
+            cb = rr.on_token
+        if cb is not None:
+            cb(rr, int(tok))
+
+    def _on_done(self, rep, ev: dict):
+        gid = ev["gid"]
+        state, reason = ev.get("state"), ev.get("reason")
+        error = ev.get("error")
+        with self._lock:
+            rr = self._requests.get(gid)
+            if rr is None or rr.terminal or rr.replica != rep.rid:
+                return
+            self._untrack(rr)
+            if state == "finished":
+                rr._finish("finished", reason or "stop", None)
+                return
+            if state == "cancelled":
+                if rr.cancel_requested or reason == "deadline":
+                    rr._finish("cancelled", reason, error)
+                    return
+                # engine-side cancel the client never asked for (replica
+                # shutting down under us): treat as retryable failure
+                state, error = "failed", error or "cancelled by replica"
+            # state == "failed": retry on another replica unless the error
+            # is a deterministic property of the request itself
+            retryable = not (error or "").startswith(_NON_RETRYABLE)
+            if retryable and rr.retries < self.max_retries:
+                rr.retries += 1
+                self._m.retries.inc()
+                self._c["retries"] += 1
+                rr.suppress = len(rr.tokens)
+                try:
+                    rep2 = self._place(rr.prompt, rr.priority,
+                                       exclude={rep.rid}, bypass_shed=True)
+                except NoHealthyReplica:
+                    rr._finish("failed", reason, error)
+                    return
+                telemetry.record_event("router.retry", gid=gid,
+                                       from_replica=rep.rid,
+                                       to_replica=rep2.rid, error=error)
+                self._dispatch(rr, rep2, exclude={rep.rid})
+                return
+            rr._finish("failed", reason, error)
+
+    # -- health ------------------------------------------------------------
+    def _sync_health_gauge(self):
+        self._m.healthy.set(sum(
+            1 for r in self.replicas.values()
+            if r.state is ReplicaState.HEALTHY))
+
+    def _mark_unhealthy(self, rep, reason: str):
+        with self._lock:
+            if rep.state in (ReplicaState.UNHEALTHY, ReplicaState.STOPPED):
+                return
+            rep.state = ReplicaState.UNHEALTHY
+            self._m.deaths.inc()
+            self._c["replica_deaths"] += 1
+            self._sync_health_gauge()
+            orphans = [self._requests[g]
+                       for g in sorted(self._inflight.get(rep.rid, set()))
+                       if not self._requests[g].terminal]
+            self._inflight[rep.rid] = set()
+            telemetry.record_event("router.replica_unhealthy",
+                                   replica=rep.rid, reason=reason,
+                                   orphans=len(orphans))
+            for rr in orphans:
+                self._failover(rr, exclude={rep.rid})
+            if self.auto_restart:
+                self._schedule_restart(rep, reason)
+
+    def _failover(self, rr: RouterRequest, exclude):
+        """Re-dispatch an orphaned in-flight request (under the lock):
+        original prompt + sampling, already-streamed tokens replayed and
+        suppressed. Never shed — this stream is already in flight."""
+        rr.failovers += 1
+        rr.suppress = len(rr.tokens)
+        self._m.failovers.inc()
+        self._c["failovers"] += 1
+        try:
+            rep = self._place(rr.prompt, rr.priority, exclude=exclude,
+                              bypass_shed=True)
+        except NoHealthyReplica as e:
+            rr._finish("failed", "no_healthy_replica", str(e))
+            return
+        telemetry.record_event("router.failover", gid=rr.gid,
+                               to_replica=rep.rid, suppress=rr.suppress)
+        self._dispatch(rr, rep, exclude=exclude)
+
+    def _schedule_restart(self, rep, reason: str):
+        """Supervisor-budgeted restart decision (called under the lock)."""
+        backoff = 0.0
+        if self.supervisor is not None:
+            decision = self.supervisor.decide(
+                rc=1, n_failed=1, interrupted=False,
+                world_size=len(self.replicas), dead_ranks=[rep.rid])
+            if decision["action"] != "restart":
+                rep.state = ReplicaState.STOPPED
+                telemetry.record_event("router.replica_abandoned",
+                                       replica=rep.rid,
+                                       reason=decision["reason"])
+                return
+            backoff = decision["backoff_s"]
+        self._restart_at[rep.rid] = time.monotonic() + backoff
+
+    def _probe_loop(self):
+        while not self._stop.wait(self.probe_interval_s):
+            now = time.monotonic()
+            for rid in self._order:
+                rep = self.replicas[rid]
+                try:
+                    faults.inject("router.probe", replica=rid)
+                except faults.FaultError as e:
+                    self._mark_unhealthy(rep, f"probe fault: {e}")
+                    continue
+                if rep.state in (ReplicaState.HEALTHY, ReplicaState.DRAINING,
+                                 ReplicaState.STARTING):
+                    if not rep.alive:
+                        self._mark_unhealthy(rep, "process death")
+                    elif (rep.state is not ReplicaState.STARTING
+                          and rep.last_heartbeat
+                          and now - rep.last_heartbeat
+                          > self.probe_timeout_s):
+                        # liveness, not readiness: a STARTING replica is
+                        # allowed its compile warmup; timeouts only count
+                        # once it has reported ready
+                        self._mark_unhealthy(
+                            rep, f"probe timeout "
+                                 f"({now - rep.last_heartbeat:.2f}s since "
+                                 f"last heartbeat)")
+                # due restarts
+                due = self._restart_at.get(rid)
+                if due is not None and now >= due and \
+                        rep.state in (ReplicaState.UNHEALTHY,
+                                      ReplicaState.STOPPED):
+                    del self._restart_at[rid]
+                    self._do_restart(rep)
+
+    def _do_restart(self, rep):
+        try:
+            rep.stop(graceful=False, timeout=2.0)
+        except Exception:
+            pass
+        rep.stats = {}
+        rep.last_heartbeat = 0.0
+        with self._lock:
+            self._stall_seen[rep.rid] = 0
+        rep.start(self._on_event)
+        self._m.restarts.inc()
+        self._c["replica_restarts"] += 1
+        telemetry.record_event("router.replica_restart", replica=rep.rid)
+
+    # -- drain / restart (operator surface) --------------------------------
+    def drain(self, rid: str, budget_s: float = 30.0,
+              stop_replica: bool = True) -> dict:
+        """Stop placement to a replica, wait for its in-flight work up to
+        ``budget_s``, fail over whatever is left, and (by default) stop it.
+        An in-flight stream is never lost to a drain."""
+        rep = self.replicas[rid]
+        with self._lock:
+            if rep.state is not ReplicaState.HEALTHY:
+                return {"replica": rid, "drained": False,
+                        "state": rep.state.value,
+                        "reason": "not in a drainable state"}
+            rep.state = ReplicaState.DRAINING
+        self._m.drains.inc()
+        self._c["drains"] += 1
+        telemetry.record_event("router.drain", replica=rid,
+                               inflight=self._load(rid))
+        deadline = time.monotonic() + float(budget_s)
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not self._inflight.get(rid):
+                    break
+            time.sleep(0.01)
+        with self._lock:
+            leftovers = [self._requests[g]
+                         for g in sorted(self._inflight.get(rid, set()))
+                         if not self._requests[g].terminal]
+            self._inflight[rid] = set()
+            for rr in leftovers:
+                self._failover(rr, exclude={rid})
+            completed_in_budget = not leftovers
+            if stop_replica:
+                rep.state = ReplicaState.STOPPED
+            else:
+                rep.state = ReplicaState.HEALTHY
+            self._sync_health_gauge()
+        if stop_replica:
+            rep.stop(graceful=True)
+        if self.supervisor is not None and self.supervisor.ledger is not None:
+            self.supervisor.ledger.record(
+                "replica_drain", replica=rid,
+                completed_in_budget=completed_in_budget,
+                failed_over=len(leftovers))
+        return {"replica": rid, "drained": True,
+                "completed_in_budget": completed_in_budget,
+                "failed_over": len(leftovers)}
+
+    def restart(self, rid: str) -> None:
+        """Bring a STOPPED/UNHEALTHY replica back (clean restarts — e.g.
+        after an operator drain — do not consume the supervisor's restart
+        budget; failure-driven restarts go through ``auto_restart``)."""
+        rep = self.replicas[rid]
+        if rep.state not in (ReplicaState.STOPPED, ReplicaState.UNHEALTHY):
+            raise RuntimeError(
+                f"replica {rid} is {rep.state.value}; drain/stop it first")
+        if self.supervisor is not None and self.supervisor.ledger is not None:
+            self.supervisor.ledger.record("replica_restart", replica=rid)
+        self._do_restart(rep)
+
+    def drain_and_restart(self, rid: str, budget_s: float = 30.0) -> dict:
+        """The rolling-restart primitive: drain, stop, start again."""
+        report = self.drain(rid, budget_s=budget_s, stop_replica=True)
+        if report.get("drained"):
+            self.restart(rid)
+        return report
+
+    # -- introspection -----------------------------------------------------
+    def stats(self) -> dict:
+        """The fleet view a gateway /stats endpoint serves: per-replica
+        state + heartbeat age + SLO block + in-flight, and router totals."""
+        with self._lock:
+            now = time.monotonic()
+            reps = {}
+            for rid in self._order:
+                rep = self.replicas[rid]
+                reps[rid] = {
+                    "kind": rep.kind,
+                    "state": rep.state.value,
+                    "pid": rep.pid,
+                    "inflight": self._load(rid),
+                    "heartbeat_age_s": (now - rep.last_heartbeat
+                                        if rep.last_heartbeat else None),
+                    "slo": (rep.stats or {}).get("slo"),
+                    "stats": {k: v for k, v in (rep.stats or {}).items()
+                              if k not in ("slo", "prefix_cache")},
+                }
+            live = [rr for rr in self._requests.values() if not rr.terminal]
+            return {
+                "replicas": reps,
+                "healthy": sum(1 for r in self.replicas.values()
+                               if r.state is ReplicaState.HEALTHY),
+                "inflight": len(live),
+                "requests_total": len(self._requests),
+                **self._c,
+            }

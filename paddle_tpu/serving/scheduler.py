@@ -356,13 +356,30 @@ class Scheduler:
         return False
 
     def close(self, cancel_pending: bool = True) -> list[Request]:
-        """Shut the intake down. Pending (waiting + running) requests are
-        cancelled (default) so callers holding their handles see a terminal
-        state; returns whatever was cancelled."""
+        """Shut the intake down. Still-queued requests that never reached a
+        prefill slot end ``FAILED`` with :class:`EngineClosed` attached — a
+        fleet router keyed on terminal states must see an *error* it can
+        re-dispatch on, not a cancel that looks user-initiated; running
+        requests are ``CANCELLED`` (reason "shutdown"). Returns every
+        request transitioned."""
         self.closed = True
         dropped = []
         if cancel_pending:
-            for req in list(self.waiting) + list(self.running.values()):
+            while self.waiting:
+                req = self.waiting.popleft()
+                req.state = RequestState.FAILED
+                req.finish_time = time.monotonic()
+                req.finish_reason = "engine_closed"
+                req.error = EngineClosed(
+                    f"request {req.rid} was still queued (never prefilled) "
+                    f"when the engine closed")
+                self.num_failed += 1
+                telemetry.record_event(
+                    "scheduler.fail", rid=req.rid,
+                    error="EngineClosed: still queued at close()")
+                self._on_event("fail", rid=req.rid)
+                dropped.append(req)
+            for req in list(self.running.values()):
                 if self.cancel(req.rid, reason="shutdown"):
                     dropped.append(req)
         return dropped

@@ -72,6 +72,17 @@ Known sites (see docs/ROBUSTNESS.md for the full table):
                           (error => compile fails; isolation boundary
                           fails the request / in-flight batch, engine
                           survives)
+    gateway.request       per parsed HTTP request in the serving gateway
+                          (error => that request answers 500; the
+                          connection layer and every other stream survive)
+    router.submit         per FleetRouter submission (error surfaces to
+                          the caller before placement)
+    router.dispatch       per dispatch attempt to a replica (error =>
+                          treated as a failed dispatch; the router tries
+                          the next healthy replica)
+    router.probe          per replica health probe (error => the replica
+                          is marked UNHEALTHY and its in-flight requests
+                          fail over — the operator-injected death)
     store.connect         each TCPStore connect attempt
     store.get             each TCPStore get attempt
     collective.<op>       inside the timeout-guarded collective call

@@ -271,17 +271,43 @@ class TestBackpressureAndShutdown:
         assert eng.stats()["num_finished"] == 2
 
     def test_add_after_close_raises_engine_closed(self):
-        """Satellite: no silent drop after shutdown."""
+        """Satellite: no silent drop after shutdown. A still-queued request
+        that never reached a prefill slot ends FAILED with EngineClosed
+        attached (ISSUE 10: a router keyed on terminal states must see an
+        error it can re-dispatch on); a running one ends CANCELLED."""
         model = _tiny_model()
         eng = LLMEngine(model, block_size=8, max_slots=2, max_model_len=64)
+        running = eng.add_request([7, 8, 9], SamplingParams(max_new_tokens=8))
+        eng.step()                                # running now holds a slot
         pending = eng.add_request([1, 2, 3], SamplingParams(max_new_tokens=4))
         eng.close()
         with pytest.raises(EngineClosed, match="shut down"):
             eng.add_request([4, 5, 6])
-        assert pending.state is RequestState.CANCELLED
-        assert pending.finish_reason == "shutdown"
+        assert pending.state is RequestState.FAILED
+        assert pending.finish_reason == "engine_closed"
+        assert isinstance(pending.error, EngineClosed)
+        assert running.state is RequestState.CANCELLED
+        assert running.finish_reason == "shutdown"
         assert eng.step() is False
         assert eng.stats()["blocks_used"] == 0
+
+    def test_cancel_is_idempotent_for_router_fanout(self):
+        """Satellite: cancel() never raises — unknown rids, double cancels,
+        and cancels racing a finished request all return False."""
+        model = _tiny_model()
+        sp = SamplingParams(max_new_tokens=2, temperature=0.0)
+        eng = LLMEngine(model, block_size=8, max_slots=2, max_model_len=64)
+        req = eng.add_request([1, 2, 3], sp)
+        assert not eng.cancel(10_000)             # never existed
+        assert eng.cancel(req.rid)                # live -> cancelled
+        assert not eng.cancel(req.rid)            # double cancel
+        done = eng.add_request([4, 5, 6], sp)
+        eng.run()
+        assert done.state is RequestState.FINISHED
+        assert not eng.cancel(done.rid)           # already finished
+        eng.close()
+        assert not eng.cancel(done.rid)           # closed engine: still False
+        assert eng.stats()["num_cancelled"] == 1
 
     def test_stall_detector_fails_queue_head(self):
         """Permanent allocator exhaustion must not spin forever: after

@@ -1,0 +1,336 @@
+"""Gateway tests (ISSUE 10): the HTTP front door over a live LocalReplica
+fleet — OpenAI-shape completions/chat, SSE streaming with mid-stream
+failover invisible to the client, deadline budget propagation into engine
+deadlines, shed → 429 + Retry-After, and the ops endpoints.
+"""
+import json
+import http.client
+
+import pytest
+
+import paddle_tpu
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+from paddle_tpu.serving import (
+    FleetRouter, Gateway, LLMEngine, LocalReplica, ReplicaState,
+    SamplingParams, naive_generate)
+from paddle_tpu.utils import faults
+from paddle_tpu.utils.faults import FaultPlan
+
+pytestmark = pytest.mark.fleet
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults.deactivate()
+
+VOCAB = 61
+
+
+def build_model():
+    paddle_tpu.seed(0)
+    cfg = llama_tiny(vocab=VOCAB, hidden=32, layers=2, heads=4, kv_heads=2,
+                     inter=64, seq=64)
+    return LlamaForCausalLM(cfg)
+
+
+@pytest.fixture(scope="module")
+def refmodel():
+    return build_model()
+
+
+@pytest.fixture(scope="module")
+def fleet():
+    """One 2-replica fleet + gateway shared by the module; tests that kill
+    a replica restart it before handing the fleet back."""
+    def factory():
+        return LLMEngine(build_model(), block_size=8, max_slots=2,
+                         max_model_len=64)
+
+    reps = [LocalReplica(f"g{i}", factory, stats_interval_s=0.02,
+                         warmup=list(range(1, 11))) for i in range(2)]
+    router = FleetRouter(reps, probe_interval_s=0.05, probe_timeout_s=10.0,
+                         affinity_block_size=8).start(wait_healthy_s=120)
+    gw = Gateway(router).start()
+    yield gw, router, reps
+    gw.stop()
+    router.close()
+
+
+def request(gw, method, path, body=None, timeout=120):
+    conn = http.client.HTTPConnection(gw.host, gw.port, timeout=timeout)
+    conn.request(method, path,
+                 json.dumps(body) if body is not None else None,
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    return resp, conn
+
+
+def post_json(gw, path, body, timeout=120):
+    resp, conn = request(gw, "POST", path, body, timeout)
+    doc = json.loads(resp.read())
+    conn.close()
+    return resp, doc
+
+
+def read_sse(resp):
+    """Parse an SSE body into (token list, finish_reason, error)."""
+    toks, finish, error = [], None, None
+    while True:
+        line = resp.readline()
+        if not line:
+            break
+        line = line.decode().strip()
+        if not line.startswith("data: "):
+            continue
+        payload = line[len("data: "):]
+        if payload == "[DONE]":
+            break
+        doc = json.loads(payload)
+        ch = doc["choices"][0]
+        toks += ch.get("token_ids") or []
+        finish = ch.get("finish_reason") or finish
+        if doc.get("error"):
+            error = doc["error"]["message"]
+    return toks, finish, error
+
+
+class TestCompletions:
+    def test_non_streaming_matches_reference(self, fleet, refmodel):
+        gw, _, _ = fleet
+        prompt = [3, 1, 4, 1, 5, 9, 2, 6, 5]
+        ref = naive_generate(refmodel, prompt,
+                             SamplingParams(max_new_tokens=6))
+        resp, doc = post_json(gw, "/v1/completions",
+                              {"prompt": prompt, "max_tokens": 6})
+        assert resp.status == 200
+        c = doc["choices"][0]
+        assert c["token_ids"] == ref
+        assert c["text"] == " ".join(str(t) for t in ref)
+        assert c["finish_reason"] == "length"
+        assert doc["usage"] == {"prompt_tokens": 9, "completion_tokens": 6,
+                                "total_tokens": 15}
+        assert doc["paddle_tpu"]["replica"] in ("g0", "g1")
+
+    def test_string_prompt_and_seeded_sampling(self, fleet, refmodel):
+        gw, _, _ = fleet
+        sp = SamplingParams(max_new_tokens=5, temperature=0.8, top_k=7,
+                            seed=42)
+        ref = naive_generate(refmodel, [5, 6, 7, 8, 9], sp)
+        resp, doc = post_json(gw, "/v1/completions", {
+            "prompt": "5 6 7 8 9", "max_tokens": 5, "temperature": 0.8,
+            "top_k": 7, "seed": 42})
+        assert resp.status == 200
+        assert doc["choices"][0]["token_ids"] == ref
+
+    def test_chat_completions_concatenates_messages(self, fleet, refmodel):
+        gw, _, _ = fleet
+        ref = naive_generate(refmodel, [1, 2, 3, 4, 5, 6],
+                             SamplingParams(max_new_tokens=4))
+        resp, doc = post_json(gw, "/v1/chat/completions", {
+            "messages": [{"role": "system", "content": [1, 2, 3]},
+                         {"role": "user", "content": "4 5 6"}],
+            "max_tokens": 4})
+        assert resp.status == 200
+        assert doc["object"] == "chat.completion"
+        c = doc["choices"][0]
+        assert c["token_ids"] == ref
+        assert c["message"]["role"] == "assistant"
+        assert c["message"]["content"] == " ".join(str(t) for t in ref)
+
+    def test_streaming_sse_matches_reference(self, fleet, refmodel):
+        gw, _, _ = fleet
+        prompt = [9, 8, 7, 6, 5, 4, 3, 2, 1]
+        ref = naive_generate(refmodel, prompt,
+                             SamplingParams(max_new_tokens=8))
+        resp, conn = request(gw, "POST", "/v1/completions",
+                             {"prompt": prompt, "max_tokens": 8,
+                              "stream": True})
+        assert resp.status == 200
+        assert resp.getheader("Content-Type") == "text/event-stream"
+        toks, finish, error = read_sse(resp)
+        conn.close()
+        assert toks == ref and finish == "length" and error is None
+
+    def test_deadline_budget_propagates_to_engine(self, fleet):
+        """deadline_ms rides into the engine's per-request deadline: the
+        request comes back cancelled with finish_reason "deadline" and a
+        partial (possibly empty) stream — not a hang, not a 500."""
+        gw, _, _ = fleet
+        resp, doc = post_json(gw, "/v1/completions", {
+            "prompt": [1, 2, 3, 4, 5], "max_tokens": 40,
+            "deadline_ms": 1})
+        assert resp.status == 200
+        c = doc["choices"][0]
+        assert c["finish_reason"] == "deadline"
+        assert len(c["token_ids"]) < 40
+
+    def test_bad_requests_get_400(self, fleet):
+        gw, _, _ = fleet
+        for body in ({"prompt": "not token ids"},
+                     {"prompt": []},
+                     {"prompt": {"nested": 1}}):
+            resp, doc = post_json(gw, "/v1/completions", body)
+            assert resp.status == 400, body
+            assert doc["error"]["type"] == "invalid_request_error"
+        resp, conn = request(gw, "GET", "/v1/completions")
+        assert resp.status == 405
+        conn.close()
+        resp, conn = request(gw, "GET", "/nope")
+        assert resp.status == 404
+        conn.close()
+
+    def test_validation_failure_surfaces_as_500_with_error(self, fleet):
+        gw, _, _ = fleet
+        # prompt+max_tokens exceeds max_model_len: engine-side ValueError,
+        # non-retryable, surfaced with the message intact
+        resp, doc = post_json(gw, "/v1/completions", {
+            "prompt": list(range(1, 11)), "max_tokens": 64})
+        assert resp.status == 500
+        assert "max_model_len" in doc["error"]["message"]
+
+
+class TestOpsEndpoints:
+    def test_healthz_models_stats_metrics(self, fleet):
+        gw, router, _ = fleet
+        resp, doc = {}, {}
+        resp, conn = request(gw, "GET", "/healthz")
+        doc = json.loads(resp.read())
+        conn.close()
+        assert resp.status == 200 and doc["status"] == "ok"
+        assert doc["healthy_replicas"] == 2
+
+        resp, conn = request(gw, "GET", "/v1/models")
+        doc = json.loads(resp.read())
+        conn.close()
+        assert doc["data"][0]["id"] == "paddle-tpu"
+
+        resp, conn = request(gw, "GET", "/stats")
+        doc = json.loads(resp.read())
+        conn.close()
+        assert set(doc["replicas"]) == {"g0", "g1"}
+        assert "failovers" in doc and "shed" in doc
+
+        resp, conn = request(gw, "GET", "/metrics")
+        text = resp.read().decode()
+        conn.close()
+        assert "gateway_requests_total" in text
+        assert "router_dispatches_total" in text
+
+    def test_healthz_503_when_no_replica_healthy(self):
+        class DeadRouter:
+            def stats(self):
+                return {"healthy": 0, "inflight": 0,
+                        "replicas": {"x": {"state": "unhealthy"}}}
+
+        gw = Gateway(DeadRouter()).start()
+        try:
+            resp, conn = request(gw, "GET", "/healthz")
+            assert resp.status == 503
+            conn.close()
+        finally:
+            gw.stop()
+
+
+class TestShedAndFailoverOverHTTP:
+    def test_shed_returns_429_with_retry_after(self, fleet, refmodel):
+        """Fill router-side capacity with live streams, then a low-priority
+        request sheds (429 + Retry-After) while a high-priority one is
+        admitted; no in-flight stream is harmed."""
+        gw, router, _ = fleet
+        sp = SamplingParams(max_new_tokens=16)
+        refs = {}
+        old = router.max_inflight
+        router.max_inflight = 1
+        streams = []
+        try:
+            prompts = [[1 + i, 2, 3, 4, 5, 6, 7, 8, 9] for i in range(2)]
+            for i, p in enumerate(prompts):
+                refs[i] = naive_generate(refmodel, p, sp)
+            # slow every decode step while the shed window is open so the
+            # fill streams deterministically stay in flight
+            with FaultPlan.parse("serving.decode:delay=0.05x*"):
+                for p in prompts:
+                    resp, conn = request(gw, "POST", "/v1/completions",
+                                         {"prompt": p, "max_tokens": 16,
+                                          "stream": True})
+                    assert resp.status == 200
+                    streams.append((resp, conn))
+                # wait until both replicas actually carry their stream
+                import time as _t
+                t0 = _t.monotonic()
+                while _t.monotonic() - t0 < 60:
+                    st = router.stats()
+                    if all(v["inflight"] >= 1
+                           for v in st["replicas"].values()):
+                        break
+                    _t.sleep(0.005)
+                resp, doc = post_json(gw, "/v1/completions",
+                                      {"prompt": [9, 9, 9, 9, 9],
+                                       "max_tokens": 4})
+                assert resp.status == 429
+                assert int(resp.getheader("Retry-After")) >= 1
+                assert doc["error"]["type"] == "overloaded_error"
+                # high priority bypasses the shed
+                resp, doc = post_json(gw, "/v1/completions",
+                                      {"prompt": [9, 9, 9, 9, 9],
+                                       "max_tokens": 4, "priority": 5})
+                assert resp.status == 200
+            # the in-flight streams complete unharmed, token-for-token
+            for i, (resp, conn) in enumerate(streams):
+                toks, finish, error = read_sse(resp)
+                conn.close()
+                assert toks == refs[i] and error is None
+            assert router.stats()["shed"] >= 1
+        finally:
+            router.max_inflight = old
+
+    def test_failover_mid_sse_stream_is_invisible(self, fleet, refmodel):
+        """Kill the serving replica after the client has read >= 2 SSE
+        chunks: the stream continues from another replica with no
+        duplicate, no gap, and no error event."""
+        gw, router, reps = fleet
+        prompt = [2, 7, 1, 8, 2, 8, 1, 8, 2]
+        ref = naive_generate(refmodel, prompt,
+                             SamplingParams(max_new_tokens=16))
+        resp, conn = request(gw, "POST", "/v1/completions",
+                             {"prompt": prompt, "max_tokens": 16,
+                              "stream": True})
+        assert resp.status == 200
+        toks = []
+        victim = None
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            line = line.decode().strip()
+            if not line.startswith("data: ") or line == "data: [DONE]":
+                if line == "data: [DONE]":
+                    break
+                continue
+            doc = json.loads(line[6:])
+            ch = doc["choices"][0]
+            toks += ch.get("token_ids") or []
+            if ch.get("finish_reason"):
+                assert doc.get("error") is None
+            if victim is None and len(toks) >= 2:
+                # find which replica carries the stream and kill it
+                st = router.stats()
+                carrying = [r for r, v in st["replicas"].items()
+                            if v["inflight"] > 0]
+                assert carrying
+                victim = router.replicas[carrying[0]]
+                victim.kill()
+        conn.close()
+        assert toks == ref
+        assert router.stats()["failovers"] >= 1
+        # restore the fleet for the next test: restart the killed replica
+        deadline = 120
+        router.restart(victim.rid)
+        import time as _t
+        t0 = _t.monotonic()
+        while victim.state is not ReplicaState.HEALTHY and \
+                _t.monotonic() - t0 < deadline:
+            _t.sleep(0.02)
+        assert victim.state is ReplicaState.HEALTHY
+        assert router.stats()["replica_restarts"] >= 1

@@ -1,0 +1,452 @@
+"""Fleet router tests (ISSUE 10): placement, shedding, drain state
+machines on fake replicas (no engines, instant), plus the real contract —
+failover token parity — on live :class:`LocalReplica` fleets: kill a
+replica after k streamed tokens and the client-visible stream must equal
+the uninterrupted single-engine stream, greedy AND seeded sampling.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+from paddle_tpu.serving import (
+    FleetRouter, LLMEngine, LocalReplica, NoHealthyReplica, ReplicaState,
+    RouterShed, SamplingParams, naive_generate)
+from paddle_tpu.serving.router import sampling_from_dict, sampling_to_dict
+from paddle_tpu.utils import faults
+from paddle_tpu.utils.faults import FaultPlan
+
+pytestmark = pytest.mark.fleet
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults.deactivate()
+
+
+# ---------------------------------------------------------------------------
+# fake replicas: the state machines without engines
+# ---------------------------------------------------------------------------
+
+class FakeReplica:
+    kind = "fake"
+
+    def __init__(self, rid, state=ReplicaState.HEALTHY, shed=False):
+        self.rid = rid
+        self.state = state
+        self.stats = {"slo": {"shed": shed}}
+        self.last_heartbeat = time.monotonic()
+        self.pid = 0
+        self.sent = []
+        self.alive = True
+        self._on_event = None
+
+    def start(self, on_event):
+        self._on_event = on_event
+        self.state = ReplicaState.HEALTHY
+
+    def send(self, cmd):
+        if not self.alive:
+            raise BrokenPipeError(self.rid)
+        self.sent.append(cmd)
+
+    def stop(self, graceful=True, timeout=0):
+        pass
+
+    def kill(self):
+        self.alive = False
+
+    # test helpers: emit protocol events as if the engine produced them
+    def emit_tokens(self, gid, toks, start=0):
+        for i, t in enumerate(toks, start=start):
+            self._on_event(self, {"ev": "token", "gid": gid, "tok": t,
+                                  "i": i})
+
+    def emit_done(self, gid, state="finished", reason="length", error=None,
+                  n=0):
+        self._on_event(self, {"ev": "done", "gid": gid, "state": state,
+                              "reason": reason, "error": error, "n": n})
+
+
+def fake_router(n=3, **kw):
+    reps = [FakeReplica(f"f{i}") for i in range(n)]
+    router = FleetRouter(reps, affinity_block_size=4, **kw)
+    for r in reps:
+        r.start(router._on_event)       # no probe thread: tests drive events
+    return router, reps
+
+
+class TestPlacement:
+    def test_affinity_is_stable_and_block_aligned(self):
+        router, reps = fake_router(3)
+        # 13 tokens, block 4: the shareable prefix is the first 3 FULL
+        # blocks (capped at len-1, exactly like the prefix-cache match)
+        prompt = list(range(13))
+        picks = {router._place(prompt, 0).rid for _ in range(10)}
+        assert len(picks) == 1          # same prefix -> same replica
+        # a tail-divergent prompt with the same 3 full blocks hashes the
+        # same and lands on the same replica
+        same = router._place(list(range(12)) + [99, 98], 0).rid
+        assert same in picks
+        assert router.stats()["affinity_hits"] >= 11
+
+    def test_short_prompt_skips_affinity(self):
+        router, _ = fake_router(2)
+        # < 1 full block: no affinity key, p2c picks something healthy
+        assert router._place([1, 2], 0) is not None
+        assert router.stats()["affinity_hits"] == 0
+
+    def test_p2c_falls_back_when_preferred_overloaded(self):
+        router, reps = fake_router(2)
+        prompt = list(range(8))
+        preferred = router._place(prompt, 0)
+        # pile router-side load onto the preferred replica only
+        for g in range(5):
+            router._inflight[preferred.rid].add(1000 + g)
+        other = [r for r in reps if r.rid != preferred.rid][0]
+        assert router._place(prompt, 0).rid == other.rid
+
+    def test_no_healthy_raises_503_shape(self):
+        router, reps = fake_router(2)
+        for r in reps:
+            r.state = ReplicaState.UNHEALTHY
+        with pytest.raises(NoHealthyReplica):
+            router._place([1, 2, 3], 0)
+
+    def test_unhealthy_and_draining_excluded_from_placement(self):
+        router, reps = fake_router(3)
+        reps[0].state = ReplicaState.UNHEALTHY
+        reps[1].state = ReplicaState.DRAINING
+        for _ in range(8):
+            assert router._place(list(np.random.randint(0, 50, 10)), 0) \
+                is reps[2]
+
+
+class TestShedding:
+    def test_sheds_lowest_priority_first(self):
+        router, reps = fake_router(2, shed_bypass_priority=1)
+        for r in reps:
+            r.stats = {"slo": {"shed": True}}    # every replica sheds
+        with pytest.raises(RouterShed) as ei:
+            router._place([1, 2, 3, 4, 5], priority=0)
+        assert ei.value.retry_after_s > 0
+        # higher priority bypasses the total shed
+        assert router._place([1, 2, 3, 4, 5], priority=1) is not None
+        assert router.stats()["shed"] == 1
+
+    def test_partial_shed_routes_around(self):
+        router, reps = fake_router(2)
+        reps[0].stats = {"slo": {"shed": True}}
+        for _ in range(6):
+            assert router._place(
+                list(np.random.randint(0, 50, 9)), 0) is reps[1]
+
+    def test_inflight_bound_is_a_shed_signal(self):
+        router, reps = fake_router(2, max_inflight_per_replica=1)
+        r0 = router.submit([1, 2, 3, 4, 5], SamplingParams())
+        r1 = router.submit([9, 8, 7, 6, 5], SamplingParams())
+        assert {r0.replica, r1.replica} == {"f0", "f1"}   # spread by bound
+        with pytest.raises(RouterShed):
+            router.submit([5, 5, 5, 5, 5], SamplingParams(), priority=0)
+
+    def test_inflight_streams_never_shed_on_failover(self):
+        """A dead replica's streams re-dispatch even when every survivor
+        sheds — shedding only ever rejects NEW work."""
+        router, reps = fake_router(2)
+        rr = router.submit([1, 2, 3, 4, 5, 6, 7, 8], SamplingParams())
+        victim = router.replicas[rr.replica]
+        survivor = [r for r in reps if r.rid != victim.rid][0]
+        victim.emit_tokens(rr.gid, [11, 12])
+        survivor.stats = {"slo": {"shed": True}}          # survivor sheds
+        router._mark_unhealthy(victim, "test death")
+        assert rr.replica == survivor.rid                 # still placed
+        assert rr.failovers == 1 and not rr.terminal
+        add = [c for c in survivor.sent if c["op"] == "add"][-1]
+        assert add["prompt"] == rr.prompt                 # original prompt
+
+
+class TestFailoverStateMachine:
+    def test_replay_suppress_then_continue(self):
+        router, reps = fake_router(2)
+        rr = router.submit([1, 2, 3, 4, 5], SamplingParams())
+        a = router.replicas[rr.replica]
+        b = [r for r in reps if r.rid != a.rid][0]
+        seen = []
+        rr.on_token = lambda r, t: seen.append(t)
+        a.emit_tokens(rr.gid, [10, 11, 12])
+        router._mark_unhealthy(a, "death")
+        assert rr.suppress == 3 and rr.replica == b.rid
+        b.emit_tokens(rr.gid, [10, 11, 12, 13, 14])       # replay + new
+        b.emit_done(rr.gid, n=5)
+        assert rr.tokens == [10, 11, 12, 13, 14]
+        # the pre-kill tokens streamed once, the replay was swallowed, the
+        # continuation streamed once: no duplicate, no gap
+        assert seen == [10, 11, 12, 13, 14]
+        assert rr.state == "finished"
+        assert router.stats()["replay_suppressed"] == 3
+
+    def test_replay_mismatch_fails_request(self):
+        router, reps = fake_router(2)
+        rr = router.submit([1, 2, 3, 4, 5], SamplingParams())
+        a = router.replicas[rr.replica]
+        b = [r for r in reps if r.rid != a.rid][0]
+        a.emit_tokens(rr.gid, [10, 11])
+        router._mark_unhealthy(a, "death")
+        b.emit_tokens(rr.gid, [10, 99])   # diverged replay
+        assert rr.state == "failed"
+        assert "ReplayMismatch" in rr.error
+        assert router.stats()["replay_mismatches"] == 1
+
+    def test_stale_replica_events_dropped(self):
+        router, reps = fake_router(2)
+        rr = router.submit([1, 2, 3, 4, 5], SamplingParams())
+        a = router.replicas[rr.replica]
+        b = [r for r in reps if r.rid != a.rid][0]
+        router._mark_unhealthy(a, "death")
+        a.emit_tokens(rr.gid, [42])       # the dead replica babbles
+        a.emit_done(rr.gid, state="failed", error="zombie")
+        assert rr.tokens == [] and not rr.terminal
+        b.emit_done(rr.gid, state="finished", reason="stop")
+        assert rr.state == "finished"
+
+    def test_engine_failure_retries_then_surfaces(self):
+        router, reps = fake_router(2, max_retries=1)
+        rr = router.submit([1, 2, 3, 4, 5], SamplingParams())
+        first = router.replicas[rr.replica]
+        first.emit_done(rr.gid, state="failed", reason="error",
+                        error="FaultError: injected")
+        second = router.replicas[rr.replica]
+        assert second.rid != first.rid and rr.retries == 1
+        second.emit_done(rr.gid, state="failed", reason="error",
+                         error="FaultError: injected again")
+        assert rr.state == "failed"       # retry budget spent
+        assert "again" in rr.error
+
+    def test_validation_errors_do_not_retry(self):
+        router, reps = fake_router(2, max_retries=3)
+        rr = router.submit([1, 2, 3, 4, 5], SamplingParams())
+        router.replicas[rr.replica].emit_done(
+            rr.gid, state="failed", reason="add_failed",
+            error="ValueError: prompt exceeds max_model_len")
+        assert rr.state == "failed" and rr.retries == 0
+
+    def test_deadline_cancel_is_terminal(self):
+        router, reps = fake_router(2)
+        rr = router.submit([1, 2, 3, 4, 5], SamplingParams(), deadline_s=5)
+        router.replicas[rr.replica].emit_done(
+            rr.gid, state="cancelled", reason="deadline",
+            error="DeadlineExceeded: ...")
+        assert rr.state == "cancelled" and rr.finish_reason == "deadline"
+
+    def test_failover_with_no_survivor_fails_not_hangs(self):
+        router, reps = fake_router(2)
+        rr = router.submit([1, 2, 3, 4, 5], SamplingParams())
+        for r in reps:
+            router._mark_unhealthy(r, "total outage")
+        assert rr.state == "failed"
+        assert rr.finish_reason == "no_healthy_replica"
+        assert rr.wait(0.1)               # waiters released
+
+
+class TestDrainStateMachine:
+    def test_drain_stops_placement_waits_then_stops(self):
+        router, reps = fake_router(2)
+        report = router.drain(reps[0].rid, budget_s=0.2)
+        assert report["drained"] and report["completed_in_budget"]
+        assert reps[0].state is ReplicaState.STOPPED
+        for _ in range(5):
+            assert router._place(list(range(8)), 0) is reps[1]
+
+    def test_drain_fails_over_stragglers_after_budget(self):
+        router, reps = fake_router(2)
+        rr = router.submit([1, 2, 3, 4, 5], SamplingParams())
+        rep = router.replicas[rr.replica]
+        other = [r for r in reps if r.rid != rep.rid][0]
+        rep.emit_tokens(rr.gid, [7, 8])
+        report = router.drain(rep.rid, budget_s=0.05)
+        assert report["drained"] and not report["completed_in_budget"]
+        assert report["failed_over"] == 1
+        assert rr.replica == other.rid and rr.suppress == 2
+        assert not rr.terminal            # the stream survived the drain
+
+    def test_drain_only_from_healthy(self):
+        router, reps = fake_router(2)
+        reps[0].state = ReplicaState.UNHEALTHY
+        report = router.drain(reps[0].rid, budget_s=0.01)
+        assert not report["drained"]
+
+    def test_restart_requires_stopped_or_unhealthy(self):
+        router, reps = fake_router(2)
+        with pytest.raises(RuntimeError, match="drain/stop it first"):
+            router.restart(reps[0].rid)
+        router.drain(reps[0].rid, budget_s=0.05)
+        router.restart(reps[0].rid)       # FakeReplica.start -> HEALTHY
+        assert reps[0].state is ReplicaState.HEALTHY
+        assert router.stats()["replica_restarts"] >= 1
+
+
+class TestRouterChaosSites:
+    def test_dispatch_fault_falls_through_to_next_replica(self):
+        router, reps = fake_router(2)
+        with FaultPlan.parse("router.dispatch:error@1"):
+            rr = router.submit([1, 2, 3, 4, 5], SamplingParams())
+        assert rr.replica is not None and not rr.terminal
+        assert rr.dispatches == 1         # second candidate took it
+
+    def test_submit_fault_surfaces(self):
+        router, _ = fake_router(2)
+        with FaultPlan.parse("router.submit:error@1"):
+            with pytest.raises(faults.FaultError):
+                router.submit([1, 2, 3], SamplingParams())
+
+    def test_sampling_roundtrip(self):
+        sp = SamplingParams(max_new_tokens=9, temperature=0.7, top_k=5,
+                            top_p=0.9, seed=41)
+        assert sampling_from_dict(sampling_to_dict(sp)) == sp
+
+
+# ---------------------------------------------------------------------------
+# live fleets: the failover token-parity contract
+# ---------------------------------------------------------------------------
+
+VOCAB = 61
+
+
+def build_model():
+    paddle_tpu.seed(0)
+    cfg = llama_tiny(vocab=VOCAB, hidden=32, layers=2, heads=4, kv_heads=2,
+                     inter=64, seq=64)
+    return LlamaForCausalLM(cfg)
+
+
+@pytest.fixture(scope="module")
+def refmodel():
+    return build_model()
+
+
+@pytest.fixture(scope="module")
+def live_fleet():
+    """One 2-replica LocalReplica fleet shared by every live test (engine
+    builds dominate wall time); tests that kill or stop a replica heal the
+    fleet before handing it back."""
+    def factory():
+        return LLMEngine(build_model(), block_size=8, max_slots=2,
+                         max_model_len=64)
+
+    reps = [LocalReplica(f"r{i}", factory, stats_interval_s=0.02,
+                         warmup=list(range(1, 11))) for i in range(2)]
+    router = FleetRouter(reps, probe_interval_s=0.05, probe_timeout_s=10.0,
+                         affinity_block_size=8,
+                         max_retries=1).start(wait_healthy_s=120)
+    assert all(r.state is ReplicaState.HEALTHY for r in reps), \
+        {r.rid: r.state for r in reps}
+    yield router, reps
+    router.close()
+
+
+def heal(router, reps, timeout=120.0):
+    """Restart every non-HEALTHY replica and wait for readiness."""
+    for rep in reps:
+        if rep.state is not ReplicaState.HEALTHY:
+            router.restart(rep.rid)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if all(r.state is ReplicaState.HEALTHY for r in reps):
+            return
+        time.sleep(0.02)
+    raise AssertionError(
+        {r.rid: r.state for r in reps})
+
+
+class TestFailoverParity:
+    @pytest.mark.parametrize("sp", [
+        SamplingParams(max_new_tokens=14, temperature=0.0),
+        SamplingParams(max_new_tokens=14, temperature=0.9, top_k=7,
+                       top_p=0.9, seed=123),
+    ], ids=["greedy", "seeded"])
+    def test_kill_after_k_tokens_stream_unchanged(self, live_fleet,
+                                                  refmodel, sp):
+        """THE failover contract: SIGKILL-equivalent death after k streamed
+        tokens; the client-visible stream equals the uninterrupted
+        single-engine stream token-for-token."""
+        router, reps = live_fleet
+        prompt = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3]
+        ref = naive_generate(refmodel, prompt, sp)
+        before = router.stats()["replay_suppressed"]
+        seen = []
+        rr = router.submit(prompt, sp,
+                           on_token=lambda r, t: seen.append(t))
+        deadline = time.monotonic() + 60
+        while len(seen) < 3 and time.monotonic() < deadline:
+            time.sleep(0.002)
+        assert len(seen) >= 3, "stream never started"
+        router.replicas[rr.replica].kill()
+        assert rr.wait(120), "failover never completed"
+        assert rr.state == "finished", (rr.state, rr.error)
+        assert rr.failovers == 1
+        assert rr.tokens == ref
+        assert seen == ref                # callback stream: no dup, no gap
+        assert router.stats()["replay_suppressed"] >= before + 3
+        heal(router, reps)
+
+    def test_fleet_parity_and_mixed_sampling(self, live_fleet, refmodel):
+        """No faults: a mixed greedy/seeded fleet through the router equals
+        per-request naive decode — placement is invisible to outputs."""
+        router, _ = live_fleet
+        rng = np.random.RandomState(1)
+        prompts = [[int(t) for t in rng.randint(0, VOCAB, n)]
+                   for n in (9, 11, 10, 12)]
+        sps = [SamplingParams(max_new_tokens=6, temperature=0.0),
+               SamplingParams(max_new_tokens=6, temperature=0.8, seed=7),
+               SamplingParams(max_new_tokens=6, temperature=0.0),
+               SamplingParams(max_new_tokens=6, temperature=1.1, top_k=9,
+                              seed=99)]
+        refs = [naive_generate(refmodel, p, s) for p, s in zip(prompts, sps)]
+        rrs = [router.submit(p, s) for p, s in zip(prompts, sps)]
+        for rr in rrs:
+            assert rr.wait(120)
+        assert [rr.tokens for rr in rrs] == refs
+        assert all(rr.state == "finished" for rr in rrs)
+
+    def test_engine_fault_retry_on_sibling(self, live_fleet, refmodel):
+        """An engine-reported failure (injected prefill error) retries on a
+        sibling replica and still matches the reference stream."""
+        router, _ = live_fleet
+        sp = SamplingParams(max_new_tokens=8, temperature=0.0)
+        prompt = [7, 7, 3, 2, 9, 1, 4, 4, 8]
+        ref = naive_generate(refmodel, prompt, sp)
+        with FaultPlan.parse("serving.prefill:error@1"):
+            rr = router.submit(prompt, sp)
+            assert rr.wait(120)
+        assert rr.state == "finished", (rr.state, rr.error)
+        assert rr.retries == 1
+        assert rr.tokens == ref
+
+    def test_cancel_fanout_is_idempotent(self, live_fleet):
+        router, _ = live_fleet
+        rr = router.submit([5, 4, 3, 2, 1, 5, 4, 3, 2],
+                           SamplingParams(max_new_tokens=30))
+        assert router.cancel(rr.gid)
+        assert rr.wait(60)
+        assert rr.state == "cancelled"
+        assert not router.cancel(rr.gid)          # terminal now
+        assert not router.cancel(424242)          # unknown gid
+
+    def test_draining_replica_finishes_streams_locally(self, live_fleet,
+                                                       refmodel):
+        """Drain with enough budget: the in-flight stream completes on the
+        draining replica (no failover), then the replica stops."""
+        router, reps = live_fleet
+        sp = SamplingParams(max_new_tokens=10, temperature=0.0)
+        prompt = [2, 4, 6, 8, 10, 12, 14, 16, 18]
+        ref = naive_generate(refmodel, prompt, sp)
+        rr = router.submit(prompt, sp)
+        report = router.drain(rr.replica, budget_s=120.0)
+        assert report["drained"] and report["completed_in_budget"]
+        assert rr.wait(10) and rr.state == "finished"
+        assert rr.failovers == 0
+        assert rr.tokens == ref
+        heal(router, reps)

@@ -1,7 +1,7 @@
 """Chaos sweep: drive the runtime through batteries of deterministic fault
 plans and report survival / degradation stats per plan.
 
-Four suites:
+Six suites:
 
 ``--suite serving`` (default) — the continuous-batching engine under fault
 plans. For every plan the same request fleet runs on a fresh engine; the
@@ -41,6 +41,21 @@ simulated block leak while a clean drain stays quiet; and an
 instrumentation-overhead ratio is measured (the precise instrument is
 ``serving_bench --telemetry on|off``).
 
+``--suite serve-fleet`` — the production front door (docs/SERVING.md
+"Fleet serving"): a real gateway + FleetRouter over engine replica
+*processes* (``serving/replica_worker.py``) driven by HTTP SSE clients.
+Four scenarios, every one held to **zero lost requests** and
+token-for-token parity with an uninterrupted single-engine reference:
+(1) SIGKILL a replica mid-decode while clients stream — its requests
+fail over with replay-and-suppress; (2) fault storms armed per replica
+via ``FLAGS_fault_plan`` (``serving.compile:error`` on one replica →
+engine-isolated failures retried on a sibling; a wedging
+``serving.decode:delay`` + ``collective:delay`` storm on another → probe
+timeout → failover); (3) load shedding under a full fleet — low-priority
+requests get 429 + Retry-After, high-priority bypasses, no in-flight
+stream is harmed; (4) ``drain_and_restart`` under a real
+ElasticSupervisor ledger while traffic flows.
+
 ``--suite straggler`` — the cluster observability plane
 (docs/OBSERVABILITY.md "Cluster observability"): a 4-rank job over a real
 TCPStore where one rank carries a ``collective:delay`` fault plan.
@@ -53,7 +68,8 @@ as the suspect and a postmortem bundle must collect EVERY rank's flight
 recorder + stack snapshot.
 
 Usage:
-    python tools/chaos_run.py [--suite serving|train|straggler]
+    python tools/chaos_run.py
+        [--suite serving|prefix|train|straggler|perf|serve-fleet]
         [--requests 6] [--prompt-len 24] [--max-new 16]
         [--slots 3] [--block-size 8] [--plan NAME:SPEC ...] [--json OUT.json]
 
@@ -68,6 +84,7 @@ import argparse
 import json
 import os
 import sys
+import threading
 import time
 
 import numpy as np
@@ -498,6 +515,406 @@ def run_perf_suite(args):
     }
 
 
+# -- the serve-fleet battery -----------------------------------------------
+
+def _fleet_spec(args, workdir, max_len):
+    return {
+        "seed": 0,
+        "llama_tiny": {"vocab": args.vocab, "hidden": args.hidden,
+                       "layers": args.layers, "heads": 4, "kv_heads": 2,
+                       "inter": 2 * args.hidden, "seq": 2 * max_len},
+        "engine": {"block_size": args.block_size, "max_slots": args.slots,
+                   "max_model_len": max_len},
+        "warmup": list(range(1, args.prompt_len + 1)),
+        "stats_interval_s": 0.05,
+        # all replicas share one persistent compile cache: only the first
+        # pays XLA for each trace, which keeps the battery's wall time sane
+        "jax_cache_dir": os.path.join(workdir, "jax-cache"),
+    }
+
+
+def _fleet_reference(spec, prompts, sps):
+    """Uninterrupted single-engine streams: the parity oracle every fleet
+    scenario is held to (engine == naive decode is proven elsewhere)."""
+    from paddle_tpu.serving.replica_worker import build_model
+
+    eng = LLMEngine(build_model(spec), **spec["engine"])
+    outs = eng.generate(prompts, sps)
+    eng.close()
+    return outs
+
+
+def _start_fleet(workdir, spec, n, *, plans=None, scenario="fleet",
+                 router_kw=None, supervisor=None):
+    from paddle_tpu.serving import FleetRouter, Gateway, ProcReplica
+
+    reps = []
+    for i in range(n):
+        env = {}
+        if plans and i in plans:
+            env["FLAGS_fault_plan"] = plans[i]
+        reps.append(ProcReplica(
+            f"p{i}", spec, env=env,
+            log_path=os.path.join(workdir, f"{scenario}-p{i}.log")))
+    kw = dict(probe_interval_s=0.1, probe_timeout_s=8.0,
+              affinity_block_size=spec["engine"]["block_size"],
+              supervisor=supervisor)
+    kw.update(router_kw or {})
+    router = FleetRouter(reps, **kw).start(wait_healthy_s=600)
+    unhealthy = [r.rid for r in reps if r.state.value != "healthy"]
+    if unhealthy:
+        router.close()
+        raise RuntimeError(f"fleet never became healthy: {unhealthy}")
+    gateway = Gateway(router).start()
+    return router, gateway, reps
+
+
+class _SSEClient(threading.Thread):
+    """One streaming HTTP client: POSTs a completion with stream=true and
+    collects every token chunk until [DONE]."""
+
+    def __init__(self, gw, prompt, sp, priority=0):
+        super().__init__(daemon=True)
+        self.gw, self.prompt, self.sp = gw, list(prompt), sp
+        self.priority = priority
+        self.status = None
+        self.tokens: list[int] = []
+        self.finish = None
+        self.error = None
+        self.retry_after = None
+        self.start()
+
+    def run(self):
+        import http.client
+        import json as _json
+
+        body = {"prompt": self.prompt,
+                "max_tokens": self.sp.max_new_tokens,
+                "temperature": self.sp.temperature,
+                "top_k": self.sp.top_k, "top_p": self.sp.top_p,
+                "seed": self.sp.seed, "priority": self.priority,
+                "stream": True}
+        try:
+            conn = http.client.HTTPConnection(self.gw.host, self.gw.port,
+                                              timeout=600)
+            conn.request("POST", "/v1/completions", _json.dumps(body),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            self.status = resp.status
+            if resp.status != 200:
+                doc = _json.loads(resp.read())
+                self.error = doc.get("error", {}).get("message")
+                self.retry_after = resp.getheader("Retry-After")
+                conn.close()
+                return
+            while True:
+                line = resp.readline()
+                if not line:
+                    break
+                line = line.decode().strip()
+                if not line.startswith("data: "):
+                    continue
+                payload = line[6:]
+                if payload == "[DONE]":
+                    break
+                doc = _json.loads(payload)
+                ch = doc["choices"][0]
+                self.tokens += ch.get("token_ids") or []
+                if ch.get("finish_reason"):
+                    self.finish = ch["finish_reason"]
+                if doc.get("error"):
+                    self.error = doc["error"]["message"]
+            conn.close()
+        except Exception as e:
+            self.error = f"{type(e).__name__}: {e}"
+
+
+def _affinity_prompt(router, rng, length, vocab, want_rid):
+    """Deterministically craft a prompt whose affinity hash prefers
+    ``want_rid`` — how the battery guarantees a fault-armed replica
+    actually receives traffic."""
+    order = router._order
+    for _ in range(512):
+        p = [int(t) for t in rng.randint(0, vocab, length)]
+        key = router._affinity_key(p)
+        if key is not None and order[key % len(order)] == want_rid:
+            return p
+    raise RuntimeError(f"could not craft a prompt preferring {want_rid}")
+
+
+def _scenario_sigkill(args, workdir, spec, max_len):
+    """SIGKILL a replica while its streams decode: every client stream
+    completes on a survivor, token-for-token equal to the reference."""
+    sp_greedy = SamplingParams(max_new_tokens=args.max_new, temperature=0.0)
+    sp_seeded = SamplingParams(max_new_tokens=args.max_new, temperature=0.9,
+                               top_k=7, seed=123)
+    rng = np.random.RandomState(0)
+    prompts = [[int(t) for t in rng.randint(0, args.vocab, args.prompt_len)]
+               for _ in range(args.requests)]
+    sps = [sp_seeded if i % 3 == 2 else sp_greedy
+           for i in range(len(prompts))]
+    refs = _fleet_reference(spec, prompts, sps)
+    router, gateway, reps = _start_fleet(workdir, spec, 3,
+                                         scenario="sigkill")
+    killed = None
+    try:
+        clients = [_SSEClient(gateway, p, s) for p, s in zip(prompts, sps)]
+        deadline = time.time() + 300
+        while time.time() < deadline and killed is None:
+            streamed = sum(len(c.tokens) for c in clients)
+            if streamed >= 3:
+                st = router.stats()
+                loaded = sorted(st["replicas"].items(),
+                                key=lambda kv: -kv[1]["inflight"])
+                rid, info = loaded[0]
+                if info["inflight"] > 0:
+                    killed = rid
+                    router.replicas[rid].kill()   # real SIGKILL
+            time.sleep(0.02)
+        for c in clients:
+            c.join(600)
+        st = router.stats()
+        lost = [i for i, c in enumerate(clients)
+                if c.status != 200 or c.finish != "length" or c.error]
+        parity = [i for i, c in enumerate(clients) if c.tokens != refs[i]]
+        ok = (killed is not None and not lost and not parity
+              and st["failovers"] >= 1 and st["replica_deaths"] >= 1
+              and st["replay_mismatches"] == 0)
+        return {
+            "scenario": "replica_sigkill",
+            "survived": bool(ok),
+            "killed_replica": killed,
+            "lost_requests": len(lost),
+            "parity_failures": len(parity),
+            "failovers": st["failovers"],
+            "replay_suppressed": st["replay_suppressed"],
+            "replay_mismatches": st["replay_mismatches"],
+            "replica_deaths": st["replica_deaths"],
+        }
+    finally:
+        gateway.stop()
+        router.close()
+
+
+def _scenario_fault_storms(args, workdir, spec, max_len):
+    """Per-replica fault plans through the FaultPlan grammar: p1 cannot
+    create any new jit trace (serving.compile:error) so its long-prompt
+    requests fail over; p2 wedges mid-decode (serving.decode:delay storm,
+    plus a collective:delay that is a no-op on single-chip engines but
+    rides along for the future sharded engine) until the probe timeout
+    fails it over. Zero lost requests, full parity."""
+    long_len = 2 * args.prompt_len          # a prefill bucket nobody warmed
+    spec = dict(spec, engine=dict(spec["engine"],
+                                  max_model_len=long_len + args.max_new))
+    plans = {
+        1: "serving.compile:error@1x*",
+        2: f"serving.decode:delay=30@4;collective:delay=0.1",
+    }
+    router, gateway, reps = _start_fleet(
+        workdir, spec, 3, plans=plans, scenario="storm",
+        router_kw=dict(probe_timeout_s=6.0, max_retries=2))
+    try:
+        rng = np.random.RandomState(1)
+        sp = SamplingParams(max_new_tokens=args.max_new, temperature=0.0)
+        # craft traffic that *must* hit the armed replicas: two long
+        # prompts preferring p1 (new bucket -> compile error -> retry) and
+        # two normal prompts preferring p2 (wedge -> probe -> failover)
+        prompts = [
+            _affinity_prompt(router, rng, long_len, args.vocab, "p1"),
+            _affinity_prompt(router, rng, long_len, args.vocab, "p1"),
+            _affinity_prompt(router, rng, args.prompt_len, args.vocab, "p2"),
+            _affinity_prompt(router, rng, args.prompt_len, args.vocab, "p2"),
+            _affinity_prompt(router, rng, args.prompt_len, args.vocab, "p0"),
+        ]
+        refs = _fleet_reference(spec, prompts, [sp] * len(prompts))
+        clients = [_SSEClient(gateway, p, sp) for p in prompts]
+        for c in clients:
+            c.join(600)
+        st = router.stats()
+        lost = [i for i, c in enumerate(clients)
+                if c.status != 200 or c.error]
+        parity = [i for i, c in enumerate(clients) if c.tokens != refs[i]]
+        ok = (not lost and not parity and st["retries"] >= 1
+              and st["failovers"] >= 1 and st["replica_deaths"] >= 1)
+        return {
+            "scenario": "fault_storms",
+            "survived": bool(ok),
+            "plans": plans,
+            "lost_requests": len(lost),
+            "parity_failures": len(parity),
+            "retries": st["retries"],
+            "failovers": st["failovers"],
+            "replica_deaths": st["replica_deaths"],
+            "replica_states": {r: v["state"]
+                               for r, v in st["replicas"].items()},
+        }
+    finally:
+        gateway.stop()
+        router.close()
+
+
+def _scenario_shed(args, workdir, spec, max_len):
+    """Fleet at capacity: low-priority arrivals shed with 429+Retry-After,
+    a high-priority arrival bypasses, and no in-flight stream is failed.
+    Local replicas (the shed path is router-side; process isolation adds
+    nothing here)."""
+    from paddle_tpu.serving import FleetRouter, Gateway, LLMEngine as _E
+    from paddle_tpu.serving import LocalReplica
+    from paddle_tpu.serving.replica_worker import build_model
+
+    # longer decodes keep the fleet at capacity for the shed window
+    spec = dict(spec, engine=dict(
+        spec["engine"],
+        max_model_len=args.prompt_len + 2 * args.max_new))
+
+    def factory():
+        return _E(build_model(spec), **spec["engine"])
+
+    sp = SamplingParams(max_new_tokens=2 * args.max_new, temperature=0.0)
+    rng = np.random.RandomState(2)
+    fill = [[int(t) for t in rng.randint(0, args.vocab, args.prompt_len)]
+            for _ in range(2)]
+    refs = _fleet_reference(spec, fill, [sp] * 2)
+    reps = [LocalReplica(f"p{i}", factory, stats_interval_s=0.05,
+                         warmup=spec["warmup"]) for i in range(2)]
+    router = FleetRouter(reps, probe_interval_s=0.1, probe_timeout_s=30.0,
+                         affinity_block_size=spec["engine"]["block_size"],
+                         max_inflight_per_replica=1,
+                         shed_bypass_priority=1).start(wait_healthy_s=600)
+    gateway = Gateway(router).start()
+    try:
+        streams = [_SSEClient(gateway, p, sp) for p in fill]
+        deadline = time.time() + 120
+        while time.time() < deadline:           # both streams in flight
+            st = router.stats()
+            if all(v["inflight"] >= 1 for v in st["replicas"].values()):
+                break
+            time.sleep(0.01)
+        low = [_SSEClient(gateway, fill[0], sp, priority=0)
+               for _ in range(3)]
+        high = _SSEClient(gateway, fill[1], sp, priority=5)
+        for c in low + [high]:
+            c.join(600)
+        for c in streams:
+            c.join(600)
+        st = router.stats()
+        shed_ok = all(c.status == 429 and c.retry_after is not None
+                      for c in low)
+        inflight_ok = all(
+            c.status == 200 and c.error is None and c.tokens == refs[i]
+            for i, c in enumerate(streams))
+        ok = (shed_ok and inflight_ok and high.status == 200
+              and st["shed"] >= 3)
+        return {
+            "scenario": "shed_under_load",
+            "survived": bool(ok),
+            "low_priority_statuses": [c.status for c in low],
+            "retry_after": [c.retry_after for c in low],
+            "high_priority_status": high.status,
+            "inflight_streams_ok": bool(inflight_ok),
+            "shed_total": st["shed"],
+        }
+    finally:
+        gateway.stop()
+        router.close()
+
+
+def _scenario_drain_restart(args, workdir, spec, max_len):
+    """Rolling restart under live traffic: drain the loaded replica (its
+    streams finish within budget), stop it, bring it back through the
+    ElasticSupervisor's ledger, and serve on it again."""
+    from paddle_tpu.resilience import ElasticSupervisor, JobLedger
+
+    ledger = JobLedger(os.path.join(workdir, "fleet_job_state.json"))
+    supervisor = ElasticSupervisor(world_size=2, max_restarts=4,
+                                   ledger=ledger)
+    sp = SamplingParams(max_new_tokens=args.max_new, temperature=0.0)
+    rng = np.random.RandomState(3)
+    prompts = [[int(t) for t in rng.randint(0, args.vocab, args.prompt_len)]
+               for _ in range(4)]
+    refs = _fleet_reference(spec, prompts, [sp] * 4)
+    router, gateway, reps = _start_fleet(workdir, spec, 2,
+                                         scenario="drain",
+                                         supervisor=supervisor)
+    try:
+        clients = [_SSEClient(gateway, p, sp) for p in prompts]
+        target = None
+        deadline = time.time() + 300
+        while time.time() < deadline and target is None:
+            st = router.stats()
+            for rid, v in st["replicas"].items():
+                if v["inflight"] > 0:
+                    target = rid
+                    break
+            time.sleep(0.01)
+        report = router.drain_and_restart(target, budget_s=600.0)
+        for c in clients:
+            c.join(600)
+        t0 = time.time()
+        while time.time() - t0 < 300 and \
+                router.replicas[target].state.value != "healthy":
+            time.sleep(0.05)
+        extra = _SSEClient(gateway, prompts[0], sp)
+        extra.join(600)
+        st = router.stats()
+        events = [e["event"] for e in ledger.read()["events"]]
+        lost = [i for i, c in enumerate(clients)
+                if c.status != 200 or c.error]
+        parity = [i for i, c in enumerate(clients) if c.tokens != refs[i]]
+        ok = (report.get("drained") and not lost and not parity
+              and router.replicas[target].state.value == "healthy"
+              and extra.status == 200 and extra.tokens == refs[0]
+              and "replica_drain" in events
+              and "replica_restart" in events
+              and st["drains"] >= 1 and st["replica_restarts"] >= 1)
+        return {
+            "scenario": "drain_restart",
+            "survived": bool(ok),
+            "drained_replica": target,
+            "drain_report": report,
+            "lost_requests": len(lost),
+            "parity_failures": len(parity),
+            "post_restart_state": router.replicas[target].state.value,
+            "post_restart_request_ok": bool(extra.status == 200),
+            "ledger_events": events,
+        }
+    finally:
+        gateway.stop()
+        router.close()
+
+
+def run_serve_fleet_suite(args, workdir=None):
+    import tempfile
+
+    workdir = workdir or tempfile.mkdtemp(prefix="chaos-serve-fleet-")
+    max_len = args.prompt_len + args.max_new
+    spec = _fleet_spec(args, workdir, max_len)
+    rows = []
+    for scenario in (_scenario_sigkill, _scenario_fault_storms,
+                     _scenario_shed, _scenario_drain_restart):
+        try:
+            rows.append(scenario(args, workdir, spec, max_len))
+        except Exception as e:
+            rows.append({"scenario": scenario.__name__, "survived": False,
+                         "crashed": f"{type(e).__name__}: {e}"})
+    survived = sum(1 for r in rows if r["survived"])
+    zero_lost = all(r.get("lost_requests", 0) == 0 for r in rows)
+    dump_path = telemetry.dump(reason="serve-fleet chaos suite complete")
+    return {
+        "suite": "serve-fleet",
+        "workdir": workdir,
+        "config": {"requests": args.requests, "prompt_len": args.prompt_len,
+                   "max_new_tokens": args.max_new, "slots": args.slots,
+                   "block_size": args.block_size},
+        "plans_run": len(rows),
+        "plans_survived": survived,
+        "all_survived": survived == len(rows),
+        "zero_lost_requests": bool(zero_lost),
+        "flight_recorder_dump": dump_path,
+        "results": rows,
+    }
+
+
 # -- the straggler battery -------------------------------------------------
 
 def _spawn_demo_ranks(endpoint, world, steps, scenario, workdir,
@@ -686,7 +1103,7 @@ def run_sweep(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--suite",
                     choices=["serving", "prefix", "train", "straggler",
-                             "perf"],
+                             "perf", "serve-fleet"],
                     default="serving")
     ap.add_argument("--prefix-share", type=float, default=0.75,
                     help="--suite prefix: fraction of every prompt that is "
@@ -705,10 +1122,13 @@ def run_sweep(argv=None):
     ap.add_argument("--json", default=None)
     args = ap.parse_args(argv)
 
-    if args.suite in ("train", "straggler", "prefix", "perf"):
+    if args.suite in ("train", "straggler", "prefix", "perf",
+                      "serve-fleet"):
         report = (run_train_suite() if args.suite == "train"
                   else run_straggler_suite() if args.suite == "straggler"
                   else run_perf_suite(args) if args.suite == "perf"
+                  else run_serve_fleet_suite(args)
+                  if args.suite == "serve-fleet"
                   else run_prefix_suite(args))
         if args.json:
             with open(args.json, "w") as f:
@@ -762,7 +1182,8 @@ def main(argv=None):
     print(json.dumps(report, indent=2))
     for r in report["results"]:
         status = "OK " if r["survived"] else "DIED"
-        if report.get("suite") in ("train", "straggler", "perf"):
+        if report.get("suite") in ("train", "straggler", "perf",
+                                   "serve-fleet"):
             detail = " ".join(f"{k}={v}" for k, v in r.items()
                               if k not in ("scenario", "survived"))
             print(f"[{status}] {r['scenario']:<26} {detail}",

@@ -56,6 +56,9 @@ DIRECTIONS = {
     "prefix_ttft_speedup": "higher",
     "prefix_tok_per_sec": "higher",
     "prefix_hit_rate": "higher",
+    "fleet_tok_per_sec": "higher",
+    "fleet_ttft_mean_s": "lower",
+    "fleet_ttft_p95_s": "lower",
 }
 
 
@@ -71,6 +74,12 @@ def extract_metrics(doc: dict) -> tuple[str, dict]:
         put("train_tok_per_sec", doc.get("value"))
         put("mfu", (doc.get("extra") or {}).get("mfu"))
         return "train", metrics
+    if doc.get("mode") == "fleet" or isinstance(doc.get("fleet"), dict):
+        f = doc.get("fleet") or {}
+        put("fleet_tok_per_sec", f.get("tok_per_sec"))
+        put("fleet_ttft_mean_s", f.get("ttft_mean_s"))
+        put("fleet_ttft_p95_s", f.get("ttft_p95_s"))
+        return "serving_fleet", metrics
     if doc.get("mode") == "prefix" or isinstance(doc.get("prefix"), dict):
         p = doc.get("prefix") or {}
         put("prefix_ttft_warm_s", p.get("ttft_warm_on_s"))

@@ -17,6 +17,15 @@ Usage:
         [--metrics-out METRICS.json] [--telemetry on|off]
         [--slo-ttft-ms 200 --slo-tpot-ms 50]
         [--prefix-share 0.9]
+        [--fleet 2]
+
+``--fleet N`` benches the production front door instead of a bare engine:
+N LocalReplica engines behind the FleetRouter + HTTP gateway, driven by
+streaming SSE clients. The JSON gains a ``fleet`` block — client-measured
+TTFT (to first SSE chunk) and tokens/s, shed/failover/affinity counts,
+and one SLO block per replica — gated by ``tools/perf_gate.py`` as bench
+kind ``serving_fleet`` (metrics ``fleet_tok_per_sec``,
+``fleet_ttft_mean_s``, ``fleet_ttft_p95_s``).
 
 ``--prefix-share <frac>`` switches to the shared-prefix workload: every
 prompt starts with the same ``frac * prompt_len`` tokens (the "system
@@ -161,6 +170,140 @@ def run_prefix_bench(args, slo_kw):
             "prefix-cache-on outputs diverged from prefix-cache-off")
 
 
+def run_fleet_bench(args, slo_kw):
+    """``--fleet N``: drive the HTTP gateway over N LocalReplica engines
+    with streaming clients — the client-measured numbers (TTFT to first
+    SSE chunk, end-to-end tokens/s) plus the router's fleet view
+    (per-replica SLO blocks, shed/failover/affinity counts), gateable by
+    ``tools/perf_gate.py`` as bench kind ``serving_fleet``."""
+    import http.client
+    import threading
+
+    from paddle_tpu.serving import FleetRouter, Gateway, LocalReplica
+
+    plen = args.prompt_len if args.prompt_len is not None else 32
+    slots = args.slots if args.slots is not None else 4
+    max_len = plen + args.max_new
+
+    def build_model():
+        paddle_tpu.seed(0)
+        cfg = llama_tiny(vocab=args.vocab, hidden=args.hidden,
+                         layers=args.layers, heads=4, kv_heads=2,
+                         inter=2 * args.hidden, seq=2 * max_len)
+        return LlamaForCausalLM(cfg)
+
+    def factory():
+        return LLMEngine(build_model(), block_size=args.block_size,
+                         max_slots=slots, max_model_len=max_len, **slo_kw)
+
+    reps = [LocalReplica(f"r{i}", factory, stats_interval_s=0.05,
+                         warmup=list(range(1, plen + 1)))
+            for i in range(args.fleet)]
+    router = FleetRouter(reps, probe_interval_s=0.2, probe_timeout_s=30.0,
+                         affinity_block_size=args.block_size).start(
+        wait_healthy_s=600)
+    gateway = Gateway(router).start()
+
+    rng = np.random.RandomState(0)
+    prompts = [[int(t) for t in rng.randint(0, args.vocab, plen)]
+               for _ in range(args.requests)]
+
+    class Client(threading.Thread):
+        def __init__(self, prompt):
+            super().__init__(daemon=True)
+            self.prompt = prompt
+            self.status = None
+            self.tokens = []
+            self.ttft = None
+            self.error = None
+
+        def run(self):
+            t0 = time.perf_counter()
+            conn = http.client.HTTPConnection(gateway.host, gateway.port,
+                                              timeout=600)
+            conn.request("POST", "/v1/completions", json.dumps(
+                {"prompt": self.prompt, "max_tokens": args.max_new,
+                 "stream": True}), {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            self.status = resp.status
+            if resp.status != 200:
+                self.error = resp.read().decode()[:200]
+                conn.close()
+                return
+            while True:
+                line = resp.readline()
+                if not line:
+                    break
+                line = line.decode().strip()
+                if not line.startswith("data: "):
+                    continue
+                if line == "data: [DONE]":
+                    break
+                ch = json.loads(line[6:])["choices"][0]
+                ids = ch.get("token_ids") or []
+                if ids and self.ttft is None:
+                    self.ttft = time.perf_counter() - t0
+                self.tokens += ids
+                if ch.get("finish_reason"):
+                    pass
+            conn.close()
+
+    try:
+        t0 = time.perf_counter()
+        clients = [Client(p) for p in prompts]
+        for c in clients:
+            c.start()
+        for c in clients:
+            c.join(600)
+        dt = time.perf_counter() - t0
+        st = router.stats()
+        n_tokens = sum(len(c.tokens) for c in clients)
+        ttfts = sorted(c.ttft for c in clients if c.ttft is not None)
+        result = {
+            "mode": "fleet",
+            "requests": args.requests,
+            "prompt_len": plen,
+            "max_new_tokens": args.max_new,
+            "telemetry": args.telemetry,
+            "fleet": {
+                "replicas": args.fleet,
+                "wall_sec": dt,
+                "generated_tokens": n_tokens,
+                "tok_per_sec": n_tokens / dt if dt > 0 else 0.0,
+                "ttft_mean_s": _mean(ttfts),
+                "ttft_p95_s": (ttfts[int(0.95 * (len(ttfts) - 1))]
+                               if ttfts else None),
+                "http_errors": sum(1 for c in clients
+                                   if c.status != 200 or c.error),
+                "shed_total": st["shed"],
+                "failovers_total": st["failovers"],
+                "retries_total": st["retries"],
+                "affinity_hits": st["affinity_hits"],
+                "dispatches": st["dispatches"],
+                # one SLO block per replica, straight off the heartbeats —
+                # the per-replica goodput/p99 view a fleet dashboard plots
+                "per_replica": {
+                    rid: {"state": v["state"], "slo": v["slo"],
+                          "generated_tokens":
+                              (v["stats"] or {}).get("generated_tokens")}
+                    for rid, v in st["replicas"].items()},
+            },
+            "__meta__": _perf.run_meta(),
+        }
+    finally:
+        gateway.stop()
+        router.close()
+    print(json.dumps(result, indent=2))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(result, f, indent=2)
+    if args.metrics_out:
+        telemetry.registry().snapshot_json(args.metrics_out)
+        print(f"# metrics snapshot -> {args.metrics_out}", file=sys.stderr)
+    if result["fleet"]["http_errors"]:
+        raise SystemExit("fleet bench saw failed requests")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=8)
@@ -189,6 +332,11 @@ def main():
                          "prompt is one common prefix; benches the prefix "
                          "cache on vs off (hit rate, blocks saved, warm "
                          "TTFT)")
+    ap.add_argument("--fleet", type=int, default=None, metavar="N",
+                    help="drive the HTTP gateway over N engine replicas "
+                         "(streaming clients; reports client-side TTFT, "
+                         "tokens/s, per-replica SLO blocks, shed/failover "
+                         "counts — docs/SERVING.md \"Fleet serving\")")
     args = ap.parse_args()
 
     if args.telemetry == "off":
@@ -199,6 +347,9 @@ def main():
                     if args.slo_ttft_ms is not None else None),
         slo_tpot_s=(args.slo_tpot_ms / 1e3
                     if args.slo_tpot_ms is not None else None))
+    if args.fleet is not None:
+        run_fleet_bench(args, slo_kw)
+        return
     if args.prefix_share is not None:
         run_prefix_bench(args, slo_kw)
         return
