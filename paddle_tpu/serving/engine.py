@@ -117,6 +117,11 @@ def _engine_metrics(label: str) -> SimpleNamespace:
                      "peak live KV blocks this run"),
         utilization=G("serving_cache_utilization",
                       "live / usable KV block fraction"),
+        roofline=reg.gauge(
+            "serving_roofline_frac",
+            "achieved fraction of the roofline-model step time "
+            "(rolling mean per engine and step kind)",
+            ("engine", "kind")),
         ttft=H("serving_ttft_seconds",
                "request arrival to first emitted token"),
         tpot=H("serving_tpot_seconds",
@@ -207,9 +212,24 @@ class LLMEngine:
         self._next_rid = 0
         self._decode_fn = None
         self._prefill_fns: dict[int, object] = {}
+        self._py_fns: dict = {}            # trace key -> python callable
         self.decode_traces = 0
         self.prefill_traces: dict[int, int] = {}
         self._donate = (2,) if active_platform() == "tpu" else ()
+
+        # roofline cost model (telemetry.cost): each new trace is walked
+        # for FLOPs/HBM bytes at creation (jaxpr only, no extra compile);
+        # per-step achieved-fraction-of-roofline feeds stats()["perf"].
+        # The fingerprint keys the process-global cost registry so
+        # identical engines (fleet replicas, tests) share one estimate.
+        self._cost_fp = (
+            cfg.vocab_size, cfg.hidden_size, cfg.intermediate_size,
+            cfg.num_hidden_layers, cfg.num_attention_heads,
+            cfg.num_key_value_heads, self.block_size, self.max_slots,
+            self.max_blocks, str(kv_dtype))
+        self._suspend_trace_counts = False  # cost tracing must not count
+        self._trace_costs: dict[tuple, dict] = {}   # (kind, bucket) -> est
+        self._roofline_fracs: dict[str, list] = {"prefill": [], "decode": []}
 
         # performance observability (telemetry.perf): compile watching on
         # the bucketed prefill/decode traces, per-tag memory accounting,
@@ -245,15 +265,21 @@ class LLMEngine:
     # public API
     # ------------------------------------------------------------------
     def add_request(self, prompt, sampling: SamplingParams | None = None,
-                    on_token=None, deadline_s: float | None = None) -> Request:
+                    on_token=None, deadline_s: float | None = None,
+                    trace_id: str | None = None,
+                    trace_parent: int | None = None) -> Request:
         """Queue a prompt (list/array of token ids); returns the live
         request handle (``output_tokens`` grows as the engine steps;
         ``on_token(req, tok)`` streams each new token). ``deadline_s``
         bounds the request's total wall time: past it, the request is
-        CANCELLED with :class:`DeadlineExceeded` attached."""
+        CANCELLED with :class:`DeadlineExceeded` attached. ``trace_id``
+        is the request-trace context a gateway/router minted: every span
+        this request produces carries it, and the replica protocol streams
+        those spans back for the per-request merged Chrome trace."""
         req = Request(rid=self._next_rid, prompt=[int(t) for t in prompt],
                       sampling=sampling or SamplingParams(),
-                      on_token=on_token)
+                      on_token=on_token, trace_id=trace_id,
+                      trace_parent=trace_parent)
         if deadline_s is not None:
             req.deadline = time.monotonic() + float(deadline_s)
         self._next_rid += 1
@@ -430,7 +456,79 @@ class LLMEngine:
                 if storms else None),
             "decode_step": self._decode_tl.report(),
             "memory": self._mm.snapshot(),
+            "roofline": self._roofline_block(),
         }
+
+    # ------------------------------------------------------------------
+    # roofline cost model (telemetry.cost)
+    # ------------------------------------------------------------------
+    def _trace_cost(self, kind: str, bucket: str, py_key,
+                    call_args) -> dict | None:
+        """FLOPs/bytes of one compiled trace, estimated once at trace
+        creation: jaxpr walk over the exact python callable + concrete
+        arguments the engine just jitted (no extra XLA compile). The
+        process-global registry (fingerprinted by model config + engine
+        geometry) dedupes across fleet replicas and repeated engines."""
+        name = f"engine.{kind}"
+        est = telemetry.cost.lookup(name, bucket, self._cost_fp)
+        if est is None and telemetry.enabled():
+            try:
+                self._suspend_trace_counts = True
+                est = telemetry.cost.estimate_fn_cost(
+                    self._py_fns[py_key], *call_args)
+            except Exception:
+                est = None
+            finally:
+                self._suspend_trace_counts = False
+            if est is not None:
+                est = telemetry.cost.register_trace(
+                    name, bucket, est, fingerprint=self._cost_fp,
+                    engine=self.engine_label)
+        if est is not None:
+            self._trace_costs[(kind, bucket)] = est
+        return est
+
+    def _note_roofline(self, kind: str, bucket: str, wall_s: float):
+        """One steady-state step's achieved fraction of the roofline-model
+        time (compile steps are excluded by the callers)."""
+        est = self._trace_costs.get((kind, bucket))
+        if est is None or not wall_s or not telemetry.enabled():
+            return
+        frac = telemetry.cost.achieved_fraction(est, wall_s)
+        if frac is None:
+            return
+        fracs = self._roofline_fracs[kind]
+        fracs.append(frac)
+        if len(fracs) > 256:
+            del fracs[:len(fracs) - 256]
+        self._m.roofline.labels(engine=self.engine_label, kind=kind).set(
+            sum(fracs) / len(fracs))
+
+    def _roofline_block(self) -> dict:
+        """stats()["perf"]["roofline"]: per-kind modeled cost + achieved
+        fraction — the serving analogue of the training MFU headline."""
+        out = {"peaks": telemetry.cost.platform_peaks()}
+        for kind in ("prefill", "decode"):
+            buckets = {b: e for (k, b), e in self._trace_costs.items()
+                       if k == kind}
+            fracs = self._roofline_fracs[kind]
+            entry = {
+                "buckets": {
+                    b: {"flops": e["flops"], "bytes": e["bytes"],
+                        "arithmetic_intensity":
+                            round(e["arithmetic_intensity"], 3)}
+                    for b, e in sorted(buckets.items())},
+                "achieved_frac_mean": (sum(fracs) / len(fracs)
+                                       if fracs else None),
+                "achieved_frac_last": fracs[-1] if fracs else None,
+                "samples": len(fracs),
+            }
+            out[kind] = entry
+        dec = self._trace_costs.get(("decode", "decode"))
+        out["decode_ai"] = (round(dec["arithmetic_intensity"], 3)
+                            if dec else None)
+        out["serving_roofline_frac"] = out["decode"]["achieved_frac_mean"]
+        return out
 
     def _mean_ttft_direct(self):
         ttfts = [r.ttft for r in self.finished if r.ttft is not None]
@@ -467,9 +565,11 @@ class LLMEngine:
             queue_time = (req.admit_time - req.arrival_time
                           if req.admit_time is not None else None)
             self.slo.record_finished(ttft=req.ttft, tpot=tpot,
-                                     queue_time=queue_time, tokens=n)
+                                     queue_time=queue_time, tokens=n,
+                                     trace_id=req.trace_id)
         else:
-            self.slo.record_failed(tokens=len(req.output_tokens))
+            self.slo.record_failed(tokens=len(req.output_tokens),
+                                   trace_id=req.trace_id)
 
     def _sync_gauges(self):
         alloc = self.cache.allocator
@@ -495,28 +595,37 @@ class LLMEngine:
         tr = telemetry.tracer()
         tid = 100_000 + req.rid
         tid_name = f"request-{req.rid}"
-        root = tr.emit(
-            "request", req.arrival_time, req.finish_time,
-            attrs={"rid": req.rid, "engine": self.engine_label,
-                   "state": req.state.value, "reason": req.finish_reason,
-                   "prompt_tokens": len(req.prompt),
-                   "output_tokens": len(req.output_tokens),
-                   "preemptions": req.num_preemptions},
-            tid=tid, tid_name=tid_name)
+        # request-trace context rides every lifecycle span (incl. the
+        # engine label, so a LocalReplica driver sharing this process's
+        # tracer can heartbeat only its own engine's spans)
+        ctx = {"engine": self.engine_label}
+        if req.trace_id:
+            ctx["trace_id"] = req.trace_id
+        root_attrs = {"rid": req.rid,
+                      "state": req.state.value, "reason": req.finish_reason,
+                      "prompt_tokens": len(req.prompt),
+                      "output_tokens": len(req.output_tokens),
+                      "preemptions": req.num_preemptions, **ctx}
+        if req.trace_parent is not None:
+            root_attrs["trace_parent"] = req.trace_parent
+        root = tr.emit("request", req.arrival_time, req.finish_time,
+                       attrs=root_attrs, tid=tid, tid_name=tid_name)
         if root is None:          # telemetry disabled
             return
         queued_end = req.admit_time or req.finish_time
         tr.emit("queued", req.arrival_time, queued_end,
-                attrs={"rid": req.rid}, parent_id=root.span_id, tid=tid)
+                attrs={"rid": req.rid, **ctx}, parent_id=root.span_id,
+                tid=tid)
         if req.admit_time is not None:
             prefill_end = req.first_token_time or req.finish_time
             tr.emit("prefill", req.admit_time, prefill_end,
-                    attrs={"rid": req.rid, "tokens": len(req.prompt)},
+                    attrs={"rid": req.rid, "tokens": len(req.prompt),
+                           **ctx},
                     parent_id=root.span_id, tid=tid)
         if req.first_token_time is not None:
             tr.emit("decode", req.first_token_time, req.finish_time,
                     attrs={"rid": req.rid,
-                           "tokens": len(req.output_tokens)},
+                           "tokens": len(req.output_tokens), **ctx},
                     parent_id=root.span_id, tid=tid)
 
     # ------------------------------------------------------------------
@@ -616,7 +725,8 @@ class LLMEngine:
 
         def prefill(params, buffers, pool, tokens, length, bt,
                     temp, top_k, top_p, seed, step_idx):
-            self.prefill_traces[P] = self.prefill_traces.get(P, 0) + 1
+            if not self._suspend_trace_counts:   # cost walks retrace too
+                self.prefill_traces[P] = self.prefill_traces.get(P, 0) + 1
             view = PagedCacheView(pool, bt[None, :], None, self.block_size)
             positions = jnp.arange(P, dtype=jnp.int32)[None]
             logits, _ = functional_call(
@@ -629,6 +739,7 @@ class LLMEngine:
 
         fn = jax.jit(prefill, donate_argnums=self._donate)
         self._prefill_fns[P] = fn
+        self._py_fns[P] = prefill
         return fn
 
     def _get_tail_prefill_fn(self, P: int, NPB: int):
@@ -646,7 +757,8 @@ class LLMEngine:
 
         def tail_prefill(params, buffers, pool, tokens, length, bt, pbt,
                          prefix_len, temp, top_k, top_p, seed, step_idx):
-            self.prefill_traces[key] = self.prefill_traces.get(key, 0) + 1
+            if not self._suspend_trace_counts:
+                self.prefill_traces[key] = self.prefill_traces.get(key, 0) + 1
             view = PagedCacheView(
                 pool, bt[None, :], None, self.block_size,
                 prefix_block_tables=pbt[None, :], prefix_len=prefix_len)
@@ -662,6 +774,7 @@ class LLMEngine:
 
         fn = jax.jit(tail_prefill, donate_argnums=self._donate)
         self._prefill_fns[key] = fn
+        self._py_fns[key] = tail_prefill
         return fn
 
     def _run_prefill(self, slot: int, req: Request):
@@ -678,20 +791,29 @@ class LLMEngine:
         sp = req.sampling
         new_trace = P not in self._prefill_fns
         self._mm.set("activations_estimate", self._act_estimate(P))
+        fn = self._get_prefill_fn(P)
+        call_args = (
+            self.params, self.buffers, self.cache.pool,
+            jnp.asarray(padded), jnp.int32(L), jnp.asarray(bt),
+            jnp.float32(sp.temperature), jnp.int32(sp.top_k),
+            jnp.float32(sp.top_p), jnp.int32(sp.seed),
+            jnp.int32(len(req.output_tokens)))
+        cost_est = (self._trace_cost("prefill", f"P{P}", P, call_args)
+                    if new_trace else None)
         t0 = time.monotonic()
         with telemetry.span("engine.prefill", rid=req.rid, tokens=L,
-                            padded=P):
-            tok, pool = self._get_prefill_fn(P)(
-                self.params, self.buffers, self.cache.pool,
-                jnp.asarray(padded), jnp.int32(L), jnp.asarray(bt),
-                jnp.float32(sp.temperature), jnp.int32(sp.top_k),
-                jnp.float32(sp.top_p), jnp.int32(sp.seed),
-                jnp.int32(len(req.output_tokens)))
+                            padded=P, engine=self.engine_label,
+                            **({"trace_id": req.trace_id}
+                               if req.trace_id else {})):
+            tok, pool = fn(*call_args)
+        wall = time.monotonic() - t0
         self._watcher.record_call(
             "engine.prefill",
             (("tokens", (P,), "int32"),
              ("block_table", (P // self.block_size,), "int32")),
-            wall_s=time.monotonic() - t0 if new_trace else None)
+            wall_s=wall if new_trace else None, cost=cost_est)
+        if not new_trace:
+            self._note_roofline("prefill", f"P{P}", wall)
         self.cache.pool = pool
         self.cache.commit_prefix(req.rid, toks)
         self._emit(slot, req, int(tok))
@@ -718,22 +840,33 @@ class LLMEngine:
         sp = req.sampling
         new_trace = (P, NPB) not in self._prefill_fns
         self._mm.set("activations_estimate", self._act_estimate(P))
+        fn = self._get_tail_prefill_fn(P, NPB)
+        call_args = (
+            self.params, self.buffers, self.cache.pool,
+            jnp.asarray(padded), jnp.int32(L), jnp.asarray(bt),
+            jnp.asarray(pbt), jnp.int32(cached),
+            jnp.float32(sp.temperature), jnp.int32(sp.top_k),
+            jnp.float32(sp.top_p), jnp.int32(sp.seed),
+            jnp.int32(len(req.output_tokens)))
+        bucket = f"P{P}-NPB{NPB}"
+        cost_est = (self._trace_cost("prefill", bucket, (P, NPB), call_args)
+                    if new_trace else None)
         t0 = time.monotonic()
         with telemetry.span("engine.prefill", rid=req.rid, tokens=L,
-                            padded=P, cached=cached):
-            tok, pool = self._get_tail_prefill_fn(P, NPB)(
-                self.params, self.buffers, self.cache.pool,
-                jnp.asarray(padded), jnp.int32(L), jnp.asarray(bt),
-                jnp.asarray(pbt), jnp.int32(cached),
-                jnp.float32(sp.temperature), jnp.int32(sp.top_k),
-                jnp.float32(sp.top_p), jnp.int32(sp.seed),
-                jnp.int32(len(req.output_tokens)))
+                            padded=P, cached=cached,
+                            engine=self.engine_label,
+                            **({"trace_id": req.trace_id}
+                               if req.trace_id else {})):
+            tok, pool = fn(*call_args)
+        wall = time.monotonic() - t0
         self._watcher.record_call(
             "engine.prefill",
             (("tokens", (P,), "int32"),
              ("block_table", (P // bs,), "int32"),
              ("prefix_table", (NPB,), "int32")),
-            wall_s=time.monotonic() - t0 if new_trace else None)
+            wall_s=wall if new_trace else None, cost=cost_est)
+        if not new_trace:
+            self._note_roofline("prefill", bucket, wall)
         self.cache.pool = pool
         self.cache.commit_prefix(req.rid, toks)
         self._emit(slot, req, int(tok))
@@ -749,7 +882,8 @@ class LLMEngine:
 
         def decode(params, buffers, pool, tokens, bt, ctx,
                    temps, top_ks, top_ps, seeds, step_idx):
-            self.decode_traces += 1
+            if not self._suspend_trace_counts:
+                self.decode_traces += 1
             view = PagedCacheView(pool, bt, ctx, self.block_size)
             logits, _ = functional_call(
                 model, params, buffers, tokens[:, None], cache=view,
@@ -762,6 +896,7 @@ class LLMEngine:
             return toks, view.pool
 
         self._decode_fn = jax.jit(decode, donate_argnums=self._donate)
+        self._py_fns["decode"] = decode
         return self._decode_fn
 
     def _run_decode(self):
@@ -803,17 +938,29 @@ class LLMEngine:
 
         new_trace = self._decode_fn is None
         self._mm.set("activations_estimate", self._act_estimate(S))
+        # batch-level decode ticks carry every member request's trace
+        # context so per-request merged traces can include them
+        span_kw = {}
+        tids = [r.trace_id for r in running.values() if r.trace_id]
+        if tids:
+            span_kw["trace_ids"] = tids
+        cost_est = None
         t0 = time.monotonic()
         try:
             with telemetry.span("engine.decode", batch=len(running),
-                                engine=self.engine_label):
+                                engine=self.engine_label, **span_kw):
                 faults.inject("serving.decode", batch=len(running))
-                toks, pool = self._get_decode_fn()(
+                fn = self._get_decode_fn()
+                call_args = (
                     self.params, self.buffers, self.cache.pool,
                     jnp.asarray(tokens), jnp.asarray(bt), jnp.asarray(ctx),
                     jnp.asarray(temps), jnp.asarray(top_ks),
                     jnp.asarray(top_ps), jnp.asarray(seeds),
                     jnp.asarray(steps))
+                cost_est = (
+                    self._trace_cost("decode", "decode", "decode", call_args)
+                    if new_trace else None)
+                toks, pool = fn(*call_args)
         except Exception as e:
             # the fused step died: every request in the batch fails, the
             # engine itself (and the waiting queue) survives
@@ -830,7 +977,8 @@ class LLMEngine:
                 "engine.decode",
                 (("tokens", (S,), "int32"),
                  ("block_tables", (S, self.max_blocks), "int32")),
-                wall_s=self.last_decode_s if new_trace else None)
+                wall_s=self.last_decode_s if new_trace else None,
+                cost=cost_est)
             self._m.decode_step.observe(self.last_decode_s)
             if (self.watchdog_timeout_s is not None
                     and self.last_decode_s > self.watchdog_timeout_s):
@@ -840,6 +988,8 @@ class LLMEngine:
                     "engine.watchdog_trip", engine=self.engine_label,
                     decode_s=self.last_decode_s,
                     limit_s=self.watchdog_timeout_s)
+        if not new_trace:
+            self._note_roofline("decode", "decode", self.last_decode_s)
         self.cache.pool = pool
         if self.prefix_cache:
             # a decode write that just filled its block completes another
@@ -858,7 +1008,12 @@ class LLMEngine:
         self._total_generated += 1
         self._m.tokens.inc()
         if len(req.output_tokens) == 1:
-            self._m.ttft.observe(req.ttft)
+            # the trace-id exemplar links a slow TTFT bucket straight to
+            # the request trace that landed in it (OpenMetrics exemplars)
+            self._m.ttft.observe(
+                req.ttft,
+                exemplar=({"trace_id": req.trace_id}
+                          if req.trace_id else None))
         if (self.eos_token_id is not None and token == self.eos_token_id):
             self._finish(slot, "stop")
         elif len(req.output_tokens) >= req.sampling.max_new_tokens:
@@ -871,7 +1026,9 @@ class LLMEngine:
         n = len(req.output_tokens)
         if n > 1 and req.first_token_time is not None:
             self._m.tpot.observe(
-                (req.finish_time - req.first_token_time) / (n - 1))
+                (req.finish_time - req.first_token_time) / (n - 1),
+                exemplar=({"trace_id": req.trace_id}
+                          if req.trace_id else None))
         self._record_lifecycle(req)
 
 

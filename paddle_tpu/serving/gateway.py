@@ -40,6 +40,7 @@ import time
 from types import SimpleNamespace
 
 from .. import telemetry
+from ..telemetry import reqtrace
 from ..utils import faults
 from .router import NoHealthyReplica, RouterShed
 
@@ -252,6 +253,8 @@ class Gateway:
                     raise _HTTPError(405, "POST only")
                 return await self._route_completions(
                     req, writer, chat=req.path.endswith("chat/completions"))
+            if req.path.startswith("/v1/traces/"):
+                return await self._route_trace(req, writer)
             raise _HTTPError(404, f"no route {req.path}")
         except _HTTPError as e:
             await self._write_response(
@@ -293,6 +296,20 @@ class Gateway:
              "healthy_replicas": st["healthy"],
              "replicas": {r: v["state"] for r, v in st["replicas"].items()},
              "inflight": st["inflight"]})
+        return True
+
+    async def _route_trace(self, req, writer) -> bool:
+        """``GET /v1/traces/<id>``: the merged per-request Chrome trace
+        (id = completion id ``cmpl-<gid>``, a raw gid, or the ``trace_id``
+        the response's ``paddle_tpu`` block carried). This is what
+        ``tools/trace_view.py --gateway`` renders as a waterfall."""
+        key = req.path.rsplit("/", 1)[1]
+        try:
+            doc = self.router.request_trace(key)
+        except KeyError:
+            raise _HTTPError(404, f"no request trace for {key!r} (traces "
+                                  "are retained for recent requests only)")
+        await self._write_response(writer, 200, doc)
         return True
 
     async def _route_metrics(self, writer) -> bool:
@@ -356,20 +373,32 @@ class Gateway:
         def on_finish(rr):
             loop.call_soon_threadsafe(q.put_nowait, ("done", None))
 
+        # the gateway mints the request-trace context: this id follows the
+        # request through the router into every replica hop, and names the
+        # merged trace at GET /v1/traces/<id>
+        trace_id = reqtrace.new_trace_id()
+        t_req0 = time.monotonic()
         # RouterShed / NoHealthyReplica propagate to _handle's mapping
         rr = self.router.submit(
             p["prompt"], p["sampling"], priority=p["priority"],
             deadline_s=p["deadline_s"], on_token=on_token,
-            on_finish=on_finish)
+            on_finish=on_finish, trace_id=trace_id)
         rid = f"{'chatcmpl' if chat else 'cmpl'}-{rr.gid}"
-        if p["stream"]:
-            return await self._stream(writer, rr, rid, q, chat)
-        while True:                       # non-streaming: drain to terminal
-            kind, _ = await q.get()
-            if kind == "done":
-                break
-        return await self._finish_response(writer, rr, rid, chat,
-                                           len(p["prompt"]))
+        try:
+            if p["stream"]:
+                return await self._stream(writer, rr, rid, q, chat)
+            while True:                   # non-streaming: drain to terminal
+                kind, _ = await q.get()
+                if kind == "done":
+                    break
+            return await self._finish_response(writer, rr, rid, chat,
+                                               len(p["prompt"]))
+        finally:
+            telemetry.tracer().emit(
+                "gateway.request", t_req0, time.monotonic(),
+                attrs={"trace_id": trace_id, "gid": rr.gid,
+                       "route": "chat" if chat else "completions",
+                       "stream": p["stream"], "tokens": len(rr.tokens)})
 
     async def _finish_response(self, writer, rr, rid, chat, n_prompt) -> bool:
         if rr.state == "failed":
@@ -400,7 +429,8 @@ class Gateway:
                       "total_tokens": n_prompt + len(rr.tokens)},
             "paddle_tpu": {"replica": rr.replica,
                            "failovers": rr.failovers,
-                           "retries": rr.retries}})
+                           "retries": rr.retries,
+                           "trace_id": rr.trace_id}})
         return True
 
     async def _stream(self, writer, rr, rid, q, chat) -> bool:
@@ -430,19 +460,28 @@ class Gateway:
                 doc["error"] = {"message": error, "type": "server_error"}
             return f"data: {json.dumps(doc)}\n\n".encode()
 
+        t_first = None
         try:
             while True:
                 kind, tok = await q.get()
                 if kind == "tok":
+                    if t_first is None:
+                        t_first = time.monotonic()
                     self._m.tokens.inc()
                     writer.write(chunk(tok=tok))
                     await writer.drain()
                     continue
                 break                                    # done
             finish = (rr.finish_reason or rr.state)
-            writer.write(chunk(finish=finish,
-                               error=rr.error if rr.state == "failed"
-                               else None))
+            final = chunk(finish=finish,
+                          error=rr.error if rr.state == "failed" else None)
+            # the trace id rides the final chunk so an SSE client can pull
+            # GET /v1/traces/<id> for its own request
+            doc = json.loads(final[6:-2])
+            doc["paddle_tpu"] = {"trace_id": rr.trace_id,
+                                 "replica": rr.replica,
+                                 "failovers": rr.failovers}
+            writer.write(f"data: {json.dumps(doc)}\n\n".encode())
             writer.write(b"data: [DONE]\n\n")
             await writer.drain()
         except (ConnectionError, asyncio.CancelledError):
@@ -450,4 +489,11 @@ class Gateway:
             self.router.cancel(rr.gid)
         finally:
             self._m.active.dec()
+            if t_first is not None:
+                # SSE-flush window: first chunk written -> stream closed
+                # (the waterfall's "how long did streaming take" row)
+                telemetry.tracer().emit(
+                    "gateway.sse", t_first, time.monotonic(),
+                    attrs={"trace_id": rr.trace_id, "gid": rr.gid,
+                           "tokens": len(rr.tokens)})
         return False                        # Connection: close

@@ -15,15 +15,24 @@ that makes failover replay token-for-token exact:
 Protocol (one JSON object per line):
 
     stdin  <- {"op": "add", "gid": 7, "prompt": [...],
-               "sampling": {...}, "deadline_s": 1.5 | null}
+               "sampling": {...}, "deadline_s": 1.5 | null,
+               "trace_id": "req-ab12cd" | null}
               {"op": "cancel", "gid": 7}
               {"op": "close"}
     stdout -> {"ev": "hello", "pid": 1234}
               {"ev": "token", "gid": 7, "tok": 42, "i": 0}
               {"ev": "done", "gid": 7, "state": "finished",
                "reason": "length", "error": null, "n": 16}
-              {"ev": "stats", "stats": {... replica_stats() ...}}
+              {"ev": "stats", "stats": {... replica_stats() ...},
+               "spans": [... optional: request-scoped spans since the
+                         last heartbeat, unix-stamped wire format —
+                         telemetry.reqtrace ...]}
               {"ev": "bye"}
+
+``trace_id`` is the router/gateway-minted request-trace context: the
+engine stamps it on every span the request produces, and the heartbeat
+streams those spans back so the router can merge one Chrome trace per
+request across replica hops (docs/OBSERVABILITY.md "Request tracing").
 
 Anything that is not protocol (import-time warnings, stray prints) fails
 JSON parsing on the router side and is ignored; diagnostics belong on
@@ -76,6 +85,7 @@ def main() -> int:
                 "jax_persistent_cache_min_compile_time_secs", 0.5)
         except Exception:
             pass
+    from ..telemetry import reqtrace
     from .engine import LLMEngine
     from .router import replica_stats, sampling_from_dict
 
@@ -134,6 +144,20 @@ def main() -> int:
                                 if req.error is not None else None),
                       "n": len(req.output_tokens)})
 
+    span_wm = 0                            # request-span drain watermark
+
+    def heartbeat():
+        nonlocal span_wm
+        ev = {"ev": "stats", "stats": replica_stats(engine)}
+        # request-scoped spans (trace-context-carrying) stream back with
+        # every heartbeat, unix-stamped, so a SIGKILL mid-request still
+        # leaves this hop's spans on the router for the merged trace
+        spans, span_wm = reqtrace.drain_request_spans(
+            span_wm, engine_label=engine.engine_label)
+        if spans:
+            ev["spans"] = spans
+        emit(ev)
+
     last_pub = 0.0
     closing = False
     while not closing:
@@ -153,7 +177,8 @@ def main() -> int:
                         cmd["prompt"],
                         sampling_from_dict(cmd.get("sampling")),
                         on_token=on_token(gid),
-                        deadline_s=cmd.get("deadline_s"))
+                        deadline_s=cmd.get("deadline_s"),
+                        trace_id=cmd.get("trace_id"))
                 except Exception as e:
                     emit({"ev": "done", "gid": gid, "state": "failed",
                           "reason": "add_failed",
@@ -170,11 +195,11 @@ def main() -> int:
         now = time.monotonic()
         if now - last_pub >= stats_interval:
             last_pub = now
-            emit({"ev": "stats", "stats": replica_stats(engine)})
+            heartbeat()
 
     engine.close()
     sweep()
-    emit({"ev": "stats", "stats": replica_stats(engine)})
+    heartbeat()
     emit({"ev": "bye"})
     return 0
 

@@ -66,6 +66,7 @@ import time
 from types import SimpleNamespace
 
 from .. import telemetry
+from ..telemetry import reqtrace
 from ..utils import faults
 from .scheduler import SamplingParams
 
@@ -133,7 +134,7 @@ class RouterRequest:
 
     def __init__(self, gid: int, prompt, sampling: dict, *, priority=0,
                  deadline: float | None = None, on_token=None,
-                 on_finish=None):
+                 on_finish=None, trace_id: str | None = None):
         self.gid = gid
         self.prompt = [int(t) for t in prompt]
         self.sampling = dict(sampling)
@@ -155,6 +156,15 @@ class RouterRequest:
         self.first_token_time: float | None = None
         self.finish_time: float | None = None
         self._done = threading.Event()
+        # request-trace context (telemetry.reqtrace): the id every hop's
+        # spans carry; remote_spans are the replica-side spans streamed
+        # back in heartbeats (wire format, unix-stamped, +replica label);
+        # hop_log records each dispatch's replica + wall window so a hop
+        # whose spans died with its replica still gets a trace row
+        self.trace_id = trace_id or reqtrace.new_trace_id()
+        self.remote_spans: list[dict] = []
+        self.hop_log: list[dict] = []
+        self._failover_t0: float | None = None
 
     @property
     def terminal(self) -> bool:
@@ -304,6 +314,20 @@ class LocalReplica:
         tracked: dict[int, object] = {}    # gid -> engine Request
         last_pub = 0.0
         closing = False
+        span_wm = 0                        # request-span drain watermark
+
+        def heartbeat():
+            nonlocal span_wm
+            ev = {"ev": "stats", "stats": replica_stats(engine)}
+            # stream request-scoped spans with the heartbeat (NOT only at
+            # terminal) so the first hop of a failover survives this
+            # replica's death; filtered to THIS engine's spans — two
+            # LocalReplica drivers share one process tracer
+            spans, span_wm = reqtrace.drain_request_spans(
+                span_wm, engine_label=engine.engine_label)
+            if spans:
+                ev["spans"] = spans
+            self._emit(gen, ev)
 
         def on_token(gid):
             def cb(req, tok):
@@ -330,7 +354,8 @@ class LocalReplica:
                             cmd["prompt"],
                             sampling_from_dict(cmd.get("sampling")),
                             on_token=on_token(gid),
-                            deadline_s=cmd.get("deadline_s"))
+                            deadline_s=cmd.get("deadline_s"),
+                            trace_id=cmd.get("trace_id"))
                         tracked[gid] = req
                     except Exception as e:
                         self._emit(gen, {
@@ -356,13 +381,12 @@ class LocalReplica:
             now = time.monotonic()
             if now - last_pub >= self.stats_interval_s:
                 last_pub = now
-                self._emit(gen, {"ev": "stats",
-                                 "stats": replica_stats(engine)})
+                heartbeat()
         if self._killed or gen != self._gen:
             return                         # abandoned, simulating SIGKILL
         engine.close()                     # graceful: terminal-ize leftovers
         self._sweep(gen, tracked)
-        self._emit(gen, {"ev": "stats", "stats": replica_stats(engine)})
+        heartbeat()
         self._emit(gen, {"ev": "bye"})
 
     def _sweep(self, gen: int, tracked: dict):
@@ -506,6 +530,9 @@ def _router_metrics() -> SimpleNamespace:
         affinity_hits=reg.counter(
             "router_affinity_hits_total",
             "placements that landed on the prefix-affinity replica"),
+        p2c=reg.counter(
+            "router_p2c_placements_total",
+            "placements decided by power-of-two-choices load fallback"),
         suppressed=reg.counter(
             "router_replay_suppressed_total",
             "replayed tokens suppressed during failover"),
@@ -589,8 +616,9 @@ class FleetRouter:
         # fleet view must not read totals back from them
         self._c = {k: 0 for k in (
             "dispatches", "failovers", "retries", "shed", "affinity_hits",
-            "replay_suppressed", "replay_mismatches", "drains",
-            "replica_restarts", "replica_deaths")}
+            "p2c_placements", "replay_suppressed", "replay_mismatches",
+            "drains", "replica_restarts", "replica_deaths")}
+        self._by_trace: dict[str, RouterRequest] = {}
         self._probe_thread: threading.Thread | None = None
         self._stop = threading.Event()
         self.closed = False
@@ -642,10 +670,14 @@ class FleetRouter:
     # -- submission --------------------------------------------------------
     def submit(self, prompt, sampling: SamplingParams | dict | None = None,
                *, priority: int = 0, deadline_s: float | None = None,
-               on_token=None, on_finish=None) -> RouterRequest:
+               on_token=None, on_finish=None,
+               trace_id: str | None = None) -> RouterRequest:
         """Place and dispatch one request; returns the live
         :class:`RouterRequest`. Raises :class:`RouterShed` (shed — retry
-        later) or :class:`NoHealthyReplica` (no capacity at all)."""
+        later) or :class:`NoHealthyReplica` (no capacity at all).
+        ``trace_id`` carries the gateway's request-trace context; without
+        one the router mints its own, so every routed request has exactly
+        one id its spans — local and replica-side — are merged under."""
         if self.closed:
             raise NoHealthyReplica("router is closed")
         faults.inject("router.submit", priority=priority)
@@ -655,12 +687,19 @@ class FleetRouter:
                     if deadline_s is not None else None)
         rr = RouterRequest(next(self._gids), prompt, sampling,
                            priority=priority, deadline=deadline,
-                           on_token=on_token, on_finish=on_finish)
+                           on_token=on_token, on_finish=on_finish,
+                           trace_id=trace_id)
+        t0 = time.monotonic()
         with self._lock:
             rep = self._place(rr.prompt, rr.priority)
             self._prune_terminal()
             self._requests[rr.gid] = rr
+            self._by_trace[rr.trace_id] = rr
             self._dispatch(rr, rep)
+        telemetry.tracer().emit(
+            "router.submit", t0, time.monotonic(),
+            attrs={"trace_id": rr.trace_id, "gid": rr.gid,
+                   "replica": rr.replica, "priority": rr.priority})
         return rr
 
     def _prune_terminal(self):
@@ -672,6 +711,7 @@ class FleetRouter:
             rr = self._requests[gid]
             if rr.terminal:
                 del self._requests[gid]
+                self._by_trace.pop(rr.trace_id, None)
                 if len(self._requests) < self._retain_terminal:
                     break
 
@@ -753,7 +793,11 @@ class FleetRouter:
                 self._m.affinity_hits.inc()
                 self._c["affinity_hits"] += 1
                 return preferred
-        # power-of-two-choices on load
+        # power-of-two-choices on load ("why did this replica get the
+        # request": every non-affinity placement counts as p2c, so the
+        # gateway /stats affinity-vs-p2c split covers all placements)
+        self._m.p2c.inc()
+        self._c["p2c_placements"] += 1
         if len(eligible) == 1:
             return eligible[0]
         a, b = self._rng.sample(eligible, 2)
@@ -764,6 +808,7 @@ class FleetRouter:
         an injected ``router.dispatch`` fault) falls through to the next
         candidate; with none left the request fails."""
         exclude = set(exclude or ())
+        t0 = time.monotonic()
         while True:
             try:
                 faults.inject("router.dispatch", replica=rep.rid,
@@ -771,7 +816,8 @@ class FleetRouter:
                 deadline_s = (rr.deadline - time.monotonic()
                               if rr.deadline is not None else None)
                 rep.send({"op": "add", "gid": rr.gid, "prompt": rr.prompt,
-                          "sampling": rr.sampling, "deadline_s": deadline_s})
+                          "sampling": rr.sampling, "deadline_s": deadline_s,
+                          "trace_id": rr.trace_id})
             except (BrokenPipeError, faults.FaultError) as e:
                 exclude.add(rep.rid)
                 try:
@@ -788,6 +834,9 @@ class FleetRouter:
         rr.replica = rep.rid
         rr.state = "running"
         rr.dispatches += 1
+        self._close_hop(rr)
+        rr.hop_log.append({"replica": rep.rid, "t0": time.monotonic(),
+                           "t1": None, "suppress": rr.suppress})
         self._inflight.setdefault(rep.rid, set()).add(rr.gid)
         self._m.dispatches.labels(replica=rep.rid).inc()
         self._c["dispatches"] += 1
@@ -795,6 +844,15 @@ class FleetRouter:
         telemetry.record_event("router.dispatch", gid=rr.gid,
                                replica=rep.rid, attempt=rr.dispatches,
                                suppress=rr.suppress)
+        telemetry.tracer().emit(
+            "router.dispatch", t0, time.monotonic(),
+            attrs={"trace_id": rr.trace_id, "gid": rr.gid,
+                   "replica": rep.rid, "attempt": rr.dispatches,
+                   "suppress": rr.suppress})
+
+    def _close_hop(self, rr: RouterRequest):
+        if rr.hop_log and rr.hop_log[-1]["t1"] is None:
+            rr.hop_log[-1]["t1"] = time.monotonic()
 
     def _untrack(self, rr: RouterRequest):
         if rr.replica is not None:
@@ -810,6 +868,8 @@ class FleetRouter:
             self._on_done(rep, ev)
         elif kind == "stats":
             self._on_stats(rep, ev.get("stats") or {})
+            if ev.get("spans"):
+                self._absorb_spans(rep, ev["spans"])
         elif kind == "hello":
             rep.pid = ev.get("pid", rep.pid)
             rep.last_heartbeat = time.monotonic()
@@ -837,6 +897,21 @@ class FleetRouter:
         if unhealthy:
             self._mark_unhealthy(rep, "engine stall-detector trip")
 
+    def _absorb_spans(self, rep, wire_spans):
+        """Replica-side request spans (streamed in heartbeats) land on the
+        owning RouterRequest, labeled with the replica they ran on. Spans
+        are bounded per request — a runaway replica cannot grow router
+        memory through its heartbeats."""
+        with self._lock:
+            for s in wire_spans:
+                if not isinstance(s, dict):
+                    continue
+                for tid in reqtrace.wire_trace_ids(s):
+                    rr = self._by_trace.get(tid)
+                    if rr is None or len(rr.remote_spans) >= 1024:
+                        continue
+                    rr.remote_spans.append({**s, "replica": rep.rid})
+
     def _on_token(self, rep, gid: int, tok: int, i: int):
         cb = None
         with self._lock:
@@ -855,6 +930,16 @@ class FleetRouter:
                         "failed", "replay_mismatch",
                         f"ReplayMismatch: token {i} replayed as {tok}, "
                         f"client already saw {rr.tokens[i]}")
+                    return
+                if i == rr.suppress - 1 and rr._failover_t0 is not None:
+                    # the whole replay verified: annotate the suppressed
+                    # window on the request trace
+                    telemetry.tracer().emit(
+                        "router.replay_suppressed", rr._failover_t0,
+                        time.monotonic(),
+                        attrs={"trace_id": rr.trace_id, "gid": gid,
+                               "replica": rep.rid, "tokens": rr.suppress})
+                    rr._failover_t0 = None
                 return
             if i != len(rr.tokens):
                 return                      # duplicate/out-of-order: drop
@@ -874,6 +959,7 @@ class FleetRouter:
             if rr is None or rr.terminal or rr.replica != rep.rid:
                 return
             self._untrack(rr)
+            self._close_hop(rr)
             if state == "finished":
                 rr._finish("finished", reason or "stop", None)
                 return
@@ -888,10 +974,12 @@ class FleetRouter:
             # is a deterministic property of the request itself
             retryable = not (error or "").startswith(_NON_RETRYABLE)
             if retryable and rr.retries < self.max_retries:
+                t0 = time.monotonic()
                 rr.retries += 1
                 self._m.retries.inc()
                 self._c["retries"] += 1
                 rr.suppress = len(rr.tokens)
+                rr._failover_t0 = t0
                 try:
                     rep2 = self._place(rr.prompt, rr.priority,
                                        exclude={rep.rid}, bypass_shed=True)
@@ -902,6 +990,11 @@ class FleetRouter:
                                        from_replica=rep.rid,
                                        to_replica=rep2.rid, error=error)
                 self._dispatch(rr, rep2, exclude={rep.rid})
+                telemetry.tracer().emit(
+                    "router.retry", t0, time.monotonic(),
+                    attrs={"trace_id": rr.trace_id, "gid": gid,
+                           "from_replica": rep.rid, "to_replica": rr.replica,
+                           "error": error})
                 return
             rr._finish("failed", reason, error)
 
@@ -935,8 +1028,12 @@ class FleetRouter:
         """Re-dispatch an orphaned in-flight request (under the lock):
         original prompt + sampling, already-streamed tokens replayed and
         suppressed. Never shed — this stream is already in flight."""
+        t0 = time.monotonic()
+        from_replica = rr.replica
         rr.failovers += 1
         rr.suppress = len(rr.tokens)
+        rr._failover_t0 = t0
+        self._close_hop(rr)
         self._m.failovers.inc()
         self._c["failovers"] += 1
         try:
@@ -948,6 +1045,14 @@ class FleetRouter:
         telemetry.record_event("router.failover", gid=rr.gid,
                                to_replica=rep.rid, suppress=rr.suppress)
         self._dispatch(rr, rep, exclude=exclude)
+        # the span that joins the two replica rows in the merged request
+        # trace: dead hop -> new hop, replayed-token count annotated
+        telemetry.tracer().emit(
+            "router.failover", t0, time.monotonic(),
+            attrs={"trace_id": rr.trace_id, "gid": rr.gid,
+                   "from_replica": from_replica, "to_replica": rr.replica,
+                   "replay_suppressed": rr.suppress,
+                   "failover": rr.failovers})
 
     def _schedule_restart(self, rep, reason: str):
         """Supervisor-budgeted restart decision (called under the lock)."""
@@ -1077,6 +1182,71 @@ class FleetRouter:
         if report.get("drained"):
             self.restart(rid)
         return report
+
+    # -- request tracing ---------------------------------------------------
+    def find_request(self, key) -> RouterRequest | None:
+        """Resolve a request by gid (int), trace id, or the gateway's
+        completion id (``cmpl-<gid>`` / ``chatcmpl-<gid>``)."""
+        with self._lock:
+            if isinstance(key, str):
+                rr = self._by_trace.get(key)
+                if rr is not None:
+                    return rr
+                if key.startswith(("cmpl-", "chatcmpl-")):
+                    key = key.rsplit("-", 1)[1]
+                try:
+                    key = int(key)
+                except ValueError:
+                    return None
+            return self._requests.get(key)
+
+    def request_trace(self, key, out_path: str | None = None) -> dict:
+        """ONE merged Chrome trace for one request, spanning
+        gateway/router -> every replica hop (failover included), with
+        clock-corrected timestamps (``telemetry.reqtrace``). Rows: the
+        router's own process (gateway + router spans) plus one per replica
+        that served the request; a hop whose replica died before its spans
+        could heartbeat out still gets a synthesized ``replica.hop`` span
+        from the router's dispatch ledger. Raises ``KeyError`` for an
+        unknown request (gateway: 404)."""
+        rr = self.find_request(key)
+        if rr is None:
+            raise KeyError(f"no routed request {key!r}")
+        with self._lock:
+            remote = list(rr.remote_spans)
+            hops = [dict(h) for h in rr.hop_log]
+        # local spans: what this process (gateway + router) recorded
+        local = [reqtrace.span_to_wire(s) for s in telemetry.tracer().spans()
+                 if s.attrs.get("trace_id") == rr.trace_id
+                 and not s.attrs.get("engine")]
+        sources: dict[str, list] = {"gateway": local}
+        for s in remote:
+            sources.setdefault(s.get("replica", "?"), []).append(s)
+        now_mono = time.monotonic()
+        for h in hops:
+            rid = h["replica"]
+            if sources.get(rid):
+                continue
+            # replica died (or never heartbeat) before its spans shipped:
+            # synthesize the hop window so the row still exists
+            t1 = h["t1"] if h["t1"] is not None else now_mono
+            sources[rid] = [{
+                "name": "replica.hop",
+                "t0_unix": telemetry.mono_to_unix(h["t0"]),
+                "t1_unix": telemetry.mono_to_unix(t1),
+                "span_id": None, "parent_id": None,
+                "attrs": {"trace_id": rr.trace_id, "replica": rid,
+                          "suppress": h.get("suppress", 0),
+                          "synthesized": True},
+            }]
+        return reqtrace.merge_request_trace(
+            rr.trace_id, sources, out_path=out_path,
+            meta={"gid": rr.gid, "state": rr.state,
+                  "finish_reason": rr.finish_reason,
+                  "replicas": [h["replica"] for h in hops],
+                  "failovers": rr.failovers, "retries": rr.retries,
+                  "replay_suppressed": rr.suppress,
+                  "tokens": len(rr.tokens)})
 
     # -- introspection -----------------------------------------------------
     def stats(self) -> dict:

@@ -104,6 +104,12 @@ class Request:
     num_preemptions: int = 0
     finish_reason: str | None = None
     error: BaseException | None = None
+    # request-trace context (telemetry.reqtrace): stamped on every span
+    # this request produces so the router can merge its hops into one
+    # Chrome trace; trace_parent is the submitter's span id (propagated
+    # over the replica pipe, opaque here)
+    trace_id: str | None = None
+    trace_parent: int | None = None
 
     @property
     def prefill_tokens(self) -> list[int]:

@@ -55,6 +55,7 @@ from .tracing import (  # noqa: F401
     Span,
     Tracer,
     device_trace_active,
+    mono_to_unix,
     set_device_trace_active,
     span,
     trace_id,
@@ -78,15 +79,20 @@ from .perf import (  # noqa: F401
     memory_monitor,
     step_timeline,
 )
+from . import cost  # noqa: F401  (roofline cost model: jaxpr FLOPs/bytes
+#                                  walk + trace-cost registry — see cost.py)
+from . import reqtrace  # noqa: F401  (request-scoped trace propagation +
+#                                      per-request Chrome merge — reqtrace.py)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "DEFAULT_BUCKETS", "registry", "Span", "Tracer", "span", "tracer",
-    "trace_id", "set_device_trace_active", "device_trace_active",
+    "trace_id", "mono_to_unix", "set_device_trace_active",
+    "device_trace_active",
     "FlightRecorder", "flight", "record_event", "dump", "install_excepthook",
     "enable", "disable", "enabled", "prometheus_text", "snapshot",
     "cluster", "SLOTracker", "perf", "compile_watcher", "memory_monitor",
-    "step_timeline", "explain_recompile",
+    "step_timeline", "explain_recompile", "cost", "reqtrace",
 ]
 
 

@@ -831,43 +831,70 @@ class ClusterAggregator:
 def merge_traces(traces: dict, out_path: str | None = None,
                  offsets_s: dict | None = None,
                  bases_unix: dict | None = None) -> dict:
-    """Merge per-rank Chrome traces onto one timeline, one process row per
-    rank.
+    """Merge per-source Chrome traces onto one timeline, one process row
+    per source.
 
-    ``traces``: {rank: path-or-trace-dict}. Each rank's events are shifted
-    by ``(epoch_unix_r + offset_r) - min over ranks`` so the earliest
-    rank's first microsecond is ts 0 and every other rank lands at its
-    true (clock-corrected) wall position. ``bases_unix`` overrides the
-    per-trace ``otherData.epoch_unix`` (the publishers' meta records carry
-    the authoritative value, measured on the same clock the offsets were
-    estimated against). ``offsets_s[r]`` is rank r's :class:`ClockEstimate`
-    ``offset_s``. Returns the merged trace dict (and writes it to
-    ``out_path`` when given)."""
+    ``traces``: {source: path-or-trace-dict}. A source is a rank (int, or
+    a numeric string — the original use) or any string label (a serving
+    replica id in a per-request merge, ``telemetry.reqtrace``). Each
+    source's events are shifted by ``(epoch_unix_s + offset_s) - min over
+    sources`` so the earliest source's first microsecond is ts 0 and every
+    other source lands at its true (clock-corrected) wall position.
+    ``bases_unix`` overrides the per-trace ``otherData.epoch_unix`` (the
+    publishers' meta records carry the authoritative value, measured on
+    the same clock the offsets were estimated against). ``offsets_s[s]``
+    is source s's :class:`ClockEstimate` ``offset_s``. Returns the merged
+    trace dict (and writes it to ``out_path`` when given)."""
     offsets_s = offsets_s or {}
     bases_unix = bases_unix or {}
     loaded = {}
-    for rank, t in traces.items():
+    for src, t in traces.items():
         if isinstance(t, str):
             with open(t) as f:
                 t = json.load(f)
-        loaded[int(rank)] = t
+        try:
+            key = int(src)
+        except (TypeError, ValueError):
+            key = str(src)
+        loaded[key] = t
+
+    def _get(d, key):
+        if key in d:
+            return d[key]
+        return d.get(str(key))
+
     bases = {}
-    for rank, t in loaded.items():
-        base = bases_unix.get(rank)
+    for key, t in loaded.items():
+        base = _get(bases_unix, key)
         if base is None:
             base = float(t.get("otherData", {}).get("epoch_unix", 0.0))
-        bases[rank] = base + float(offsets_s.get(rank, 0.0))
+        bases[key] = base + float(_get(offsets_s, key) or 0.0)
     t_zero = min(bases.values()) if bases else 0.0
+    # ranks keep their numeric pid and "rank N" label; string sources get
+    # sequential pids after the ranks and their label verbatim
+    int_keys = sorted(k for k in loaded if isinstance(k, int))
+    str_keys = sorted((k for k in loaded if isinstance(k, str)), key=str)
+    next_pid = (max(int_keys) + 1) if int_keys else 0
+    order, pids, names = [], {}, {}
+    for k in int_keys:
+        order.append(k)
+        pids[k] = k
+        names[k] = f"rank {k}"
+    for i, k in enumerate(str_keys):
+        order.append(k)
+        pids[k] = next_pid + i
+        names[k] = k
     events = []
-    for rank in sorted(loaded):
-        shift_us = (bases[rank] - t_zero) * 1e6
-        events.append({"ph": "M", "name": "process_name", "pid": rank,
-                       "args": {"name": f"rank {rank}"}})
-        events.append({"ph": "M", "name": "process_sort_index", "pid": rank,
-                       "args": {"sort_index": rank}})
-        for e in loaded[rank].get("traceEvents", []):
+    for idx, key in enumerate(order):
+        pid = pids[key]
+        shift_us = (bases[key] - t_zero) * 1e6
+        events.append({"ph": "M", "name": "process_name", "pid": pid,
+                       "args": {"name": names[key]}})
+        events.append({"ph": "M", "name": "process_sort_index", "pid": pid,
+                       "args": {"sort_index": idx}})
+        for e in loaded[key].get("traceEvents", []):
             e2 = dict(e)
-            e2["pid"] = rank
+            e2["pid"] = pid
             if "ts" in e2:
                 e2["ts"] = round(float(e2["ts"]) + shift_us, 3)
             events.append(e2)
@@ -877,10 +904,11 @@ def merge_traces(traces: dict, out_path: str | None = None,
         "displayTimeUnit": "ms",
         "otherData": {
             "merged": True,
-            "ranks": sorted(loaded),
+            "ranks": order,
+            "sources": {str(k): names[k] for k in order},
             "t_zero_unix": t_zero,
-            "clock_offsets_s": {str(r): offsets_s.get(r, 0.0)
-                                for r in loaded},
+            "clock_offsets_s": {str(k): _get(offsets_s, k) or 0.0
+                                for k in loaded},
         },
     }
     if out_path:

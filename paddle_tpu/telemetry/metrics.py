@@ -48,6 +48,17 @@ DEFAULT_BUCKETS = (
 )
 
 
+def _fmt_exemplar(ex) -> str:
+    """OpenMetrics exemplar suffix for a _bucket line (empty when the
+    bucket has none — the plain-Prometheus exposition is unchanged then):
+    `` # {trace_id="abc"} 0.093 1690000000.0``."""
+    if not ex:
+        return ""
+    labels, value, ts = ex
+    ls = ",".join(f'{k}="{_escape_label(v)}"' for k, v in labels.items())
+    return f" # {{{ls}}} {_fmt(value)} {ts:.3f}"
+
+
 def _escape_label(v) -> str:
     return (str(v).replace("\\", "\\\\").replace("\n", "\\n")
             .replace('"', '\\"'))
@@ -117,7 +128,7 @@ class GaugeChild(_Child):
 
 
 class HistogramChild(_Child):
-    __slots__ = ("buckets", "counts", "sum", "count")
+    __slots__ = ("buckets", "counts", "sum", "count", "exemplars")
 
     def __init__(self, buckets=DEFAULT_BUCKETS):
         super().__init__()
@@ -125,8 +136,13 @@ class HistogramChild(_Child):
         self.counts = [0] * (len(self.buckets) + 1)   # +1: the +Inf bucket
         self.sum = 0.0
         self.count = 0
+        # bucket index -> (labels, value, unix ts): the last observation
+        # that landed in the bucket with an exemplar attached — how a p99
+        # TTFT bucket links to the exact request trace that caused it
+        # (OpenMetrics exemplar semantics; docs/OBSERVABILITY.md)
+        self.exemplars: dict[int, tuple] = {}
 
-    def observe(self, value: float):
+    def observe(self, value: float, exemplar: dict | None = None):
         if not ENABLED[0]:
             return
         value = float(value)
@@ -135,6 +151,8 @@ class HistogramChild(_Child):
             self.counts[i] += 1
             self.sum += value
             self.count += 1
+            if exemplar:
+                self.exemplars[i] = (dict(exemplar), value, time.time())
 
     @property
     def mean(self) -> float | None:
@@ -227,8 +245,8 @@ class Gauge(_Metric):
 class Histogram(_Metric):
     kind = "histogram"
 
-    def observe(self, value: float):
-        self._default.observe(value)
+    def observe(self, value: float, exemplar: dict | None = None):
+        self._default.observe(value, exemplar=exemplar)
 
     @property
     def sum(self):
@@ -308,11 +326,14 @@ class MetricsRegistry:
                                 for k, v in labeldict.items())
                 if m.kind == "histogram":
                     cum = ch.cumulative()
-                    for edge, c in zip(ch.buckets, cum):
+                    exs = dict(ch.exemplars)
+                    for i, (edge, c) in enumerate(zip(ch.buckets, cum)):
                         ls = (base + "," if base else "") + f'le="{_fmt(edge)}"'
-                        lines.append(f"{m.name}_bucket{{{ls}}} {c}")
+                        lines.append(f"{m.name}_bucket{{{ls}}} {c}"
+                                     + _fmt_exemplar(exs.get(i)))
                     ls = (base + "," if base else "") + 'le="+Inf"'
-                    lines.append(f"{m.name}_bucket{{{ls}}} {cum[-1]}")
+                    lines.append(f"{m.name}_bucket{{{ls}}} {cum[-1]}"
+                                 + _fmt_exemplar(exs.get(len(ch.buckets))))
                     suffix = f"{{{base}}}" if base else ""
                     lines.append(f"{m.name}_sum{suffix} {_fmt(ch.sum)}")
                     lines.append(f"{m.name}_count{suffix} {ch.count}")
@@ -331,13 +352,21 @@ class MetricsRegistry:
             series = []
             for labeldict, ch in m.series():
                 if m.kind == "histogram":
-                    series.append({
+                    s = {
                         "labels": labeldict,
                         "buckets": {_fmt(e): c for e, c in
                                     zip(ch.buckets, ch.cumulative())},
                         "sum": ch.sum, "count": ch.count,
                         "mean": ch.mean,
-                    })
+                    }
+                    if ch.exemplars:
+                        edges = list(ch.buckets) + [float("inf")]
+                        s["exemplars"] = {
+                            _fmt(edges[i]): {"labels": labels,
+                                             "value": value, "ts": ts}
+                            for i, (labels, value, ts)
+                            in sorted(ch.exemplars.items())}
+                    series.append(s)
                 else:
                     series.append({"labels": labeldict, "value": ch.value})
             out[m.name] = {"type": m.kind, "help": m.help,

@@ -204,9 +204,13 @@ class CompileWatcher:
 
     # -- recording -------------------------------------------------------
     def record_call(self, name: str, signature: tuple,
-                    wall_s: float | None = None) -> bool:
+                    wall_s: float | None = None,
+                    cost: dict | None = None) -> bool:
         """One invocation of a watched callable. Returns True when the
-        signature is new for ``name`` (i.e. this call (re)traced)."""
+        signature is new for ``name`` (i.e. this call (re)traced).
+        ``cost`` is an optional roofline estimate (``telemetry.cost``)
+        registered at trace time — it rides the ``compile.trace`` flight
+        event so every recorded (re)trace names its modeled FLOPs/bytes."""
         if not ENABLED[0]:
             return False
         now = time.monotonic()
@@ -238,9 +242,16 @@ class CompileWatcher:
         pm.signatures.labels(callable=name).set(n_sigs)
         if wall_s is not None:
             pm.compile_s.labels(callable=name).observe(wall_s)
+        extra = {}
+        if cost:
+            extra = {"flops": cost.get("flops"),
+                     "bytes": cost.get("bytes"),
+                     "arithmetic_intensity":
+                         round(cost.get("arithmetic_intensity", 0.0), 3)}
         record_event("compile.trace", callable=name,
                      wall_s=wall_s, distinct=n_sigs,
-                     args=[f"{n}:{s}:{d}" for n, s, d in signature][:8])
+                     args=[f"{n}:{s}:{d}" for n, s, d in signature][:8],
+                     **extra)
         if storm:
             pm.storms.labels(callable=name).inc()
             diff = self.explain(name)

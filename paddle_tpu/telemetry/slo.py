@@ -46,6 +46,16 @@ def _percentile(sorted_vals: list[float], q: float) -> float | None:
     return sorted_vals[idx]
 
 
+def _pct_exemplar(sorted_pairs: list[tuple], q: float) -> str | None:
+    """The trace id of the nearest-rank sample at quantile ``q`` (the
+    request that *is* the window p99, not a neighbor)."""
+    if not sorted_pairs:
+        return None
+    idx = max(0, min(len(sorted_pairs) - 1,
+                     int(round(q * (len(sorted_pairs) - 1)))))
+    return sorted_pairs[idx][1]
+
+
 def _slo_metrics(engine_label: str):
     reg = registry()
     ls = ("engine",)
@@ -91,8 +101,10 @@ class SLOTracker:
         self.window_s = float(window_s)
         self.min_samples = int(min_samples)
         self._clock = clock
-        # (t, ttft, tpot, queue_time, tokens, ok) — ok=None marks a
-        # failed/cancelled request (no latency sample, counts as violation)
+        # (t, ttft, tpot, queue_time, tokens, ok, trace_id) — ok=None marks
+        # a failed/cancelled request (no latency sample, counts as
+        # violation); trace_id is the request-trace exemplar the summary's
+        # p99s link back to (telemetry.reqtrace)
         self._win: deque[tuple] = deque(maxlen=int(max_samples))
         self._lock = threading.Lock()
         self._m = _slo_metrics(engine_label)
@@ -105,7 +117,8 @@ class SLOTracker:
 
     # -- recording -------------------------------------------------------
     def record_finished(self, *, ttft: float | None, tpot: float | None,
-                        queue_time: float | None, tokens: int):
+                        queue_time: float | None, tokens: int,
+                        trace_id: str | None = None):
         if not ENABLED[0]:
             return
         ok = True
@@ -115,16 +128,16 @@ class SLOTracker:
             ok = ok and tpot <= self.tpot_slo_s
         with self._lock:
             self._win.append((self._clock(), ttft, tpot, queue_time,
-                              int(tokens), ok))
+                              int(tokens), ok, trace_id))
 
-    def record_failed(self, tokens: int = 0):
+    def record_failed(self, tokens: int = 0, trace_id: str | None = None):
         """A failed or cancelled request: its tokens (already streamed to
         a client that won't use them) count against goodput."""
         if not ENABLED[0]:
             return
         with self._lock:
             self._win.append((self._clock(), None, None, None,
-                              int(tokens), None))
+                              int(tokens), None, trace_id))
 
     # -- reading ---------------------------------------------------------
     def _window(self):
@@ -138,8 +151,14 @@ class SLOTracker:
         """The window digested: percentiles, goodput, and the admit/shed
         verdict. Also refreshes the ``slo_*`` gauges."""
         win = self._window()
-        ttfts = sorted(v[1] for v in win if v[1] is not None)
-        tpots = sorted(v[2] for v in win if v[2] is not None)
+        # key on the value alone: trace ids may be None and must not be
+        # drawn into tie-break comparisons
+        ttft_pairs = sorted(((v[1], v[6]) for v in win if v[1] is not None),
+                            key=lambda p: p[0])
+        tpot_pairs = sorted(((v[2], v[6]) for v in win if v[2] is not None),
+                            key=lambda p: p[0])
+        ttfts = [p[0] for p in ttft_pairs]
+        tpots = [p[0] for p in tpot_pairs]
         queues = sorted(v[3] for v in win if v[3] is not None)
         total_tokens = sum(v[4] for v in win)
         good_tokens = sum(v[4] for v in win if v[5] is True)
@@ -176,6 +195,12 @@ class SLOTracker:
                                       if win else 1.0),
             "healthy": healthy,
             "shed": not healthy,
+            # trace-id exemplars: the exact request behind each window p99
+            # (GET /v1/traces/<id> on the gateway renders its timeline)
+            "exemplars": {
+                "ttft_p99": _pct_exemplar(ttft_pairs, 0.99),
+                "tpot_p99": _pct_exemplar(tpot_pairs, 0.99),
+            },
         }
         if ENABLED[0]:
             m = self._m

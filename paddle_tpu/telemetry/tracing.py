@@ -30,7 +30,7 @@ import time
 from .metrics import ENABLED
 
 __all__ = ["Span", "Tracer", "tracer", "span", "trace_id", "epoch_unix",
-           "set_device_trace_active", "device_trace_active"]
+           "mono_to_unix", "set_device_trace_active", "device_trace_active"]
 
 _EPOCH = time.monotonic()
 _TRACE_ID = f"{os.getpid():x}-{os.urandom(4).hex()}"
@@ -50,6 +50,14 @@ def epoch_unix() -> float:
     (:func:`telemetry.cluster.merge_traces`) uses this plus a per-rank
     clock offset to place every rank's events on one shared timeline."""
     return time.time() - (time.monotonic() - _EPOCH)
+
+
+def mono_to_unix(t_mono: float) -> float:
+    """Wall-clock time of a ``time.monotonic()`` stamp on THIS process's
+    clock — how request-scoped spans are serialized across the replica pipe
+    (``telemetry.reqtrace``): the worker stamps spans in unix time so the
+    router can place hops from different processes on one timeline."""
+    return epoch_unix() + (float(t_mono) - _EPOCH)
 
 
 def set_device_trace_active(active: bool):

@@ -217,6 +217,42 @@ class TestOpsEndpoints:
         assert "gateway_requests_total" in text
         assert "router_dispatches_total" in text
 
+    def test_request_trace_endpoint(self, fleet):
+        """ISSUE 11: the response's trace id resolves at GET /v1/traces/
+        <id> to the merged per-request Chrome trace (by trace id AND by
+        completion id); unknown ids answer 404."""
+        gw, router, _ = fleet
+        resp, doc = post_json(gw, "/v1/completions",
+                              {"prompt": [4, 4, 2, 3, 1], "max_tokens": 3})
+        assert resp.status == 200
+        trace_id = doc["paddle_tpu"]["trace_id"]
+        assert trace_id
+        import time as _t
+        deadline = _t.monotonic() + 10
+        while _t.monotonic() < deadline:   # replica heartbeat flushes spans
+            resp, tdoc = {}, {}
+            resp, conn = request(gw, "GET", f"/v1/traces/{trace_id}")
+            tdoc = json.loads(resp.read())
+            conn.close()
+            assert resp.status == 200
+            names = {e["name"] for e in tdoc["traceEvents"]
+                     if e.get("ph") == "X"}
+            if "request" in names:
+                break
+            _t.sleep(0.05)
+        assert tdoc["otherData"]["trace_id"] == trace_id
+        assert "gateway.request" in names and "router.submit" in names
+        assert {"queued", "prefill", "decode"} <= names
+        # same doc by completion id
+        resp, conn = request(gw, "GET", f"/v1/traces/{doc['id']}")
+        same = json.loads(resp.read())
+        conn.close()
+        assert resp.status == 200
+        assert same["otherData"]["trace_id"] == trace_id
+        resp, conn = request(gw, "GET", "/v1/traces/req-unknown")
+        assert resp.status == 404
+        conn.close()
+
     def test_healthz_503_when_no_replica_healthy(self):
         class DeadRouter:
             def stats(self):

@@ -435,6 +435,56 @@ class TestFailoverParity:
         assert not router.cancel(rr.gid)          # terminal now
         assert not router.cancel(424242)          # unknown gid
 
+    def test_trace_context_survives_kill_and_failover(self, live_fleet,
+                                                      refmodel):
+        """ISSUE 11: the merged request trace spans BOTH replica hops of a
+        mid-stream kill — joined by a router.failover span carrying the
+        replayed-token count — and contains no orphan spans."""
+        router, reps = live_fleet
+        sp = SamplingParams(max_new_tokens=12, temperature=0.0)
+        prompt = [8, 6, 7, 5, 3, 0, 9, 1, 2]
+        ref = naive_generate(refmodel, prompt, sp)
+        seen = []
+        rr = router.submit(prompt, sp, trace_id="req-killtest",
+                           on_token=lambda r, t: seen.append(t))
+        deadline = time.monotonic() + 60
+        while len(seen) < 3 and time.monotonic() < deadline:
+            time.sleep(0.002)
+        assert len(seen) >= 3, "stream never started"
+        first_replica = rr.replica
+        router.replicas[rr.replica].kill()
+        assert rr.wait(120) and rr.state == "finished", (rr.state, rr.error)
+        assert rr.tokens == ref
+        # survivor heartbeats flush the request's spans every 0.02s; poll
+        # until the merged trace shows the failover join
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            doc = router.request_trace("req-killtest")
+            spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+            if any(e["name"] == "request" for e in spans):
+                break
+            time.sleep(0.05)
+        rows = {e["args"]["name"] for e in doc["traceEvents"]
+                if e.get("ph") == "M" and e["name"] == "process_name"}
+        assert {first_replica, rr.replica, "gateway"} <= rows  # both hops
+        names = [e["name"] for e in spans]
+        assert "router.failover" in names
+        fo = [e for e in spans if e["name"] == "router.failover"][0]
+        assert fo["args"]["replay_suppressed"] >= 3       # annotated
+        assert fo["args"]["from_replica"] == first_replica
+        assert "router.replay_suppressed" in names        # replay window
+        # no orphan spans: every parent resolves within its own row
+        by_pid = {}
+        for e in spans:
+            by_pid.setdefault(e["pid"], set()).add(e["args"].get("span_id"))
+        for e in spans:
+            pid = e["args"].get("parent_id")
+            if pid is not None:
+                assert pid in by_pid[e["pid"]], (e["name"], pid)
+        assert doc["otherData"]["replicas"][0] == first_replica
+        assert doc["otherData"]["failovers"] == 1
+        heal(router, reps)
+
     def test_draining_replica_finishes_streams_locally(self, live_fleet,
                                                        refmodel):
         """Drain with enough budget: the in-flight stream completes on the

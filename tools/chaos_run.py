@@ -677,9 +677,15 @@ def _scenario_sigkill(args, workdir, spec, max_len):
         lost = [i for i, c in enumerate(clients)
                 if c.status != 200 or c.finish != "length" or c.error]
         parity = [i for i, c in enumerate(clients) if c.tokens != refs[i]]
+        # request tracing across the kill (ISSUE 11): a failed-over
+        # request's merged trace must show BOTH replica hops joined by a
+        # router.failover span with the replayed-token count annotated,
+        # and no orphan spans
+        trace_report = _check_failover_trace(router, workdir)
         ok = (killed is not None and not lost and not parity
               and st["failovers"] >= 1 and st["replica_deaths"] >= 1
-              and st["replay_mismatches"] == 0)
+              and st["replay_mismatches"] == 0
+              and trace_report.get("ok", False))
         return {
             "scenario": "replica_sigkill",
             "survived": bool(ok),
@@ -690,10 +696,50 @@ def _scenario_sigkill(args, workdir, spec, max_len):
             "replay_suppressed": st["replay_suppressed"],
             "replay_mismatches": st["replay_mismatches"],
             "replica_deaths": st["replica_deaths"],
+            "request_trace": trace_report,
         }
     finally:
         gateway.stop()
         router.close()
+
+
+def _check_failover_trace(router, workdir):
+    """Merged-request-trace acceptance on a live fleet after a SIGKILL:
+    two replica hop rows, a router.failover span annotated with the
+    replayed/suppressed token count, no orphan spans."""
+    victims = [rr for rr in router._requests.values() if rr.failovers >= 1]
+    if not victims:
+        return {"ok": False, "reason": "no failed-over request to trace"}
+    rr = victims[0]
+    # heartbeats flush spans every stats_interval_s; give the survivor a
+    # beat to ship the tail of the request's spans
+    time.sleep(0.3)
+    out = os.path.join(workdir, f"request-trace-{rr.gid}.json")
+    doc = router.request_trace(rr.gid, out_path=out)
+    rows = {e["args"]["name"] for e in doc["traceEvents"]
+            if e.get("ph") == "M" and e["name"] == "process_name"}
+    spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    failover = [e for e in spans if e["name"] == "router.failover"]
+    replica_rows = {h for h in rows if h != "gateway"}
+    by_pid = {}
+    for e in spans:
+        by_pid.setdefault(e["pid"], set()).add(e["args"].get("span_id"))
+    orphans = [e["name"] for e in spans
+               if e["args"].get("parent_id") is not None
+               and e["args"]["parent_id"] not in by_pid[e["pid"]]]
+    annotated = [e for e in failover
+                 if e["args"].get("replay_suppressed", 0) >= 1]
+    ok = (len(replica_rows) >= 2 and len(failover) >= 1
+          and len(annotated) >= 1 and not orphans)
+    return {
+        "ok": bool(ok),
+        "trace_path": out,
+        "gid": rr.gid,
+        "rows": sorted(rows),
+        "failover_spans": len(failover),
+        "replay_suppressed_annotated": bool(annotated),
+        "orphan_spans": orphans,
+    }
 
 
 def _scenario_fault_storms(args, workdir, spec, max_len):

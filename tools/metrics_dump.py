@@ -20,6 +20,15 @@ the deltas are that rank's traffic over the interval. Gauges print the
 last-value transition with its signed delta, ``a -> b (+d)`` — how a
 memory watermark (``memory_live_bytes{tag=...}``) or queue depth moved
 over the interval, not just where it ended.
+
+Histogram series may carry **exemplar annotations** (PR 11: trace-id
+exemplars on the serving TTFT/TPOT histograms — an ``exemplars`` key next
+to ``buckets``, and OpenMetrics ``# {...}`` suffixes in the text
+exposition). Both modes tolerate them: pretty-print shows the
+highest-bucket exemplar's trace id next to the percentile row (the "p99
+culprit" link), ``--diff`` ignores them, and unknown keys on a series —
+today's exemplars or tomorrow's annotations — are never mis-parsed as
+bucket data.
 """
 from __future__ import annotations
 
@@ -51,6 +60,22 @@ def _labelstr(labels: dict) -> str:
     return ",".join(f"{k}={v}" for k, v in labels.items()) or "-"
 
 
+def _exemplar_note(s: dict) -> str:
+    """The highest-bucket exemplar's identity, if the series carries
+    exemplar annotations — the trace id behind the worst observation."""
+    exs = s.get("exemplars")
+    if not isinstance(exs, dict) or not exs:
+        return ""
+    try:
+        edge = max(exs, key=lambda e: float(e))
+    except (TypeError, ValueError):
+        return ""
+    labels = (exs[edge] or {}).get("labels") or {}
+    if not labels:
+        return ""
+    return "  ex:" + ",".join(f"{k}={v}" for k, v in labels.items())
+
+
 def format_snapshot(snap: dict, name_filter: str = "") -> str:
     lines = []
     scalars = []
@@ -60,20 +85,23 @@ def format_snapshot(snap: dict, name_filter: str = "") -> str:
             continue
         if name_filter and name_filter not in name:
             continue
-        for s in fam["series"]:
-            if fam["type"] == "histogram":
+        if not isinstance(fam, dict):    # unknown family annotation
+            continue
+        for s in fam.get("series", []):
+            if fam.get("type") == "histogram":
                 hists.append((name, s))
             else:
-                scalars.append((name, fam["type"], s))
+                scalars.append((name, fam.get("type", "?"), s))
     if scalars:
         w = max(len(n) for n, _, _ in scalars)
         lines.append(f"{'metric':<{w}}  {'type':<7} {'labels':<24} value")
         lines.append("-" * (w + 46))
         for name, kind, s in scalars:
-            v = s["value"]
+            v = s.get("value", 0)
             vs = f"{v:.6g}" if isinstance(v, float) else str(v)
             lines.append(
-                f"{name:<{w}}  {kind:<7} {_labelstr(s['labels']):<24} {vs}")
+                f"{name:<{w}}  {kind:<7} "
+                f"{_labelstr(s.get('labels', {})):<24} {vs}")
     if hists:
         if scalars:
             lines.append("")
@@ -82,17 +110,20 @@ def format_snapshot(snap: dict, name_filter: str = "") -> str:
                      f"{'mean':>12} {'p50':>12} {'p90':>12} {'p99':>12}")
         lines.append("-" * (w + 86))
         for name, s in hists:
-            cnt = s["count"]
+            cnt = s.get("count", 0)
+            buckets = s.get("buckets", {})
 
             def fmt(x):
                 return f"{x:.6g}" if x is not None else "-"
 
             lines.append(
-                f"{name:<{w}}  {_labelstr(s['labels']):<24} {cnt:>8} "
+                f"{name:<{w}}  {_labelstr(s.get('labels', {})):<24} "
+                f"{cnt:>8} "
                 f"{fmt(s.get('mean')):>12} "
-                f"{fmt(_quantile(s['buckets'], cnt, 0.5)):>12} "
-                f"{fmt(_quantile(s['buckets'], cnt, 0.9)):>12} "
-                f"{fmt(_quantile(s['buckets'], cnt, 0.99)):>12}")
+                f"{fmt(_quantile(buckets, cnt, 0.5)):>12} "
+                f"{fmt(_quantile(buckets, cnt, 0.9)):>12} "
+                f"{fmt(_quantile(buckets, cnt, 0.99)):>12}"
+                f"{_exemplar_note(s)}")
     if not lines:
         lines.append("(no metrics matched)")
     return "\n".join(lines)
@@ -100,7 +131,8 @@ def format_snapshot(snap: dict, name_filter: str = "") -> str:
 
 def _series_map(fam: dict) -> dict:
     """{frozen label tuple: series} for positional-independent matching."""
-    return {tuple(sorted(s["labels"].items())): s for s in fam["series"]}
+    return {tuple(sorted(s.get("labels", {}).items())): s
+            for s in fam.get("series", [])}
 
 
 def format_diff(a: dict, b: dict, name_filter: str = "") -> str:
@@ -125,21 +157,25 @@ def format_diff(a: dict, b: dict, name_filter: str = "") -> str:
             continue
         if name_filter and name_filter not in name:
             continue
+        if not isinstance(fam, dict):
+            continue
         old = _series_map(a.get(name, {"series": []}))
         for key, s in sorted(_series_map(fam).items()):
             o = old.get(key)
             lbl = _labelstr(dict(key))
-            if fam["type"] == "histogram":
-                dc = s["count"] - (o["count"] if o else 0)
-                ds = s["sum"] - (o["sum"] if o else 0.0)
+            if fam.get("type") == "histogram":
+                # exemplar annotations (and any future per-series keys)
+                # ride along on the series; only count/sum are diffed
+                dc = s.get("count", 0) - (o.get("count", 0) if o else 0)
+                ds = s.get("sum", 0.0) - (o.get("sum", 0.0) if o else 0.0)
                 if dc == 0 and ds == 0:
                     continue
                 rate = f" {dc / dt:10.4g}/s" if dt else ""
                 mean = (f" mean={ds / dc:.6g}s" if dc
                         else f" sum{ds:+.6g}s")
                 rows.append(f"{name:<40} {lbl:<28} +{dc:<10}{rate}{mean}")
-            elif fam["type"] == "counter":
-                dv = s["value"] - (o["value"] if o else 0.0)
+            elif fam.get("type") == "counter":
+                dv = s.get("value", 0.0) - (o.get("value", 0.0) if o else 0.0)
                 if dv == 0:
                     continue
                 rate = f" {dv / dt:10.4g}/s" if dt else ""
@@ -148,14 +184,15 @@ def format_diff(a: dict, b: dict, name_filter: str = "") -> str:
                 # gauges: last-value transition + signed delta (a series
                 # absent from A shows "-" and no delta — nothing to
                 # subtract from)
-                va = o["value"] if o else None
-                if o is not None and va == s["value"]:
+                va = o.get("value") if o else None
+                vb = s.get("value", 0.0)
+                if o is not None and va == vb:
                     continue
                 frm = f"{va:.6g}" if va is not None else "-"
-                dlt = (f" ({s['value'] - va:+.6g})"
+                dlt = (f" ({vb - va:+.6g})"
                        if va is not None else "")
                 rows.append(f"{name:<40} {lbl:<28} {frm} -> "
-                            f"{s['value']:.6g}{dlt}")
+                            f"{vb:.6g}{dlt}")
     lines.extend(rows or ["(no changed series matched)"])
     return "\n".join(lines)
 

@@ -59,6 +59,12 @@ DIRECTIONS = {
     "fleet_tok_per_sec": "higher",
     "fleet_ttft_mean_s": "lower",
     "fleet_ttft_p95_s": "lower",
+    # roofline cost model (PR 11): the serving analogue of MFU — fraction
+    # of the roofline-model step time actually achieved — and the decode
+    # trace's arithmetic intensity (higher = more compute per HBM byte,
+    # i.e. better batching of the memory-bound step)
+    "serving_roofline_frac": "higher",
+    "decode_ai": "higher",
 }
 
 
@@ -96,6 +102,9 @@ def extract_metrics(doc: dict) -> tuple[str, dict]:
         tpot = (slo.get("tpot") or {})
         put("slo_ttft_p99_s", ttft.get("p99"))
         put("slo_tpot_p99_s", tpot.get("p99"))
+        roof = doc.get("roofline") or {}
+        put("serving_roofline_frac", roof.get("serving_roofline_frac"))
+        put("decode_ai", roof.get("decode_ai"))
         return "serving", metrics
     return "unknown", metrics
 

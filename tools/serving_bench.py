@@ -45,6 +45,13 @@ tracker: the result JSON gains a ``slo`` block (TTFT/TPOT/queue p50/p95/
 p99, goodput = tokens within SLO, and the admit/shed health bit), so bench
 trajectories capture tail latency next to the tok/s headline.
 
+The single-engine result also carries a ``roofline`` block (PR 11,
+``telemetry.cost``): modeled FLOPs + HBM bytes per compiled prefill/decode
+trace, the decode arithmetic intensity, and the achieved fraction of the
+roofline-model step time — the serving analogue of training's MFU.
+``tools/perf_gate.py`` gates ``serving_roofline_frac`` / ``decode_ai``
+direction-aware against BASELINE.json.
+
 ``--metrics-out`` writes the telemetry registry's JSON snapshot (TTFT/TPOT
 histograms, block-pool gauges, per-request counters) next to the bench
 artifact — pretty-print it with ``python tools/metrics_dump.py``.
@@ -407,6 +414,11 @@ def main():
         # rolling-window latency/goodput so BENCH_*.json trajectories
         # capture tail latency and SLO attainment, not just throughput
         "slo": st["slo"],
+        # roofline cost model (telemetry.cost): modeled FLOPs/bytes per
+        # compiled trace and the achieved fraction of the roofline step
+        # time — the serving MFU-style headline perf_gate tracks as
+        # serving_roofline_frac / decode_ai
+        "roofline": st["perf"]["roofline"],
         # provenance stamp (git sha, jax version, platform, wall time):
         # tools/perf_gate.py keys its regression gate on this
         "__meta__": _perf.run_meta(),
