@@ -9,7 +9,9 @@ launch CLI, health heartbeats for elastic restart, and user-level rendezvous.
 Robustness (docs/ROBUSTNESS.md): rendezvous runs while the cluster is still
 assembling — the master may not be up yet, and transient resets are normal
 during elastic restarts. Connect and the request verbs therefore retry with
-exponential backoff (``retries`` / ``backoff_s``), and every terminal error
+full-jitter exponential backoff (``retries`` / ``backoff_s``; the jitter
+keeps a herd of simultaneously-failing ranks from re-converging on the
+master in synchronized retry waves), and every terminal error
 names the endpoint, the key, and how long was spent, so a timeout reads as
 "could not reach 10.0.0.2:8765 after 4 attempts over 3.1s" instead of a
 bare errno. Chaos sites ``store.connect`` / ``store.get`` / ``store.set`` /
@@ -20,6 +22,7 @@ from __future__ import annotations
 
 import ctypes
 import json
+import random
 import threading
 import time
 
@@ -44,6 +47,17 @@ def _store_metrics():
 
 
 _M_OPS, _M_RETRIES, _M_TIMEOUTS, _M_SECONDS = _store_metrics()
+
+# full-jitter backoff RNG (per-process): during an elastic restart every
+# rank hits the same failure at the same moment; bare exponential backoff
+# re-synchronizes them into a thundering herd that re-overloads the master
+# on every retry wave. Full jitter (sleep uniform in [0, cap]) decorrelates
+# the waves while keeping the same expected growth.
+_JITTER_RNG = random.Random()
+
+
+def _full_jitter(cap: float) -> float:
+    return _JITTER_RNG.uniform(0.0, max(0.0, cap))
 
 
 class StoreTimeout(TimeoutError):
@@ -102,7 +116,8 @@ class TCPStore:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     break
-                time.sleep(min(self.backoff_s * (2 ** attempt), remaining))
+                time.sleep(min(_full_jitter(self.backoff_s * (2 ** attempt)),
+                               remaining))
         _M_TIMEOUTS.labels(op="connect").inc()
         _M_SECONDS.labels(op="connect").observe(time.monotonic() - t0)
         err = StoreTimeout(
@@ -133,7 +148,8 @@ class TCPStore:
                     if attempt + 1 < self.retries:
                         self.num_retries += 1
                         _M_RETRIES.labels(op=op).inc()
-                        time.sleep(self.backoff_s * (2 ** attempt))
+                        time.sleep(_full_jitter(
+                            self.backoff_s * (2 ** attempt)))
             _M_TIMEOUTS.labels(op=op).inc()
             err = StoreTimeout(
                 f"TCPStore {op}({key!r}) against {self.host}:{self.port} "

@@ -30,6 +30,7 @@ Above the single engine sits the fleet plane (docs/SERVING.md
 """
 from .engine import LLMEngine, naive_generate  # noqa: F401
 from .gateway import Gateway  # noqa: F401
+from .journal import Journal, JournalError, JournalTornWrite  # noqa: F401
 from .kv_cache import (  # noqa: F401
     BlockAllocator,
     DenseKVCache,
@@ -37,6 +38,7 @@ from .kv_cache import (  # noqa: F401
     PagedKVCache,
 )
 from .router import (  # noqa: F401
+    CircuitBreaker,
     FleetRouter,
     LocalReplica,
     NoHealthyReplica,
@@ -63,4 +65,5 @@ __all__ = [
     "DeadlineExceeded", "PreemptionStorm",
     "FleetRouter", "LocalReplica", "ProcReplica", "ReplicaState",
     "RouterRequest", "RouterShed", "NoHealthyReplica", "Gateway",
+    "CircuitBreaker", "Journal", "JournalError", "JournalTornWrite",
 ]

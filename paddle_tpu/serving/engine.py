@@ -267,7 +267,8 @@ class LLMEngine:
     def add_request(self, prompt, sampling: SamplingParams | None = None,
                     on_token=None, deadline_s: float | None = None,
                     trace_id: str | None = None,
-                    trace_parent: int | None = None) -> Request:
+                    trace_parent: int | None = None,
+                    on_watermark=None, watermark_every: int = 8) -> Request:
         """Queue a prompt (list/array of token ids); returns the live
         request handle (``output_tokens`` grows as the engine steps;
         ``on_token(req, tok)`` streams each new token). ``deadline_s``
@@ -275,11 +276,17 @@ class LLMEngine:
         CANCELLED with :class:`DeadlineExceeded` attached. ``trace_id``
         is the request-trace context a gateway/router minted: every span
         this request produces carries it, and the replica protocol streams
-        those spans back for the per-request merged Chrome trace."""
+        those spans back for the per-request merged Chrome trace.
+        ``on_watermark(req, n)`` fires whenever the output length crosses
+        a multiple of ``watermark_every`` — the coarse durable-progress
+        signal the gateway's write-ahead journal records
+        (docs/ROBUSTNESS.md "Durable requests")."""
         req = Request(rid=self._next_rid, prompt=[int(t) for t in prompt],
                       sampling=sampling or SamplingParams(),
                       on_token=on_token, trace_id=trace_id,
-                      trace_parent=trace_parent)
+                      trace_parent=trace_parent,
+                      on_watermark=on_watermark,
+                      watermark_every=watermark_every)
         if deadline_s is not None:
             req.deadline = time.monotonic() + float(deadline_s)
         self._next_rid += 1

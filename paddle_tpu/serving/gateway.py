@@ -1,4 +1,4 @@
-"""Async serving gateway: the fleet's HTTP front door.
+"""Async serving gateway: the fleet's durable HTTP front door.
 
 A dependency-free asyncio HTTP/1.1 server exposing OpenAI-compatible
 endpoints over a :class:`~paddle_tpu.serving.router.FleetRouter`
@@ -15,20 +15,47 @@ endpoints over a :class:`~paddle_tpu.serving.router.FleetRouter`
   per-request deadline; a missed deadline ends the request with
   ``finish_reason: "deadline"`` and whatever tokens made it out.
 - **Load shedding**: a :class:`~paddle_tpu.serving.router.RouterShed`
-  becomes ``429 Too Many Requests`` with a ``Retry-After`` header;
+  becomes ``429 Too Many Requests`` with a ``Retry-After`` header derived
+  from the fleet's observed SLO window (an honest hint, not a constant);
   :class:`~paddle_tpu.serving.router.NoHealthyReplica` becomes ``503``.
   ``priority`` in the body (int, default 0, higher = keep longer) feeds
   the router's shed-lowest-first policy.
 - Operations: ``GET /healthz`` (fleet health; 503 when no replica is
   healthy), ``GET /metrics`` (Prometheus text exposition of the global
-  registry), ``GET /stats`` (the router's JSON fleet view),
+  registry), ``GET /stats`` (the router's JSON fleet view + a ``gateway``
+  block: journal state, recovery report, retained streams),
   ``GET /v1/models``.
+
+Durable request lifecycle (docs/ROBUSTNESS.md "Durable requests"), on when
+``journal_dir`` is set:
+
+- **Write-ahead journal** (:mod:`paddle_tpu.serving.journal`): every
+  accepted request is journaled *before* it is submitted, token
+  watermarks ride the router's ``on_watermark`` callback, and the
+  terminal record carries the full result. A journal append failure
+  refuses the request (500) — durability is never silently dropped.
+- **Crash recovery**: a restarted ``Gateway(journal_dir=...)`` scans the
+  journal and re-submits every accepted-non-terminal request through the
+  router's replay-and-suppress path (``submit(replay_tokens=...)``): the
+  journaled prefix is regenerated, verified token-for-token, and
+  swallowed — zero accepted requests are lost to a gateway SIGKILL.
+- **Idempotency keys**: an ``Idempotency-Key`` request header dedupes
+  client retries — in-flight → the retry attaches to the live request;
+  terminal → the recorded result is replayed byte-identically; unknown →
+  a new admission. At-least-once retries become exactly-once semantics.
+- **Resumable SSE**: every token chunk carries a monotonic ``id:`` line;
+  a reconnecting client sends ``Last-Event-ID`` (on an idempotent retry
+  POST or ``GET /v1/streams/<id>``) and receives exactly the missing
+  suffix. A dropped connection does not cancel the request (the decode
+  keeps running for the reconnect) unless ``cancel_on_disconnect`` says
+  otherwise.
 
 The server runs on a daemon thread with its own event loop so synchronous
 tools (``tools/serving_bench.py --fleet``, the chaos suite, tests) can
-``start()``/``stop()`` it around plain-socket clients. Chaos site:
+``start()``/``stop()`` it around plain-socket clients. Chaos sites:
 ``gateway.request`` fires per parsed request (an injected error answers
-500 — the connection layer survives).
+500 — the connection layer survives); ``gateway.journal.append`` /
+``gateway.journal.fsync`` live in the journal.
 """
 from __future__ import annotations
 
@@ -37,11 +64,13 @@ import json
 import math
 import threading
 import time
+import uuid
 from types import SimpleNamespace
 
 from .. import telemetry
 from ..telemetry import reqtrace
 from ..utils import faults
+from .journal import Journal, JournalError
 from .router import NoHealthyReplica, RouterShed
 
 __all__ = ["Gateway"]
@@ -66,6 +95,16 @@ def _gateway_metrics() -> SimpleNamespace:
         latency=reg.histogram(
             "gateway_request_seconds",
             "wall time from request parse to response end"),
+        resumes=reg.counter(
+            "gateway_resumes_total",
+            "SSE streams resumed from a Last-Event-ID watermark"),
+        recovered=reg.counter(
+            "gateway_recovered_requests_total",
+            "accepted-non-terminal requests re-submitted from the journal "
+            "at startup"),
+        idem_hits=reg.counter(
+            "gateway_idempotent_hits_total",
+            "requests deduplicated by Idempotency-Key", ("outcome",)),
     )
 
 
@@ -82,16 +121,57 @@ def _parse_tokens(v, what: str) -> list[int]:
 
 
 class _HTTPError(Exception):
-    def __init__(self, status: int, message: str, headers=()):
+    def __init__(self, status: int, message: str, headers=(),
+                 close: bool = False):
         super().__init__(message)
         self.status = status
         self.headers = list(headers)
+        # close=True: the connection's framing can no longer be trusted
+        # (unread body bytes, garbled request line) — answering and then
+        # parsing the leftover bytes as a "request" would wedge the
+        # connection state machine
+        self.close = close
 
 
 _REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found",
             405: "Method Not Allowed", 408: "Request Timeout",
             429: "Too Many Requests", 500: "Internal Server Error",
             503: "Service Unavailable"}
+
+
+class _Stream:
+    """Gateway-side durable handle for one accepted request: the fan-out
+    point SSE subscribers attach to (first connection and reconnects
+    alike), the journal watermark cursor, and the snapshot an idempotent
+    retry replays. Lives in the gateway's bounded stream registry under
+    both its journal id (= trace id) and its completion id."""
+
+    def __init__(self, jid: str, *, chat: bool, created: int,
+                 prompt_len: int, idem: str | None = None,
+                 priority: int = 0, recovered: bool = False):
+        self.jid = jid
+        self.chat = chat
+        self.created = created
+        self.prompt_len = prompt_len
+        self.idem = idem
+        self.priority = priority
+        self.recovered = recovered
+        self.rr = None                    # live RouterRequest (may be None
+        self.rid: str | None = None       # for journal-replayed terminals)
+        self.tokens: list[int] = []
+        self.marked = 0                   # journal watermark cursor
+        self.state = "running"
+        self.finish_reason: str | None = None
+        self.error: str | None = None
+        self.replica: str | None = None
+        self.failovers = 0
+        self.retries = 0
+        self.subscribers: list = []       # (loop, asyncio.Queue)
+        self.done = threading.Event()
+
+    @property
+    def terminal(self) -> bool:
+        return self.state != "running"
 
 
 class Gateway:
@@ -101,20 +181,57 @@ class Gateway:
                         after :meth:`start`).
     default_deadline_s: applied when a request names no deadline (None =
                         unbounded).
-    max_body_bytes:     request-body bound (413-by-400 beyond it).
+    max_body_bytes:     request-body bound (413-by-400 beyond it; the
+                        connection closes — its framing is unrecoverable).
+    journal_dir:        enable the durable request lifecycle: write-ahead
+                        journal + crash recovery + idempotency replay
+                        (None = stateless gateway, in-memory resume only).
+    journal_fsync:      the journal's fsync policy (always|interval|never).
+    journal_watermark_every: token-watermark journal cadence.
+    gateway_id:         stable identity stamped into journal records
+                        (defaults to a fresh ``gw-<hex>``).
+    resume_retention:   how many *terminal* streams stay attachable for
+                        idempotent replay / late ``Last-Event-ID`` resume.
+    cancel_on_disconnect: cancel the engine work when an SSE client hangs
+                        up (default: True without a journal — the old
+                        behavior — False with one, so the stream survives
+                        for the reconnect).
+    recover:            scan the journal and re-submit accepted-
+                        non-terminal requests during :meth:`start`.
     """
 
     def __init__(self, router, host: str = "127.0.0.1", port: int = 0, *,
                  default_deadline_s: float | None = None,
                  max_body_bytes: int = 1 << 20,
-                 model_name: str = "paddle-tpu"):
+                 model_name: str = "paddle-tpu",
+                 journal_dir: str | None = None,
+                 journal_fsync: str = "interval",
+                 journal_watermark_every: int = 8,
+                 gateway_id: str | None = None,
+                 resume_retention: int = 512,
+                 cancel_on_disconnect: bool | None = None,
+                 recover: bool = True):
         self.router = router
         self.host = host
         self.port = int(port)
         self.default_deadline_s = default_deadline_s
         self.max_body_bytes = int(max_body_bytes)
         self.model_name = model_name
+        self.gateway_id = gateway_id or f"gw-{uuid.uuid4().hex[:8]}"
+        self.journal = (Journal(journal_dir, fsync=journal_fsync)
+                        if journal_dir else None)
+        self.journal_watermark_every = int(journal_watermark_every)
+        self.resume_retention = int(resume_retention)
+        self.cancel_on_disconnect = (cancel_on_disconnect
+                                     if cancel_on_disconnect is not None
+                                     else self.journal is None)
+        self._recover_on_start = bool(recover)
+        self.recovery_report: dict | None = None
         self._m = _gateway_metrics()
+        self._slock = threading.Lock()
+        self._streams: dict[str, _Stream] = {}    # jid AND rid -> stream
+        self._stream_order: list[str] = []        # jids, acceptance order
+        self._idem: dict[str, str] = {}           # idempotency key -> jid
         self._loop: asyncio.AbstractEventLoop | None = None
         self._server: asyncio.AbstractServer | None = None
         self._thread: threading.Thread | None = None
@@ -123,7 +240,10 @@ class Gateway:
 
     # -- lifecycle ---------------------------------------------------------
     def start(self, timeout: float = 10.0) -> "Gateway":
-        """Bind and serve on a daemon thread; returns once listening."""
+        """Recover journaled requests (when enabled), then bind and serve
+        on a daemon thread; returns once listening."""
+        if self.journal is not None and self._recover_on_start:
+            self.recover()
         self._thread = threading.Thread(
             target=self._run, name="gateway", daemon=True)
         self._thread.start()
@@ -134,11 +254,23 @@ class Gateway:
         return self
 
     def stop(self, timeout: float = 10.0):
-        if self._loop is None:
-            return
-        self._loop.call_soon_threadsafe(self._loop.stop)
-        if self._thread is not None:
-            self._thread.join(timeout)
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            if self._thread is not None:
+                self._thread.join(timeout)
+        if self.journal is not None and not self.journal.closed:
+            self.journal.close()
+
+    def crash(self):
+        """Chaos/test helper: die like a SIGKILL — no terminal journal
+        records, no graceful stream shutdown. The journal file is left
+        exactly as the last append left it, which is the whole point."""
+        if self.journal is not None:
+            self.journal.closed = True     # appends now raise; no cleanup
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            if self._thread is not None:
+                self._thread.join(5)
 
     @property
     def url(self) -> str:
@@ -170,6 +302,307 @@ class Gateway:
                     asyncio.gather(*tasks, return_exceptions=True))
             loop.close()
 
+    # -- stream registry ---------------------------------------------------
+    def _register_stream(self, st: _Stream):
+        with self._slock:
+            self._streams[st.jid] = st
+            self._stream_order.append(st.jid)
+            if st.idem:
+                self._idem[st.idem] = st.jid
+            self._prune_streams_locked()
+
+    def _bind_stream(self, st: _Stream, rid: str):
+        st.rid = rid
+        with self._slock:
+            self._streams[rid] = st
+
+    def _prune_streams_locked(self):
+        """Bound retained *terminal* streams; a live stream is never
+        dropped (its tokens are the resume source of truth)."""
+        n_terminal = sum(1 for j in self._stream_order
+                         if self._streams[j].terminal)
+        if n_terminal <= self.resume_retention:
+            return
+        for jid in list(self._stream_order):
+            st = self._streams.get(jid)
+            if st is None or not st.terminal:
+                continue
+            self._stream_order.remove(jid)
+            self._streams.pop(jid, None)
+            if st.rid:
+                self._streams.pop(st.rid, None)
+            if st.idem and self._idem.get(st.idem) == jid:
+                del self._idem[st.idem]
+            n_terminal -= 1
+            if n_terminal <= self.resume_retention:
+                break
+
+    def _find_stream(self, key: str) -> _Stream | None:
+        with self._slock:
+            return self._streams.get(key)
+
+    def _find_idem(self, key: str) -> _Stream | None:
+        with self._slock:
+            jid = self._idem.get(key)
+            return self._streams.get(jid) if jid else None
+
+    def _subscribe(self, st: _Stream, from_idx: int):
+        """Atomically snapshot the already-delivered suffix and register a
+        live queue: everything before the snapshot boundary is returned,
+        everything after lands on the queue — no token is ever skipped or
+        duplicated between the two."""
+        q: asyncio.Queue = asyncio.Queue()
+        with self._slock:
+            snapshot = list(st.tokens[from_idx:])
+            terminal = st.terminal
+            if not terminal:
+                st.subscribers.append((self._loop, q))
+        return q, snapshot, terminal
+
+    def _unsubscribe(self, st: _Stream, q):
+        with self._slock:
+            st.subscribers = [(lo, qq) for lo, qq in st.subscribers
+                              if qq is not q]
+
+    # -- router callbacks (replica reader threads) -------------------------
+    def _stream_cbs(self, st: _Stream):
+        def push(subs, item):
+            for loop, q in subs:
+                try:
+                    loop.call_soon_threadsafe(q.put_nowait, item)
+                except RuntimeError:
+                    pass     # loop gone (gateway stopped/crashed): the
+                             # subscriber is dead, the stream lives on
+
+        def on_token(rr, tok):
+            with self._slock:
+                st.tokens.append(int(tok))
+                i = len(st.tokens) - 1
+                subs = list(st.subscribers)
+            push(subs, ("tok", i, int(tok)))
+
+        def on_watermark(rr, n):
+            if self.journal is None:
+                return
+            with self._slock:
+                if n <= st.marked:
+                    return
+                suffix = st.tokens[st.marked:n]
+                st.marked = n
+            try:
+                self.journal.mark(st.jid, n, suffix)
+            except JournalError:
+                pass     # the terminal record is the durable truth; a
+                         # missed watermark only widens the replay window
+
+        def on_finish(rr):
+            with self._slock:
+                st.state = rr.state
+                st.finish_reason = rr.finish_reason
+                st.error = rr.error
+                st.replica = rr.replica
+                st.failovers = rr.failovers
+                st.retries = rr.retries
+                subs = list(st.subscribers)
+            if self.journal is not None:
+                try:
+                    self.journal.end(st.jid, state=st.state,
+                                     reason=st.finish_reason,
+                                     error=st.error, rid=st.rid,
+                                     tokens=st.tokens)
+                except JournalError:
+                    pass   # crash-equivalent: recovery re-runs the tail
+            st.done.set()
+            push(subs, ("done", None, None))
+
+        return on_token, on_watermark, on_finish
+
+    # -- admission ---------------------------------------------------------
+    def _accept(self, p: dict, chat: bool,
+                idem: str | None) -> tuple[_Stream, bool]:
+        """Admit one request: reserve the idempotency key, journal
+        (write-ahead), then submit. Returns ``(stream, fresh)`` — fresh is
+        False when the key already named a stream (the caller attaches or
+        replays instead). Raises RouterShed / NoHealthyReplica /
+        JournalError for the handler's status mapping.
+
+        The key reservation and stream registration happen atomically
+        *before* the submit, so two concurrent first submissions with the
+        same key can never both generate — the loser of the race attaches
+        to the winner's stream."""
+        jid = reqtrace.new_trace_id()
+        created = int(time.time())
+        st = _Stream(jid, chat=chat, created=created,
+                     prompt_len=len(p["prompt"]), idem=idem,
+                     priority=p["priority"])
+        with self._slock:
+            if idem:
+                existing = self._idem.get(idem)
+                if existing is not None and existing in self._streams:
+                    return self._streams[existing], False
+                self._idem[idem] = jid
+            self._streams[jid] = st
+            self._stream_order.append(jid)
+            self._prune_streams_locked()
+        journaled = False
+        on_token, on_wm, on_fin = self._stream_cbs(st)
+        try:
+            if self.journal is not None:
+                deadline_unix = (time.time() + p["deadline_s"]
+                                 if p["deadline_s"] is not None else None)
+                self.journal.accept(
+                    jid, gateway_id=self.gateway_id, prompt=p["prompt"],
+                    sampling=p["sampling"], priority=p["priority"],
+                    deadline_unix=deadline_unix, idem=idem, chat=chat,
+                    created=created)
+                journaled = True
+            rr = self.router.submit(
+                p["prompt"], p["sampling"], priority=p["priority"],
+                deadline_s=p["deadline_s"], on_token=on_token,
+                on_finish=on_fin, trace_id=jid,
+                on_watermark=on_wm if self.journal is not None else None,
+                watermark_every=self.journal_watermark_every)
+        except Exception as e:
+            # the client is getting an error response right now — undo
+            # the reservation, and make sure a future recovery does not
+            # resurrect the journaled acceptance. Any attacher that won a
+            # subscription in the meantime must be released, not hung.
+            with self._slock:
+                st.state = "failed"
+                st.finish_reason = "rejected"
+                st.error = f"{type(e).__name__}: {e}"
+                subs = list(st.subscribers)
+                self._streams.pop(jid, None)
+                if jid in self._stream_order:
+                    self._stream_order.remove(jid)
+                if idem and self._idem.get(idem) == jid:
+                    del self._idem[idem]
+            st.done.set()
+            for loop, q in subs:
+                try:
+                    loop.call_soon_threadsafe(q.put_nowait,
+                                              ("done", None, None))
+                except RuntimeError:
+                    pass
+            if journaled:
+                try:
+                    self.journal.end(jid, state="rejected",
+                                     reason=type(e).__name__)
+                except JournalError:
+                    pass
+            raise
+        st.rr = rr
+        rid = f"{'chatcmpl' if chat else 'cmpl'}-{rr.gid}"
+        self._bind_stream(st, rid)
+        if self.journal is not None:
+            try:
+                self.journal.bind(jid, rid)
+            except JournalError:
+                pass
+        return st, True
+
+    # -- crash recovery ----------------------------------------------------
+    def recover(self) -> dict:
+        """Scan the journal and re-submit every accepted-non-terminal
+        request through the router's replay-and-suppress path. Terminal
+        entries rebuild the idempotency/resume registry so retries of
+        pre-crash requests still replay their recorded results."""
+        scan = self.journal.recovered
+        report = {"scanned": len(scan.requests),
+                  "torn_records": scan.torn_records,
+                  "recovered": 0, "expired": 0, "restored_terminal": 0,
+                  "failed": 0}
+        for e in scan.terminal():
+            a = e["accept"]
+            if a is None:
+                continue
+            end = e["end"]
+            if end.get("state") == "rejected":
+                continue                  # never had a live submission
+            st = _Stream(e["jid"], chat=bool(a.get("chat")),
+                         created=int(a.get("created") or 0),
+                         prompt_len=len(a.get("prompt") or ()),
+                         idem=a.get("idem"), priority=a.get("priority", 0),
+                         recovered=True)
+            st.tokens = list(e["tokens"])
+            st.marked = len(st.tokens)
+            st.state = end.get("state") or "finished"
+            st.finish_reason = end.get("reason")
+            st.error = end.get("error")
+            st.done.set()
+            self._register_stream(st)
+            if e["rid"]:
+                self._bind_stream(st, e["rid"])
+            report["restored_terminal"] += 1
+        for e in scan.recoverable():
+            a = e["accept"]
+            jid = e["jid"]
+            remaining = None
+            if a.get("deadline_unix") is not None:
+                remaining = float(a["deadline_unix"]) - time.time()
+                if remaining <= 0:
+                    # the deadline passed while no gateway was alive:
+                    # terminal-ize it in the journal, keep it resumable
+                    st = _Stream(jid, chat=bool(a.get("chat")),
+                                 created=int(a.get("created") or 0),
+                                 prompt_len=len(a.get("prompt") or ()),
+                                 idem=a.get("idem"),
+                                 priority=a.get("priority", 0),
+                                 recovered=True)
+                    st.tokens = list(e["tokens"])
+                    st.marked = len(st.tokens)
+                    st.state = "cancelled"
+                    st.finish_reason = "deadline"
+                    st.done.set()
+                    self._register_stream(st)
+                    if e["rid"]:
+                        self._bind_stream(st, e["rid"])
+                    try:
+                        self.journal.end(jid, state="cancelled",
+                                         reason="deadline", rid=e["rid"],
+                                         tokens=e["tokens"])
+                    except JournalError:
+                        pass
+                    report["expired"] += 1
+                    continue
+            st = _Stream(jid, chat=bool(a.get("chat")),
+                         created=int(a.get("created") or 0),
+                         prompt_len=len(a.get("prompt") or ()),
+                         idem=a.get("idem"), priority=a.get("priority", 0),
+                         recovered=True)
+            st.tokens = list(e["tokens"])
+            st.marked = e["n"]
+            on_token, on_wm, on_fin = self._stream_cbs(st)
+            try:
+                rr = self.router.submit(
+                    a["prompt"], a.get("sampling") or {},
+                    priority=a.get("priority", 0), deadline_s=remaining,
+                    on_token=on_token, on_finish=on_fin, trace_id=jid,
+                    on_watermark=on_wm,
+                    watermark_every=self.journal_watermark_every,
+                    replay_tokens=e["tokens"], bypass_shed=True)
+            except Exception as ex:        # fleet not ready: keep journaled
+                report["failed"] += 1
+                telemetry.record_event("gateway.recover_failed", jid=jid,
+                                       error=f"{type(ex).__name__}: {ex}")
+                continue
+            st.rr = rr
+            rid = f"{'chatcmpl' if st.chat else 'cmpl'}-{rr.gid}"
+            self._bind_stream(st, rid)
+            try:
+                self.journal.bind(jid, rid)
+            except JournalError:
+                pass
+            self._register_stream(st)
+            self._m.recovered.inc()
+            report["recovered"] += 1
+            telemetry.record_event("gateway.recovered", jid=jid,
+                                   replayed=len(st.tokens))
+        self.recovery_report = report
+        telemetry.record_event("gateway.recovery", **{
+            k: v for k, v in report.items()})
+        return report
+
     # -- HTTP plumbing -----------------------------------------------------
     async def _serve_conn(self, reader: asyncio.StreamReader,
                           writer: asyncio.StreamWriter):
@@ -178,6 +611,16 @@ class Gateway:
                 try:
                     req = await self._read_request(reader)
                 except (asyncio.IncompleteReadError, ConnectionError):
+                    break
+                except _HTTPError as e:
+                    # framing-level rejection (garbled request line, bad or
+                    # oversized Content-Length): answer it, then close —
+                    # these all leave unread bytes no parser can resync
+                    await self._write_response(
+                        writer, e.status,
+                        {"error": {"message": str(e),
+                                   "type": "invalid_request_error"}},
+                        headers=e.headers)
                     break
                 if req is None:
                     break
@@ -200,7 +643,9 @@ class Gateway:
         try:
             method, path, _ = line.decode("latin-1").split(None, 2)
         except ValueError:
-            raise _HTTPError(400, "malformed request line")
+            # the request line is garbage: there is no framing left to
+            # trust, answer and hang up
+            raise _HTTPError(400, "malformed request line", close=True)
         headers = {}
         while True:
             hl = await reader.readline()
@@ -208,12 +653,22 @@ class Gateway:
                 break
             name, _, value = hl.decode("latin-1").partition(":")
             headers[name.strip().lower()] = value.strip()
-        length = int(headers.get("content-length", 0) or 0)
+        try:
+            length = int(headers.get("content-length", 0) or 0)
+        except ValueError:
+            raise _HTTPError(400, "Content-Length is not an integer",
+                             close=True)
+        if length < 0:
+            raise _HTTPError(400, "negative Content-Length", close=True)
         if length > self.max_body_bytes:
-            raise _HTTPError(400, f"body too large ({length} bytes)")
+            # the body is not going to be read: the connection cannot be
+            # resynced, so this response must be the connection's last
+            raise _HTTPError(400, f"body too large ({length} bytes)",
+                             close=True)
         body = await reader.readexactly(length) if length else b""
-        return SimpleNamespace(method=method.upper(), path=path.split("?")[0],
-                               headers=headers, body=body)
+        path, _, query = path.partition("?")
+        return SimpleNamespace(method=method.upper(), path=path,
+                               query=query, headers=headers, body=body)
 
     async def _write_response(self, writer, status: int, payload: dict,
                               headers=()):
@@ -240,7 +695,9 @@ class Gateway:
             if req.path == "/metrics":
                 return await self._route_metrics(writer)
             if req.path == "/stats":
-                await self._write_response(writer, 200, self.router.stats())
+                doc = self.router.stats()
+                doc["gateway"] = self.gateway_stats()
+                await self._write_response(writer, 200, doc)
                 return True
             if req.path == "/v1/models":
                 await self._write_response(writer, 200, {
@@ -253,6 +710,8 @@ class Gateway:
                     raise _HTTPError(405, "POST only")
                 return await self._route_completions(
                     req, writer, chat=req.path.endswith("chat/completions"))
+            if req.path.startswith("/v1/streams/"):
+                return await self._route_stream_resume(req, writer)
             if req.path.startswith("/v1/traces/"):
                 return await self._route_trace(req, writer)
             raise _HTTPError(404, f"no route {req.path}")
@@ -263,7 +722,7 @@ class Gateway:
                                              if e.status < 500 else
                                              "server_error"}},
                 headers=e.headers)
-            return e.status < 500
+            return e.status < 500 and not e.close
         except RouterShed as e:
             self._m.shed.inc()
             retry = max(1, math.ceil(e.retry_after_s))
@@ -278,6 +737,14 @@ class Gateway:
                 writer, 503, {"error": {"message": str(e),
                                         "type": "server_error"}})
             return True
+        except JournalError as e:
+            # durability could not be promised: refuse rather than accept
+            # a request a crash would silently lose
+            await self._write_response(
+                writer, 500,
+                {"error": {"message": f"journal unavailable: {e}",
+                           "type": "server_error"}})
+            return False
         except Exception as e:
             await self._write_response(
                 writer, 500,
@@ -286,6 +753,23 @@ class Gateway:
             return False
         finally:
             self._m.latency.observe(time.monotonic() - t0)
+
+    def gateway_stats(self) -> dict:
+        """The ``gateway`` block of ``GET /stats``."""
+        with self._slock:
+            retained = len(self._stream_order)
+            live = sum(1 for j in self._stream_order
+                       if not self._streams[j].terminal)
+            idem = len(self._idem)
+        return {
+            "gateway_id": self.gateway_id,
+            "journal": (self.journal.stats()
+                        if self.journal is not None else None),
+            "recovery": self.recovery_report,
+            "streams_retained": retained,
+            "streams_live": live,
+            "idempotency_keys": idem,
+        }
 
     async def _route_healthz(self, writer) -> bool:
         st = self.router.stats()
@@ -362,80 +846,143 @@ class Gateway:
                 "priority": int(doc.get("priority", 0)),
                 "deadline_s": deadline_s}
 
+    @staticmethod
+    def _last_event_id(req) -> int:
+        """The resume watermark: ``Last-Event-ID`` header (SSE standard)
+        or a ``from=`` query parameter; 0 = from the beginning."""
+        v = req.headers.get("last-event-id")
+        if v is None and req.query:
+            for part in req.query.split("&"):
+                k, _, val = part.partition("=")
+                if k == "from":
+                    v = val
+        try:
+            return max(0, int(v)) if v is not None else 0
+        except ValueError:
+            raise _HTTPError(400, f"bad Last-Event-ID {v!r}")
+
     async def _route_completions(self, req, writer, chat: bool) -> bool:
         p = self._parse_body(req, chat)
-        loop = asyncio.get_running_loop()
-        q: asyncio.Queue = asyncio.Queue()
-
-        def on_token(rr, tok):
-            loop.call_soon_threadsafe(q.put_nowait, ("tok", tok))
-
-        def on_finish(rr):
-            loop.call_soon_threadsafe(q.put_nowait, ("done", None))
-
-        # the gateway mints the request-trace context: this id follows the
-        # request through the router into every replica hop, and names the
-        # merged trace at GET /v1/traces/<id>
-        trace_id = reqtrace.new_trace_id()
+        idem = req.headers.get("idempotency-key")
         t_req0 = time.monotonic()
-        # RouterShed / NoHealthyReplica propagate to _handle's mapping
-        rr = self.router.submit(
-            p["prompt"], p["sampling"], priority=p["priority"],
-            deadline_s=p["deadline_s"], on_token=on_token,
-            on_finish=on_finish, trace_id=trace_id)
-        rid = f"{'chatcmpl' if chat else 'cmpl'}-{rr.gid}"
+        st, fresh = self._accept(p, chat, idem)
+        if not fresh:
+            # a client retry of a request this gateway (or, via the
+            # journal, a previous incarnation) already accepted:
+            # exactly-once semantics — attach or replay, never re-run
+            self._m.idem_hits.labels(
+                outcome="replay" if st.terminal else "attach").inc()
+            if p["stream"]:
+                self._m.resumes.inc()
+                return await self._stream_from(writer, st,
+                                               self._last_event_id(req))
+            return await self._respond_when_done(writer, st)
         try:
             if p["stream"]:
-                return await self._stream(writer, rr, rid, q, chat)
-            while True:                   # non-streaming: drain to terminal
-                kind, _ = await q.get()
-                if kind == "done":
-                    break
-            return await self._finish_response(writer, rr, rid, chat,
-                                               len(p["prompt"]))
+                return await self._stream_from(writer, st, 0)
+            return await self._respond_when_done(writer, st)
         finally:
             telemetry.tracer().emit(
                 "gateway.request", t_req0, time.monotonic(),
-                attrs={"trace_id": trace_id, "gid": rr.gid,
+                attrs={"trace_id": st.jid,
+                       "gid": st.rr.gid if st.rr is not None else None,
                        "route": "chat" if chat else "completions",
-                       "stream": p["stream"], "tokens": len(rr.tokens)})
+                       "stream": p["stream"], "tokens": len(st.tokens)})
 
-    async def _finish_response(self, writer, rr, rid, chat, n_prompt) -> bool:
-        if rr.state == "failed":
-            await self._write_response(
-                writer, 500,
-                {"error": {"message": rr.error or "request failed",
-                           "type": "server_error",
-                           "finish_reason": rr.finish_reason}})
-            return True
-        text = " ".join(str(t) for t in rr.tokens)
-        finish = (rr.finish_reason if rr.state == "finished"
-                  else (rr.finish_reason or "cancelled"))
-        if chat:
+    async def _route_stream_resume(self, req, writer) -> bool:
+        """``GET /v1/streams/<id>``: (re-)attach to a stream by trace id
+        or completion id, from the ``Last-Event-ID`` watermark (or
+        ``?from=N``). Running streams continue live; terminal ones replay
+        their recorded suffix. The resume contract: the client receives
+        exactly the tokens it has not seen — no duplicates, no gaps."""
+        key = req.path.rsplit("/", 1)[1]
+        st = self._find_stream(key)
+        if st is None:
+            raise _HTTPError(404, f"no stream {key!r} (streams are "
+                                  "retained for recent requests only)")
+        from_idx = self._last_event_id(req)
+        self._m.resumes.inc()
+        return await self._stream_from(writer, st, from_idx)
+
+    # -- responses ---------------------------------------------------------
+    def _completion_doc(self, st: _Stream) -> tuple[int, dict]:
+        """(status, body) for a terminal stream — built purely from the
+        stream snapshot so live responses and idempotent replays are
+        byte-identical."""
+        if st.state == "failed":
+            return 500, {"error": {"message": st.error or "request failed",
+                                   "type": "server_error",
+                                   "finish_reason": st.finish_reason}}
+        text = " ".join(str(t) for t in st.tokens)
+        finish = (st.finish_reason if st.state == "finished"
+                  else (st.finish_reason or "cancelled"))
+        if st.chat:
             choice = {"index": 0,
                       "message": {"role": "assistant", "content": text},
-                      "token_ids": rr.tokens, "finish_reason": finish}
+                      "token_ids": list(st.tokens), "finish_reason": finish}
             obj = "chat.completion"
         else:
-            choice = {"index": 0, "text": text, "token_ids": rr.tokens,
-                      "finish_reason": finish}
+            choice = {"index": 0, "text": text,
+                      "token_ids": list(st.tokens), "finish_reason": finish}
             obj = "text_completion"
-        self._m.tokens.inc(len(rr.tokens))
-        await self._write_response(writer, 200, {
-            "id": rid, "object": obj, "created": int(time.time()),
+        return 200, {
+            "id": st.rid, "object": obj, "created": st.created,
             "model": self.model_name, "choices": [choice],
-            "usage": {"prompt_tokens": n_prompt,
-                      "completion_tokens": len(rr.tokens),
-                      "total_tokens": n_prompt + len(rr.tokens)},
-            "paddle_tpu": {"replica": rr.replica,
-                           "failovers": rr.failovers,
-                           "retries": rr.retries,
-                           "trace_id": rr.trace_id}})
+            "usage": {"prompt_tokens": st.prompt_len,
+                      "completion_tokens": len(st.tokens),
+                      "total_tokens": st.prompt_len + len(st.tokens)},
+            "paddle_tpu": {"replica": st.replica,
+                           "failovers": st.failovers,
+                           "retries": st.retries,
+                           "trace_id": st.jid}}
+
+    async def _respond_when_done(self, writer, st: _Stream) -> bool:
+        """Non-streaming: wait for the terminal state, answer once."""
+        q, _, terminal = self._subscribe(st, len(st.tokens))
+        try:
+            while not terminal and not st.done.is_set():
+                kind, _, _ = await q.get()
+                if kind == "done":
+                    break
+        finally:
+            self._unsubscribe(st, q)
+        status, doc = self._completion_doc(st)
+        if status == 200:
+            self._m.tokens.inc(len(st.tokens))
+        await self._write_response(writer, status, doc)
         return True
 
-    async def _stream(self, writer, rr, rid, q, chat) -> bool:
-        """SSE: one chunk per token as it decodes; failover is invisible
-        (the router only forwards post-suppression tokens)."""
+    def _sse_chunk(self, st: _Stream, tok=None, event_id=None,
+                   finish=None, error=None, extra=None) -> bytes:
+        obj = ("chat.completion.chunk" if st.chat
+               else "text_completion.chunk")
+        if st.chat:
+            delta = {"content": f"{tok} "} if tok is not None else {}
+            c = {"index": 0, "delta": delta, "finish_reason": finish}
+        else:
+            c = {"index": 0, "text": f"{tok} " if tok is not None else "",
+                 "finish_reason": finish}
+        if tok is not None:
+            c["token_ids"] = [tok]
+        doc = {"id": st.rid, "object": obj, "model": self.model_name,
+               "choices": [c]}
+        if error is not None:
+            doc["error"] = {"message": error, "type": "server_error"}
+        if extra:
+            doc.update(extra)
+        frame = b""
+        if event_id is not None:
+            # the resume watermark: a client that reconnects with
+            # Last-Event-ID: <n> resumes after its n-th token
+            frame += f"id: {event_id}\n".encode()
+        frame += f"data: {json.dumps(doc)}\n\n".encode()
+        return frame
+
+    async def _stream_from(self, writer, st: _Stream, from_idx: int) -> bool:
+        """SSE from token index ``from_idx``: replay the retained suffix,
+        then follow live; failover is invisible (the router only forwards
+        post-suppression tokens) and a disconnect leaves the request
+        running for the next resume (unless ``cancel_on_disconnect``)."""
         head = (f"HTTP/1.1 200 OK\r\nServer: {_SERVER}\r\n"
                 "Content-Type: text/event-stream\r\n"
                 "Cache-Control: no-cache\r\nConnection: close\r\n\r\n")
@@ -443,57 +990,59 @@ class Gateway:
         await writer.drain()
         self._m.responses.labels(code="200").inc()
         self._m.active.inc()
-        obj = "chat.completion.chunk" if chat else "text_completion.chunk"
-
-        def chunk(tok=None, finish=None, error=None):
-            if chat:
-                delta = {"content": f"{tok} "} if tok is not None else {}
-                c = {"index": 0, "delta": delta, "finish_reason": finish}
-            else:
-                c = {"index": 0, "text": f"{tok} " if tok is not None
-                     else "", "finish_reason": finish}
-            if tok is not None:
-                c["token_ids"] = [tok]
-            doc = {"id": rid, "object": obj, "model": self.model_name,
-                   "choices": [c]}
-            if error is not None:
-                doc["error"] = {"message": error, "type": "server_error"}
-            return f"data: {json.dumps(doc)}\n\n".encode()
-
+        q, snapshot, terminal = self._subscribe(st, from_idx)
+        idx = from_idx
         t_first = None
+        disconnected = False
         try:
-            while True:
-                kind, tok = await q.get()
-                if kind == "tok":
+            for tok in snapshot:
+                if t_first is None:
+                    t_first = time.monotonic()
+                writer.write(self._sse_chunk(st, tok=tok, event_id=idx + 1))
+                idx += 1
+                self._m.tokens.inc()
+            await writer.drain()
+            if not terminal:
+                while True:
+                    kind, i, tok = await q.get()
+                    if kind == "done":
+                        break
+                    if i < idx:
+                        continue           # already covered by the snapshot
                     if t_first is None:
                         t_first = time.monotonic()
+                    writer.write(self._sse_chunk(st, tok=tok,
+                                                 event_id=i + 1))
+                    idx = i + 1
                     self._m.tokens.inc()
-                    writer.write(chunk(tok=tok))
                     await writer.drain()
-                    continue
-                break                                    # done
-            finish = (rr.finish_reason or rr.state)
-            final = chunk(finish=finish,
-                          error=rr.error if rr.state == "failed" else None)
-            # the trace id rides the final chunk so an SSE client can pull
-            # GET /v1/traces/<id> for its own request
-            doc = json.loads(final[6:-2])
-            doc["paddle_tpu"] = {"trace_id": rr.trace_id,
-                                 "replica": rr.replica,
-                                 "failovers": rr.failovers}
-            writer.write(f"data: {json.dumps(doc)}\n\n".encode())
+            finish = st.finish_reason or st.state
+            final = self._sse_chunk(
+                st, finish=finish,
+                error=st.error if st.state == "failed" else None,
+                extra={"paddle_tpu": {"trace_id": st.jid,
+                                      "replica": st.replica,
+                                      "failovers": st.failovers}})
+            writer.write(final)
             writer.write(b"data: [DONE]\n\n")
             await writer.drain()
         except (ConnectionError, asyncio.CancelledError):
-            # client hung up mid-stream: release the engine work
-            self.router.cancel(rr.gid)
+            disconnected = True
+            if self.cancel_on_disconnect and not st.terminal \
+                    and st.rr is not None:
+                # old stateless behavior: client gone => release the work
+                self.router.cancel(st.rr.gid)
+            # durable behavior: detach only — the decode keeps running and
+            # the journal keeps filling, so a reconnect picks up the tail
         finally:
+            self._unsubscribe(st, q)
             self._m.active.dec()
             if t_first is not None:
-                # SSE-flush window: first chunk written -> stream closed
-                # (the waterfall's "how long did streaming take" row)
                 telemetry.tracer().emit(
                     "gateway.sse", t_first, time.monotonic(),
-                    attrs={"trace_id": rr.trace_id, "gid": rr.gid,
-                           "tokens": len(rr.tokens)})
+                    attrs={"trace_id": st.jid,
+                           "gid": st.rr.gid if st.rr is not None else None,
+                           "tokens": idx - from_idx,
+                           "resumed_from": from_idx,
+                           "disconnected": disconnected})
         return False                        # Connection: close

@@ -41,6 +41,20 @@ placement/health plane (docs/SERVING.md "Fleet serving"):
   :class:`~paddle_tpu.resilience.ElasticSupervisor`'s restart budget and
   ledger, so replica churn shows up in the same ``job_state.json`` record
   as training restarts.
+- **Circuit breakers + retry budget.** A replica can be *alive* (process
+  up, heartbeating) yet failing every request it is handed — a poisoned
+  compile cache, a bad device. Each replica carries a
+  :class:`CircuitBreaker` over its rolling dispatch outcomes: past
+  ``breaker_failure_rate`` over ``breaker_window_s`` (with at least
+  ``breaker_min_samples`` outcomes) it trips OPEN and placement skips the
+  replica; after ``breaker_cooldown_s`` one HALF_OPEN probe request is
+  allowed through — success closes the breaker, failure re-opens it.
+  Orthogonally, a global **retry budget** caps re-dispatch volume: re-
+  dispatches (failovers + engine-failure retries) within
+  ``retry_budget_window_s`` may not exceed ``retry_budget_min +
+  retry_budget_ratio * first_dispatches`` — when the budget is spent the
+  request fails fast (``retry_budget_exhausted``) instead of feeding a
+  retry storm against a sick fleet.
 
 Chaos sites: ``router.submit`` (per submission), ``router.dispatch`` (per
 dispatch attempt; an injected error is treated as a failed dispatch and the
@@ -73,7 +87,7 @@ from .scheduler import SamplingParams
 __all__ = [
     "FleetRouter", "RouterRequest", "ReplicaState", "LocalReplica",
     "ProcReplica", "RouterShed", "NoHealthyReplica", "ReplayMismatch",
-    "sampling_to_dict", "sampling_from_dict",
+    "CircuitBreaker", "sampling_to_dict", "sampling_from_dict",
 ]
 
 
@@ -123,6 +137,84 @@ class ReplicaState(enum.Enum):
 _NON_RETRYABLE = ("ValueError",)
 
 
+class CircuitBreaker:
+    """Rolling failure-rate breaker over one replica's dispatch outcomes.
+
+    States: CLOSED (normal placement) -> OPEN (failure rate over the
+    window crossed ``failure_rate`` with >= ``min_samples`` outcomes;
+    placement skips the replica) -> HALF_OPEN (cooldown elapsed; exactly
+    one probe request may be placed) -> CLOSED on probe success / OPEN on
+    probe failure. All transitions happen under the router lock.
+    """
+
+    def __init__(self, *, window_s: float = 30.0, min_samples: int = 4,
+                 failure_rate: float = 0.5, cooldown_s: float = 2.0):
+        self.window_s = float(window_s)
+        self.min_samples = int(min_samples)
+        self.failure_rate = float(failure_rate)
+        self.cooldown_s = float(cooldown_s)
+        self.state = "closed"            # closed | open | half_open
+        self.trips = 0
+        self.probes = 0
+        self._events: list[tuple[float, bool]] = []   # (t, ok)
+        self._opened_at = 0.0
+        self._probe_inflight = False
+
+    def _prune(self, now: float):
+        cutoff = now - self.window_s
+        self._events = [e for e in self._events if e[0] >= cutoff]
+
+    def _trip(self, now: float):
+        self.state = "open"
+        self.trips += 1
+        self._opened_at = now
+        self._probe_inflight = False
+        self._events.clear()
+
+    def record(self, ok: bool, now: float | None = None):
+        """One dispatch outcome (request finished vs failed on the
+        replica). A HALF_OPEN probe's outcome decides the next state."""
+        now = time.monotonic() if now is None else now
+        if self.state == "half_open":
+            self._probe_inflight = False
+            if ok:
+                self.state = "closed"
+                self._events.clear()
+            else:
+                self._trip(now)
+            return
+        if self.state == "open":
+            return                        # stale outcome from before the trip
+        self._events.append((now, ok))
+        self._prune(now)
+        fails = sum(1 for _, k in self._events if not k)
+        total = len(self._events)
+        if total >= self.min_samples and fails / total >= self.failure_rate:
+            self._trip(now)
+
+    def allow(self, now: float | None = None) -> bool:
+        """May placement hand this replica a request right now? An OPEN
+        breaker whose cooldown elapsed transitions to HALF_OPEN and admits
+        exactly one probe."""
+        now = time.monotonic() if now is None else now
+        if self.state == "closed":
+            return True
+        if self.state == "open":
+            if now - self._opened_at < self.cooldown_s:
+                return False
+            self.state = "half_open"
+        if self._probe_inflight:
+            return False
+        return True
+
+    def note_probe(self):
+        """Placement chose this HALF_OPEN replica: the next outcome is the
+        probe verdict."""
+        if self.state == "half_open":
+            self._probe_inflight = True
+            self.probes += 1
+
+
 class RouterRequest:
     """The router-side handle for one client stream.
 
@@ -134,7 +226,8 @@ class RouterRequest:
 
     def __init__(self, gid: int, prompt, sampling: dict, *, priority=0,
                  deadline: float | None = None, on_token=None,
-                 on_finish=None, trace_id: str | None = None):
+                 on_finish=None, trace_id: str | None = None,
+                 on_watermark=None, watermark_every: int = 8):
         self.gid = gid
         self.prompt = [int(t) for t in prompt]
         self.sampling = dict(sampling)
@@ -142,6 +235,11 @@ class RouterRequest:
         self.deadline = deadline            # absolute time.monotonic()
         self.on_token = on_token            # callable(rr, token)
         self.on_finish = on_finish          # callable(rr)
+        # durable-lifecycle watermark: called with (rr, n_tokens) every
+        # ``watermark_every`` delivered tokens — the gateway's journal
+        # cadence (suppressed replay tokens never re-fire it)
+        self.on_watermark = on_watermark
+        self.watermark_every = max(1, int(watermark_every))
         self.tokens: list[int] = []
         self.state = "queued"
         self.finish_reason: str | None = None
@@ -551,7 +649,23 @@ def _router_metrics() -> SimpleNamespace:
             "router_inflight_requests", "requests currently dispatched"),
         healthy=reg.gauge(
             "router_replicas_healthy", "replicas in the HEALTHY state"),
+        breaker_trips=reg.counter(
+            "router_breaker_trips_total",
+            "circuit-breaker OPEN transitions", ("replica",)),
+        breaker_probes=reg.counter(
+            "router_breaker_probes_total",
+            "HALF_OPEN probe dispatches", ("replica",)),
+        breaker_state=reg.gauge(
+            "router_breaker_state",
+            "per-replica breaker state (0 closed, 1 half-open, 2 open)",
+            ("replica",)),
+        budget_denied=reg.counter(
+            "router_retry_budget_denied_total",
+            "re-dispatches refused by the global retry budget"),
     )
+
+
+_BREAKER_STATE_NUM = {"closed": 0, "half_open": 1, "open": 2}
 
 
 class FleetRouter:
@@ -576,6 +690,19 @@ class FleetRouter:
                     whose restart budget/ledger governs replica restarts.
     auto_restart:   restart UNHEALTHY replicas automatically (through the
                     supervisor when one is set).
+    retry_after_s:  floor (and no-signal fallback) for the *derived*
+                    Retry-After hint a shed carries — the actual value is
+                    estimated from the fleet's SLO windows
+                    (:meth:`_derive_retry_after`).
+    breaker_window_s / breaker_min_samples / breaker_failure_rate /
+    breaker_cooldown_s: per-replica :class:`CircuitBreaker` tuning —
+                    rolling outcome window, minimum outcomes before a
+                    verdict, the OPEN-tripping failure rate, and how long
+                    an OPEN breaker waits before its HALF_OPEN probe.
+    retry_budget_ratio / retry_budget_min / retry_budget_window_s: the
+                    global re-dispatch cap — re-dispatches (failovers +
+                    retries) in the window may not exceed
+                    ``min + ratio * first_dispatches``.
     """
 
     def __init__(self, replicas, *, probe_interval_s: float = 0.25,
@@ -587,7 +714,14 @@ class FleetRouter:
                  affinity_block_size: int = 16,
                  supervisor=None, auto_restart: bool = False,
                  verify_replay: bool = True, rng_seed: int = 0,
-                 retain_terminal: int = 4096):
+                 retain_terminal: int = 4096,
+                 breaker_window_s: float = 30.0,
+                 breaker_min_samples: int = 4,
+                 breaker_failure_rate: float = 0.5,
+                 breaker_cooldown_s: float = 2.0,
+                 retry_budget_ratio: float = 0.5,
+                 retry_budget_min: int = 8,
+                 retry_budget_window_s: float = 30.0):
         self.replicas: dict[str, object] = {r.rid: r for r in replicas}
         self._order = [r.rid for r in replicas]
         self.probe_interval_s = float(probe_interval_s)
@@ -610,6 +744,19 @@ class FleetRouter:
         self._inflight: dict[str, set[int]] = {r: set() for r in self._order}
         self._stall_seen: dict[str, int] = {r: 0 for r in self._order}
         self._restart_at: dict[str, float] = {}
+        # per-replica circuit breakers over dispatch outcomes (an alive
+        # replica that fails everything it touches must stop getting
+        # traffic) + the global retry budget that bounds re-dispatches
+        self.breakers: dict[str, CircuitBreaker] = {
+            r: CircuitBreaker(window_s=breaker_window_s,
+                              min_samples=breaker_min_samples,
+                              failure_rate=breaker_failure_rate,
+                              cooldown_s=breaker_cooldown_s)
+            for r in self._order}
+        self.retry_budget_ratio = float(retry_budget_ratio)
+        self.retry_budget_min = int(retry_budget_min)
+        self.retry_budget_window_s = float(retry_budget_window_s)
+        self._dispatch_log: list[tuple[float, bool]] = []  # (t, redispatch)
         self._m = _router_metrics()
         # per-router counts for stats(): the registry families above are
         # process-global (shared by every router in the process), so the
@@ -617,7 +764,8 @@ class FleetRouter:
         self._c = {k: 0 for k in (
             "dispatches", "failovers", "retries", "shed", "affinity_hits",
             "p2c_placements", "replay_suppressed", "replay_mismatches",
-            "drains", "replica_restarts", "replica_deaths")}
+            "drains", "replica_restarts", "replica_deaths",
+            "breaker_trips", "breaker_probes", "retry_budget_denied")}
         self._by_trace: dict[str, RouterRequest] = {}
         self._probe_thread: threading.Thread | None = None
         self._stop = threading.Event()
@@ -671,13 +819,26 @@ class FleetRouter:
     def submit(self, prompt, sampling: SamplingParams | dict | None = None,
                *, priority: int = 0, deadline_s: float | None = None,
                on_token=None, on_finish=None,
-               trace_id: str | None = None) -> RouterRequest:
+               trace_id: str | None = None,
+               on_watermark=None, watermark_every: int = 8,
+               replay_tokens=None,
+               bypass_shed: bool = False) -> RouterRequest:
         """Place and dispatch one request; returns the live
         :class:`RouterRequest`. Raises :class:`RouterShed` (shed — retry
         later) or :class:`NoHealthyReplica` (no capacity at all).
         ``trace_id`` carries the gateway's request-trace context; without
         one the router mints its own, so every routed request has exactly
-        one id its spans — local and replica-side — are merged under."""
+        one id its spans — local and replica-side — are merged under.
+
+        ``replay_tokens`` is the gateway crash-recovery hook: the tokens a
+        previous gateway incarnation already journaled/delivered. They
+        pre-seed the handle and arm the same replay-and-suppress machinery
+        failover uses — the replica regenerates the stream from index 0,
+        the first ``len(replay_tokens)`` are verified against the journal
+        and swallowed, and ``on_token`` fires only for genuinely new
+        tokens. ``bypass_shed`` admits the request even when every healthy
+        replica sheds (recovery re-submissions were *already* accepted —
+        shedding them now would lose them)."""
         if self.closed:
             raise NoHealthyReplica("router is closed")
         faults.inject("router.submit", priority=priority)
@@ -688,10 +849,16 @@ class FleetRouter:
         rr = RouterRequest(next(self._gids), prompt, sampling,
                            priority=priority, deadline=deadline,
                            on_token=on_token, on_finish=on_finish,
-                           trace_id=trace_id)
+                           trace_id=trace_id, on_watermark=on_watermark,
+                           watermark_every=watermark_every)
+        if replay_tokens:
+            rr.tokens = [int(t) for t in replay_tokens]
+            rr.suppress = len(rr.tokens)
+            rr._failover_t0 = time.monotonic()
         t0 = time.monotonic()
         with self._lock:
-            rep = self._place(rr.prompt, rr.priority)
+            rep = self._place(rr.prompt, rr.priority,
+                              bypass_shed=bypass_shed)
             self._prune_terminal()
             self._requests[rr.gid] = rr
             self._by_trace[rr.trace_id] = rr
@@ -747,6 +914,76 @@ class FleetRouter:
         slo = (rep.stats or {}).get("slo") or {}
         return bool(slo.get("shed"))
 
+    def _derive_retry_after(self, healthy) -> float:
+        """An honest Retry-After for the 429: Little's law over the SLO
+        windows the healthy replicas heartbeat — work ahead (dispatched +
+        replica-queued) divided by the fleet's observed completion rate —
+        falling back to observed TPOT when the window has no completions
+        yet, and to the configured ``retry_after_s`` floor when the fleet
+        has no signal at all. Clamped to [retry_after_s, 60s]."""
+        rate = 0.0
+        queued = 0
+        tpots = []
+        for rep in healthy:
+            slo = (rep.stats or {}).get("slo") or {}
+            n = slo.get("window_requests") or 0
+            w = slo.get("window_s") or 0.0
+            if n and w:
+                rate += n / float(w)
+            tp = (slo.get("tpot") or {}).get("p50")
+            if tp:
+                tpots.append(float(tp))
+            queued += int((rep.stats or {}).get("queue_depth") or 0)
+        ahead = sum(len(s) for s in self._inflight.values()) + queued
+        if rate > 0:
+            est = (ahead + 1) / rate
+        elif tpots:
+            est = (ahead + 1) * (sum(tpots) / len(tpots))
+        else:
+            est = self.retry_after_s
+        return float(min(max(est, self.retry_after_s), 60.0))
+
+    # -- circuit breakers / retry budget -----------------------------------
+    def _breaker_record(self, rid: str, ok: bool):
+        """One dispatch outcome lands on the replica's breaker (under the
+        lock); an OPEN transition is counted and the state gauge synced."""
+        br = self.breakers.get(rid)
+        if br is None:
+            return
+        trips_before = br.trips
+        br.record(ok)
+        if br.trips > trips_before:
+            self._m.breaker_trips.labels(replica=rid).inc()
+            self._c["breaker_trips"] += 1
+            telemetry.record_event("router.breaker_open", replica=rid,
+                                   trips=br.trips)
+        self._m.breaker_state.labels(replica=rid).set(
+            _BREAKER_STATE_NUM[br.state])
+
+    def _budget_ok(self, now: float | None = None) -> bool:
+        """Is there retry budget left (under the lock)? Re-dispatches in
+        the window are capped at ``retry_budget_min + retry_budget_ratio *
+        first_dispatches`` — a sick fleet fast-fails instead of feeding a
+        retry storm."""
+        now = time.monotonic() if now is None else now
+        cutoff = now - self.retry_budget_window_s
+        self._dispatch_log = [e for e in self._dispatch_log
+                              if e[0] >= cutoff]
+        first = sum(1 for _, re in self._dispatch_log if not re)
+        redisp = sum(1 for _, re in self._dispatch_log if re)
+        return redisp < self.retry_budget_min + \
+            self.retry_budget_ratio * first
+
+    def _budget_deny(self, rr: "RouterRequest", origin: str):
+        """Finish a request the retry budget refused to re-dispatch."""
+        self._m.budget_denied.inc()
+        self._c["retry_budget_denied"] += 1
+        telemetry.record_event("router.retry_budget_denied", gid=rr.gid,
+                               origin=origin)
+        rr._finish("failed", "retry_budget_exhausted",
+                   f"retry budget exhausted (origin: {origin}; "
+                   f"window {self.retry_budget_window_s:.0f}s)")
+
     def _affinity_key(self, prompt) -> int | None:
         bs = self.affinity_block_size
         nb = max(0, (len(prompt) - 1) // bs)   # full, shareable blocks only
@@ -758,14 +995,37 @@ class FleetRouter:
 
     def _place(self, prompt, priority: int, exclude=(),
                bypass_shed: bool = False):
-        """Pick a replica. Called under the lock."""
-        healthy = [self.replicas[r] for r in self._order
-                   if self.replicas[r].state is ReplicaState.HEALTHY
-                   and r not in exclude]
-        if not healthy:
+        """Pick a replica (under the lock); a HALF_OPEN pick is marked as
+        that breaker's probe — its outcome decides the breaker's fate."""
+        rep = self._pick(prompt, priority, exclude=exclude,
+                         bypass_shed=bypass_shed)
+        br = self.breakers.get(rep.rid)
+        if br is not None and br.state == "half_open":
+            br.note_probe()
+            self._m.breaker_probes.labels(replica=rep.rid).inc()
+            self._c["breaker_probes"] += 1
+            telemetry.record_event("router.breaker_probe", replica=rep.rid)
+        return rep
+
+    def _pick(self, prompt, priority: int, exclude=(),
+              bypass_shed: bool = False):
+        """The placement decision. Called under the lock."""
+        alive = [self.replicas[r] for r in self._order
+                 if self.replicas[r].state is ReplicaState.HEALTHY
+                 and r not in exclude]
+        if not alive:
             raise NoHealthyReplica(
                 f"no healthy replica "
                 f"({ {r: self.replicas[r].state.value for r in self._order} })")
+        # circuit breakers: an alive replica that fails everything it is
+        # handed is OPEN and skipped; a cooled-down one admits one
+        # HALF_OPEN probe. All breakers open => fast-fail, not a storm.
+        healthy = [r for r in alive if self.breakers[r.rid].allow()]
+        if not healthy:
+            states = {r.rid: self.breakers[r.rid].state for r in alive}
+            raise NoHealthyReplica(
+                f"all {len(alive)} alive replicas have open circuit "
+                f"breakers ({states})")
         eligible = [r for r in healthy if not self._is_shedding(r)]
         if not eligible:
             if bypass_shed or priority >= self.shed_bypass_priority:
@@ -775,12 +1035,13 @@ class FleetRouter:
                 self._c["shed"] += 1
                 telemetry.record_event("router.shed", priority=priority,
                                        healthy=len(healthy))
+                retry_after = self._derive_retry_after(healthy)
                 raise RouterShed(
                     f"all {len(healthy)} healthy replicas are shedding "
                     f"(priority {priority} < bypass "
                     f"{self.shed_bypass_priority}); retry after "
-                    f"{self.retry_after_s:.1f}s",
-                    retry_after_s=self.retry_after_s)
+                    f"{retry_after:.1f}s",
+                    retry_after_s=retry_after)
         # prefix affinity: a stable hash over the block-aligned prefix
         # names the preferred replica so shared prefixes keep hitting the
         # same engine's prefix cache
@@ -819,6 +1080,7 @@ class FleetRouter:
                           "sampling": rr.sampling, "deadline_s": deadline_s,
                           "trace_id": rr.trace_id})
             except (BrokenPipeError, faults.FaultError) as e:
+                self._breaker_record(rep.rid, ok=False)
                 exclude.add(rep.rid)
                 try:
                     rep2 = self._place(rr.prompt, rr.priority,
@@ -834,6 +1096,7 @@ class FleetRouter:
         rr.replica = rep.rid
         rr.state = "running"
         rr.dispatches += 1
+        self._dispatch_log.append((time.monotonic(), rr.dispatches > 1))
         self._close_hop(rr)
         rr.hop_log.append({"replica": rep.rid, "t0": time.monotonic(),
                            "t1": None, "suppress": rr.suppress})
@@ -947,8 +1210,15 @@ class FleetRouter:
             if rr.first_token_time is None:
                 rr.first_token_time = time.monotonic()
             cb = rr.on_token
+            wm_cb = None
+            n = len(rr.tokens)
+            if rr.on_watermark is not None and \
+                    n % rr.watermark_every == 0:
+                wm_cb = rr.on_watermark
         if cb is not None:
             cb(rr, int(tok))
+        if wm_cb is not None:
+            wm_cb(rr, n)
 
     def _on_done(self, rep, ev: dict):
         gid = ev["gid"]
@@ -961,6 +1231,7 @@ class FleetRouter:
             self._untrack(rr)
             self._close_hop(rr)
             if state == "finished":
+                self._breaker_record(rep.rid, ok=True)
                 rr._finish("finished", reason or "stop", None)
                 return
             if state == "cancelled":
@@ -973,7 +1244,14 @@ class FleetRouter:
             # state == "failed": retry on another replica unless the error
             # is a deterministic property of the request itself
             retryable = not (error or "").startswith(_NON_RETRYABLE)
+            if retryable:
+                # a request-shaped failure (bad params) says nothing about
+                # the replica; everything else is a replica outcome
+                self._breaker_record(rep.rid, ok=False)
             if retryable and rr.retries < self.max_retries:
+                if not self._budget_ok():
+                    self._budget_deny(rr, f"retry after: {error}")
+                    return
                 t0 = time.monotonic()
                 rr.retries += 1
                 self._m.retries.inc()
@@ -1030,6 +1308,10 @@ class FleetRouter:
         suppressed. Never shed — this stream is already in flight."""
         t0 = time.monotonic()
         from_replica = rr.replica
+        if not self._budget_ok():
+            self._close_hop(rr)
+            self._budget_deny(rr, f"failover from {from_replica}")
+            return
         rr.failovers += 1
         rr.suppress = len(rr.tokens)
         rr._failover_t0 = t0
@@ -1112,6 +1394,14 @@ class FleetRouter:
         rep.last_heartbeat = 0.0
         with self._lock:
             self._stall_seen[rep.rid] = 0
+            # a restart is a fresh start: the old incarnation's failure
+            # history must not keep the new one fenced off
+            br = self.breakers.get(rep.rid)
+            if br is not None:
+                br.state = "closed"
+                br._events.clear()
+                br._probe_inflight = False
+                self._m.breaker_state.labels(replica=rep.rid).set(0)
         rep.start(self._on_event)
         self._m.restarts.inc()
         self._c["replica_restarts"] += 1
@@ -1257,6 +1547,7 @@ class FleetRouter:
             reps = {}
             for rid in self._order:
                 rep = self.replicas[rid]
+                br = self.breakers.get(rid)
                 reps[rid] = {
                     "kind": rep.kind,
                     "state": rep.state.value,
@@ -1264,6 +1555,8 @@ class FleetRouter:
                     "inflight": self._load(rid),
                     "heartbeat_age_s": (now - rep.last_heartbeat
                                         if rep.last_heartbeat else None),
+                    "breaker": br.state if br is not None else None,
+                    "breaker_trips": br.trips if br is not None else 0,
                     "slo": (rep.stats or {}).get("slo"),
                     "stats": {k: v for k, v in (rep.stats or {}).items()
                               if k not in ("slo", "prefix_cache")},

@@ -92,6 +92,12 @@ class Request:
     prompt: list[int]
     sampling: SamplingParams
     on_token: object = None            # callable(req, token) per new token
+    # durable-lifecycle watermark (serving/journal.py): called with
+    # (req, n_tokens) whenever the output length crosses a multiple of
+    # watermark_every — the coarse progress signal a write-ahead journal
+    # records without paying one append per token
+    on_watermark: object = None
+    watermark_every: int = 8
     state: RequestState = RequestState.WAITING
     output_tokens: list[int] = field(default_factory=list)
     cached_tokens: int = 0             # prefix-cache hit at last admission
@@ -138,6 +144,9 @@ class Request:
             self.first_token_time = time.monotonic()
         if self.on_token is not None:
             self.on_token(self, int(token))
+        if self.on_watermark is not None and \
+                len(self.output_tokens) % max(1, self.watermark_every) == 0:
+            self.on_watermark(self, len(self.output_tokens))
 
 
 class Scheduler:

@@ -52,6 +52,10 @@ Grammar (``;``-separated specs)::
            stale_hash inject() returns "stale_hash"; the prefix index
                       behaves as if it resolved a wrong-content block
                       (the cache drops the whole match: no-share fallback)
+           torn_write inject() returns "torn_write"; the gateway journal
+                      writes half a frame and raises JournalTornWrite —
+                      simulated process death mid-append (recovery must
+                      detect the torn record by CRC and skip it)
     @start 1-based call index at which the spec starts firing (default 1)
     xcount how many consecutive calls fire (default 1; ``x*`` = forever)
     %prob  instead of @/x determinism, fire each call with probability
@@ -75,6 +79,12 @@ Known sites (see docs/ROBUSTNESS.md for the full table):
     gateway.request       per parsed HTTP request in the serving gateway
                           (error => that request answers 500; the
                           connection layer and every other stream survive)
+    gateway.journal.append per journal record append (error => the append
+                          raises and the gateway refuses the request —
+                          durability is never silently dropped;
+                          torn_write => half the frame is written, then
+                          JournalTornWrite: death mid-write)
+    gateway.journal.fsync per journal fsync() (delay => a slow disk)
     router.submit         per FleetRouter submission (error surfaces to
                           the caller before placement)
     router.dispatch       per dispatch attempt to a replica (error =>
@@ -119,7 +129,8 @@ class FaultError(RuntimeError):
 
 _SPEC_RE = re.compile(
     r"^(?P<site>[\w.\-]+):"
-    r"(?P<kind>error|delay|exhaust|nan_grads|bad_batch|stale_hash)"
+    r"(?P<kind>error|delay|exhaust|nan_grads|bad_batch|stale_hash"
+    r"|torn_write)"
     r"(?:=(?P<arg>[^@x%;]+))?"
     r"(?:@(?P<start>\d+))?"
     r"(?:x(?P<count>\d+|\*))?"
@@ -153,7 +164,8 @@ class FaultSpec:
     # which decides what the fault means there (exhaust => resource dry,
     # nan_grads => poisoned gradients, bad_batch => NaN batch,
     # stale_hash => prefix index resolved wrong content)
-    TOKEN_KINDS = ("exhaust", "nan_grads", "bad_batch", "stale_hash")
+    TOKEN_KINDS = ("exhaust", "nan_grads", "bad_batch", "stale_hash",
+                   "torn_write")
 
     def __post_init__(self):
         if self.kind not in ("error", "delay") + self.TOKEN_KINDS:

@@ -1,0 +1,316 @@
+"""Durable request lifecycle over a live fleet (ISSUE 12): idempotency
+keys (concurrent + after-completion retries are byte-identical, exactly
+one generation), resumable SSE (monotonic ``id:`` lines, ``Last-Event-ID``
+reconnect receives exactly the missing suffix), gateway crash-recovery
+from the write-ahead journal (replay-and-suppress through the router,
+token parity), and the engine-level watermark callbacks.
+"""
+import http.client
+import json
+import threading
+import time
+
+import pytest
+
+import paddle_tpu
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+from paddle_tpu.serving import (
+    FleetRouter, Gateway, LLMEngine, LocalReplica, SamplingParams,
+    naive_generate)
+from paddle_tpu.serving.journal import scan_dir
+from paddle_tpu.utils import faults
+from paddle_tpu.utils.faults import FaultPlan
+
+pytestmark = [pytest.mark.durable, pytest.mark.fleet]
+
+VOCAB = 61
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults.deactivate()
+
+
+def build_model():
+    paddle_tpu.seed(0)
+    cfg = llama_tiny(vocab=VOCAB, hidden=32, layers=2, heads=4, kv_heads=2,
+                     inter=64, seq=64)
+    return LlamaForCausalLM(cfg)
+
+
+def factory():
+    return LLMEngine(build_model(), block_size=8, max_slots=2,
+                     max_model_len=64)
+
+
+@pytest.fixture(scope="module")
+def refmodel():
+    return build_model()
+
+
+# one shared reference stream per prompt, computed at the longest length a
+# test needs: sampling is keyed (seed, output index), so naive_generate's
+# prefix is the reference for every shorter max_new — one set of jit
+# shapes instead of one per test
+PROMPT_A = [3, 1, 4, 1, 5, 9, 2, 6, 5]
+PROMPT_B = [9, 8, 7, 6, 5, 4, 3, 2, 1]
+
+
+@pytest.fixture(scope="module")
+def refs(refmodel):
+    sp = SamplingParams(max_new_tokens=10)
+    return {"A": naive_generate(refmodel, PROMPT_A, sp),
+            "B": naive_generate(refmodel, PROMPT_B, sp)}
+
+
+def start_fleet(journal_dir, n=2, **gw_kw):
+    reps = [LocalReplica(f"d{i}", factory, stats_interval_s=0.02,
+                         warmup=list(range(1, 11))) for i in range(n)]
+    router = FleetRouter(reps, probe_interval_s=0.05, probe_timeout_s=10.0,
+                         affinity_block_size=8).start(wait_healthy_s=120)
+    gw = Gateway(router, journal_dir=journal_dir,
+                 journal_watermark_every=2, **gw_kw).start()
+    return gw, router
+
+
+@pytest.fixture(scope="module")
+def fleet(tmp_path_factory):
+    jdir = tmp_path_factory.mktemp("journal")
+    gw, router = start_fleet(str(jdir))
+    yield gw, router
+    gw.stop()
+    router.close()
+
+
+def post(gw, body, headers=None):
+    conn = http.client.HTTPConnection(gw.host, gw.port, timeout=120)
+    h = {"Content-Type": "application/json"}
+    h.update(headers or {})
+    conn.request("POST", "/v1/completions", json.dumps(body), h)
+    return conn.getresponse(), conn
+
+
+def get(gw, path, headers=None):
+    conn = http.client.HTTPConnection(gw.host, gw.port, timeout=120)
+    conn.request("GET", path, None, headers or {})
+    return conn.getresponse(), conn
+
+
+def read_sse(resp, stop_after=None):
+    """(ids, tokens, finish, trace_id) from an SSE body; ``stop_after``
+    returns early once that many tokens arrived (connection stays open)."""
+    ids, toks, finish, trace_id = [], [], None, None
+    while True:
+        line = resp.readline()
+        if not line:
+            break
+        line = line.decode().strip()
+        if line.startswith("id: "):
+            ids.append(int(line[4:]))
+            continue
+        if not line.startswith("data: "):
+            continue
+        if line == "data: [DONE]":
+            break
+        doc = json.loads(line[6:])
+        ch = doc["choices"][0]
+        toks += ch.get("token_ids") or []
+        finish = ch.get("finish_reason") or finish
+        if doc.get("paddle_tpu"):
+            trace_id = doc["paddle_tpu"].get("trace_id")
+        if stop_after is not None and len(toks) >= stop_after:
+            break
+    return ids, toks, finish, trace_id
+
+
+class TestIdempotency:
+    def test_concurrent_and_late_retries_byte_identical(self, fleet, refs):
+        gw, router = fleet
+        prompt = PROMPT_A
+        ref = refs["A"][:6]
+        bodies, statuses = [], []
+
+        def do_post():
+            r, c = post(gw, {"prompt": prompt, "max_tokens": 6},
+                        {"Idempotency-Key": "idem-A"})
+            statuses.append(r.status)
+            bodies.append(r.read())
+            c.close()
+
+        base_dispatches = router.stats()["dispatches"]
+        ts = [threading.Thread(target=do_post) for _ in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(120)
+        # a retry long after completion replays the recorded result
+        do_post()
+        assert statuses == [200] * 4
+        assert len(set(bodies)) == 1               # byte-identical
+        doc = json.loads(bodies[0])
+        assert doc["choices"][0]["token_ids"] == ref
+        # exactly ONE generation happened for the four submissions
+        assert router.stats()["dispatches"] == base_dispatches + 1
+
+    def test_distinct_keys_generate_independently(self, fleet):
+        gw, router = fleet
+        base = router.stats()["dispatches"]
+        for key in ("idem-B", "idem-C"):
+            r, c = post(gw, {"prompt": [5, 5, 5, 5], "max_tokens": 2},
+                        {"Idempotency-Key": key})
+            assert r.status == 200
+            r.read()
+            c.close()
+        assert router.stats()["dispatches"] == base + 2
+
+
+class TestResumableSSE:
+    def test_ids_are_monotonic_and_resume_is_exact(self, fleet, refs):
+        gw, router = fleet
+        prompt = PROMPT_B
+        ref = refs["B"][:8]
+        # slow decode keeps the stream alive across the disconnect window
+        with FaultPlan.parse("serving.decode:delay=0.02x*"):
+            r, c = post(gw, {"prompt": prompt, "max_tokens": 8,
+                             "stream": True},
+                        {"Idempotency-Key": "idem-sse"})
+            assert r.status == 200
+            ids, toks, _, _ = read_sse(r, stop_after=3)
+            c.close()                      # client drops mid-stream
+            assert ids == [1, 2, 3]
+            # reconnect with Last-Event-ID: exactly the missing suffix
+            r2, c2 = post(gw, {"prompt": prompt, "max_tokens": 8,
+                               "stream": True},
+                          {"Idempotency-Key": "idem-sse",
+                           "Last-Event-ID": str(ids[-1])})
+            ids2, toks2, finish, _ = read_sse(r2)
+            c2.close()
+        assert toks + toks2 == ref         # no duplicate, no gap
+        assert ids2[0] == ids[-1] + 1 and ids2 == sorted(ids2)
+        assert finish == "length"
+
+    def test_get_streams_replays_terminal_stream(self, fleet, refs):
+        gw, _ = fleet
+        prompt = PROMPT_A
+        ref = refs["A"][:5]
+        r, c = post(gw, {"prompt": prompt, "max_tokens": 5})
+        doc = json.loads(r.read())
+        c.close()
+        trace_id = doc["paddle_tpu"]["trace_id"]
+        # full replay by trace id
+        r2, c2 = get(gw, f"/v1/streams/{trace_id}")
+        assert r2.status == 200
+        ids, toks, finish, tid = read_sse(r2)
+        c2.close()
+        assert toks == ref and finish == "length" and tid == trace_id
+        # suffix replay by completion id, from a watermark
+        r3, c3 = get(gw, f"/v1/streams/{doc['id']}?from=3")
+        _, tail, _, _ = read_sse(r3)
+        c3.close()
+        assert tail == ref[3:]
+        # unknown stream: 404
+        r4, c4 = get(gw, "/v1/streams/nope")
+        assert r4.status == 404
+        c4.close()
+
+    def test_disconnect_does_not_cancel_durable_stream(self, fleet):
+        gw, router = fleet
+        with FaultPlan.parse("serving.decode:delay=0.02x*"):
+            r, c = post(gw, {"prompt": [6, 6, 6, 6, 6], "max_tokens": 6,
+                             "stream": True},
+                        {"Idempotency-Key": "idem-drop"})
+            read_sse(r, stop_after=1)
+            c.close()
+        st = gw._find_idem("idem-drop")
+        assert st is not None
+        assert st.done.wait(60)            # ran to completion unattended
+        assert st.state == "finished" and len(st.tokens) == 6
+
+
+class TestCrashRecovery:
+    def test_crash_recovery_with_torn_tail(self, refs, tmp_path):
+        """Crash the gateway with TWO streams mid-flight (no terminal
+        journal records, no graceful shutdown), then physically tear the
+        journal's final record. A fresh gateway over the same journal
+        detects the torn frame by CRC, skips it, and re-submits both
+        accepted-non-terminal requests through the replay-and-suppress
+        path; the reconnecting clients receive exactly their missing
+        suffixes and the assembled streams are token-for-token equal to
+        an uninterrupted run — zero lost accepted requests."""
+        jdir = str(tmp_path / "journal")
+        gw, router = start_fleet(jdir)
+        try:
+            with FaultPlan.parse("serving.decode:delay=0.05x*"):
+                ra, ca = post(gw, {"prompt": PROMPT_A, "max_tokens": 10,
+                                   "stream": True},
+                              {"Idempotency-Key": "idem-crash"})
+                rb, cb = post(gw, {"prompt": PROMPT_B, "max_tokens": 10,
+                                   "stream": True},
+                              {"Idempotency-Key": "idem-torn"})
+                _, got_a, _, _ = read_sse(ra, stop_after=4)
+                _, got_b, _, _ = read_sse(rb, stop_after=2)
+            gw.crash()                      # no end records hit the journal
+            ca.close()
+            cb.close()
+        finally:
+            router.close()                  # the "process" died entirely
+        assert len(got_a) >= 4 and len(got_b) >= 2
+        # the journal holds both acceptances + watermarks, no terminals
+        scan = scan_dir(jdir)
+        entry = scan.by_idem()["idem-crash"]
+        assert entry["end"] is None and entry["n"] >= 2
+        # tear the final journal record in half (death mid-append)
+        import os
+        seg = sorted(p for p in os.listdir(jdir)
+                     if p.startswith("wal-"))[-1]
+        with open(os.path.join(jdir, seg), "r+b") as f:
+            f.seek(0, 2)
+            f.truncate(f.tell() - 6)
+
+        gw2, router2 = start_fleet(jdir)
+        try:
+            rep = gw2.recovery_report
+            assert rep["torn_records"] >= 1  # detected, skipped, counted
+            assert rep["recovered"] == 2 and rep["failed"] == 0
+            # reconnect exactly like a real SSE client: idempotent retry
+            # with the last seen event id
+            for key, prompt, got, want in (
+                    ("idem-crash", PROMPT_A, got_a, refs["A"]),
+                    ("idem-torn", PROMPT_B, got_b, refs["B"])):
+                r2, c2 = post(gw2, {"prompt": prompt, "max_tokens": 10,
+                                    "stream": True},
+                              {"Idempotency-Key": key,
+                               "Last-Event-ID": str(len(got))})
+                _, tail, finish, _ = read_sse(r2)
+                c2.close()
+                assert got + tail == want   # zero lost, zero duplicated
+                assert finish == "length"
+            # the journaled prefixes were regenerated and verified-
+            # suppressed by the router (the same machinery replica
+            # failover uses); the tear cost at most one watermark
+            assert router2.stats()["replay_suppressed"] >= entry["n"]
+            assert router2.stats()["replay_mismatches"] == 0
+            # the terminal records landed in the journal this time
+            post_scan = scan_dir(jdir)
+            assert post_scan.by_idem()["idem-crash"]["end"] is not None
+            assert post_scan.by_idem()["idem-torn"]["end"] is not None
+        finally:
+            gw2.stop()
+            router2.close()
+
+
+class TestEngineWatermark:
+    def test_add_request_watermark_cadence(self):
+        eng = factory()
+        try:
+            marks = []
+            req = eng.add_request(
+                [1, 2, 3, 4, 5], SamplingParams(max_new_tokens=7),
+                on_watermark=lambda r, n: marks.append(n),
+                watermark_every=3)
+            eng.run()
+            assert req.state.value == "finished"
+            assert marks == [3, 6]
+        finally:
+            eng.close()

@@ -370,3 +370,97 @@ class TestShedAndFailoverOverHTTP:
             _t.sleep(0.02)
         assert victim.state is ReplicaState.HEALTHY
         assert router.stats()["replica_restarts"] >= 1
+
+
+class TestFramingEdges:
+    """ISSUE 12 satellite: request-size / malformed-framing edges. The
+    connection state machine must answer what it can and close what it
+    cannot resync — it must never wedge (a wedged connection would hang
+    every later request pipelined behind the bad one)."""
+
+    def _raw(self, gw, payload, timeout=30):
+        import socket
+
+        s = socket.create_connection((gw.host, gw.port), timeout=timeout)
+        s.sendall(payload)
+        return s
+
+    def _read_response(self, s):
+        """One HTTP response (status line + headers + sized body)."""
+        f = s.makefile("rb")
+        status = f.readline().decode()
+        headers = {}
+        while True:
+            line = f.readline().decode().strip()
+            if not line:
+                break
+            k, _, v = line.partition(":")
+            headers[k.lower()] = v.strip()
+        body = f.read(int(headers.get("content-length", 0)))
+        return status, headers, body
+
+    def test_oversized_content_length_answers_400_and_closes(self, fleet):
+        gw, _, _ = fleet
+        big = gw.max_body_bytes + 1
+        s = self._raw(gw, (f"POST /v1/completions HTTP/1.1\r\n"
+                           f"Content-Length: {big}\r\n\r\n").encode())
+        status, _, body = self._read_response(s)
+        assert " 400 " in status
+        assert b"too large" in body
+        # the unread body makes the framing unrecoverable: the server
+        # must close rather than parse garbage as a next request
+        f = s.makefile("rb")
+        assert f.readline() == b""         # EOF, not a wedged socket
+        s.close()
+
+    def test_bad_content_length_answers_400_and_closes(self, fleet):
+        gw, _, _ = fleet
+        s = self._raw(gw, b"POST /v1/completions HTTP/1.1\r\n"
+                          b"Content-Length: banana\r\n\r\n")
+        status, _, _ = self._read_response(s)
+        assert " 400 " in status
+        assert s.makefile("rb").readline() == b""
+        s.close()
+
+    def test_malformed_request_line_answers_400_and_closes(self, fleet):
+        gw, _, _ = fleet
+        s = self._raw(gw, b"GARBAGE\r\n\r\n")
+        status, _, _ = self._read_response(s)
+        assert " 400 " in status
+        assert s.makefile("rb").readline() == b""
+        s.close()
+
+    def test_truncated_body_never_wedges_the_server(self, fleet):
+        gw, _, _ = fleet
+        # promise 100 bytes, send 10, hang up: the read loop sees the
+        # incomplete body and drops the connection quietly
+        s = self._raw(gw, b"POST /v1/completions HTTP/1.1\r\n"
+                          b"Content-Length: 100\r\n\r\n0123456789")
+        s.close()
+        # the server is still fully alive for the next client
+        resp, conn = request(gw, "GET", "/healthz")
+        assert resp.status in (200, 503)
+        conn.close()
+
+    def test_pipelined_request_after_4xx_is_served(self, fleet):
+        gw, _, _ = fleet
+        # request 1: well-framed but semantically bad (not JSON) -> 400
+        # with the body fully consumed; request 2 pipelined on the same
+        # connection must be parsed and served normally
+        bad = b"not json"
+        r2 = json.dumps({"prompt": [1, 2, 3], "max_tokens": 2}).encode()
+        payload = (b"POST /v1/completions HTTP/1.1\r\n"
+                   b"Content-Type: application/json\r\n"
+                   b"Content-Length: %d\r\n\r\n%s"
+                   b"POST /v1/completions HTTP/1.1\r\n"
+                   b"Content-Type: application/json\r\n"
+                   b"Content-Length: %d\r\n\r\n%s"
+                   % (len(bad), bad, len(r2), r2))
+        s = self._raw(gw, payload, timeout=120)
+        status1, _, body1 = self._read_response(s)
+        assert " 400 " in status1 and b"not JSON" in body1
+        status2, _, body2 = self._read_response(s)
+        assert " 200 " in status2
+        doc = json.loads(body2)
+        assert len(doc["choices"][0]["token_ids"]) == 2
+        s.close()

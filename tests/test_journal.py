@@ -1,0 +1,176 @@
+"""Write-ahead journal unit tests (ISSUE 12): CRC framing, torn-tail
+detection, fsync policies, segment rotation + compaction bounds, the
+accept/mark/end merge, and the ``gateway.journal.append`` fault sites.
+No engines, no sockets — these are fast.
+"""
+import os
+
+import pytest
+
+from paddle_tpu.serving.journal import (
+    Journal, JournalError, JournalTornWrite, scan_dir)
+from paddle_tpu.utils import faults
+from paddle_tpu.utils.faults import FaultPlan
+
+pytestmark = pytest.mark.durable
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults.deactivate()
+
+
+def segments(root):
+    return sorted(p for p in os.listdir(root) if p.startswith("wal-"))
+
+
+class TestFraming:
+    def test_round_trip_and_merge(self, tmp_path):
+        j = Journal(str(tmp_path))
+        j.accept("t1", gateway_id="gw", prompt=[1, 2, 3],
+                 sampling={"seed": 7}, priority=2, idem="key-1")
+        j.bind("t1", "cmpl-0")
+        j.mark("t1", 2, [10, 11])
+        j.mark("t1", 4, [12, 13])
+        j.accept("t2", gateway_id="gw", prompt=[4], sampling={})
+        j.end("t1", state="finished", reason="length", rid="cmpl-0",
+              tokens=[10, 11, 12, 13])
+        j.close()
+        s = scan_dir(str(tmp_path))
+        assert s.torn_records == 0
+        t1, t2 = s.requests["t1"], s.requests["t2"]
+        assert t1["end"]["state"] == "finished"
+        assert t1["tokens"] == [10, 11, 12, 13]
+        assert t1["rid"] == "cmpl-0"
+        assert t1["accept"]["sampling"] == {"seed": 7}
+        assert [e["jid"] for e in s.recoverable()] == ["t2"]
+        assert s.by_idem()["key-1"]["jid"] == "t1"
+
+    def test_mark_suffixes_concatenate(self, tmp_path):
+        j = Journal(str(tmp_path))
+        j.accept("a", gateway_id="gw", prompt=[1], sampling={})
+        j.mark("a", 3, [5, 6, 7])
+        j.mark("a", 5, [8, 9])
+        j.mark("a", 5, [8, 9])            # duplicate mark: ignored by n
+        j.close()
+        e = scan_dir(str(tmp_path)).requests["a"]
+        assert e["tokens"] == [5, 6, 7, 8, 9] and e["n"] == 5
+
+    def test_torn_tail_detected_and_skipped(self, tmp_path):
+        j = Journal(str(tmp_path))
+        j.accept("a", gateway_id="gw", prompt=[1], sampling={})
+        j.accept("b", gateway_id="gw", prompt=[2], sampling={})
+        j.close()
+        path = os.path.join(str(tmp_path), segments(str(tmp_path))[-1])
+        with open(path, "r+b") as f:
+            f.seek(0, 2)
+            f.truncate(f.tell() - 5)      # chop mid-frame: torn tail
+        s = scan_dir(str(tmp_path))
+        assert s.torn_records == 1
+        # the torn record ("b") is gone; the intact one survives
+        assert "a" in s.requests and "b" not in s.requests
+
+    def test_garbage_line_never_poisons_scan(self, tmp_path):
+        j = Journal(str(tmp_path))
+        j.accept("a", gateway_id="gw", prompt=[1], sampling={})
+        j.close()
+        path = os.path.join(str(tmp_path), segments(str(tmp_path))[-1])
+        with open(path, "ab") as f:
+            f.write(b"deadbeef not-json-at-all\n")
+            f.write(b"total garbage without a crc\n")
+        s = scan_dir(str(tmp_path))
+        assert s.torn_records == 2
+        assert "a" in s.requests
+
+    def test_reopen_appends_to_fresh_segment(self, tmp_path):
+        j = Journal(str(tmp_path))
+        j.accept("a", gateway_id="gw", prompt=[1], sampling={})
+        j.close()
+        j2 = Journal(str(tmp_path))
+        assert [e["jid"] for e in j2.recovered.recoverable()] == ["a"]
+        j2.end("a", state="finished", tokens=[9])
+        j2.close()
+        assert len(segments(str(tmp_path))) == 2
+        assert scan_dir(str(tmp_path)).recoverable() == []
+
+
+class TestPolicies:
+    @pytest.mark.parametrize("mode", ["always", "interval", "never"])
+    def test_fsync_modes_round_trip(self, tmp_path, mode):
+        j = Journal(str(tmp_path / mode), fsync=mode)
+        for i in range(5):
+            j.accept(f"r{i}", gateway_id="gw", prompt=[i], sampling={})
+        j.close()
+        assert len(scan_dir(str(tmp_path / mode)).requests) == 5
+
+    def test_bad_fsync_mode_rejected(self, tmp_path):
+        with pytest.raises(ValueError):
+            Journal(str(tmp_path), fsync="sometimes")
+
+    def test_rotation_and_compaction_bound_disk(self, tmp_path):
+        j = Journal(str(tmp_path), segment_max_records=4,
+                    compact_segments=2, retain_terminal=3)
+        j.accept("live", gateway_id="gw", prompt=[0], sampling={})
+        j.mark("live", 2, [1, 2])
+        for i in range(30):
+            j.accept(f"t{i}", gateway_id="gw", prompt=[i], sampling={})
+            j.end(f"t{i}", state="finished", tokens=[i])
+        # compaction kept segment count bounded
+        assert len(segments(str(tmp_path))) <= 4
+        s = scan_dir(str(tmp_path))
+        # the non-terminal request survives compaction with its watermark
+        assert [e["jid"] for e in s.recoverable()] == ["live"]
+        assert s.requests["live"]["tokens"] == [1, 2]
+        # terminal retention is bounded (only recent terminals kept)
+        assert len(s.terminal()) < 30
+        assert "t29" in s.requests        # the newest terminal survives
+        j.close()
+
+    def test_closed_journal_refuses_appends(self, tmp_path):
+        j = Journal(str(tmp_path))
+        j.close()
+        with pytest.raises(JournalError):
+            j.accept("x", gateway_id="gw", prompt=[1], sampling={})
+
+
+class TestFaultSites:
+    def test_append_error_raises_journal_error(self, tmp_path):
+        j = Journal(str(tmp_path))
+        with FaultPlan.parse("gateway.journal.append:error@2"):
+            j.accept("a", gateway_id="gw", prompt=[1], sampling={})
+            with pytest.raises(faults.FaultError):
+                j.accept("b", gateway_id="gw", prompt=[2], sampling={})
+        j.close()
+        s = scan_dir(str(tmp_path))
+        assert "a" in s.requests and "b" not in s.requests
+
+    def test_torn_write_fault_leaves_recoverable_journal(self, tmp_path):
+        j = Journal(str(tmp_path), fsync="always")
+        with FaultPlan.parse("gateway.journal.append:torn_write@3"):
+            j.accept("a", gateway_id="gw", prompt=[1], sampling={})
+            j.mark("a", 2, [5, 6])
+            with pytest.raises(JournalTornWrite):
+                j.mark("a", 4, [7, 8])    # dies mid-write
+        j.close()
+        s = scan_dir(str(tmp_path))
+        # the torn mark is skipped by CRC; everything before it intact
+        assert s.torn_records == 1
+        assert s.requests["a"]["tokens"] == [5, 6]
+        assert [e["jid"] for e in s.recoverable()] == ["a"]
+
+    def test_append_after_torn_write_resyncs_framing(self, tmp_path):
+        j = Journal(str(tmp_path), fsync="always")
+        with FaultPlan.parse("gateway.journal.append:torn_write@2"):
+            j.accept("a", gateway_id="gw", prompt=[1], sampling={})
+            with pytest.raises(JournalTornWrite):
+                j.mark("a", 2, [5, 6])
+            # the same process keeps going: the next record must not glue
+            # onto the torn frame
+            j.mark("a", 2, [5, 6])
+        j.end("a", state="finished", tokens=[5, 6])
+        j.close()
+        s = scan_dir(str(tmp_path))
+        assert s.torn_records == 1
+        assert s.requests["a"]["end"]["state"] == "finished"
+        assert s.requests["a"]["tokens"] == [5, 6]

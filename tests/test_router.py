@@ -500,3 +500,189 @@ class TestFailoverParity:
         assert rr.failovers == 0
         assert rr.tokens == ref
         heal(router, reps)
+
+
+class TestCircuitBreaker:
+    """ISSUE 12: per-replica breakers over dispatch outcomes + the global
+    retry budget, on fake replicas (instant, deterministic)."""
+
+    def _fail_on(self, router, rep, n):
+        """Drive n engine-reported failures onto ``rep`` via direct
+        submissions (breaker outcomes are recorded in _on_done); stops
+        early once the breaker opens (the replica stops getting traffic)."""
+        fails = 0
+        for _ in range(64 * n):
+            if fails >= n or router.breakers[rep.rid].state == "open":
+                return
+            rr = router.submit([1, 2], {})
+            owner = router.replicas[rr.replica]
+            if owner is rep:
+                owner.emit_done(rr.gid, state="failed",
+                                error="RuntimeError: boom")
+                fails += 1
+            else:
+                owner.emit_done(rr.gid, state="finished")
+        raise AssertionError(f"could not land {n} failures on {rep.rid}")
+
+    def test_breaker_trips_open_and_placement_routes_around(self):
+        router, reps = fake_router(2, breaker_min_samples=3,
+                                   breaker_failure_rate=0.5,
+                                   breaker_cooldown_s=60.0, max_retries=0)
+        victim = reps[0]
+        self._fail_on(router, victim, 3)
+        br = router.breakers[victim.rid]
+        assert br.state == "open" and br.trips == 1
+        assert router.stats()["breaker_trips"] >= 1
+        assert router.stats()["replicas"][victim.rid]["breaker"] == "open"
+        # every subsequent placement avoids the open replica
+        for _ in range(8):
+            assert router._place([1, 2, 3], 0).rid != victim.rid
+
+    def test_all_breakers_open_fast_fails(self):
+        router, reps = fake_router(2, breaker_min_samples=2,
+                                   breaker_failure_rate=0.5,
+                                   breaker_cooldown_s=60.0, max_retries=0)
+        for rep in reps:
+            # enough failures to outweigh any successes the replica
+            # banked while its sibling was the one being failed
+            self._fail_on(router, rep, 8)
+        assert all(b.state == "open" for b in router.breakers.values())
+        with pytest.raises(NoHealthyReplica):
+            router.submit([1, 2, 3], {})
+
+    def test_half_open_probe_recovers_and_reopens(self):
+        router, reps = fake_router(2, breaker_min_samples=2,
+                                   breaker_failure_rate=0.5,
+                                   breaker_cooldown_s=0.05, max_retries=0)
+        victim = reps[0]
+        self._fail_on(router, victim, 2)
+        br = router.breakers[victim.rid]
+        assert br.state == "open"
+        time.sleep(0.08)                  # cooldown elapses
+        # place until the half-open probe lands on the victim
+        probe = None
+        for _ in range(64):
+            rr = router.submit([1, 2], {})
+            if rr.replica == victim.rid:
+                probe = rr
+                break
+            router.replicas[rr.replica].emit_done(rr.gid, state="finished")
+        assert probe is not None and br.state == "half_open"
+        assert router.stats()["breaker_probes"] >= 1
+        # while the probe is in flight, no second request reaches it
+        for _ in range(4):
+            assert router._place([1, 2], 0).rid != victim.rid
+        # probe succeeds: breaker closes, replica serves again
+        victim.emit_done(probe.gid, state="finished")
+        assert br.state == "closed"
+        # trip it again, then fail the next probe: straight back to open
+        self._fail_on(router, victim, 2)
+        time.sleep(0.08)
+        probe = None
+        for _ in range(64):
+            rr = router.submit([1, 2], {})
+            if rr.replica == victim.rid:
+                probe = rr
+                break
+            router.replicas[rr.replica].emit_done(rr.gid, state="finished")
+        victim.emit_done(probe.gid, state="failed",
+                         error="RuntimeError: still sick")
+        assert br.state == "open" and br.trips >= 2
+
+    def test_replica_restart_resets_breaker(self):
+        router, reps = fake_router(2, breaker_min_samples=2,
+                                   breaker_failure_rate=0.5,
+                                   breaker_cooldown_s=60.0, max_retries=0)
+        victim = reps[0]
+        self._fail_on(router, victim, 2)
+        assert router.breakers[victim.rid].state == "open"
+        victim.state = ReplicaState.UNHEALTHY
+        router._do_restart(victim)
+        assert router.breakers[victim.rid].state == "closed"
+
+    def test_retry_budget_caps_redispatch_volume(self):
+        router, reps = fake_router(3, retry_budget_min=2,
+                                   retry_budget_ratio=0.0,
+                                   breaker_min_samples=1000,
+                                   max_retries=5)
+        # every replica fails everything: each request would retry
+        # max_retries times without the budget; the budget allows only 2
+        # re-dispatches total in the window
+        denied = 0
+        for k in range(6):
+            rr = router.submit([1, 2], {})
+            for _ in range(10):
+                if rr.terminal:
+                    break
+                owner = router.replicas[rr.replica]
+                owner.emit_done(rr.gid, state="failed",
+                                error="RuntimeError: sick fleet")
+            assert rr.terminal
+            if rr.finish_reason == "retry_budget_exhausted":
+                denied += 1
+        st = router.stats()
+        assert st["retry_budget_denied"] >= 1
+        assert denied == st["retry_budget_denied"]
+        # total dispatches bounded: 6 first dispatches + <=2 re-dispatches
+        assert st["dispatches"] <= 6 + 2
+
+    def test_failover_respects_retry_budget(self):
+        router, reps = fake_router(3, retry_budget_min=1,
+                                   retry_budget_ratio=0.0,
+                                   breaker_min_samples=1000)
+        rrs = [router.submit([1, 2], {}) for _ in range(3)]
+        # kill the replicas carrying them, one by one: first orphan fails
+        # over (budget 1), later orphans fast-fail on the spent budget
+        for rep in reps:
+            rep.kill()
+            router._mark_unhealthy(rep, "test kill")
+        states = sorted(rr.finish_reason or rr.state for rr in rrs
+                        if rr.terminal)
+        assert "retry_budget_exhausted" in states
+        assert router.stats()["failovers"] <= 1 + 1  # budget + in-flight slop
+
+    def test_submit_replay_tokens_verifies_and_suppresses(self):
+        router, reps = fake_router(1)
+        seen = []
+        rr = router.submit([1, 2, 3], {}, replay_tokens=[10, 11],
+                           on_token=lambda r, t: seen.append(t))
+        rep = router.replicas[rr.replica]
+        rep.emit_tokens(rr.gid, [10, 11, 12, 13])
+        assert rr.tokens == [10, 11, 12, 13]
+        assert seen == [12, 13]           # the replayed prefix is swallowed
+        assert router.stats()["replay_suppressed"] == 2
+        # a mismatching replay fails the request instead of forking it
+        rr2 = router.submit([4, 5, 6], {}, replay_tokens=[7])
+        rep.emit_tokens(rr2.gid, [8])
+        assert rr2.state == "failed"
+        assert rr2.finish_reason == "replay_mismatch"
+
+    def test_on_watermark_cadence(self):
+        router, reps = fake_router(1)
+        marks = []
+        rr = router.submit([1, 2, 3], {},
+                           on_watermark=lambda r, n: marks.append(n),
+                           watermark_every=2)
+        reps[0].emit_tokens(rr.gid, [5, 6, 7, 8, 9])
+        assert marks == [2, 4]
+
+    def test_derived_retry_after_uses_slo_window(self):
+        router, reps = fake_router(2, retry_after_s=1.0)
+        # a fleet completing 2 req/s per replica with 6 requests ahead
+        for rep in reps:
+            rep.stats = {"slo": {"shed": True, "window_requests": 20,
+                                 "window_s": 10.0,
+                                 "tpot": {"p50": 0.05}},
+                         "queue_depth": 2}
+        for g in range(2):
+            router._inflight[reps[0].rid].add(1000 + g)
+        with pytest.raises(RouterShed) as ei:
+            router.submit([1, 2], {})
+        # ahead = 2 inflight + 4 queued, rate = 4/s -> (6+1)/4 = 1.75s
+        assert 1.5 <= ei.value.retry_after_s <= 2.0
+        # no SLO signal at all: falls back to the configured floor
+        for rep in reps:
+            rep.stats = {"slo": {"shed": True}}
+        with pytest.raises(RouterShed) as ei2:
+            router.submit([1, 2], {})
+        assert ei2.value.retry_after_s == 1.0

@@ -56,6 +56,20 @@ requests get 429 + Retry-After, high-priority bypasses, no in-flight
 stream is harmed; (4) ``drain_and_restart`` under a real
 ElasticSupervisor ledger while traffic flows.
 
+``--suite durable`` — the durable request lifecycle (docs/ROBUSTNESS.md
+"Durable requests"): the *gateway* is the victim. (1) SIGKILL the gateway
+process mid-stream → restart over the same write-ahead journal → recovery
+re-submits every accepted-non-terminal request through the router's
+replay-and-suppress path, clients reconnect with Idempotency-Key +
+Last-Event-ID and receive exactly the missing suffix — zero lost accepted
+requests, token-for-token parity vs an uninterrupted run; (2) a torn
+final journal record (death mid-append) is detected by CRC and skipped,
+never poisoning recovery; (3) a replica failing 100% of dispatches trips
+its circuit breaker OPEN within the rolling window, placement routes
+around it, and a HALF_OPEN probe restores it after it heals; (4) a
+fleet-wide fault plan exhausts the global retry budget — requests
+fast-fail with bounded re-dispatch volume instead of a retry storm.
+
 ``--suite straggler`` — the cluster observability plane
 (docs/OBSERVABILITY.md "Cluster observability"): a 4-rank job over a real
 TCPStore where one rank carries a ``collective:delay`` fault plan.
@@ -69,7 +83,7 @@ recorder + stack snapshot.
 
 Usage:
     python tools/chaos_run.py
-        [--suite serving|prefix|train|straggler|perf|serve-fleet]
+        [--suite serving|prefix|train|straggler|perf|serve-fleet|durable]
         [--requests 6] [--prompt-len 24] [--max-new 16]
         [--slots 3] [--block-size 8] [--plan NAME:SPEC ...] [--json OUT.json]
 
@@ -961,6 +975,426 @@ def run_serve_fleet_suite(args, workdir=None):
     }
 
 
+# -- the durable battery ---------------------------------------------------
+#
+# ``--suite durable`` (docs/ROBUSTNESS.md "Durable requests"): the gateway
+# itself is the victim. Four scenarios, all held to zero lost ACCEPTED
+# requests: (1) SIGKILL the gateway process mid-stream -> restart over the
+# same journal -> journal recovery re-submits every accepted-non-terminal
+# request through replay-and-suppress, clients reconnect with
+# Idempotency-Key + Last-Event-ID and the assembled streams are
+# token-for-token equal to an uninterrupted run; (2) a torn final journal
+# record (process died mid-append) is detected by CRC, skipped, and never
+# poisons recovery; (3) a replica failing 100% of dispatches trips its
+# circuit breaker OPEN, placement routes around it (zero lost), and a
+# half-open probe restores it once it heals; (4) a fleet-wide fault plan
+# exhausts the retry budget -> requests fast-fail with bounded re-dispatch
+# volume instead of a retry storm.
+
+def _gateway_spec(args, workdir, max_len, jdir, ready, *, n_replicas=2,
+                  router_kw=None, gateway_kw=None):
+    spec = _fleet_spec(args, workdir, max_len)
+    gspec = dict(spec)
+    gspec["n_replicas"] = n_replicas
+    gspec["router"] = dict({"probe_interval_s": 0.1,
+                            "probe_timeout_s": 60.0,
+                            "affinity_block_size":
+                                spec["engine"]["block_size"]},
+                           **(router_kw or {}))
+    gspec["gateway"] = dict({"journal_dir": jdir,
+                             "journal_watermark_every": 2},
+                            **(gateway_kw or {}))
+    gspec["ready_file"] = ready
+    return gspec
+
+
+def _spawn_gateway_worker(gspec, workdir, *, tag, fault_plan=None):
+    import subprocess
+
+    if os.path.exists(gspec["ready_file"]):
+        os.remove(gspec["ready_file"])
+    env = dict(os.environ, PADDLE_GATEWAY_SPEC=json.dumps(gspec),
+               PYTHONPATH=".", JAX_PLATFORMS="cpu")
+    if fault_plan:
+        env["FLAGS_fault_plan"] = fault_plan
+    logf = open(os.path.join(workdir, f"gateway-{tag}.log"), "w")
+    return subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.serving.gateway_worker"],
+        env=env, stdout=logf, stderr=subprocess.STDOUT)
+
+
+def _wait_gateway_ready(ready_file, proc, timeout=600):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"gateway worker exited rc={proc.returncode} before ready")
+        if os.path.exists(ready_file):
+            with open(ready_file) as f:
+                return json.load(f)
+        time.sleep(0.05)
+    raise RuntimeError("gateway worker never became ready")
+
+
+class _DurableClient(threading.Thread):
+    """A streaming client that survives its server's death: it records
+    SSE event ids as it reads, treats a dropped connection as a pause
+    (not a failure), and can resume against a new port with
+    Idempotency-Key + Last-Event-ID — the reconnect contract a real
+    durable client follows."""
+
+    def __init__(self, port, prompt, sp, key):
+        super().__init__(daemon=True)
+        self.port = port
+        self.prompt = list(prompt)
+        self.sp = sp
+        self.key = key
+        self.tokens: list[int] = []
+        self.last_id = 0
+        self.finish = None
+        self.error = None
+        self.interrupted = False
+        self.start()
+
+    def _read_stream(self, port, last_id):
+        import http.client as _http
+        import json as _json
+
+        body = {"prompt": self.prompt,
+                "max_tokens": self.sp.max_new_tokens,
+                "temperature": self.sp.temperature,
+                "top_k": self.sp.top_k, "top_p": self.sp.top_p,
+                "seed": self.sp.seed, "stream": True}
+        headers = {"Content-Type": "application/json",
+                   "Idempotency-Key": self.key}
+        if last_id:
+            headers["Last-Event-ID"] = str(last_id)
+        conn = _http.HTTPConnection("127.0.0.1", port, timeout=600)
+        conn.request("POST", "/v1/completions", _json.dumps(body), headers)
+        resp = conn.getresponse()
+        if resp.status != 200:
+            self.error = f"HTTP {resp.status}"
+            conn.close()
+            return
+        while True:
+            line = resp.readline()
+            if not line:
+                self.interrupted = True        # server died mid-stream
+                break
+            line = line.decode().strip()
+            if line.startswith("id: "):
+                self.last_id = int(line[4:])
+                continue
+            if not line.startswith("data: "):
+                continue
+            if line == "data: [DONE]":
+                break
+            doc = _json.loads(line[6:])
+            ch = doc["choices"][0]
+            self.tokens += ch.get("token_ids") or []
+            if ch.get("finish_reason"):
+                self.finish = ch["finish_reason"]
+            if doc.get("error"):
+                self.error = doc["error"]["message"]
+        conn.close()
+
+    def run(self):
+        try:
+            self._read_stream(self.port, 0)
+        except Exception:
+            self.interrupted = True            # connection torn down
+
+    def resume(self, port):
+        """Reconnect against the restarted gateway; returns once the
+        stream finishes (or errors)."""
+        self.interrupted = False
+        try:
+            self._read_stream(port, self.last_id)
+        except Exception as e:
+            self.error = f"{type(e).__name__}: {e}"
+
+
+def _scenario_gateway_sigkill(args, workdir, spec, max_len):
+    """SIGKILL the gateway process while clients stream; restart it over
+    the same journal; clients reconnect and every accepted request
+    completes token-for-token equal to an uninterrupted run."""
+    jdir = os.path.join(workdir, "journal-sigkill")
+    ready = os.path.join(workdir, "gw-sigkill-ready.json")
+    gspec = _gateway_spec(args, workdir, max_len, jdir, ready)
+    sp = SamplingParams(max_new_tokens=args.max_new, temperature=0.0)
+    sp_seeded = SamplingParams(max_new_tokens=args.max_new,
+                               temperature=0.9, top_k=7, seed=31)
+    rng = np.random.RandomState(7)
+    prompts = [[int(t) for t in rng.randint(0, args.vocab, args.prompt_len)]
+               for _ in range(4)]
+    sps = [sp_seeded if i == 3 else sp for i in range(4)]
+    refs = _fleet_reference(spec, prompts, sps)
+    # a decode delay keeps the streams mid-flight long enough to kill
+    proc = _spawn_gateway_worker(gspec, workdir, tag="sigkill-1",
+                                 fault_plan="serving.decode:delay=0.05x*")
+    killed_at = None
+    try:
+        info = _wait_gateway_ready(ready, proc)
+        clients = [_DurableClient(info["port"], p, s, key=f"dur-{i}")
+                   for i, (p, s) in enumerate(zip(prompts, sps))]
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            if sum(len(c.tokens) for c in clients) >= 3:
+                killed_at = sum(len(c.tokens) for c in clients)
+                os.kill(proc.pid, 9)           # the real thing
+                break
+            time.sleep(0.02)
+        for c in clients:
+            c.join(60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait(30)
+    interrupted = sum(1 for c in clients if c.interrupted)
+    # restart over the same journal (no decode delay this time)
+    proc2 = _spawn_gateway_worker(gspec, workdir, tag="sigkill-2")
+    try:
+        info2 = _wait_gateway_ready(ready, proc2)
+        recovery = info2.get("recovery") or {}
+        for c in clients:
+            c.resume(info2["port"])
+        lost = [i for i, c in enumerate(clients)
+                if c.error or c.finish != "length"]
+        parity = [i for i, c in enumerate(clients)
+                  if c.tokens != refs[i]]
+        ok = (killed_at is not None and interrupted >= 1
+              and recovery.get("recovered", 0) + recovery.get(
+                  "restored_terminal", 0) >= 1
+              and not lost and not parity)
+        return {
+            "scenario": "gateway_sigkill_recovery",
+            "survived": bool(ok),
+            "tokens_streamed_before_kill": killed_at,
+            "clients_interrupted": interrupted,
+            "recovery_report": recovery,
+            "lost_requests": len(lost),
+            "parity_failures": len(parity),
+        }
+    finally:
+        proc2.terminate()
+        try:
+            proc2.wait(30)
+        except Exception:
+            proc2.kill()
+
+
+def _scenario_torn_journal_tail(args, workdir, spec, max_len):
+    """Crash the gateway mid-append (in-process crash + a physically
+    chopped journal tail): recovery must detect the torn record by CRC,
+    skip it, and still recover every intact acceptance."""
+    from paddle_tpu.serving import FleetRouter, Gateway, LLMEngine
+    from paddle_tpu.serving import LocalReplica
+    from paddle_tpu.serving.journal import scan_dir
+    from paddle_tpu.serving.replica_worker import build_model
+
+    jdir = os.path.join(workdir, "journal-torn")
+
+    def factory():
+        return LLMEngine(build_model(spec), **spec["engine"])
+
+    def start_fleet():
+        reps = [LocalReplica(f"t{i}", factory, stats_interval_s=0.05,
+                             warmup=spec["warmup"]) for i in range(2)]
+        router = FleetRouter(
+            reps, probe_interval_s=0.1, probe_timeout_s=60.0,
+            affinity_block_size=spec["engine"]["block_size"],
+        ).start(wait_healthy_s=600)
+        gw = Gateway(router, journal_dir=jdir,
+                     journal_watermark_every=2).start()
+        return gw, router
+
+    sp = SamplingParams(max_new_tokens=args.max_new, temperature=0.0)
+    rng = np.random.RandomState(8)
+    prompt = [int(t) for t in rng.randint(0, args.vocab, args.prompt_len)]
+    ref = _fleet_reference(spec, [prompt], [sp])[0]
+    gw, router = start_fleet()
+    got = []
+    try:
+        with FaultPlan.parse("serving.decode:delay=0.05x*"):
+            client = _DurableClient(gw.port, prompt, sp, key="torn-1")
+            deadline = time.time() + 300
+            while time.time() < deadline and len(client.tokens) < 2:
+                time.sleep(0.02)
+            gw.crash()                      # no terminal records written
+            client.join(30)
+            got = list(client.tokens)
+            last_id = client.last_id
+    finally:
+        router.close()
+    # chop the journal tail mid-record: the torn frame must be skipped
+    segs = sorted(p for p in os.listdir(jdir) if p.startswith("wal-"))
+    tail_path = os.path.join(jdir, segs[-1])
+    with open(tail_path, "r+b") as f:
+        f.seek(0, 2)
+        f.truncate(f.tell() - 6)
+    pre_scan = scan_dir(jdir)
+    gw2, router2 = start_fleet()
+    try:
+        report = gw2.recovery_report or {}
+        client.resume(gw2.port)
+        ok = (report.get("torn_records", 0) >= 1
+              and report.get("recovered") == 1
+              and not client.error
+              and got + client.tokens[len(got):] == ref
+              and client.tokens == ref
+              and router2.stats()["replay_mismatches"] == 0)
+        return {
+            "scenario": "torn_journal_tail",
+            "survived": bool(ok),
+            "tokens_before_crash": len(got),
+            "torn_records_detected": report.get("torn_records"),
+            "recovered": report.get("recovered"),
+            "lost_requests": 0 if client.tokens == ref else 1,
+            "parity_failures": 0 if client.tokens == ref else 1,
+            "replay_mismatches": router2.stats()["replay_mismatches"],
+        }
+    finally:
+        gw2.stop()
+        router2.close()
+
+
+def _scenario_breaker_trip(args, workdir, spec, max_len):
+    """One replica fails 100% of its dispatches (per-replica
+    ``serving.prefill:error`` plan): its breaker trips OPEN inside the
+    rolling window, placement routes around it with zero lost requests,
+    and once the fault plan exhausts, a HALF_OPEN probe restores it."""
+    plans = {1: "serving.prefill:error@1x4"}
+    router, gateway, reps = _start_fleet(
+        workdir, spec, 2, plans=plans, scenario="breaker",
+        router_kw=dict(max_retries=2, breaker_min_samples=3,
+                       breaker_failure_rate=0.5, breaker_cooldown_s=1.0))
+    try:
+        rng = np.random.RandomState(9)
+        sp = SamplingParams(max_new_tokens=args.max_new, temperature=0.0)
+        prompts = [_affinity_prompt(router, rng, args.prompt_len,
+                                    args.vocab, "p1") for _ in range(4)]
+        refs = _fleet_reference(spec, prompts, [sp] * len(prompts))
+        clients = [_SSEClient(gateway, p, sp) for p in prompts]
+        for c in clients:
+            c.join(600)
+        tripped = router.stats()["breaker_trips"] >= 1
+        lost = [i for i, c in enumerate(clients)
+                if c.status != 200 or c.error]
+        parity = [i for i, c in enumerate(clients) if c.tokens != refs[i]]
+        # the plan is exhausted (4 fires); keep offering affinity traffic
+        # until the half-open probe lands and the breaker closes again
+        deadline = time.time() + 120
+        recovered = False
+        extra_lost = 0
+        while time.time() < deadline and not recovered:
+            c = _SSEClient(gateway, prompts[0], sp)
+            c.join(600)
+            if c.status != 200 or c.error or c.tokens != refs[0]:
+                extra_lost += 1
+            if router.breakers["p1"].state == "closed" and \
+                    router.stats()["breaker_probes"] >= 1:
+                recovered = True
+            time.sleep(0.2)
+        st = router.stats()
+        ok = (tripped and not lost and not parity and recovered
+              and extra_lost == 0 and st["retries"] >= 1)
+        return {
+            "scenario": "breaker_trip_recovery",
+            "survived": bool(ok),
+            "breaker_tripped": tripped,
+            "breaker_trips": st["breaker_trips"],
+            "breaker_probes": st["breaker_probes"],
+            "breaker_final_state": router.breakers["p1"].state,
+            "retries": st["retries"],
+            "lost_requests": len(lost) + extra_lost,
+            "parity_failures": len(parity),
+        }
+    finally:
+        gateway.stop()
+        router.close()
+
+
+def _scenario_retry_budget_storm(args, workdir, spec, max_len):
+    """Every replica fails every request: the retry budget must cap total
+    re-dispatch volume and every client must get a fast terminal answer —
+    a sick fleet degrades into fast-failing, not a retry storm."""
+    n_clients = 8
+    plans = {0: "serving.prefill:error@1x*",
+             1: "serving.prefill:error@1x*"}
+    router, gateway, reps = _start_fleet(
+        workdir, spec, 2, plans=plans, scenario="budget",
+        router_kw=dict(max_retries=3, retry_budget_min=2,
+                       retry_budget_ratio=0.0,
+                       breaker_min_samples=10_000))  # isolate the budget
+    try:
+        rng = np.random.RandomState(10)
+        sp = SamplingParams(max_new_tokens=args.max_new, temperature=0.0)
+        prompts = [[int(t) for t in rng.randint(0, args.vocab,
+                                                args.prompt_len)]
+                   for _ in range(n_clients)]
+        t0 = time.time()
+        clients = [_SSEClient(gateway, p, sp) for p in prompts]
+        for c in clients:
+            c.join(600)
+        wall = time.time() - t0
+        st = router.stats()
+        unanswered = [i for i, c in enumerate(clients)
+                      if c.status is None
+                      or (c.status == 200 and c.error is None
+                          and c.finish is None)]
+        # max_retries=3 would allow 24 re-dispatches; the budget caps at 2
+        budget_bound = n_clients + 2
+        ok = (not unanswered and st["retry_budget_denied"] >= 1
+              and st["dispatches"] <= budget_bound)
+        return {
+            "scenario": "retry_budget_storm",
+            "survived": bool(ok),
+            "clients": n_clients,
+            "wall_sec": round(wall, 2),
+            "unanswered": len(unanswered),
+            "lost_requests": len(unanswered),
+            "dispatches": st["dispatches"],
+            "dispatch_bound": budget_bound,
+            "retry_budget_denied": st["retry_budget_denied"],
+            "retries": st["retries"],
+        }
+    finally:
+        gateway.stop()
+        router.close()
+
+
+def run_durable_suite(args, workdir=None):
+    import tempfile
+
+    workdir = workdir or tempfile.mkdtemp(prefix="chaos-durable-")
+    max_len = args.prompt_len + args.max_new
+    spec = _fleet_spec(args, workdir, max_len)
+    rows = []
+    for scenario in (_scenario_gateway_sigkill, _scenario_torn_journal_tail,
+                     _scenario_breaker_trip, _scenario_retry_budget_storm):
+        try:
+            rows.append(scenario(args, workdir, spec, max_len))
+        except Exception as e:
+            rows.append({"scenario": scenario.__name__, "survived": False,
+                         "crashed": f"{type(e).__name__}: {e}"})
+    survived = sum(1 for r in rows if r["survived"])
+    zero_lost = all(r.get("lost_requests", 0) == 0 for r in rows)
+    dump_path = telemetry.dump(reason="durable chaos suite complete")
+    return {
+        "suite": "durable",
+        "workdir": workdir,
+        "config": {"requests": args.requests, "prompt_len": args.prompt_len,
+                   "max_new_tokens": args.max_new, "slots": args.slots,
+                   "block_size": args.block_size},
+        "plans_run": len(rows),
+        "plans_survived": survived,
+        "all_survived": survived == len(rows),
+        "zero_lost_requests": bool(zero_lost),
+        "flight_recorder_dump": dump_path,
+        "results": rows,
+    }
+
+
 # -- the straggler battery -------------------------------------------------
 
 def _spawn_demo_ranks(endpoint, world, steps, scenario, workdir,
@@ -1149,7 +1583,7 @@ def run_sweep(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--suite",
                     choices=["serving", "prefix", "train", "straggler",
-                             "perf", "serve-fleet"],
+                             "perf", "serve-fleet", "durable"],
                     default="serving")
     ap.add_argument("--prefix-share", type=float, default=0.75,
                     help="--suite prefix: fraction of every prompt that is "
@@ -1169,12 +1603,14 @@ def run_sweep(argv=None):
     args = ap.parse_args(argv)
 
     if args.suite in ("train", "straggler", "prefix", "perf",
-                      "serve-fleet"):
+                      "serve-fleet", "durable"):
         report = (run_train_suite() if args.suite == "train"
                   else run_straggler_suite() if args.suite == "straggler"
                   else run_perf_suite(args) if args.suite == "perf"
                   else run_serve_fleet_suite(args)
                   if args.suite == "serve-fleet"
+                  else run_durable_suite(args)
+                  if args.suite == "durable"
                   else run_prefix_suite(args))
         if args.json:
             with open(args.json, "w") as f:
@@ -1229,7 +1665,7 @@ def main(argv=None):
     for r in report["results"]:
         status = "OK " if r["survived"] else "DIED"
         if report.get("suite") in ("train", "straggler", "perf",
-                                   "serve-fleet"):
+                                   "serve-fleet", "durable"):
             detail = " ".join(f"{k}={v}" for k, v in r.items()
                               if k not in ("scenario", "survived"))
             print(f"[{status}] {r['scenario']:<26} {detail}",

@@ -59,6 +59,11 @@ DIRECTIONS = {
     "fleet_tok_per_sec": "higher",
     "fleet_ttft_mean_s": "lower",
     "fleet_ttft_p95_s": "lower",
+    # write-ahead-journal cost on the fleet bench (ISSUE 12): no-journal
+    # tok/s divided by journaled tok/s — 1.0 means the journal is free,
+    # and growth past tolerance means durability started taxing the
+    # serving hot path
+    "journal_overhead_frac": "lower",
     # roofline cost model (PR 11): the serving analogue of MFU — fraction
     # of the roofline-model step time actually achieved — and the decode
     # trace's arithmetic intensity (higher = more compute per HBM byte,
@@ -85,6 +90,8 @@ def extract_metrics(doc: dict) -> tuple[str, dict]:
         put("fleet_tok_per_sec", f.get("tok_per_sec"))
         put("fleet_ttft_mean_s", f.get("ttft_mean_s"))
         put("fleet_ttft_p95_s", f.get("ttft_p95_s"))
+        put("journal_overhead_frac",
+            (f.get("journal") or {}).get("overhead_frac"))
         return "serving_fleet", metrics
     if doc.get("mode") == "prefix" or isinstance(doc.get("prefix"), dict):
         p = doc.get("prefix") or {}

@@ -216,9 +216,10 @@ def run_fleet_bench(args, slo_kw):
                for _ in range(args.requests)]
 
     class Client(threading.Thread):
-        def __init__(self, prompt):
+        def __init__(self, prompt, gw=None):
             super().__init__(daemon=True)
             self.prompt = prompt
+            self.gw = gw or gateway
             self.status = None
             self.tokens = []
             self.ttft = None
@@ -226,7 +227,7 @@ def run_fleet_bench(args, slo_kw):
 
         def run(self):
             t0 = time.perf_counter()
-            conn = http.client.HTTPConnection(gateway.host, gateway.port,
+            conn = http.client.HTTPConnection(self.gw.host, self.gw.port,
                                               timeout=600)
             conn.request("POST", "/v1/completions", json.dumps(
                 {"prompt": self.prompt, "max_tokens": args.max_new,
@@ -266,6 +267,48 @@ def run_fleet_bench(args, slo_kw):
         st = router.stats()
         n_tokens = sum(len(c.tokens) for c in clients)
         ttfts = sorted(c.ttft for c in clients if c.ttft is not None)
+        journal_block = None
+        if args.journal != "off":
+            # journal-overhead measurement: both sides fully warm. The
+            # timed pass above was the first *prefix-cache-hit* pass never
+            # sees (repeat prompts hit the cache and compile the
+            # tail-prefill trace), so run one untimed warm pass first,
+            # then time a plain pass and a journaled pass back-to-back:
+            # overhead_frac = warm plain tok/s over journaled tok/s
+            # (1.0 = the journal is free; perf_gate: lower is better)
+            import tempfile
+
+            def timed_pass(gw):
+                t1 = time.perf_counter()
+                cs = [Client(p, gw=gw) for p in prompts]
+                for c in cs:
+                    c.start()
+                for c in cs:
+                    c.join(600)
+                d = time.perf_counter() - t1
+                toks = sum(len(c.tokens) for c in cs)
+                errs = sum(1 for c in cs if c.status != 200 or c.error)
+                return (toks / d if d > 0 else 0.0), errs
+
+            timed_pass(gateway)            # warm the prefix-hit traces
+            tok_s_plain, _ = timed_pass(gateway)
+            jdir = tempfile.mkdtemp(prefix="serving-bench-journal-")
+            gw2 = Gateway(router, journal_dir=jdir,
+                          journal_fsync=args.journal).start()
+            try:
+                tok_s_journal, errors2 = timed_pass(gw2)
+                journal_block = {
+                    "fsync": args.journal,
+                    "journal_dir": jdir,
+                    "tok_per_sec": tok_s_journal,
+                    "tok_per_sec_nojournal_warm": tok_s_plain,
+                    "http_errors": errors2,
+                    "overhead_frac": (tok_s_plain / tok_s_journal
+                                      if tok_s_journal > 0 else None),
+                    "stats": gw2.journal.stats(),
+                }
+            finally:
+                gw2.stop()
         result = {
             "mode": "fleet",
             "requests": args.requests,
@@ -294,6 +337,10 @@ def run_fleet_bench(args, slo_kw):
                           "generated_tokens":
                               (v["stats"] or {}).get("generated_tokens")}
                     for rid, v in st["replicas"].items()},
+                # --journal: the write-ahead-journal overhead pass
+                # (docs/ROBUSTNESS.md "Durable requests"); perf_gate
+                # gates journal_overhead_frac against the baseline
+                "journal": journal_block,
             },
             "__meta__": _perf.run_meta(),
         }
@@ -344,6 +391,13 @@ def main():
                          "(streaming clients; reports client-side TTFT, "
                          "tokens/s, per-replica SLO blocks, shed/failover "
                          "counts — docs/SERVING.md \"Fleet serving\")")
+    ap.add_argument("--journal", choices=("off", "interval", "always"),
+                    default="off",
+                    help="--fleet only: run a second pass through a "
+                         "write-ahead-journaled gateway (the given fsync "
+                         "policy) and report journal_overhead_frac = "
+                         "no-journal tok/s over journaled tok/s — gated "
+                         "by perf_gate against the no-journal baseline")
     args = ap.parse_args()
 
     if args.telemetry == "off":
