@@ -28,9 +28,19 @@ buffering without limit; a watchdog counts slow decode steps and a stall
 detector fails the queue head rather than spinning when no progress is
 possible. Chaos sites (``serving.prefill``, ``serving.decode.slot``,
 ``serving.decode``, ``serving.kv.alloc``, ``serving.kv.share``,
-``serving.kv.cow``, ``serving.admit``, ``serving.compile`` — the last
-fires once per new prefill/decode trace creation) let
-``paddle_tpu.utils.faults`` drive all of these paths deterministically.
+``serving.kv.cow``, ``serving.kv.spill``, ``serving.kv.promote``,
+``serving.admit``, ``serving.compile`` — the last fires once per new
+prefill/decode trace creation) let ``paddle_tpu.utils.faults`` drive all
+of these paths deterministically.
+
+Memory pressure (docs/ROBUSTNESS.md "Degradation ladder"):
+``kv_spill_blocks=N`` arms a bounded host-RAM spill tier under the
+prefix cache — LRU eviction demotes CRC32-stamped K/V to numpy instead
+of destroying it, prefix hits promote it back (CRC verified; corrupt or
+faulted promotions re-prefill, never serve wrong K/V) — and
+``kv_high_watermark``/``kv_low_watermark`` latch scheduler backpressure
+that is forced into ``stats()["slo"]["shed"]`` so the fleet router and
+gateway shed at the front door.
 
 Prefix caching (on by default; ``prefix_cache=False`` disables): admission
 maps the longest cached block-aligned prefix of each prompt into the new
@@ -107,6 +117,11 @@ def _engine_metrics(label: str) -> SimpleNamespace:
                    "decode steps slower than watchdog_timeout_s"),
         stalls=C("serving_stall_failures_total",
                  "requests failed by the no-progress stall detector"),
+        pressure_events=C("serving_kv_pressure_events_total",
+                          "device-pool high-watermark latches"),
+        pressure=G("serving_kv_pressure",
+                   "1 while the device pool is above the high watermark "
+                   "(admissions queue, the SLO shed signal is forced)"),
         queue_depth=G("serving_queue_depth", "requests waiting"),
         running=G("serving_running_requests", "requests in decode slots"),
         blocks_used=G("serving_kv_blocks_used", "live KV blocks"),
@@ -166,6 +181,21 @@ class LLMEngine:
                    previously served prefill only the divergent tail;
                    token streams are unchanged (``stats()["prefix_cache"]``
                    reports hits/blocks saved).
+    kv_spill_blocks: bound on the host-RAM spill tier (entries = KV
+                   blocks). With it set, LRU eviction *demotes* an
+                   unreferenced cached prefix block to a CRC32-stamped
+                   numpy copy instead of destroying it; a later prefix
+                   hit promotes it back (CRC verified — corrupt/faulted
+                   promotions fall back to full prefill, never wrong
+                   tokens). None/0 = eviction destroys (the old
+                   behavior). ``stats()["prefix_cache"]["spill"]``
+                   reports the tier.
+    kv_high_watermark / kv_low_watermark: device-pool backpressure
+                   (fractions of usable blocks referenced). Above high,
+                   admissions queue and ``stats()["slo"]["shed"]`` is
+                   forced True so a fleet router routes around and the
+                   gateway answers 429 + Retry-After; the latch clears
+                   below low (default 0.75 * high). None = off.
     """
 
     def __init__(self, model, *, block_size=16, num_blocks=None, max_slots=4,
@@ -173,7 +203,8 @@ class LLMEngine:
                  max_queue=None, max_preemptions_per_request=16,
                  watchdog_timeout_s=None, stall_limit=8,
                  slo_ttft_s=None, slo_tpot_s=None, slo_window_s=120.0,
-                 prefix_cache=True):
+                 prefix_cache=True, kv_spill_blocks=None,
+                 kv_high_watermark=None, kv_low_watermark=None):
         cfg = model.config
         self.model = model
         self.block_size = int(block_size)
@@ -197,7 +228,8 @@ class LLMEngine:
         self.cache = PagedKVCache(
             cfg.num_hidden_layers, num_blocks, cfg.num_key_value_heads,
             self.block_size, cfg.head_dim, dtype=kv_dtype,
-            prefix_cache=self.prefix_cache)
+            prefix_cache=self.prefix_cache,
+            spill_blocks=kv_spill_blocks if self.prefix_cache else None)
         self.engine_label = str(next(_ENGINE_IDS))
         self._m = _engine_metrics(self.engine_label)
         self.slo = telemetry.SLOTracker(
@@ -207,7 +239,9 @@ class LLMEngine:
             self.cache, self.max_slots, self.max_model_len,
             max_queue=max_queue,
             max_preemptions_per_request=max_preemptions_per_request,
-            on_event=self._on_sched_event)
+            on_event=self._on_sched_event,
+            high_watermark=kv_high_watermark,
+            low_watermark=kv_low_watermark)
 
         self._next_rid = 0
         self._decode_fn = None
@@ -244,6 +278,13 @@ class LLMEngine:
         self._block_bytes = self._pool_bytes // max(num_blocks, 1)
         self._mm.add("params", self._params_bytes)
         self._mm.add("kv_pool", self._pool_bytes)
+        if self.cache.spill_blocks:
+            # the host spill pool legitimately grows monotonically under
+            # sustained pressure up to its capacity — exempt it from the
+            # leak sentinel below that bound (past it, something is wrong)
+            self._mm.expect_bounded(
+                "kv_spill_host",
+                cap_bytes=self.cache.spill_blocks * self._block_bytes)
 
         self.finished: list[Request] = []
         self.failed: list[Request] = []
@@ -319,6 +360,8 @@ class LLMEngine:
         self.closed = True
         self._mm.sub("params", self._params_bytes)
         self._mm.sub("kv_pool", self._pool_bytes)
+        if self.cache.spill_blocks:
+            self._mm.set("kv_spill_host", 0)
         dropped = self.scheduler.close(cancel_pending=True)
         for req in dropped:
             if req.state is RequestState.FAILED:
@@ -560,6 +603,19 @@ class LLMEngine:
             m.preemptions.inc()
         elif kind == "admit" and req is not None:
             m.queue_time.observe(req.admit_time - req.arrival_time)
+        elif kind == "deadline_queued" and req is not None:
+            # scheduler fail-fast: the request expired while still queued
+            # and never reached a prefill slot — it is CANCELLED with
+            # DeadlineExceeded attached, and must land in the engine's
+            # terminal bookkeeping like every other cancel
+            m.cancelled.inc()
+            self.cancelled.append(req)
+            self._record_lifecycle(req)
+        elif kind == "kv_pressure":
+            m.pressure_events.inc()
+            m.pressure.set(1)
+        elif kind == "kv_pressure_clear":
+            m.pressure.set(0)
 
     def _record_slo(self, req: Request):
         """One rolling-window observation per terminal request: finished
@@ -589,6 +645,15 @@ class LLMEngine:
         m.high_water.set(alloc.high_water)
         m.utilization.set(self.cache.utilization())
         self._mm.set("kv_blocks", alloc.num_used * self._block_bytes)
+        if self.cache.spill_blocks:
+            self._mm.set("kv_spill_host", self.cache.spilled_bytes)
+        # memory-pressure shed: refresh the watermark latch (admit() may
+        # not run again once the queue drains) and ride the SLO tracker —
+        # the existing stats()["slo"]["shed"] -> router -> gateway 429
+        # path needs no new plumbing
+        self.scheduler._update_pressure()
+        self.slo.set_pressure(self.scheduler.mem_pressure,
+                              reason="kv_watermark")
 
     def _record_lifecycle(self, req: Request):
         """Emit the request's queued -> prefill -> decode lifecycle as

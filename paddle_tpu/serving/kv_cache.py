@@ -35,11 +35,27 @@ evicted on demand — the scheduler admits against *effective* free blocks
 (free + evictable). The ragged paged-attention kernel gathers K/V through
 per-sequence block tables, so shared blocks are purely host-side
 bookkeeping: no kernel change.
+
+Tiered host-RAM spill (``spill_blocks=N``, docs/ROBUSTNESS.md "Degradation
+ladder"): with a spill tier armed, LRU eviction *demotes* instead of
+destroys — the evicted block's K/V is copied to a bounded host (numpy)
+pool keyed by the same content address and stamped with a CRC32. A later
+prefix match that runs off the end of the device index continues through
+the spill pool: each spilled block is **promoted** back to a device block
+(CRC verified against the stamp first — a corrupt or faulted promotion
+drops the entry and falls back to full prefill, never wrong tokens) and
+parked in the device LRU so the ordinary shared-block refcounting takes
+over. Every allocation path already funnels through ``_alloc_evict``, so
+"demote then retry" is the universal step before preempt/fail. Fault
+sites ``serving.kv.spill`` / ``serving.kv.promote`` drive the failure
+paths deterministically.
 """
 from __future__ import annotations
 
 import hashlib
+import zlib
 from collections import OrderedDict
+from dataclasses import dataclass
 from types import SimpleNamespace
 
 import jax
@@ -86,6 +102,28 @@ def _prefix_metrics() -> SimpleNamespace:
                 "prefix matches dropped whole (stale/corrupt index)"),
             cached=reg.gauge("kv_prefix_cached_blocks",
                              "blocks held rc==0 in the evictable LRU pool"),
+            spills=reg.counter(
+                "kv_spill_total",
+                "cached blocks demoted to the host-RAM spill tier"),
+            spill_dropped=reg.counter(
+                "kv_spill_dropped_total",
+                "spill entries destroyed for host-pool capacity"),
+            spill_errors=reg.counter(
+                "kv_spill_errors_total",
+                "demotions that failed (eviction destroyed instead)"),
+            promotes=reg.counter(
+                "kv_promote_total",
+                "spilled blocks promoted back to device blocks"),
+            promote_errors=reg.counter(
+                "kv_promote_errors_total",
+                "promotions that failed (entry dropped, full prefill)"),
+            promote_corrupt=reg.counter(
+                "kv_promote_corrupt_total",
+                "promotions refused by the CRC check (entry dropped)"),
+            spilled=reg.gauge(
+                "kv_spill_blocks", "blocks resident in the host spill pool"),
+            spilled_bytes=reg.gauge(
+                "kv_spill_bytes", "host-RAM bytes held by the spill pool"),
         )
     return _PM
 
@@ -230,6 +268,27 @@ class BlockAllocator:
             self._free.append(b)
 
 
+# module-level so jax's jit cache keys on shapes alone: every cache
+# instance with the same pool geometry shares ONE compiled scatter, and a
+# promotion after warmup costs a dispatch, not a compile
+@jax.jit
+def _promote_write(pool, block, kv):
+    return pool.at[:, block].set(kv)
+
+
+@dataclass
+class _SpillEntry:
+    """One block's K/V demoted to host RAM: the index key it answered to
+    on device, its chain hash, the numpy copy, and the CRC32 stamped at
+    demotion time — promotion refuses to serve bytes that no longer match
+    the stamp."""
+
+    key: tuple
+    hash: str
+    kv: np.ndarray          # [num_layers, 2, kv_heads, block_size, head_dim]
+    crc: int
+
+
 def _chain_hash(parent_hash: str, block_tokens) -> str:
     """Content address of a full token-block given its prefix's hash: the
     chain makes a block's hash identify the *entire* token prefix ending at
@@ -248,7 +307,8 @@ class PagedKVCache:
     """
 
     def __init__(self, num_layers, num_blocks, kv_heads, block_size,
-                 head_dim, dtype=jnp.float32, prefix_cache: bool = False):
+                 head_dim, dtype=jnp.float32, prefix_cache: bool = False,
+                 spill_blocks: int | None = None):
         self.pool = jnp.zeros(
             (num_layers, num_blocks, 2, kv_heads, block_size, head_dim),
             dtype)
@@ -263,6 +323,15 @@ class PagedKVCache:
         self._lru: OrderedDict[int, None] = OrderedDict()  # rc==0, evictable
         self._seq_hashes: dict[object, list[str]] = {}   # committed chain
         self.seq_cached_tokens: dict[object, int] = {}   # last admission hit
+        # host-RAM spill tier: key -> _SpillEntry, LRU order (oldest first);
+        # bounded at spill_blocks entries, 0/None = eviction destroys
+        self.spill_blocks = int(spill_blocks or 0)
+        self._spill: OrderedDict[tuple, _SpillEntry] = OrderedDict()
+        # blocks a match walk has collected but not yet refcounted: a
+        # promotion allocating mid-walk must not evict them out from
+        # under the caller (``_evict_one`` skips pinned entries)
+        self._pinned: set[int] = set()
+        self._block_nbytes = int(self.pool.nbytes) // max(int(num_blocks), 1)
         # running totals (prefix_stats(); the telemetry counters mirror them)
         self.prefix_hits = 0
         self.prefix_misses = 0
@@ -271,6 +340,12 @@ class PagedKVCache:
         self.cow_copies = 0
         self.prefix_evictions = 0
         self.stale_drops = 0
+        self.spills = 0
+        self.spill_drops = 0
+        self.spill_errors = 0
+        self.promotes = 0
+        self.promote_errors = 0
+        self.promote_corrupt_drops = 0
 
     def blocks_for(self, num_tokens: int) -> int:
         return -(-int(num_tokens) // self.block_size)
@@ -312,7 +387,7 @@ class PagedKVCache:
             telemetry.record_event("kv.share", stale=True,
                                    tokens=len(tokens))
             return [], []
-        if not self._index:
+        if not self._index and not self._spill:
             return blocks, hashes
         bs = self.block_size
         limit = (len(tokens) - 1) // bs     # block-aligned, < len(tokens)
@@ -321,6 +396,28 @@ class PagedKVCache:
             toks = tuple(int(t) for t in tokens[i * bs:(i + 1) * bs])
             b = self._index.get((parent, toks))
             if b is None:
+                # device chain ends here; the spill tier may continue it —
+                # promote consecutive spilled blocks back to device blocks
+                # until the chain, the pool, or a CRC check stops us. The
+                # walk's blocks are pinned: a promotion's own allocation
+                # must not evict what this match is about to share.
+                self._pinned = set(blocks)
+                try:
+                    for j in range(i, limit):
+                        toks = tuple(int(t)
+                                     for t in tokens[j * bs:(j + 1) * bs])
+                        entry = self._spill.get((parent, toks))
+                        if entry is None:
+                            break
+                        pb = self._promote(entry)
+                        if pb is None:
+                            break
+                        self._pinned.add(pb)
+                        blocks.append(pb)
+                        parent = entry.hash
+                        hashes.append(parent)
+                finally:
+                    self._pinned = set()
                 break
             blocks.append(b)
             h = self._block_hash.get(b)
@@ -357,22 +454,154 @@ class PagedKVCache:
             self._register(table[i], parent, toks)
             hashes.append(_chain_hash(parent, toks))
 
-    def _evict_one(self) -> int:
+    def _evict_one(self) -> int | None:
         """Reclaim the least-recently-released cached block: drop its index
         entry, return it to the free list. Only rc==0 blocks live in the
-        LRU, so eviction can never touch a referenced block."""
-        block, _ = self._lru.popitem(last=False)
+        LRU, so eviction can never touch a referenced block. With a spill
+        tier armed, the block's K/V is demoted to the host pool first —
+        eviction becomes a tier transition, not a destruction. Returns
+        None when every LRU entry is pinned by an in-progress match walk
+        (nothing safely evictable)."""
+        block = next((b for b in self._lru if b not in self._pinned), None)
+        if block is None:
+            return None
+        del self._lru[block]
         key = self._block_key.pop(block, None)
         if key is not None and self._index.get(key) == block:
             del self._index[key]
-        self._block_hash.pop(block, None)
+        h = self._block_hash.pop(block, None)
+        spilled = False
+        if key is not None and h is not None:
+            spilled = self._spill_block(block, key, h)
         self.allocator.reclaim([block])
         self.prefix_evictions += 1
         pm = _prefix_metrics()
         pm.evictions.inc()
         pm.cached.set(self.allocator.num_cached)
-        telemetry.record_event("kv.evict", block=block,
+        telemetry.record_event("kv.evict", block=block, spilled=spilled,
                                cached=self.allocator.num_cached)
+        return block
+
+    # -- host-RAM spill tier ----------------------------------------------
+    @property
+    def spilled_bytes(self) -> int:
+        return len(self._spill) * self._block_nbytes
+
+    def _sync_spill_gauges(self, pm=None):
+        pm = pm or _prefix_metrics()
+        pm.spilled.set(len(self._spill))
+        pm.spilled_bytes.set(self.spilled_bytes)
+
+    def _spill_block(self, block: int, key: tuple, h: str) -> bool:
+        """Demote an evicted block's K/V to the host pool (CRC32-stamped).
+        Failure (injected or real) falls back to destroy-eviction: slower
+        later, never wrong. Returns True when the entry landed."""
+        if not self.spill_blocks:
+            return False
+        pm = _prefix_metrics()
+        try:
+            act = faults.inject("serving.kv.spill", block=block)
+            # np.array copies: the host pool must own (writable,
+            # device-free) memory, not a read-only view of the device
+            # buffer
+            kv = np.ascontiguousarray(np.array(self.pool[:, block]))
+            crc = zlib.crc32(kv.tobytes())
+            if act == "corrupt":
+                # simulated host-RAM bit rot *after* the stamp: the
+                # stored bytes no longer match the CRC, so a later
+                # promotion must detect the mismatch and drop the entry
+                kv.view(np.uint8).reshape(-1)[0] ^= 0xFF
+        except Exception as e:
+            # a failed demotion degrades to destroy-eviction (today's
+            # behavior): the prefix re-prefills later, never serves junk
+            self.spill_errors += 1
+            pm.spill_errors.inc()
+            telemetry.record_event(
+                "kv.spill", block=block, ok=False,
+                error=f"{type(e).__name__}: {e}")
+            return False
+        while len(self._spill) >= self.spill_blocks:
+            self._spill.popitem(last=False)
+            self.spill_drops += 1
+            pm.spill_dropped.inc()
+        self._spill[key] = _SpillEntry(key, h, kv, crc)
+        self.spills += 1
+        pm.spills.inc()
+        self._sync_spill_gauges(pm)
+        telemetry.record_event("kv.spill", block=block, ok=True,
+                               spilled=len(self._spill))
+        return True
+
+    def _promote(self, entry: _SpillEntry) -> int | None:
+        """Promote one spilled block back to a device block: verify the
+        CRC stamp, allocate a device block (demoting others on demand),
+        copy the K/V in, re-register the content address, and park the
+        block *cached* so the caller's ordinary share() path owns the
+        refcount. Any failure drops the entry from the spill index and
+        returns None — the caller stops extending the match and the
+        request prefills those tokens from scratch (never wrong K/V)."""
+        pm = _prefix_metrics()
+        try:
+            act = faults.inject("serving.kv.promote",
+                                blocks=len(self._spill))
+            crc_ok = zlib.crc32(entry.kv.tobytes()) == entry.crc
+        except Exception as e:
+            self._spill.pop(entry.key, None)
+            self.promote_errors += 1
+            pm.promote_errors.inc()
+            self._sync_spill_gauges(pm)
+            telemetry.record_event("kv.promote", ok=False,
+                                   error=f"{type(e).__name__}: {e}")
+            return None
+        if act == "corrupt" or not crc_ok:
+            # the host copy no longer matches its stamp: serving it would
+            # emit wrong tokens, so the entry is dropped and the request
+            # falls back to prefilling these tokens itself
+            self._spill.pop(entry.key, None)
+            self.promote_corrupt_drops += 1
+            pm.promote_corrupt.inc()
+            self._sync_spill_gauges(pm)
+            telemetry.record_event("kv.promote", ok=False, corrupt=True)
+            return None
+        if entry.key in self._index:     # equal content re-registered since
+            self._spill.pop(entry.key, None)
+            self._sync_spill_gauges(pm)
+            return self._index[entry.key]
+        out = self._alloc_evict(1)
+        if out is None:
+            # device pool truly dry even after demotion: the entry stays
+            # spilled for a later attempt, the match just stops here
+            self.promote_errors += 1
+            pm.promote_errors.inc()
+            telemetry.record_event("kv.promote", ok=False, exhausted=True)
+            return None
+        [block] = out
+        try:
+            self.pool = _promote_write(self.pool, jnp.int32(block),
+                                       jnp.asarray(entry.kv))
+        except Exception as e:
+            # the host->device copy itself died: give the block back and
+            # drop the entry — the request prefills those tokens itself
+            self.allocator.free([block])
+            self._spill.pop(entry.key, None)
+            self.promote_errors += 1
+            pm.promote_errors.inc()
+            self._sync_spill_gauges(pm)
+            telemetry.record_event("kv.promote", ok=False,
+                                   error=f"{type(e).__name__}: {e}")
+            return None
+        self._spill.pop(entry.key, None)
+        self._index[entry.key] = block
+        self._block_key[block] = entry.key
+        self._block_hash[block] = entry.hash
+        self.allocator.release([block])          # rc 1 -> 0: parked cached
+        self._lru[block] = None
+        self.promotes += 1
+        pm.promotes.inc()
+        pm.cached.set(self.allocator.num_cached)
+        self._sync_spill_gauges(pm)
+        telemetry.record_event("kv.promote", ok=True, block=block,
+                               spilled=len(self._spill))
         return block
 
     def _alloc_evict(self, n: int):
@@ -382,7 +611,8 @@ class PagedKVCache:
             return []
         out = self.allocator.alloc(n)
         while out is None and self._lru:
-            self._evict_one()
+            if self._evict_one() is None:    # every LRU entry pinned
+                break
             out = self.allocator.alloc(n)
         return out
 
@@ -542,6 +772,18 @@ class PagedKVCache:
             "stale_drops": self.stale_drops,
             "cached_blocks": self.allocator.num_cached,
             "indexed_blocks": len(self._block_key),
+            "spill": {
+                "enabled": self.spill_blocks > 0,
+                "limit_blocks": self.spill_blocks,
+                "spilled_blocks": len(self._spill),
+                "spilled_bytes": self.spilled_bytes,
+                "spills": self.spills,
+                "spill_drops": self.spill_drops,
+                "spill_errors": self.spill_errors,
+                "promotes": self.promotes,
+                "promote_errors": self.promote_errors,
+                "promote_corrupt_drops": self.promote_corrupt_drops,
+            },
         }
 
     def table_array(self, seq_ids, max_blocks: int) -> np.ndarray:

@@ -154,7 +154,9 @@ class Scheduler:
 
     def __init__(self, cache: PagedKVCache, max_slots: int,
                  max_model_len: int, max_queue: int | None = None,
-                 max_preemptions_per_request: int = 16, on_event=None):
+                 max_preemptions_per_request: int = 16, on_event=None,
+                 high_watermark: float | None = None,
+                 low_watermark: float | None = None):
         self.cache = cache
         # telemetry hook: the owning engine passes a callback(kind, **ctx)
         # so scheduler decisions feed its labeled metrics; standalone
@@ -164,6 +166,31 @@ class Scheduler:
         self.max_model_len = int(max_model_len)
         self.max_queue = None if max_queue is None else int(max_queue)
         self.max_preemptions = int(max_preemptions_per_request)
+        # watermark-driven backpressure (docs/ROBUSTNESS.md "Degradation
+        # ladder"): past high_watermark (fraction of usable device blocks
+        # referenced) new admissions queue and `mem_pressure` latches —
+        # the engine surfaces it through stats()["slo"]["shed"] so a
+        # fleet router routes around and the gateway answers 429. The
+        # latch clears below low_watermark (hysteresis: no flapping at
+        # the boundary).
+        self.high_watermark = (None if high_watermark is None
+                               else float(high_watermark))
+        if self.high_watermark is not None:
+            self.low_watermark = (0.75 * self.high_watermark
+                                  if low_watermark is None
+                                  else float(low_watermark))
+            if not 0.0 < self.high_watermark <= 1.0:
+                raise ValueError(
+                    f"high_watermark must be in (0, 1], got "
+                    f"{self.high_watermark}")
+            if not 0.0 <= self.low_watermark < self.high_watermark:
+                raise ValueError(
+                    f"low_watermark ({self.low_watermark}) must be below "
+                    f"high_watermark ({self.high_watermark})")
+        else:
+            self.low_watermark = None
+        self.mem_pressure = False
+        self.num_pressure_events = 0
         self.waiting: deque[Request] = deque()
         self.running: dict[int, Request] = {}       # slot -> request
         self._free_slots = list(range(max_slots))
@@ -210,6 +237,48 @@ class Scheduler:
     def has_work(self) -> bool:
         return bool(self.waiting or self.running)
 
+    # -- decode-time / admission-time pressure ----------------------------
+    def _update_pressure(self) -> bool:
+        """Refresh the watermark latch from the device pool's referenced
+        fraction. Hysteresis: latches at >= high_watermark, clears below
+        low_watermark."""
+        if self.high_watermark is None:
+            return False
+        a = self.cache.allocator
+        used_frac = a.num_used / max(a.num_usable, 1)
+        if not self.mem_pressure and used_frac >= self.high_watermark:
+            self.mem_pressure = True
+            self.num_pressure_events += 1
+            telemetry.record_event(
+                "scheduler.kv_pressure", state="high",
+                used_frac=round(used_frac, 4),
+                waiting=len(self.waiting), running=len(self.running))
+            self._on_event("kv_pressure", rid=None)
+        elif self.mem_pressure and used_frac < self.low_watermark:
+            self.mem_pressure = False
+            telemetry.record_event(
+                "scheduler.kv_pressure", state="low",
+                used_frac=round(used_frac, 4))
+            self._on_event("kv_pressure_clear", rid=None)
+        return self.mem_pressure
+
+    def _expire_queued(self, req: Request):
+        """Fail-fast for a request whose deadline passed while still
+        queued: terminal as ``deadline`` *before* any prefill work is
+        spent on it (a prefill slot is the scarce resource under
+        pressure; a dead request must not burn one)."""
+        self.waiting.popleft()
+        req.state = RequestState.CANCELLED
+        req.finish_time = time.monotonic()
+        req.finish_reason = "deadline"
+        req.error = DeadlineExceeded(
+            f"request {req.rid} missed its deadline while still queued "
+            f"(never admitted to a prefill slot)")
+        self.num_cancelled += 1
+        telemetry.record_event("scheduler.deadline_queued", rid=req.rid,
+                               waiting=len(self.waiting))
+        self._on_event("deadline_queued", rid=req.rid, req=req)
+
     # -- admission --------------------------------------------------------
     def admit(self) -> list[tuple[int, Request]]:
         """Move waiting requests into free slots while the pool can hold
@@ -217,10 +286,20 @@ class Scheduler:
         checked against *effective* free blocks (free + evictable cached
         prefixes) — a pool full of unreferenced completed prefixes is not
         a full pool, and any cached prefix the request matches shrinks its
-        real footprint further."""
+        real footprint further. Above the high watermark admissions stop
+        entirely (the queue holds; running requests drain the pressure),
+        and a queued request whose deadline already passed terminates as
+        ``deadline`` instead of being admitted."""
         admitted = []
+        now = time.monotonic()
+        self._update_pressure()      # latch/clear even with an empty queue
         while self.waiting and self._free_slots:
             req = self.waiting[0]
+            if req.past_deadline(now):
+                self._expire_queued(req)
+                continue
+            if self._update_pressure():
+                break
             faults.inject("serving.admit", rid=req.rid)
             need = self.cache.blocks_for(len(req.prefill_tokens)) + 1
             if self.cache.num_effective_free < need:

@@ -386,6 +386,11 @@ class MemoryMonitor:
         self.leak_window = int(leak_window)
         self._steps: dict[str, deque] = {}    # tag -> end-of-step watermarks
         self._leak_flagged: set[str] = set()
+        # tags whose monotonic growth is expected by design (a
+        # capacity-bounded pool filling up, e.g. the KV spill tier): the
+        # sentinel only flags them past their declared cap (never, if the
+        # cap is None)
+        self._bounded: dict[str, float | None] = {}
 
     # -- accounting ------------------------------------------------------
     def add(self, tag: str, nbytes: float):
@@ -396,6 +401,17 @@ class MemoryMonitor:
 
     def set(self, tag: str, nbytes: float):
         self._update(tag, nbytes, relative=False)
+
+    def expect_bounded(self, tag: str, cap_bytes: float | None = None):
+        """Declare ``tag``'s growth expected by design (a pool that fills
+        to a capacity and stays there — spill tiers, arenas). The leak
+        sentinel stops flagging monotonic growth of the tag while it is
+        at or under ``cap_bytes``; with ``cap_bytes=None`` it is never
+        flagged. Growth *past* the cap still flags: a bounded pool
+        exceeding its bound is precisely a leak."""
+        with self._lock:
+            self._bounded[tag] = (None if cap_bytes is None
+                                  else float(cap_bytes))
 
     def _update(self, tag, nbytes, relative):
         if not ENABLED[0]:
@@ -476,6 +492,11 @@ class MemoryMonitor:
                 d = self._steps.setdefault(
                     tag, deque(maxlen=self.leak_window))
                 d.append(live)
+                if tag in self._bounded:
+                    cap = self._bounded[tag]
+                    if cap is None or live <= cap:
+                        self._leak_flagged.discard(tag)
+                        continue
                 if self._is_leaking(d):
                     if tag not in self._leak_flagged:
                         self._leak_flagged.add(tag)
@@ -510,6 +531,7 @@ class MemoryMonitor:
             self._timeline.clear()
             self._steps.clear()
             self._leak_flagged.clear()
+            self._bounded.clear()
 
 
 # ---------------------------------------------------------------------------

@@ -107,6 +107,12 @@ class SLOTracker:
         # p99s link back to (telemetry.reqtrace)
         self._win: deque[tuple] = deque(maxlen=int(max_samples))
         self._lock = threading.Lock()
+        # external pressure overlay (e.g. the scheduler's KV-pool
+        # watermark latch): while set, the shed verdict is forced
+        # regardless of latency percentiles or min_samples — a pool out
+        # of blocks sheds even if the window looks healthy
+        self._pressure = False
+        self._pressure_reason: str | None = None
         self._m = _slo_metrics(engine_label)
         if ENABLED[0]:
             # vacuous-truth defaults: an idle engine admits (healthy=1),
@@ -129,6 +135,14 @@ class SLOTracker:
         with self._lock:
             self._win.append((self._clock(), ttft, tpot, queue_time,
                               int(tokens), ok, trace_id))
+
+    def set_pressure(self, active: bool, reason: str | None = None):
+        """Arm/clear the external pressure overlay. The caller that owns
+        a non-latency shed signal (KV-pool watermarks, an operator
+        switch) reports it here and the ``shed``/``healthy`` verdict the
+        router polls reflects it immediately."""
+        self._pressure = bool(active)
+        self._pressure_reason = reason if active else None
 
     def record_failed(self, tokens: int = 0, trace_id: str | None = None):
         """A failed or cancelled request: its tokens (already streamed to
@@ -179,6 +193,10 @@ class SLOTracker:
             if (self.tpot_slo_s is not None and tpot_p["p99"] is not None
                     and tpot_p["p99"] > self.tpot_slo_s):
                 healthy = False
+        shed_reason = None if healthy else "latency"
+        if self._pressure:       # authoritative: not gated on min_samples
+            healthy = False
+            shed_reason = self._pressure_reason or "pressure"
         out = {
             "window_s": self.window_s,
             "window_requests": len(win),
@@ -195,6 +213,7 @@ class SLOTracker:
                                       if win else 1.0),
             "healthy": healthy,
             "shed": not healthy,
+            "shed_reason": shed_reason,
             # trace-id exemplars: the exact request behind each window p99
             # (GET /v1/traces/<id> on the gateway renders its timeline)
             "exemplars": {

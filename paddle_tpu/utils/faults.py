@@ -52,6 +52,11 @@ Grammar (``;``-separated specs)::
            stale_hash inject() returns "stale_hash"; the prefix index
                       behaves as if it resolved a wrong-content block
                       (the cache drops the whole match: no-share fallback)
+           corrupt    inject() returns "corrupt"; the site simulates data
+                      corruption (at ``serving.kv.spill`` the host copy
+                      bit-rots after its CRC stamp; at
+                      ``serving.kv.promote`` the CRC check fails — either
+                      way the entry is dropped, never served)
            torn_write inject() returns "torn_write"; the gateway journal
                       writes half a frame and raises JournalTornWrite —
                       simulated process death mid-append (recovery must
@@ -71,6 +76,17 @@ Known sites (see docs/ROBUSTNESS.md for the full table):
                           (stale_hash => drop to no-share, full prefill)
     serving.kv.cow        copy-on-write guard before a shared-block write
                           (exhaust => CoW alloc fails; caller preempts)
+    serving.kv.spill      host-RAM demotion of an evicted cached block
+                          (error => the spill fails and eviction destroys
+                          as before; corrupt => the host copy bit-rots
+                          after its CRC stamp — a later promotion must
+                          catch the mismatch and drop the entry)
+    serving.kv.promote    spilled-block promotion on a prefix match
+                          (error => promotion fails, entry dropped, the
+                          request prefills those tokens itself; corrupt
+                          => the CRC check reports a mismatch — entry
+                          dropped, never wrong tokens; delay => a slow
+                          host->device copy)
     serving.admit         per admission attempt
     serving.compile       once per NEW prefill/decode trace creation
                           (error => compile fails; isolation boundary
@@ -130,7 +146,7 @@ class FaultError(RuntimeError):
 _SPEC_RE = re.compile(
     r"^(?P<site>[\w.\-]+):"
     r"(?P<kind>error|delay|exhaust|nan_grads|bad_batch|stale_hash"
-    r"|torn_write)"
+    r"|torn_write|corrupt)"
     r"(?:=(?P<arg>[^@x%;]+))?"
     r"(?:@(?P<start>\d+))?"
     r"(?:x(?P<count>\d+|\*))?"
@@ -165,7 +181,7 @@ class FaultSpec:
     # nan_grads => poisoned gradients, bad_batch => NaN batch,
     # stale_hash => prefix index resolved wrong content)
     TOKEN_KINDS = ("exhaust", "nan_grads", "bad_batch", "stale_hash",
-                   "torn_write")
+                   "torn_write", "corrupt")
 
     def __post_init__(self):
         if self.kind not in ("error", "delay") + self.TOKEN_KINDS:

@@ -1,0 +1,546 @@
+"""Tiered host-RAM KV spill + watermark backpressure (ISSUE 14).
+
+Four layers of coverage:
+
+- the spill tier's host bookkeeping (no model): eviction demotes to a
+  CRC32-stamped numpy copy, a prefix match continues through the spill
+  pool and promotes back with the content intact, the host pool is
+  capacity-bounded, and every failure path (spill error -> destroy
+  fallback, promote error/corrupt/exhaustion -> drop or retry-later,
+  never wrong K/V) degrades without leaking a device block;
+- a seeded randomized storm over the allocator interleaving
+  alloc/share/release/reclaim/spill/promote (through allocate, extend,
+  ensure_writable, fork, free_seq) asserting the global invariant after
+  every operation: every device block is exactly one of {free, allocated,
+  cached} (the partition is exact), refcounts equal table references, the
+  spill pool stays within its bound, and a full drain returns the pool;
+- watermark-driven backpressure: the scheduler's high/low hysteresis
+  latch, its surfacing through ``stats()["slo"]["shed"]`` (the path the
+  FleetRouter and gateway 429 already consume), and the queued-deadline
+  fail-fast (a request whose deadline expires while waiting terminates as
+  ``deadline`` before any prefill slot is burned);
+- the engine acceptance gate: under memory pressure with faults injected
+  (including corrupt promotions) finished requests stay token-for-token
+  equal to a cache-off engine — a corrupt promotion re-prefills, it never
+  emits a wrong token.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+from paddle_tpu.serving import (
+    LLMEngine, PagedKVCache, RequestState, SamplingParams)
+from paddle_tpu.serving.scheduler import DeadlineExceeded, Scheduler
+from paddle_tpu.telemetry.perf import MemoryMonitor
+from paddle_tpu.utils import faults
+from paddle_tpu.utils.faults import FaultPlan
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults.deactivate()
+
+
+def _cache(num_blocks=13, block_size=4, spill_blocks=8):
+    return PagedKVCache(num_layers=1, num_blocks=num_blocks, kv_heads=1,
+                        block_size=block_size, head_dim=4,
+                        prefix_cache=True, spill_blocks=spill_blocks)
+
+
+def _tiny_model(vocab=61, hidden=32, layers=2, seq=128):
+    paddle_tpu.seed(0)
+    cfg = llama_tiny(vocab=vocab, hidden=hidden, layers=layers, heads=4,
+                     kv_heads=2, inter=2 * hidden, seq=seq)
+    return LlamaForCausalLM(cfg)
+
+
+def _check_invariants(cache: PagedKVCache):
+    """The refcount+CoW contract of test_prefix_cache extended with the
+    spill tier: the device partition stays exact and the host pool stays
+    bounded and self-consistent."""
+    a = cache.allocator
+    free = set(a._free)
+    cached = set(a._cached)
+    live = {b for b, rc in a._rc.items() if rc > 0}
+    # every device block is exactly one of {free, allocated, cached}
+    assert not (free & set(a._rc))
+    assert not (live & cached)
+    assert live | cached | free == set(range(1, a.num_blocks))
+    assert len(a._free) == len(free), "duplicate ids in free list"
+    assert 0 not in a._rc and 0 not in free
+    # refcount sums never leak: rc == table references, exactly
+    counts: dict[int, int] = {}
+    for t in cache.tables.values():
+        for b in t:
+            counts[b] = counts.get(b, 0) + 1
+    assert counts == {b: rc for b, rc in a._rc.items() if rc > 0}, (
+        "refcounts drifted from table references")
+    assert set(cache._lru) == cached
+    for b in cached:
+        assert b in cache._block_key, "cached block lost its index entry"
+    for key, b in cache._index.items():
+        assert cache._block_key.get(b) == key
+        assert b in a._rc, "index entry points at a freed block"
+    # spill pool: bounded, keys self-consistent, entries never reference
+    # device block ids (they are host copies)
+    assert len(cache._spill) <= max(cache.spill_blocks, 0)
+    for key, entry in cache._spill.items():
+        assert entry.key == key
+        assert entry.kv.shape[0] == cache.pool.shape[0]
+    assert cache.spilled_bytes == len(cache._spill) * cache._block_nbytes
+
+
+def _seed_prefix(cache, tokens, seq="seed", paint=None):
+    """Allocate+commit+free one sequence so its full blocks sit cached;
+    optionally paint each block's pool content with a recognizable value
+    (block id + 1) for round-trip checks."""
+    import jax.numpy as jnp
+
+    assert cache.allocate(seq, len(tokens), tokens=tokens)
+    if paint:
+        table = list(cache.tables[seq])
+        pool = np.array(cache.pool)
+        for b in table:
+            pool[:, b] = float(b) + 1.0
+        cache.pool = jnp.asarray(pool)
+        cache._painted = table          # test-side note
+    cache.commit_prefix(seq, tokens)
+    cache.free_seq(seq)
+
+
+def _flood(cache, n_tokens, seq="flood"):
+    """Allocate a plain sequence big enough to evict the cached set."""
+    assert cache.allocate(seq, n_tokens)
+    cache.free_seq(seq)
+
+
+# ---------------------------------------------------------------------------
+# demotion (spill) semantics
+# ---------------------------------------------------------------------------
+
+class TestSpillDemote:
+    def test_evict_demotes_and_promotion_restores_content(self):
+        c = _cache(num_blocks=9, spill_blocks=8)
+        toks = list(range(11))                   # 2 full blocks + tail
+        _seed_prefix(c, toks, paint=True)
+        painted = c._painted
+        assert c.allocator.num_cached == 2
+        _flood(c, 8 * 4)                         # evicts both -> spill
+        assert c.spills == 2 and len(c._spill) == 2
+        _check_invariants(c)
+        assert c.allocate("re", 11, tokens=toks)
+        st = c.prefix_stats()["spill"]
+        assert st["promotes"] == 2 and st["spilled_blocks"] == 0
+        assert c.seq_cached_tokens["re"] == 8
+        # the K/V made the device -> host -> device round trip intact
+        for i, b in enumerate(c.tables["re"][:2]):
+            got = np.asarray(c.pool[:, b])
+            assert np.all(got == float(painted[i]) + 1.0)
+        _check_invariants(c)
+
+    def test_spill_pool_capacity_drops_oldest(self):
+        c = _cache(num_blocks=13, spill_blocks=2)
+        toks = list(range(16))                   # 4 full blocks
+        _seed_prefix(c, toks)
+        _flood(c, 12 * 4)                        # evicts all 4, pool holds 2
+        assert c.spills == 4 and len(c._spill) == 2
+        assert c.spill_drops == 2
+        # the survivors are the two newest (deepest-chain) spills; the
+        # chain head is gone, so a rematch finds nothing to promote
+        assert c.allocate("re", 16, tokens=toks)
+        assert c.seq_cached_tokens["re"] == 0
+        _check_invariants(c)
+
+    def test_spill_disabled_eviction_destroys(self):
+        c = _cache(num_blocks=9, spill_blocks=0)
+        toks = list(range(11))
+        _seed_prefix(c, toks)
+        _flood(c, 8 * 4)
+        assert c.spills == 0 and len(c._spill) == 0
+        assert c.prefix_evictions == 2
+        _check_invariants(c)
+
+    def test_spill_error_falls_back_to_destroy(self):
+        c = _cache(num_blocks=9, spill_blocks=8)
+        toks = list(range(11))
+        _seed_prefix(c, toks)
+        with FaultPlan.parse("serving.kv.spill:error@1x2") as plan:
+            _flood(c, 8 * 4)
+        assert plan.fired_at("serving.kv.spill") == 2
+        assert c.spill_errors == 2 and len(c._spill) == 0
+        # destroyed, not corrupted: the rematch is a plain miss
+        assert c.allocate("re", 11, tokens=toks)
+        assert c.seq_cached_tokens["re"] == 0
+        _check_invariants(c)
+
+
+# ---------------------------------------------------------------------------
+# promotion semantics
+# ---------------------------------------------------------------------------
+
+class TestPromote:
+    def _spilled_cache(self):
+        c = _cache(num_blocks=9, spill_blocks=8)
+        toks = list(range(11))
+        _seed_prefix(c, toks)
+        _flood(c, 8 * 4)
+        assert len(c._spill) == 2
+        return c, toks
+
+    def test_promote_error_drops_entry_and_prefills(self):
+        c, toks = self._spilled_cache()
+        with FaultPlan.parse("serving.kv.promote:error@1"):
+            assert c.allocate("re", 11, tokens=toks)
+        st = c.prefix_stats()["spill"]
+        assert st["promote_errors"] == 1 and st["promotes"] == 0
+        assert c.seq_cached_tokens["re"] == 0     # chain head gone
+        assert len(c._spill) == 1                  # only the hit entry drops
+        _check_invariants(c)
+
+    def test_promote_corrupt_fault_drops_entry(self):
+        c, toks = self._spilled_cache()
+        with FaultPlan.parse("serving.kv.promote:corrupt@1"):
+            assert c.allocate("re", 11, tokens=toks)
+        st = c.prefix_stats()["spill"]
+        assert st["promote_corrupt_drops"] == 1 and st["promotes"] == 0
+        assert c.seq_cached_tokens["re"] == 0
+        _check_invariants(c)
+
+    def test_spill_corrupt_caught_by_real_crc_at_promote(self):
+        c = _cache(num_blocks=9, spill_blocks=8)
+        toks = list(range(11))
+        _seed_prefix(c, toks)
+        with FaultPlan.parse("serving.kv.spill:corrupt@1"):
+            _flood(c, 8 * 4)                # first spill's bytes bit-rot
+        assert c.allocate("re", 11, tokens=toks)
+        st = c.prefix_stats()["spill"]
+        # no fault armed at promote time: the genuine CRC check caught it
+        assert st["promote_corrupt_drops"] == 1
+        assert c.seq_cached_tokens["re"] == 0
+        _check_invariants(c)
+
+    def test_promote_pool_exhaustion_keeps_entry_for_later(self):
+        # 3-usable-block pool with a live 2-block hog: promoting the
+        # chain's second block finds no free block and the only LRU entry
+        # is the (pinned) first promotion — the promote fails cleanly,
+        # the entry STAYS spilled, and nothing is corrupted
+        c = _cache(num_blocks=4, spill_blocks=8)
+        toks = list(range(8))                    # exactly 2 full blocks
+        _seed_prefix(c, toks)
+        _flood(c, 3 * 4)                         # evict both -> spill
+        assert len(c._spill) == 2
+        assert c.allocate("hold", 2 * 4)         # live hog: 1 block free
+        ok = c.allocate("re", 9, tokens=toks + [9])
+        # promote #1 lands; promote #2 and the tail cannot fit -> the
+        # admission fails as a whole and rolls back to a consistent state
+        assert not ok
+        st = c.prefix_stats()["spill"]
+        assert st["promotes"] == 1
+        assert st["promote_errors"] >= 1          # the exhausted attempt
+        assert len(c._spill) == 1                 # unpromoted entry kept
+        assert "re" not in c.tables
+        _check_invariants(c)
+
+
+# ---------------------------------------------------------------------------
+# the randomized storm (alloc/share/release/reclaim/spill/promote)
+# ---------------------------------------------------------------------------
+
+class TestSpillStorm:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_storm(self, seed):
+        rng = np.random.RandomState(seed)
+        bs = 4
+        c = _cache(num_blocks=11, block_size=bs, spill_blocks=6)
+        live: dict[str, list[int]] = {}          # seq -> token list
+        next_id = 0
+        for _ in range(300):
+            op = rng.choice(["admit", "free", "extend", "write", "fork"])
+            if op == "admit" or not live:
+                # tiny vocab so chains collide across sequences: rematches
+                # (and therefore promotions) actually happen
+                n = int(rng.randint(1, 3 * bs + 2))
+                toks = [int(t) for t in rng.randint(0, 3, n)]
+                sid = f"s{next_id}"
+                next_id += 1
+                if c.allocate(sid, n, tokens=toks):
+                    live[sid] = toks
+                    if rng.rand() < 0.8:
+                        c.commit_prefix(sid, toks)
+            elif op == "free":
+                sid = rng.choice(list(live))
+                c.free_seq(sid)
+                del live[sid]
+            elif op == "extend":
+                sid = rng.choice(list(live))
+                toks = live[sid]
+                grow = int(rng.randint(1, bs + 1))
+                if c.extend(sid, len(toks) + grow):
+                    toks += [int(t) for t in rng.randint(0, 3, grow)]
+                    if rng.rand() < 0.5:
+                        c.commit_prefix(sid, toks)
+            elif op == "write":
+                sid = rng.choice(list(live))
+                pos = int(rng.randint(0, len(live[sid])))
+                c.ensure_writable(sid, pos)
+            elif op == "fork":
+                sid = rng.choice(list(live))
+                child = f"s{next_id}"
+                next_id += 1
+                c.fork(sid, child)
+                live[child] = list(live[sid])
+            _check_invariants(c)
+        # drain: every reference returned, the partition is exact
+        for sid in list(live):
+            c.free_seq(sid)
+        _check_invariants(c)
+        assert c.allocator.num_used == 0
+        assert (c.allocator.num_free + c.allocator.num_cached
+                == c.allocator.num_usable)
+        # the storm must actually exercise the tier, not vacuously pass
+        assert c.spills > 0
+
+    def test_storm_with_injected_faults(self):
+        rng = np.random.RandomState(7)
+        c = _cache(num_blocks=9, block_size=4, spill_blocks=4)
+        plan = FaultPlan.parse(
+            "serving.kv.spill:error%0.2;serving.kv.spill:corrupt%0.1;"
+            "serving.kv.promote:error%0.2;serving.kv.alloc:exhaust%0.05",
+            seed=7)
+        live: dict[str, list[int]] = {}
+        next_id = 0
+        with plan:
+            for _ in range(250):
+                if rng.rand() < 0.5 or not live:
+                    n = int(rng.randint(1, 10))
+                    toks = [int(t) for t in rng.randint(0, 2, n)]
+                    sid = f"s{next_id}"
+                    next_id += 1
+                    if c.allocate(sid, n, tokens=toks):
+                        live[sid] = toks
+                        c.commit_prefix(sid, toks)
+                else:
+                    sid = rng.choice(list(live))
+                    c.free_seq(sid)
+                    del live[sid]
+                _check_invariants(c)
+        assert plan.fired, "the storm never hit a fault site"
+        for sid in list(live):
+            c.free_seq(sid)
+        _check_invariants(c)
+        assert c.allocator.num_used == 0
+
+
+# ---------------------------------------------------------------------------
+# watermark backpressure
+# ---------------------------------------------------------------------------
+
+class TestWatermarks:
+    def _sched(self, num_blocks=9, high=0.5, low=0.25, slots=4):
+        cache = _cache(num_blocks=num_blocks, spill_blocks=0)
+        return Scheduler(cache, slots, 32, high_watermark=high,
+                         low_watermark=low), cache
+
+    def test_latch_and_hysteresis(self):
+        s, cache = self._sched()     # 8 usable; high at 4, low at 2
+        assert not s._update_pressure()
+        assert cache.allocate("a", 4 * 4)        # 4 blocks = 0.5
+        assert s._update_pressure() and s.mem_pressure
+        assert s.num_pressure_events == 1
+        # between low and high: stays latched (hysteresis)
+        cache.free_seq("a")
+        assert cache.allocate("b", 3 * 4)        # 3 blocks = 0.375
+        assert s._update_pressure()
+        # below low: clears
+        cache.free_seq("b")
+        assert cache.allocate("c", 1 * 4)        # 1 block = 0.125
+        assert not s._update_pressure()
+        # re-latches (a second event)
+        assert cache.allocate("d", 4 * 4)
+        assert s._update_pressure()
+        assert s.num_pressure_events == 2
+
+    def test_admission_queues_under_pressure(self):
+        from paddle_tpu.serving.scheduler import Request
+
+        s, cache = self._sched()
+        assert cache.allocate("hog", 5 * 4)      # 0.625 > high
+        req = Request(rid=0, prompt=[1, 2, 3],
+                      sampling=SamplingParams(max_new_tokens=2))
+        s.add(req)
+        assert s.admit() == []                   # queued, not admitted
+        assert s.mem_pressure
+        cache.free_seq("hog")
+        admitted = s.admit()                     # pressure cleared
+        assert [r.rid for _, r in admitted] == [0]
+
+    def test_watermark_validation(self):
+        cache = _cache()
+        with pytest.raises(ValueError, match="high_watermark"):
+            Scheduler(cache, 2, 32, high_watermark=1.5)
+        with pytest.raises(ValueError, match="low_watermark"):
+            Scheduler(cache, 2, 32, high_watermark=0.5, low_watermark=0.6)
+
+    def test_low_defaults_to_three_quarters_of_high(self):
+        cache = _cache()
+        s = Scheduler(cache, 2, 32, high_watermark=0.8)
+        assert s.low_watermark == pytest.approx(0.6)
+
+
+# ---------------------------------------------------------------------------
+# MemoryMonitor: bounded-growth exemption
+# ---------------------------------------------------------------------------
+
+class TestMemoryMonitorBounded:
+    def test_bounded_tag_never_flags_under_cap(self):
+        mm = MemoryMonitor(leak_window=4)
+        mm.expect_bounded("spill", cap_bytes=1000)
+        for v in (100, 300, 600, 900, 950, 1000):
+            mm.set("spill", v)
+            mm.note_step()
+        assert mm.leak_report() == {}
+
+    def test_bounded_tag_flags_past_cap(self):
+        mm = MemoryMonitor(leak_window=4)
+        mm.expect_bounded("spill", cap_bytes=500)
+        for v in (600, 700, 800, 900):
+            mm.set("spill", v)
+            mm.note_step()
+        assert "spill" in mm.leak_report()
+
+    def test_uncapped_exemption_and_unbounded_tag_still_flags(self):
+        mm = MemoryMonitor(leak_window=4)
+        mm.expect_bounded("ok_tag")              # cap None: never flags
+        for v in (1, 2, 3, 4):
+            mm.set("ok_tag", v)
+            mm.set("leaky", v * 10)
+            mm.note_step()
+        rep = mm.leak_report()
+        assert "ok_tag" not in rep and "leaky" in rep
+
+
+# ---------------------------------------------------------------------------
+# chaos_run scenario selection (--list / --scenario)
+# ---------------------------------------------------------------------------
+
+class TestChaosScenarioSelection:
+    def test_catalog_covers_the_spill_battery(self):
+        from tools import chaos_run
+
+        names = chaos_run.SUITE_SCENARIOS["spill"]()
+        assert "baseline_spill" in names and "spill_storm" in names
+        assert set(chaos_run.SUITE_SCENARIOS) == {
+            "serving", "prefix", "spill", "perf", "serve-fleet",
+            "durable", "train", "straggler"}
+
+    def test_function_scenario_filtering(self):
+        from tools import chaos_run
+
+        def _scenario_a():
+            pass
+
+        def _scenario_b():
+            pass
+
+        fns = (_scenario_a, _scenario_b)
+        assert chaos_run._filter_scenarios(fns, "_scenario_", None) \
+            == [_scenario_a, _scenario_b]
+        assert chaos_run._filter_scenarios(fns, "_scenario_", "b") \
+            == [_scenario_b]
+        with pytest.raises(SystemExit, match="unknown scenario"):
+            chaos_run._filter_scenarios(fns, "_scenario_", "zzz")
+
+
+# ---------------------------------------------------------------------------
+# engine integration: pressure shed, deadline fail-fast, fault parity
+# ---------------------------------------------------------------------------
+
+def _waves(rng, vocab=61, plen=24, n_shared=16):
+    shared = list(rng.randint(0, vocab, n_shared))
+    mk = lambda: shared + list(rng.randint(0, vocab, plen - n_shared))
+    return [
+        [mk() for _ in range(2)],                              # seed
+        [list(rng.randint(0, vocab, plen)) for _ in range(3)],  # flood
+        [mk() for _ in range(2)],                              # rematch
+    ]
+
+
+class TestEngineSpill:
+    def _run(self, model, waves, sp, **kw):
+        eng = LLMEngine(model, block_size=8, max_slots=2, max_model_len=32,
+                        **kw)
+        reqs = []
+        for w in waves:
+            reqs += [eng.add_request(p, sp) for p in w]
+            eng.run()
+        return eng, [r.output_tokens for r in reqs]
+
+    def test_pressure_parity_and_spill_stats(self):
+        model = _tiny_model()
+        rng = np.random.RandomState(0)
+        waves = _waves(rng)
+        sp = SamplingParams(max_new_tokens=8, temperature=0.0)
+        eng_on, outs_on = self._run(
+            model, waves, sp, num_blocks=11, prefix_cache=True,
+            kv_spill_blocks=16, kv_high_watermark=0.9,
+            kv_low_watermark=0.6)
+        eng_off, outs_off = self._run(model, waves, sp, prefix_cache=False)
+        assert outs_on == outs_off
+        st = eng_on.stats()
+        spill = st["prefix_cache"]["spill"]
+        assert spill["enabled"] and spill["spills"] > 0
+        assert spill["promotes"] > 0
+        assert st["blocks_used"] == 0
+        # the host tier is visible to the memory monitor under its tag
+        assert eng_on._mm.peak("kv_spill_host") > 0
+        _check_invariants(eng_on.cache)
+
+    def test_corrupt_promotions_never_change_tokens(self):
+        model = _tiny_model()
+        rng = np.random.RandomState(1)
+        waves = _waves(rng)
+        sp = SamplingParams(max_new_tokens=8, temperature=0.0)
+        with FaultPlan.parse("serving.kv.promote:corrupt@1x*"):
+            eng_on, outs_on = self._run(
+                model, waves, sp, num_blocks=11, prefix_cache=True,
+                kv_spill_blocks=16)
+        eng_off, outs_off = self._run(model, waves, sp, prefix_cache=False)
+        assert outs_on == outs_off
+        spill = eng_on.stats()["prefix_cache"]["spill"]
+        assert spill["promote_corrupt_drops"] > 0
+        assert spill["promotes"] == 0
+
+    def test_pressure_forces_shed_signal(self):
+        model = _tiny_model()
+        eng = LLMEngine(model, block_size=8, max_slots=2, max_model_len=32,
+                        num_blocks=11, kv_high_watermark=0.7,
+                        kv_low_watermark=0.4)
+        # hold real blocks past the high mark: stats() recomputes the
+        # latch, so the pressure must be genuine, not hand-set
+        assert eng.cache.allocate("hog", 8 * 8)  # 8/10 = 0.8 > 0.7
+        slo = eng.stats()["slo"]
+        assert slo["shed"] is True and slo["healthy"] is False
+        assert slo["shed_reason"] == "kv_watermark"
+        eng.cache.free_seq("hog")
+        slo = eng.stats()["slo"]                 # stats() refreshes latch
+        assert slo["shed"] is False and slo["shed_reason"] is None
+
+    def test_queued_deadline_fails_fast_before_prefill(self):
+        model = _tiny_model()
+        eng = LLMEngine(model, block_size=8, max_slots=2, max_model_len=32)
+        sp = SamplingParams(max_new_tokens=4, temperature=0.0)
+        rng = np.random.RandomState(0)
+        req = eng.add_request(list(rng.randint(0, 61, 8)), sp,
+                              deadline_s=1e-4)
+        time.sleep(0.005)
+        admitted = eng.scheduler.admit()
+        assert all(r.rid != req.rid for _, r in admitted)
+        assert req.state is RequestState.CANCELLED
+        assert req.finish_reason == "deadline"
+        assert isinstance(req.error, DeadlineExceeded)
+        assert req in eng.cancelled               # engine bookkeeping too
+        assert req.admit_time is None             # truly never admitted

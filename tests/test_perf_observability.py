@@ -386,6 +386,28 @@ class TestPerfGate:
         out = capsys.readouterr().out
         assert "mean_ttft_s" in out and "REGRESSED" in out
 
+    def test_spill_prefix_result_is_its_own_bench_kind(self):
+        # the --kv-spill-blocks variant measures eviction recovery, not
+        # the plain cache-warm path: it must not cross-gate with the
+        # serving_prefix baseline
+        plain = {"mode": "prefix",
+                 "prefix": {"ttft_warm_on_s": 0.01, "ttft_speedup": 2.5,
+                            "tok_per_sec_on": 900.0, "hit_rate": 1.0}}
+        kind, metrics = perf_gate.extract_metrics(plain)
+        assert kind == "serving_prefix"
+        spilled = {"mode": "prefix",
+                   "prefix": {"hit_rate": 1.0,
+                              "spill": {"ttft_warm_spill_s": 0.02,
+                                        "ttft_speedup_vs_off": 4.0,
+                                        "tok_per_sec_spill": 800.0}}}
+        kind, metrics = perf_gate.extract_metrics(spilled)
+        assert kind == "serving_prefix_spill"
+        assert metrics == {"prefix_spill_ttft_warm_s": 0.02,
+                           "prefix_spill_ttft_speedup": 4.0,
+                           "prefix_spill_tok_per_sec": 800.0}
+        for name in metrics:
+            assert name in perf_gate.DIRECTIONS
+
     def test_within_tolerance_noise_accepted(self, tmp_path):
         base = str(tmp_path / "BASELINE.json")
         good = self._write(tmp_path, "good.json", _serving_result())

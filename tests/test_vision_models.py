@@ -112,6 +112,9 @@ class TestVisionZooRound5:
         x = paddle.to_tensor(np.random.rand(1, 3, 64, 64).astype(np.float32))
         assert net(x).shape == [1, 3]
 
+    @pytest.mark.slow  # compile-heavy scale sweep (3 variants, ~30s on 1
+    # core); ShuffleNet's forward+grad stays guarded in tier-1 by
+    # test_shufflenet_hapi_trainable
     def test_shufflenet_v2_scales(self):
         from paddle_tpu.vision.models import (
             ShuffleNetV2, shufflenet_v2_swish, shufflenet_v2_x0_25)

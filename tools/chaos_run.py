@@ -1,7 +1,7 @@
 """Chaos sweep: drive the runtime through batteries of deterministic fault
 plans and report survival / degradation stats per plan.
 
-Six suites:
+Eight suites:
 
 ``--suite serving`` (default) — the continuous-batching engine under fault
 plans. For every plan the same request fleet runs on a fresh engine; the
@@ -20,6 +20,19 @@ cache-off token streams under faults: ``serving.kv.share:stale_hash``
 preempt/fail that request, never a corrupted shared block), plus allocator
 exhaustion with eviction in play. The baseline plan must also show a real
 cache hit rate.
+
+``--suite spill`` — the tiered KV pool under memory pressure
+(docs/ROBUSTNESS.md "Degradation ladder"): a deliberately undersized
+device pool with the host-RAM spill tier and watermark backpressure
+armed, driven through a seed -> flood -> rematch workload so demotions
+and promotions are genuinely in flight when the faults land
+(``serving.kv.spill:{error,corrupt}``,
+``serving.kv.promote:{error,corrupt,delay}``, allocator exhaustion, and
+a combined >=5-fault storm). Every plan is held to token-for-token
+parity vs a fault-free cache-off engine — in particular, a *corrupt*
+promotion must be caught by the CRC check and fall back to re-prefill,
+never emit a wrong token — plus zero leaked device blocks (free + live
++ cached == usable at drain).
 
 ``--suite train`` — the resilient training loop (docs/ROBUSTNESS.md
 "Training resilience"): kill-worker (SIGKILL mid-run under the launcher,
@@ -83,11 +96,17 @@ recorder + stack snapshot.
 
 Usage:
     python tools/chaos_run.py
-        [--suite serving|prefix|train|straggler|perf|serve-fleet|durable]
+        [--suite serving|prefix|spill|train|straggler|perf|serve-fleet|durable]
         [--requests 6] [--prompt-len 24] [--max-new 16]
         [--slots 3] [--block-size 8] [--plan NAME:SPEC ...] [--json OUT.json]
+        [--list] [--scenario NAME]
 
     python bench.py --chaos        # serving sweep, via bench's opt-in mode
+
+``--list`` prints every suite's scenario names; ``--scenario NAME`` re-runs
+a single scenario of the chosen suite (the unit of re-run when one row of
+the nightly battery fails) — see docs/ROBUSTNESS.md "Running the chaos
+battery" for the CI lane wiring.
 
 Custom plans: ``--plan storm "serving.prefill:error@2;serving.kv.alloc:exhaust@5"``
 (repeatable) replaces the built-in serving battery.
@@ -138,6 +157,29 @@ PREFIX_PLANS = [
     ("prefix_storm", "serving.kv.share:stale_hash@2;"
                      "serving.kv.cow:exhaust@5x2;"
                      "serving.kv.alloc:exhaust@7"),
+]
+
+# the spill-tier battery (docs/ROBUSTNESS.md "Degradation ladder"): a
+# deliberately undersized device pool under a seed -> flood -> rematch
+# workload, so every plan runs with real demotions and promotions in
+# flight. Parity reference is a fault-free *cache-off* engine: a corrupt
+# promotion that slipped through would show up as a wrong token.
+SPILL_PLANS = [
+    ("baseline_spill", ""),
+    ("spill_error", "serving.kv.spill:error@2x2"),
+    ("spill_corrupt", "serving.kv.spill:corrupt@1x2"),
+    ("promote_error", "serving.kv.promote:error@1"),
+    ("promote_corrupt", "serving.kv.promote:corrupt@1"),
+    ("promote_delay", "serving.kv.promote:delay=0.002x3"),
+    ("alloc_exhaust", "serving.kv.alloc:exhaust@6x2"),
+    # the >=5-fault memory-pressure storm the acceptance gate names:
+    # spill error + spill corruption + promote error + two injected
+    # allocator exhaustions, all while demotions/promotions are in flight
+    # (the promote fault sits at @1 — a dropped chain head means later
+    # walks never reach the site again, so deeper indices can misfire)
+    ("spill_storm", "serving.kv.spill:error@2;serving.kv.spill:corrupt@4;"
+                    "serving.kv.promote:error@1;"
+                    "serving.kv.alloc:exhaust@8x2"),
 ]
 
 
@@ -210,7 +252,7 @@ def _run_plan(model, prompts, sp, max_len, args, plan_text, reference=None,
 
 # -- the prefix-cache battery ----------------------------------------------
 
-def run_prefix_suite(args):
+def run_prefix_suite(args, scenario=None):
     """Shared-prefix fleet through the PREFIX_PLANS battery. The parity
     reference is a fault-free *prefix-cache-off* engine, so every surviving
     plan also proves cache-on == cache-off token streams under faults."""
@@ -219,8 +261,13 @@ def run_prefix_suite(args):
     base_row, reference = _run_plan(model, prompts, sp, max_len, args, "",
                                     prefix_cache=False)
     base_wall = base_row["wall_sec"]
+    plans = [(n, s) for n, s in PREFIX_PLANS
+             if scenario is None or n == scenario]
+    if not plans:
+        raise SystemExit(f"unknown prefix scenario {scenario!r}; one of: "
+                         f"{[n for n, _ in PREFIX_PLANS]}")
     rows = []
-    for name, spec in PREFIX_PLANS:
+    for name, spec in plans:
         row, _ = _run_plan(model, prompts, sp, max_len, args, spec,
                            reference=reference, prefix_cache=True)
         row["name"] = name
@@ -247,6 +294,158 @@ def run_prefix_suite(args):
         "plans_survived": survived,
         "all_survived": survived == len(rows),
         "baseline_wall_sec": base_wall,
+        "flight_recorder_dump": dump_path,
+        "results": rows,
+    }
+
+
+# -- the spill-tier battery ------------------------------------------------
+
+def _spill_waves(args):
+    """Seed -> flood -> rematch: the memory-pressure workload. The seed
+    wave populates the prefix cache, the flood wave (unique prompts) blows
+    every cached block out of the undersized device pool (demoting them to
+    the host tier), and the rematch wave can only be warm if the spill
+    tier promotes the seeded prefix back."""
+    rng = np.random.RandomState(0)
+    n_shared = int(args.prompt_len * args.prefix_share)
+    shared = list(rng.randint(0, args.vocab, n_shared))
+    tail = args.prompt_len - n_shared
+
+    def shared_prompt():
+        return shared + list(rng.randint(0, args.vocab, tail))
+
+    seed_wave = [shared_prompt() for _ in range(2)]
+    flood = [list(rng.randint(0, args.vocab, args.prompt_len))
+             for _ in range(args.slots + 1)]
+    rematch = [shared_prompt() for _ in range(max(args.requests - 2, 2))]
+    return [seed_wave, flood, rematch]
+
+
+def _run_spill_plan(model, waves, sp, max_len, args, plan_text,
+                    reference=None):
+    """One plan against the undersized-pool engine with the spill tier and
+    watermark backpressure armed. Survival = no crash, survivor parity vs
+    the fault-free cache-off reference, all terminal handles carrying
+    errors, zero leaked device blocks, and the device partition exact
+    (free + live + cached == usable) at drain."""
+    blocks_per_seq = -(-max_len // args.block_size)
+    eng = LLMEngine(
+        model, block_size=args.block_size, max_slots=args.slots,
+        max_model_len=max_len,
+        num_blocks=args.slots * blocks_per_seq + 2,   # barely fits slots
+        prefix_cache=True, kv_spill_blocks=4 * blocks_per_seq,
+        kv_high_watermark=0.9, kv_low_watermark=0.6,
+        watchdog_timeout_s=0.002)
+    plan = FaultPlan.parse(plan_text) if plan_text else FaultPlan()
+    t0 = time.perf_counter()
+    crashed = None
+    reqs = []
+    with plan:
+        try:
+            for wave in waves:
+                reqs += [eng.add_request(p, sp) for p in wave]
+                eng.run()
+        except Exception as e:  # a crash = the degradation ladder failed
+            crashed = f"{type(e).__name__}: {e}"
+    wall = time.perf_counter() - t0
+
+    finished = [r for r in reqs if r.state is RequestState.FINISHED]
+    failed = [r for r in reqs if r.state is RequestState.FAILED]
+    cancelled = [r for r in reqs if r.state is RequestState.CANCELLED]
+    parity_ok = (reference is None or all(
+        r.output_tokens == reference[r.rid] for r in finished))
+    errors_attached = all(r.error is not None for r in failed + cancelled)
+    st = eng.stats() if crashed is None else {}
+    alloc = eng.cache.allocator
+    partition_ok = (crashed is None and alloc.num_free + alloc.num_used
+                    + alloc.num_cached == alloc.num_usable)
+    pc = (st.get("prefix_cache") or {})
+    spill = pc.get("spill") or {}
+    survived = (crashed is None and parity_ok and errors_attached
+                and partition_ok and st.get("blocks_used") == 0
+                and len(finished) + len(failed) + len(cancelled)
+                == len(reqs))
+    return {
+        "plan": plan_text or "(none)",
+        "survived": bool(survived),
+        "crashed": crashed,
+        "faults_fired": plan.summary(),
+        "num_faults_fired": len(plan.fired),
+        "finished": len(finished),
+        "failed": len(failed),
+        "cancelled": len(cancelled),
+        "survivor_parity_ok": bool(parity_ok),
+        "errors_attached": bool(errors_attached),
+        "blocks_leaked": int(st.get("blocks_used", -1)),
+        "partition_ok": bool(partition_ok),
+        "hit_rate": pc.get("hit_rate"),
+        "spill": spill,
+        "pressure_events": eng.scheduler.num_pressure_events,
+        "num_preemptions": st.get("num_preemptions"),
+        "wall_sec": round(wall, 4),
+    }, [r.output_tokens for r in reqs] if reqs else None
+
+
+def run_spill_suite(args, scenario=None):
+    """Memory-pressure battery over the tiered KV pool: every plan must
+    survive with token-for-token parity vs a fault-free cache-off engine.
+    The fault-free baseline must actually spill AND promote (a dead tier
+    would vacuously pass), and every corrupt plan must show the CRC check
+    dropping entries while parity holds — a corrupt promotion re-prefills,
+    it never emits a wrong token."""
+    model, _, sp, max_len = _build(args)
+    waves = _spill_waves(args)
+
+    # fault-free cache-off reference with an ample pool: the parity target
+    ref_eng = LLMEngine(model, block_size=args.block_size,
+                        max_slots=args.slots, max_model_len=max_len,
+                        prefix_cache=False)
+    ref_reqs = []
+    for wave in waves:
+        ref_reqs += [ref_eng.add_request(p, sp) for p in wave]
+        ref_eng.run()
+    reference = [r.output_tokens for r in ref_reqs]
+
+    plans = [(n, s) for n, s in SPILL_PLANS
+             if scenario is None or n == scenario]
+    if not plans:
+        raise SystemExit(f"unknown spill scenario {scenario!r}; one of: "
+                         f"{[n for n, _ in SPILL_PLANS]}")
+    rows = []
+    for name, spec in plans:
+        row, _ = _run_spill_plan(model, waves, sp, max_len, args, spec,
+                                 reference=reference)
+        row["name"] = row["scenario"] = name
+        sp_blk = row.get("spill") or {}
+        if name == "baseline_spill":
+            # the fault-free plan must exercise the tier end to end:
+            # demotions, promotions, and at least one watermark latch
+            row["survived"] = bool(
+                row["survived"] and sp_blk.get("spills", 0) > 0
+                and sp_blk.get("promotes", 0) > 0
+                and row["pressure_events"] > 0)
+        if name in ("spill_corrupt", "promote_corrupt"):
+            # the CRC check must have caught the corruption (parity is
+            # already asserted: no wrong token reached a client)
+            row["survived"] = bool(
+                row["survived"]
+                and sp_blk.get("promote_corrupt_drops", 0) > 0)
+        if name == "spill_storm":
+            row["survived"] = bool(row["survived"]
+                                   and row["num_faults_fired"] >= 5)
+        rows.append(row)
+    survived = sum(1 for r in rows if r["survived"])
+    dump_path = telemetry.dump(reason="spill chaos suite complete")
+    return {
+        "suite": "spill",
+        "config": {"requests": args.requests, "prompt_len": args.prompt_len,
+                   "max_new_tokens": args.max_new, "slots": args.slots,
+                   "block_size": args.block_size,
+                   "prefix_share": args.prefix_share},
+        "plans_run": len(rows),
+        "plans_survived": survived,
+        "all_survived": survived == len(rows),
         "flight_recorder_dump": dump_path,
         "results": rows,
     }
@@ -943,15 +1142,17 @@ def _scenario_drain_restart(args, workdir, spec, max_len):
         router.close()
 
 
-def run_serve_fleet_suite(args, workdir=None):
+def run_serve_fleet_suite(args, workdir=None, scenario=None):
     import tempfile
 
     workdir = workdir or tempfile.mkdtemp(prefix="chaos-serve-fleet-")
     max_len = args.prompt_len + args.max_new
     spec = _fleet_spec(args, workdir, max_len)
     rows = []
-    for scenario in (_scenario_sigkill, _scenario_fault_storms,
-                     _scenario_shed, _scenario_drain_restart):
+    fns = _filter_scenarios(
+        (_scenario_sigkill, _scenario_fault_storms,
+         _scenario_shed, _scenario_drain_restart), "_scenario_", scenario)
+    for scenario in fns:
         try:
             rows.append(scenario(args, workdir, spec, max_len))
         except Exception as e:
@@ -1363,15 +1564,18 @@ def _scenario_retry_budget_storm(args, workdir, spec, max_len):
         router.close()
 
 
-def run_durable_suite(args, workdir=None):
+def run_durable_suite(args, workdir=None, scenario=None):
     import tempfile
 
     workdir = workdir or tempfile.mkdtemp(prefix="chaos-durable-")
     max_len = args.prompt_len + args.max_new
     spec = _fleet_spec(args, workdir, max_len)
     rows = []
-    for scenario in (_scenario_gateway_sigkill, _scenario_torn_journal_tail,
-                     _scenario_breaker_trip, _scenario_retry_budget_storm):
+    fns = _filter_scenarios(
+        (_scenario_gateway_sigkill, _scenario_torn_journal_tail,
+         _scenario_breaker_trip, _scenario_retry_budget_storm),
+        "_scenario_", scenario)
+    for scenario in fns:
         try:
             rows.append(scenario(args, workdir, spec, max_len))
         except Exception as e:
@@ -1533,14 +1737,20 @@ def _hang_scenario(store, workdir, world=4, steps=8, hung_rank=1,
     }
 
 
-def run_straggler_suite(workdir=None):
+def run_straggler_suite(workdir=None, scenario=None):
     import tempfile
 
     from paddle_tpu.distributed.tcp_store import TCPStore
 
     workdir = workdir or tempfile.mkdtemp(prefix="chaos-straggler-")
+    by_name = {"straggler": _straggler_scenario, "hang": _hang_scenario}
+    if scenario is not None and scenario not in by_name:
+        raise SystemExit(f"unknown straggler scenario {scenario!r}; one "
+                         f"of: {sorted(by_name)}")
+    fns = ([by_name[scenario]] if scenario is not None
+           else [_straggler_scenario, _hang_scenario])
     rows = []
-    for scenario in (_straggler_scenario, _hang_scenario):
+    for scenario in fns:
         store = TCPStore(is_master=True)
         try:
             rows.append(scenario(store, workdir))
@@ -1557,15 +1767,19 @@ def run_straggler_suite(workdir=None):
     }
 
 
-def run_train_suite(workdir=None):
+def run_train_suite(workdir=None, scenario=None):
     import tempfile
 
     workdir = workdir or tempfile.mkdtemp(prefix="chaos-train-")
-    rows = [
-        _train_kill_worker(workdir),
-        _train_nan_injection(workdir),
-        _train_torn_checkpoint(workdir),
-    ]
+    by_name = {"kill_worker": _train_kill_worker,
+               "nan_injection": _train_nan_injection,
+               "torn_checkpoint": _train_torn_checkpoint}
+    if scenario is not None and scenario not in by_name:
+        raise SystemExit(f"unknown train scenario {scenario!r}; one of: "
+                         f"{sorted(by_name)}")
+    fns = ([by_name[scenario]] if scenario is not None
+           else list(by_name.values()))
+    rows = [fn(workdir) for fn in fns]
     survived = sum(1 for r in rows if r["survived"])
     dump_path = telemetry.dump(reason="train chaos suite complete")
     return {
@@ -1579,12 +1793,53 @@ def run_train_suite(workdir=None):
     }
 
 
+# scenario catalog per suite, for ``--list`` and ``--scenario`` selection
+# ("perf" runs as one interdependent battery and cannot be sliced)
+SUITE_SCENARIOS = {
+    "serving": lambda: [n for n, _ in DEFAULT_PLANS],
+    "prefix": lambda: [n for n, _ in PREFIX_PLANS],
+    "spill": lambda: [n for n, _ in SPILL_PLANS],
+    "perf": lambda: ["(runs as one battery; --scenario unsupported)"],
+    "serve-fleet": lambda: ["sigkill", "fault_storms", "shed",
+                            "drain_restart"],
+    "durable": lambda: ["gateway_sigkill", "torn_journal_tail",
+                        "breaker_trip", "retry_budget_storm"],
+    "train": lambda: ["kill_worker", "nan_injection", "torn_checkpoint"],
+    "straggler": lambda: ["straggler", "hang"],
+}
+
+
+def _print_scenarios():
+    for suite, names in SUITE_SCENARIOS.items():
+        print(suite)
+        for n in names():
+            print(f"  {n}")
+
+
+def _filter_scenarios(fns, prefix, scenario):
+    """Select scenario functions by their ``<prefix><name>`` suffix; the
+    whole list with ``scenario=None``."""
+    if scenario is None:
+        return list(fns)
+    keep = [f for f in fns if f.__name__ == prefix + scenario]
+    if not keep:
+        names = [f.__name__[len(prefix):] for f in fns]
+        raise SystemExit(f"unknown scenario {scenario!r}; one of: {names}")
+    return keep
+
+
 def run_sweep(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--suite",
-                    choices=["serving", "prefix", "train", "straggler",
-                             "perf", "serve-fleet", "durable"],
+                    choices=["serving", "prefix", "spill", "train",
+                             "straggler", "perf", "serve-fleet", "durable"],
                     default="serving")
+    ap.add_argument("--list", action="store_true",
+                    help="print every suite's scenario names and exit")
+    ap.add_argument("--scenario", default=None, metavar="NAME",
+                    help="run a single scenario of the suite (see --list) "
+                         "— re-run one failing scenario without the whole "
+                         "battery")
     ap.add_argument("--prefix-share", type=float, default=0.75,
                     help="--suite prefix: fraction of every prompt that is "
                          "the common template")
@@ -1602,16 +1857,28 @@ def run_sweep(argv=None):
     ap.add_argument("--json", default=None)
     args = ap.parse_args(argv)
 
-    if args.suite in ("train", "straggler", "prefix", "perf",
+    if args.list:
+        _print_scenarios()
+        raise SystemExit(0)
+    if args.scenario is not None and args.suite == "perf":
+        raise SystemExit("--suite perf runs as one interdependent battery "
+                         "and cannot be sliced with --scenario")
+
+    if args.suite in ("train", "straggler", "prefix", "spill", "perf",
                       "serve-fleet", "durable"):
-        report = (run_train_suite() if args.suite == "train"
-                  else run_straggler_suite() if args.suite == "straggler"
+        report = (run_train_suite(scenario=args.scenario)
+                  if args.suite == "train"
+                  else run_straggler_suite(scenario=args.scenario)
+                  if args.suite == "straggler"
                   else run_perf_suite(args) if args.suite == "perf"
-                  else run_serve_fleet_suite(args)
+                  else run_serve_fleet_suite(args,
+                                             scenario=args.scenario)
                   if args.suite == "serve-fleet"
-                  else run_durable_suite(args)
+                  else run_durable_suite(args, scenario=args.scenario)
                   if args.suite == "durable"
-                  else run_prefix_suite(args))
+                  else run_spill_suite(args, scenario=args.scenario)
+                  if args.suite == "spill"
+                  else run_prefix_suite(args, scenario=args.scenario))
         if args.json:
             with open(args.json, "w") as f:
                 json.dump(report, f, indent=2)
@@ -1619,6 +1886,12 @@ def run_sweep(argv=None):
 
     model, prompts, sp, max_len = _build(args)
     plans = args.plan if args.plan else DEFAULT_PLANS
+    if args.scenario is not None:
+        plans = [(n, s) for n, s in plans if n == args.scenario]
+        if not plans:
+            raise SystemExit(
+                f"unknown scenario {args.scenario!r}; one of: "
+                f"{[n for n, _ in (args.plan or DEFAULT_PLANS)]}")
 
     # fault-free reference first (also warms the traces)
     base_row, reference = _run_plan(model, prompts, sp, max_len, args, "")
@@ -1665,7 +1938,7 @@ def main(argv=None):
     for r in report["results"]:
         status = "OK " if r["survived"] else "DIED"
         if report.get("suite") in ("train", "straggler", "perf",
-                                   "serve-fleet", "durable"):
+                                   "serve-fleet", "durable", "spill"):
             detail = " ".join(f"{k}={v}" for k, v in r.items()
                               if k not in ("scenario", "survived"))
             print(f"[{status}] {r['scenario']:<26} {detail}",

@@ -59,6 +59,13 @@ DIRECTIONS = {
     "fleet_tok_per_sec": "higher",
     "fleet_ttft_mean_s": "lower",
     "fleet_ttft_p95_s": "lower",
+    # tiered KV spill (ISSUE 14): warm TTFT after the shared prefix was
+    # evicted from a small device pool — with the spill tier it promotes
+    # back (fast), without it the fleet re-prefills cold; the speedup is
+    # spill-on vs spill-off and must not erode
+    "prefix_spill_ttft_warm_s": "lower",
+    "prefix_spill_ttft_speedup": "higher",
+    "prefix_spill_tok_per_sec": "higher",
     # write-ahead-journal cost on the fleet bench (ISSUE 12): no-journal
     # tok/s divided by journaled tok/s — 1.0 means the journal is free,
     # and growth past tolerance means durability started taxing the
@@ -95,6 +102,15 @@ def extract_metrics(doc: dict) -> tuple[str, dict]:
         return "serving_fleet", metrics
     if doc.get("mode") == "prefix" or isinstance(doc.get("prefix"), dict):
         p = doc.get("prefix") or {}
+        if isinstance(p.get("spill"), dict):
+            # the memory-pressure variant (--kv-spill-blocks) is its own
+            # bench kind: its TTFTs measure eviction-recovery, not the
+            # plain cache-warm path, and must not cross-gate
+            s = p["spill"]
+            put("prefix_spill_ttft_warm_s", s.get("ttft_warm_spill_s"))
+            put("prefix_spill_ttft_speedup", s.get("ttft_speedup_vs_off"))
+            put("prefix_spill_tok_per_sec", s.get("tok_per_sec_spill"))
+            return "serving_prefix_spill", metrics
         put("prefix_ttft_warm_s", p.get("ttft_warm_on_s"))
         put("prefix_ttft_speedup", p.get("ttft_speedup"))
         put("prefix_tok_per_sec", p.get("tok_per_sec_on"))

@@ -16,8 +16,14 @@ Usage:
         [--max-new 32] [--slots 4] [--block-size 16] [--json OUT.json]
         [--metrics-out METRICS.json] [--telemetry on|off]
         [--slo-ttft-ms 200 --slo-tpot-ms 50]
-        [--prefix-share 0.9]
+        [--prefix-share 0.9] [--kv-spill-blocks 64] [--num-blocks N]
         [--fleet 2]
+
+``--prefix-share`` + ``--kv-spill-blocks`` benches the host-RAM spill
+tier under memory pressure: a small device pool, a flood that evicts the
+shared prefix, then warm TTFT with eviction-demotes-and-promotes vs
+eviction-destroys (bench kind ``serving_prefix_spill`` in perf_gate;
+docs/ROBUSTNESS.md "Degradation ladder").
 
 ``--fleet N`` benches the production front door instead of a bare engine:
 N LocalReplica engines behind the FleetRouter + HTTP gateway, driven by
@@ -85,6 +91,138 @@ from paddle_tpu.serving import (  # noqa: E402
 def _mean(xs):
     xs = [x for x in xs if x is not None]
     return float(np.mean(xs)) if xs else None
+
+
+def run_spill_prefix_bench(args, slo_kw):
+    """``--prefix-share`` + ``--kv-spill-blocks``: the memory-pressure
+    variant. Both sides run the prefix cache on a deliberately small
+    device pool (``--num-blocks``); a flood of unique prompts evicts the
+    shared prefix between priming and the timed fleet. With the spill
+    tier armed eviction demotes to host RAM and the timed fleet's prefix
+    hits promote back (warm TTFT retained); without it eviction destroys
+    and the timed fleet pays cold full prefill. The JSON's
+    ``prefix.spill`` block records both TTFTs and the speedup, gated by
+    ``tools/perf_gate.py`` as bench kind ``serving_prefix_spill``.
+    Outputs must match token-for-token across the two sides."""
+    paddle_tpu.seed(0)
+    plen = args.prompt_len if args.prompt_len is not None else 256
+    slots = args.slots if args.slots is not None else args.requests
+    max_len = plen + args.max_new
+    bps = -(-max_len // args.block_size)
+    n_shared = int(plen * args.prefix_share)
+    # matched shared blocks (same len-1 cap as the cache) and the
+    # per-request remainder size the timed fleet concurrently needs
+    shared_full = min(n_shared // args.block_size,
+                      (plen - 1) // args.block_size)
+    per_req = bps - shared_full
+    # the pool holds the timed fleet's working set (shared prefix mapped
+    # once + every request's private remainder) with a little slack, but
+    # NOT the flood's cached leftovers on top — eviction is the point
+    num_blocks = (args.num_blocks if args.num_blocks is not None
+                  else shared_full + args.requests * (per_req + 1) + 2)
+    usable = num_blocks - 1
+    cfg = llama_tiny(vocab=args.vocab, hidden=args.hidden,
+                     layers=args.layers, heads=4, kv_heads=2,
+                     inter=2 * args.hidden, seq=2 * max_len)
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.RandomState(0)
+    shared = list(rng.randint(0, args.vocab, n_shared))
+
+    def shared_prompt():
+        return shared + list(rng.randint(0, args.vocab, plen - n_shared))
+
+    prompts = [shared_prompt() for _ in range(args.requests)]
+    primers = [shared_prompt() for _ in range(2)]
+    # sized so each flood's own working set exceeds the usable pool:
+    # every cached block (the shared prefix included) must get evicted.
+    # Two distinct floods — a repeated flood would just re-promote its
+    # own spilled prefixes instead of purely evicting
+    n_flood = -(-usable // bps) + 1
+    floods = [[list(rng.randint(0, args.vocab, plen))
+               for _ in range(n_flood)] for _ in range(2)]
+    sp = SamplingParams(max_new_tokens=args.max_new, temperature=0.0)
+
+    sides = {}
+    for spill_on in (True, False):
+        eng = LLMEngine(model, block_size=args.block_size,
+                        max_slots=slots, max_model_len=max_len,
+                        num_blocks=num_blocks, prefix_cache=True,
+                        kv_spill_blocks=(args.kv_spill_blocks
+                                         if spill_on else None), **slo_kw)
+        # primers seed the cache and compile the full-prefill,
+        # tail-prefill, and decode traces; flood #1 evicts the shared
+        # prefix from the small device pool (demote vs destroy); the
+        # warm rematch then exercises the post-eviction hit path once
+        # (promote scatter / cold re-prefill) so the timed fleet below
+        # is steady-state, everything-compiled traffic; flood #2 evicts
+        # the prefix again right before timing
+        eng.generate([primers[0]], sp)
+        eng.generate([primers[1]], sp)
+        eng.generate(floods[0], sp)
+        eng.generate([shared_prompt()], sp)      # warm rematch
+        eng.generate(floods[1], sp)
+        t0 = time.perf_counter()
+        reqs = [eng.add_request(p, sp) for p in prompts]
+        eng.run()
+        dt = time.perf_counter() - t0
+        st = eng.stats()
+        sides[spill_on] = {
+            "engine_sec": dt,
+            "tok_per_sec": sum(len(r.output_tokens) for r in reqs) / dt,
+            "ttft_warm_s": _mean([r.ttft for r in reqs]),
+            "outputs": [r.output_tokens for r in reqs],
+            "stats": st,
+        }
+    on, off = sides[True], sides[False]
+    match = on["outputs"] == off["outputs"]
+    pc = on["stats"]["prefix_cache"]
+    spill = pc["spill"]
+    result = {
+        "mode": "prefix",
+        "requests": args.requests,
+        "prompt_len": plen,
+        "max_new_tokens": args.max_new,
+        "telemetry": args.telemetry,
+        "prefix": {
+            "prefix_share": args.prefix_share,
+            "shared_tokens": n_shared,
+            "hit_rate": pc["hit_rate"],
+            "blocks_saved": pc["blocks_saved"],
+            "tokens_saved": pc["tokens_saved"],
+            "evictions": pc["evictions"],
+            "spill": {
+                "device_blocks": num_blocks,
+                "spill_blocks": args.kv_spill_blocks,
+                "spills": spill["spills"],
+                "promotes": spill["promotes"],
+                "promote_errors": spill["promote_errors"],
+                "promote_corrupt_drops": spill["promote_corrupt_drops"],
+                "ttft_warm_spill_s": on["ttft_warm_s"],
+                "ttft_warm_nospill_s": off["ttft_warm_s"],
+                "ttft_speedup_vs_off": (
+                    off["ttft_warm_s"] / on["ttft_warm_s"]
+                    if on["ttft_warm_s"] else None),
+                "tok_per_sec_spill": on["tok_per_sec"],
+                "tok_per_sec_nospill": off["tok_per_sec"],
+            },
+        },
+        "outputs_match_spill_off": match,
+        "slo": on["stats"]["slo"],
+        "__meta__": _perf.run_meta(),
+    }
+    print(json.dumps(result, indent=2))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(result, f, indent=2)
+    if args.metrics_out:
+        telemetry.registry().snapshot_json(args.metrics_out)
+        print(f"# metrics snapshot -> {args.metrics_out}", file=sys.stderr)
+    if not match:
+        raise SystemExit("spill-on outputs diverged from spill-off")
+    if not spill["promotes"]:
+        raise SystemExit("spill bench never promoted — the device pool "
+                         "is not small enough to force demotion; shrink "
+                         "--num-blocks")
 
 
 def run_prefix_bench(args, slo_kw):
@@ -386,6 +524,17 @@ def main():
                          "prompt is one common prefix; benches the prefix "
                          "cache on vs off (hit rate, blocks saved, warm "
                          "TTFT)")
+    ap.add_argument("--num-blocks", type=int, default=None,
+                    help="device KV pool size override (small pools force "
+                         "eviction; pairs with --kv-spill-blocks)")
+    ap.add_argument("--kv-spill-blocks", type=int, default=None,
+                    metavar="N",
+                    help="with --prefix-share: arm the host-RAM spill "
+                         "tier (N entries) and bench spill-on vs "
+                         "spill-off warm TTFT on a small device pool — "
+                         "eviction demotes + prefix hits promote vs "
+                         "eviction destroys + cold re-prefill "
+                         "(docs/ROBUSTNESS.md \"Degradation ladder\")")
     ap.add_argument("--fleet", type=int, default=None, metavar="N",
                     help="drive the HTTP gateway over N engine replicas "
                          "(streaming clients; reports client-side TTFT, "
@@ -412,7 +561,10 @@ def main():
         run_fleet_bench(args, slo_kw)
         return
     if args.prefix_share is not None:
-        run_prefix_bench(args, slo_kw)
+        if args.kv_spill_blocks is not None:
+            run_spill_prefix_bench(args, slo_kw)
+        else:
+            run_prefix_bench(args, slo_kw)
         return
     if args.prompt_len is None:
         args.prompt_len = 32
