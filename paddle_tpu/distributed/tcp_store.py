@@ -30,7 +30,7 @@ from .. import telemetry
 from ..core import native
 from ..utils import faults
 
-__all__ = ["TCPStore", "StoreTimeout"]
+__all__ = ["TCPStore", "StoreTimeout", "StoreCorruptValue"]
 
 
 def _store_metrics():
@@ -63,6 +63,16 @@ def _full_jitter(cap: float) -> float:
 class StoreTimeout(TimeoutError):
     """A store operation exhausted its retries; the message names the
     endpoint, operation, attempts, and elapsed time."""
+
+
+class StoreCorruptValue(ValueError):
+    """``get_json`` found a value that is not valid JSON (a half-written
+    document, a raw-bytes key read as JSON, cross-writer corruption). The
+    message names the key, the endpoint, and a prefix of the offending
+    bytes. Callers for whom the value is *advisory* (e.g. the KV-fabric
+    directory) catch this and treat the key as absent; callers for whom
+    it is load-bearing let it propagate — it is never silently None,
+    which would be indistinguishable from a missing key."""
 
 
 class TCPStore:
@@ -202,9 +212,23 @@ class TCPStore:
         self.set(key, json.dumps(obj, default=str).encode())
 
     def get_json(self, key: str):
-        """``get`` with JSON decoding; None when the key is absent."""
+        """``get`` with JSON decoding; None when the key is absent.
+        A present-but-undecodable value raises :class:`StoreCorruptValue`
+        naming the key and endpoint (distinct from absence — a missing
+        key is a result, a garbage value is a fault)."""
         raw = self.get(key)
-        return None if raw is None else json.loads(raw)
+        if raw is None:
+            return None
+        try:
+            return json.loads(raw)
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            telemetry.record_event("store.corrupt_value", key=key,
+                                   endpoint=f"{self.host}:{self.port}",
+                                   nbytes=len(raw))
+            raise StoreCorruptValue(
+                f"TCPStore key {key!r} at {self.host}:{self.port} holds "
+                f"{len(raw)} bytes that are not valid JSON "
+                f"({raw[:64]!r}...): {e}") from e
 
     def add(self, key: str, amount: int = 1) -> int:
         k = key.encode()

@@ -27,7 +27,15 @@ Above the single engine sits the fleet plane (docs/SERVING.md
 - :mod:`.gateway` — the asyncio HTTP front door: OpenAI-compatible
   ``/v1/completions`` + ``/v1/chat/completions`` with SSE token
   streaming, deadline budgets, and 429/503 backpressure.
+- :mod:`.kv_fabric` — the cluster KV fabric (docs/SERVING.md "KV
+  fabric"): a fleet-wide prefix directory (epoch/lease-fenced documents
+  over the TCPStore telemetry keyspace) so placement lands where a
+  prompt's prefix actually lives, plus CRC-verified cross-replica
+  KV-block migration (``kv_fetch``/``kv_ingest`` pipe verbs) so hot
+  prefixes replicate instead of re-prefilling — strictly advisory,
+  every failure mode degrades to local prefill.
 """
+from . import kv_fabric  # noqa: F401
 from .engine import LLMEngine, naive_generate  # noqa: F401
 from .gateway import Gateway  # noqa: F401
 from .journal import Journal, JournalError, JournalTornWrite  # noqa: F401
@@ -66,4 +74,5 @@ __all__ = [
     "FleetRouter", "LocalReplica", "ProcReplica", "ReplicaState",
     "RouterRequest", "RouterShed", "NoHealthyReplica", "Gateway",
     "CircuitBreaker", "Journal", "JournalError", "JournalTornWrite",
+    "kv_fabric",
 ]

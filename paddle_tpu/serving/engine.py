@@ -29,9 +29,9 @@ detector fails the queue head rather than spinning when no progress is
 possible. Chaos sites (``serving.prefill``, ``serving.decode.slot``,
 ``serving.decode``, ``serving.kv.alloc``, ``serving.kv.share``,
 ``serving.kv.cow``, ``serving.kv.spill``, ``serving.kv.promote``,
-``serving.admit``, ``serving.compile`` — the last fires once per new
-prefill/decode trace creation) let ``paddle_tpu.utils.faults`` drive all
-of these paths deterministically.
+``serving.kv.fetch``, ``serving.admit``, ``serving.compile`` — the last
+fires once per new prefill/decode trace creation) let
+``paddle_tpu.utils.faults`` drive all of these paths deterministically.
 
 Memory pressure (docs/ROBUSTNESS.md "Degradation ladder"):
 ``kv_spill_blocks=N`` arms a bounded host-RAM spill tier under the
@@ -435,6 +435,45 @@ class LLMEngine:
                     raise req.error
                 return
             self.step()
+
+    # ------------------------------------------------------------------
+    # KV fabric (cross-replica block migration — serving/kv_fabric.py)
+    # ------------------------------------------------------------------
+    def export_kv_frames(self, hashes, *, max_frames: int | None = None,
+                         max_bytes: int | None = None) -> list[dict]:
+        """Donor half of a KV-block migration: serialize the longest
+        consecutive run of ``hashes`` (prefix chain-hashes) this engine's
+        cache holds, as CRC32-stamped wire frames. Chaos site
+        ``serving.kv.fetch``: ``error`` raises (the fetch fails at the
+        router), ``delay`` sleeps (the router's fetch timeout fires),
+        ``stale`` answers empty (the directory entry aged out from under
+        the caller), ``corrupt`` bit-rots one frame after its stamp (the
+        receiver's CRC check must refuse it). Every kind degrades the
+        admitting side to local prefill — never wrong K/V."""
+        from . import kv_fabric
+
+        act = faults.inject("serving.kv.fetch", hashes=len(list(hashes)),
+                            engine=self.engine_label)
+        if act == "stale":
+            telemetry.record_event("kv.fabric.export", stale=True,
+                                   engine=self.engine_label)
+            return []
+        frames = kv_fabric.export_frames(self.cache, hashes,
+                                         max_frames=max_frames,
+                                         max_bytes=max_bytes)
+        if act == "corrupt" and frames:
+            kv_fabric.corrupt_frame(frames[-1])
+        return frames
+
+    def ingest_kv_frames(self, frames) -> dict:
+        """Receiver half: CRC-verify and promote migrated frames into the
+        local prefix cache through the spill-tier promotion machinery
+        (``PagedKVCache._promote`` re-verifies every stamp). Returns the
+        ``{"ingested", "corrupt", "errors"}`` counts; whatever did not
+        land verified simply prefills locally on admission."""
+        from . import kv_fabric
+
+        return kv_fabric.ingest_frames(self.cache, frames)
 
     def stats(self) -> dict:
         """Serving counters, read back from this engine's registry series

@@ -346,6 +346,14 @@ class PagedKVCache:
         self.promotes = 0
         self.promote_errors = 0
         self.promote_corrupt_drops = 0
+        # cross-replica KV fabric (serving/kv_fabric.py): donor-side
+        # exports and receiver-side ingests of serialized block frames
+        self.fabric_exports = 0
+        self.fabric_export_frames = 0
+        self.fabric_ingests = 0
+        self.fabric_ingested_blocks = 0
+        self.fabric_ingest_corrupt = 0
+        self.fabric_ingest_errors = 0
 
     def blocks_for(self, num_tokens: int) -> int:
         return -(-int(num_tokens) // self.block_size)
@@ -783,6 +791,14 @@ class PagedKVCache:
                 "promotes": self.promotes,
                 "promote_errors": self.promote_errors,
                 "promote_corrupt_drops": self.promote_corrupt_drops,
+            },
+            "fabric": {
+                "exports": self.fabric_exports,
+                "export_frames": self.fabric_export_frames,
+                "ingests": self.fabric_ingests,
+                "ingested_blocks": self.fabric_ingested_blocks,
+                "ingest_corrupt": self.fabric_ingest_corrupt,
+                "ingest_errors": self.fabric_ingest_errors,
             },
         }
 

@@ -18,6 +18,9 @@ Protocol (one JSON object per line):
                "sampling": {...}, "deadline_s": 1.5 | null,
                "trace_id": "req-ab12cd" | null}
               {"op": "cancel", "gid": 7}
+              {"op": "kv_fetch", "fid": 3, "hashes": [...],
+               "max_frames": 64, "max_bytes": 33554432}
+              {"op": "kv_ingest", "frames": [...]}
               {"op": "close"}
     stdout -> {"ev": "hello", "pid": 1234}
               {"ev": "token", "gid": 7, "tok": 42, "i": 0}
@@ -27,7 +30,20 @@ Protocol (one JSON object per line):
                "spans": [... optional: request-scoped spans since the
                          last heartbeat, unix-stamped wire format —
                          telemetry.reqtrace ...]}
+              {"ev": "kv_blocks", "fid": 3, "frames": [...],
+               "error": null}
+              {"ev": "kv_ingested", "ingested": 4, "corrupt": 0,
+               "errors": 0}
               {"ev": "bye"}
+
+``kv_fetch`` / ``kv_ingest`` are the KV-fabric migration verbs
+(serving/kv_fabric.py): the router pulls CRC32-stamped block frames from
+this replica (the donor half) or lands frames fetched from a sibling
+(the receiver half, which re-verifies every stamp before promotion).
+With ``"fabric": {"store": "host:port", ...}`` in the spec, the worker
+additionally publishes its prefix-cache inventory to the fleet-wide
+directory on every heartbeat (lease-fenced: a SIGKILL simply lets the
+lease expire).
 
 ``trace_id`` is the router/gateway-minted request-trace context: the
 engine stamps it on every span the request produces, and the heartbeat
@@ -86,12 +102,34 @@ def main() -> int:
         except Exception:
             pass
     from ..telemetry import reqtrace
+    from . import kv_fabric
     from .engine import LLMEngine
     from .router import replica_stats, sampling_from_dict
 
     model = build_model(spec)
     engine = LLMEngine(model, **(spec.get("engine") or {}))
     stats_interval = float(spec.get("stats_interval_s", 0.1))
+    publisher = None
+    fab = spec.get("fabric")
+    if fab:
+        # fleet-wide prefix directory: own store connection (the wire
+        # protocol is one-request-per-conn), publish piggybacks on the
+        # heartbeat cadence. A dead store disables the fabric, never the
+        # replica — the directory is advisory.
+        try:
+            rid = str(fab.get("rid") or os.environ.get(
+                "PADDLE_REPLICA_RID") or f"pid{os.getpid()}")
+            cfg = kv_fabric.FabricConfig(**{
+                k: fab[k] for k in ("lease_s", "refresh_s", "max_hashes")
+                if k in fab})
+            publisher = kv_fabric.DirectoryPublisher(
+                kv_fabric.connect_store(fab["store"]), rid, engine.cache,
+                cfg=cfg,
+                counters_fn=lambda: engine.cache.prefix_stats()["fabric"])
+        except Exception as e:
+            print(f"replica_worker: kv fabric disabled: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            publisher = None
     warmup = spec.get("warmup")
     if warmup:
         # compile the prefill bucket + decode traces before reporting
@@ -157,6 +195,11 @@ def main() -> int:
         if spans:
             ev["spans"] = spans
         emit(ev)
+        if publisher is not None:
+            try:
+                publisher.maybe_publish()
+            except Exception:
+                pass                       # advisory: never kill the beat
 
     last_pub = 0.0
     closing = False
@@ -187,6 +230,25 @@ def main() -> int:
                 req = tracked.get(cmd["gid"])
                 if req is not None:
                     engine.cancel(req.rid)
+            elif op == "kv_fetch":
+                fid = cmd.get("fid")
+                try:
+                    frames = engine.export_kv_frames(
+                        cmd.get("hashes") or [],
+                        max_frames=cmd.get("max_frames"),
+                        max_bytes=cmd.get("max_bytes"))
+                    emit({"ev": "kv_blocks", "fid": fid, "frames": frames,
+                          "error": None})
+                except Exception as e:
+                    emit({"ev": "kv_blocks", "fid": fid, "frames": [],
+                          "error": f"{type(e).__name__}: {e}"})
+            elif op == "kv_ingest":
+                try:
+                    rep = engine.ingest_kv_frames(cmd.get("frames") or [])
+                except Exception as e:
+                    rep = {"ingested": 0, "corrupt": 0, "errors": 1,
+                           "error": f"{type(e).__name__}: {e}"}
+                emit({"ev": "kv_ingested", **rep})
         if closing:
             break
         if engine.scheduler.has_work():
@@ -200,6 +262,8 @@ def main() -> int:
     engine.close()
     sweep()
     heartbeat()
+    if publisher is not None:
+        publisher.close()                  # graceful: lease-zero tombstone
     emit({"ev": "bye"})
     return 0
 

@@ -21,11 +21,23 @@ placement/health plane (docs/SERVING.md "Fleet serving"):
   delivered tokens (verifying each equals what was streamed — a mismatch is
   a parity violation and fails the request rather than corrupting the
   stream) and the client stream continues token-for-token correct.
-- **Placement.** Prefix affinity first: the hash of the prompt's
-  block-aligned prefix names a preferred replica, so shared-prefix traffic
-  keeps hitting the same engine's prefix cache. If the preferred replica is
-  unhealthy, shedding, or clearly overloaded, fall back to
-  power-of-two-choices on in-flight load.
+- **Placement.** The fleet KV directory first (when the fabric is armed,
+  ``kv_fabric=``): place the request where its prefix chain *actually*
+  lives — the deepest advertised chain among eligible replicas, with the
+  same load slack as affinity. Then prefix affinity: the hash of the
+  prompt's block-aligned prefix names a preferred replica, so
+  shared-prefix traffic keeps hitting the same engine's prefix cache. If
+  the preferred replica is unhealthy, shedding, or clearly overloaded,
+  fall back to power-of-two-choices on in-flight load.
+- **KV migration (serving/kv_fabric.py).** When placement cannot land on
+  the prefix's host (overload, health), the router *pulls* the blocks to
+  wherever the request is going: a ``kv_fetch`` verb to the donor returns
+  CRC32-stamped serialized frames, a ``kv_ingest`` verb lands them on the
+  target for CRC-verified promotion before the ``add`` dispatches — a hot
+  prefix replicates instead of re-prefilling. Strictly advisory and
+  budgeted (``max_fetches_per_window``): a stale directory entry, a dead
+  donor mid-fetch, a corrupt frame, or a timeout all degrade to local
+  prefill, never to wrong tokens.
 - **Load shedding.** Layered on the signals the engines already export: a
   replica "sheds" when its rolling-window SLO tracker says so
   (``stats()["slo"]["shed"]``) or its router-side in-flight count hits
@@ -306,6 +318,8 @@ def replica_stats(engine) -> dict:
                       if r.finish_reason == "stalled"),
         "watchdog_trips": engine.watchdog_trips,
         "blocks_used": engine.cache.allocator.num_used,
+        "blocks_cached": engine.cache.allocator.num_cached,
+        "blocks_usable": engine.cache.allocator.num_usable,
         "generated_tokens": engine._total_generated,
         "slo": engine.slo.summary(),
         "prefix_cache": engine.cache.prefix_stats(),
@@ -336,7 +350,7 @@ class LocalReplica:
     kind = "local"
 
     def __init__(self, rid: str, engine_factory, *,
-                 stats_interval_s: float = 0.05, warmup=None):
+                 stats_interval_s: float = 0.05, warmup=None, fabric=None):
         self.rid = str(rid)
         self.engine_factory = engine_factory
         self.stats_interval_s = float(stats_interval_s)
@@ -344,6 +358,10 @@ class LocalReplica:
         # decode traces compile while the replica is still STARTING (the
         # router's liveness timeout only starts once it reports ready)
         self.warmup = list(warmup) if warmup else None
+        # KV-fabric directory publishing (serving/kv_fabric.py): a dict
+        # like {"store": <store obj | "host:port">, "lease_s": ...} arms
+        # a DirectoryPublisher on the driver's heartbeat cadence
+        self.fabric = dict(fabric) if fabric else None
         self.state = ReplicaState.STOPPED
         self.engine = None
         self.stats: dict = {}
@@ -408,6 +426,26 @@ class LocalReplica:
             self._emit(gen, {"ev": "dead",
                              "error": f"{type(e).__name__}: {e}"})
             return
+        publisher = None
+        if self.fabric:
+            # fleet-wide prefix directory (advisory: a dead store
+            # disables the fabric, never the replica)
+            from . import kv_fabric
+
+            try:
+                cfg = kv_fabric.FabricConfig(**{
+                    k: self.fabric[k]
+                    for k in ("lease_s", "refresh_s", "max_hashes")
+                    if k in self.fabric})
+                publisher = kv_fabric.DirectoryPublisher(
+                    kv_fabric.connect_store(self.fabric["store"]),
+                    self.rid, engine.cache, cfg=cfg,
+                    counters_fn=lambda: engine.cache.prefix_stats()[
+                        "fabric"])
+            except Exception as e:
+                telemetry.record_event("kv.fabric.publish", rid=self.rid,
+                                       ok=False, disabled=True,
+                                       error=f"{type(e).__name__}: {e}")
         self._emit(gen, {"ev": "hello", "pid": self.pid})
         tracked: dict[int, object] = {}    # gid -> engine Request
         last_pub = 0.0
@@ -426,6 +464,11 @@ class LocalReplica:
             if spans:
                 ev["spans"] = spans
             self._emit(gen, ev)
+            if publisher is not None and not self._killed:
+                try:
+                    publisher.maybe_publish()
+                except Exception:
+                    pass                  # advisory: never kill the beat
 
         def on_token(gid):
             def cb(req, tok):
@@ -464,6 +507,27 @@ class LocalReplica:
                     req = tracked.get(cmd["gid"])
                     if req is not None:
                         engine.cancel(req.rid)
+                elif op == "kv_fetch":
+                    fid = cmd.get("fid")
+                    try:
+                        frames = engine.export_kv_frames(
+                            cmd.get("hashes") or [],
+                            max_frames=cmd.get("max_frames"),
+                            max_bytes=cmd.get("max_bytes"))
+                        self._emit(gen, {"ev": "kv_blocks", "fid": fid,
+                                         "frames": frames, "error": None})
+                    except Exception as e:
+                        self._emit(gen, {
+                            "ev": "kv_blocks", "fid": fid, "frames": [],
+                            "error": f"{type(e).__name__}: {e}"})
+                elif op == "kv_ingest":
+                    try:
+                        rep = engine.ingest_kv_frames(
+                            cmd.get("frames") or [])
+                    except Exception as e:
+                        rep = {"ingested": 0, "corrupt": 0, "errors": 1,
+                               "error": f"{type(e).__name__}: {e}"}
+                    self._emit(gen, {"ev": "kv_ingested", **rep})
             # 2) one engine iteration
             if closing:
                 break
@@ -485,6 +549,8 @@ class LocalReplica:
         engine.close()                     # graceful: terminal-ize leftovers
         self._sweep(gen, tracked)
         heartbeat()
+        if publisher is not None:
+            publisher.close()              # graceful: lease-zero tombstone
         self._emit(gen, {"ev": "bye"})
 
     def _sweep(self, gen: int, tracked: dict):
@@ -535,6 +601,7 @@ class ProcReplica:
             p for p in (repo_root, os.environ.get("PYTHONPATH")) if p)
         env = dict(os.environ,
                    PADDLE_REPLICA_SPEC=json.dumps(self.spec),
+                   PADDLE_REPLICA_RID=self.rid,
                    PYTHONPATH=pythonpath)
         env.setdefault("JAX_PLATFORMS", "cpu")
         env.update(self.extra_env)
@@ -662,6 +729,32 @@ def _router_metrics() -> SimpleNamespace:
         budget_denied=reg.counter(
             "router_retry_budget_denied_total",
             "re-dispatches refused by the global retry budget"),
+        dir_hits=reg.counter(
+            "router_directory_hits_total",
+            "submissions whose prefix the fleet directory located"),
+        dir_misses=reg.counter(
+            "router_directory_misses_total",
+            "submissions the directory had nothing for"),
+        dir_placements=reg.counter(
+            "router_directory_placements_total",
+            "placements that landed on a directory-named replica"),
+        dir_stale=reg.counter(
+            "router_directory_stale_total",
+            "directory hits that turned out stale (donor dead, fetch "
+            "empty/failed) — degraded to local prefill"),
+        migrations=reg.counter(
+            "router_directory_migrations_total",
+            "cross-replica KV-block migrations executed (fetch+ingest)"),
+        migration_failures=reg.counter(
+            "router_directory_migration_failures_total",
+            "migrations that failed on any step (request prefilled "
+            "locally instead)"),
+        migrated_blocks=reg.counter(
+            "router_directory_migrated_blocks_total",
+            "block frames moved between replicas"),
+        fetch_skipped=reg.counter(
+            "router_directory_fetch_skipped_total",
+            "migrations skipped by the fetch budget (storm cap)"),
     )
 
 
@@ -703,6 +796,15 @@ class FleetRouter:
                     global re-dispatch cap — re-dispatches (failovers +
                     retries) in the window may not exceed
                     ``min + ratio * first_dispatches``.
+    kv_fabric:      arm the cluster KV fabric: a dict with ``store``
+                    (a store object such as ``kv_fabric.MemStore`` or a
+                    ``"host:port"`` TCPStore endpoint — the same store
+                    the replicas' DirectoryPublishers write) plus any
+                    :class:`~.kv_fabric.FabricConfig` field
+                    (``fetch_timeout_s``, ``min_match_blocks``,
+                    ``max_fetches_per_window``, ...) and ``migrate``
+                    (False = directory-aware placement only, no block
+                    movement). None = affinity/p2c placement only.
     """
 
     def __init__(self, replicas, *, probe_interval_s: float = 0.25,
@@ -721,7 +823,8 @@ class FleetRouter:
                  breaker_cooldown_s: float = 2.0,
                  retry_budget_ratio: float = 0.5,
                  retry_budget_min: int = 8,
-                 retry_budget_window_s: float = 30.0):
+                 retry_budget_window_s: float = 30.0,
+                 kv_fabric: dict | None = None):
         self.replicas: dict[str, object] = {r.rid: r for r in replicas}
         self._order = [r.rid for r in replicas]
         self.probe_interval_s = float(probe_interval_s)
@@ -757,6 +860,34 @@ class FleetRouter:
         self.retry_budget_min = int(retry_budget_min)
         self.retry_budget_window_s = float(retry_budget_window_s)
         self._dispatch_log: list[tuple[float, bool]] = []  # (t, redispatch)
+        # KV fabric (serving/kv_fabric.py): fleet-wide prefix directory +
+        # cross-replica block migration. ``kv_fabric`` is a dict like
+        # {"store": <store obj | "host:port">, "fetch_timeout_s": ...,
+        #  "migrate": True, ...} (FabricConfig field names). Strictly
+        # advisory: an unreachable store disables the fabric and the
+        # router places by affinity/p2c exactly as before.
+        self._fabric = None
+        self._fabric_migrate = True
+        if kv_fabric is not None:
+            from . import kv_fabric as kvf
+
+            try:
+                cfg = kvf.FabricConfig(**{
+                    k: v for k, v in kv_fabric.items()
+                    if k not in ("store", "migrate")})
+                self._fabric = SimpleNamespace(
+                    dir=kvf.KVDirectory(kvf.connect_store(
+                        kv_fabric["store"]), cfg=cfg),
+                    cfg=cfg)
+                self._fabric_migrate = bool(kv_fabric.get("migrate", True))
+            except Exception as e:
+                telemetry.record_event(
+                    "router.fabric_disabled",
+                    error=f"{type(e).__name__}: {e}")
+        self._fetch_lock = threading.Lock()
+        self._fetch_ids = itertools.count()
+        self._fetches: dict[int, dict] = {}     # fid -> pending fetch
+        self._fetch_log: list[float] = []       # migration budget window
         self._m = _router_metrics()
         # per-router counts for stats(): the registry families above are
         # process-global (shared by every router in the process), so the
@@ -765,7 +896,10 @@ class FleetRouter:
             "dispatches", "failovers", "retries", "shed", "affinity_hits",
             "p2c_placements", "replay_suppressed", "replay_mismatches",
             "drains", "replica_restarts", "replica_deaths",
-            "breaker_trips", "breaker_probes", "retry_budget_denied")}
+            "breaker_trips", "breaker_probes", "retry_budget_denied",
+            "directory_hits", "directory_misses", "directory_placements",
+            "directory_stale", "migrations", "migration_failures",
+            "migrated_blocks", "fetch_skipped")}
         self._by_trace: dict[str, RouterRequest] = {}
         self._probe_thread: threading.Thread | None = None
         self._stop = threading.Event()
@@ -856,13 +990,39 @@ class FleetRouter:
             rr.suppress = len(rr.tokens)
             rr._failover_t0 = time.monotonic()
         t0 = time.monotonic()
+        # fleet directory consult (store I/O — before the lock): who
+        # already holds this prompt's prefix chain?
+        hashes, donors = self._directory_lookup(rr.prompt)
         with self._lock:
             rep = self._place(rr.prompt, rr.priority,
-                              bypass_shed=bypass_shed)
+                              bypass_shed=bypass_shed,
+                              directory_hint=donors)
             self._prune_terminal()
             self._requests[rr.gid] = rr
             self._by_trace[rr.trace_id] = rr
-            self._dispatch(rr, rep)
+            plan = self._plan_migration(rep, donors, hashes)
+            if plan is None:
+                self._dispatch(rr, rep)
+        if plan is not None:
+            # pull-based KV-block migration OUTSIDE the lock (tokens and
+            # heartbeats keep flowing while the donor serializes); every
+            # failure mode just means the target prefills locally
+            self._migrate(rr, rep, *plan)
+            with self._lock:
+                if not rr.terminal:
+                    if rep.state is not ReplicaState.HEALTHY:
+                        # the chosen replica died during the fetch: this
+                        # request was already accepted — place it
+                        # anywhere healthy rather than shedding it
+                        try:
+                            rep = self._place(rr.prompt, rr.priority,
+                                              bypass_shed=True)
+                        except NoHealthyReplica as e:
+                            rr._finish("failed", "no_healthy_replica",
+                                       str(e))
+                            rep = None
+                    if rep is not None and not rr.terminal:
+                        self._dispatch(rr, rep)
         telemetry.tracer().emit(
             "router.submit", t0, time.monotonic(),
             attrs={"trace_id": rr.trace_id, "gid": rr.gid,
@@ -902,6 +1062,136 @@ class FleetRouter:
                 self._untrack(rr)
                 rr._finish("cancelled", "cancelled", None)
         return True
+
+    # -- KV fabric: directory + migration ----------------------------------
+    def _directory_lookup(self, prompt):
+        """``(chain_hashes, {rid: depth})`` from the fleet directory for
+        this prompt's shareable prefix — strictly advisory (any store
+        trouble returns an empty hint), consulted before the lock so
+        store latency never stalls token delivery."""
+        if self._fabric is None:
+            return None, {}
+        from . import kv_fabric as kvf
+
+        hashes = kvf.chain_hashes(prompt, self.affinity_block_size)
+        if not hashes:
+            return hashes, {}
+        try:
+            donors = self._fabric.dir.lookup(hashes, rids=self._order)
+        except Exception as e:
+            telemetry.record_event("router.directory_error",
+                                   error=f"{type(e).__name__}: {e}")
+            return hashes, {}
+        donors = {r: d for r, d in donors.items()
+                  if d >= self._fabric.cfg.min_match_blocks}
+        with self._lock:
+            if donors:
+                self._m.dir_hits.inc()
+                self._c["directory_hits"] += 1
+            else:
+                self._m.dir_misses.inc()
+                self._c["directory_misses"] += 1
+        return hashes, donors
+
+    def _fetch_budget_ok(self, now: float | None = None) -> bool:
+        """Is there migration budget left in the window (under the
+        lock)? Past it, requests skip migration and prefill locally — a
+        hot-prefix storm must not turn into a fetch storm."""
+        cfg = self._fabric.cfg
+        now = time.monotonic() if now is None else now
+        cutoff = now - cfg.fetch_window_s
+        self._fetch_log = [t for t in self._fetch_log if t >= cutoff]
+        return len(self._fetch_log) < cfg.max_fetches_per_window
+
+    def _plan_migration(self, rep, donors, hashes):
+        """Should blocks move to ``rep`` before this dispatch (under the
+        lock)? Returns ``(donor_replica, chain_hashes)`` when a healthy
+        sibling holds meaningfully more of the prefix than the placement
+        target and the fetch budget allows — else None (plain dispatch,
+        local prefill)."""
+        if self._fabric is None or not self._fabric_migrate \
+                or not donors or not hashes:
+            return None
+        have = donors.get(rep.rid, 0)
+        best_rid, best_depth = None, have
+        for rid, depth in donors.items():
+            if rid == rep.rid:
+                continue
+            d = self.replicas.get(rid)
+            if d is None or d.state is not ReplicaState.HEALTHY \
+                    or not d.alive:
+                continue
+            if depth > best_depth:
+                best_rid, best_depth = rid, depth
+        if best_rid is None or \
+                best_depth - have < self._fabric.cfg.min_match_blocks:
+            return None
+        if not self._fetch_budget_ok():
+            self._m.fetch_skipped.inc()
+            self._c["fetch_skipped"] += 1
+            telemetry.record_event("router.fetch_skipped",
+                                   donor=best_rid, target=rep.rid)
+            return None
+        self._fetch_log.append(time.monotonic())   # reserve budget now
+        return (self.replicas[best_rid], hashes[:best_depth])
+
+    def _migrate(self, rr: RouterRequest, target, donor, hashes) -> bool:
+        """One pull-based migration (NOT under the router lock): fetch
+        serialized block frames from the donor through the pipe protocol,
+        land them on the target for CRC-verified promotion. Timeout, dead
+        donor, empty answer, or a failed ingest send all degrade to local
+        prefill on the target — counted, never raised."""
+        cfg = self._fabric.cfg
+        t0 = time.monotonic()
+        fid = next(self._fetch_ids)
+        pend = {"ev": threading.Event(), "frames": None, "error": None,
+                "rid": donor.rid}
+        with self._fetch_lock:
+            self._fetches[fid] = pend
+        frames = None
+        try:
+            donor.send({"op": "kv_fetch", "fid": fid,
+                        "hashes": list(hashes),
+                        "max_frames": cfg.max_fetch_frames,
+                        "max_bytes": cfg.max_fetch_bytes})
+            if pend["ev"].wait(cfg.fetch_timeout_s) and not pend["error"]:
+                frames = pend["frames"]
+        except BrokenPipeError as e:
+            pend["error"] = str(e)
+        finally:
+            with self._fetch_lock:
+                self._fetches.pop(fid, None)
+        ok = False
+        if frames:
+            try:
+                target.send({"op": "kv_ingest", "frames": frames})
+                ok = True
+            except BrokenPipeError as e:
+                pend["error"] = str(e)
+        with self._lock:
+            if ok:
+                self._m.migrations.inc()
+                self._c["migrations"] += 1
+                self._m.migrated_blocks.inc(len(frames))
+                self._c["migrated_blocks"] += len(frames)
+            else:
+                self._m.migration_failures.inc()
+                self._c["migration_failures"] += 1
+                if not frames:
+                    # the directory promised, the donor declined (dead,
+                    # evicted since publishing, faulted): a stale entry
+                    self._m.dir_stale.inc()
+                    self._c["directory_stale"] += 1
+        telemetry.record_event(
+            "router.migration", gid=rr.gid, donor=donor.rid,
+            target=target.rid, ok=ok,
+            frames=len(frames) if frames else 0, error=pend["error"])
+        telemetry.tracer().emit(
+            "router.kv_migration", t0, time.monotonic(),
+            attrs={"trace_id": rr.trace_id, "gid": rr.gid,
+                   "donor": donor.rid, "target": target.rid, "ok": ok,
+                   "frames": len(frames) if frames else 0})
+        return ok
 
     # -- placement ---------------------------------------------------------
     def _load(self, rid: str) -> int:
@@ -994,11 +1284,12 @@ class FleetRouter:
         return int.from_bytes(h.digest()[:8], "big")
 
     def _place(self, prompt, priority: int, exclude=(),
-               bypass_shed: bool = False):
+               bypass_shed: bool = False, directory_hint=None):
         """Pick a replica (under the lock); a HALF_OPEN pick is marked as
         that breaker's probe — its outcome decides the breaker's fate."""
         rep = self._pick(prompt, priority, exclude=exclude,
-                         bypass_shed=bypass_shed)
+                         bypass_shed=bypass_shed,
+                         directory_hint=directory_hint)
         br = self.breakers.get(rep.rid)
         if br is not None and br.state == "half_open":
             br.note_probe()
@@ -1008,7 +1299,7 @@ class FleetRouter:
         return rep
 
     def _pick(self, prompt, priority: int, exclude=(),
-              bypass_shed: bool = False):
+              bypass_shed: bool = False, directory_hint=None):
         """The placement decision. Called under the lock."""
         alive = [self.replicas[r] for r in self._order
                  if self.replicas[r].state is ReplicaState.HEALTHY
@@ -1042,6 +1333,21 @@ class FleetRouter:
                     f"{self.shed_bypass_priority}); retry after "
                     f"{retry_after:.1f}s",
                     retry_after_s=retry_after)
+        # fleet directory first (advisory): place where the prefix
+        # *actually* lives — deepest advertised chain wins, ties broken
+        # by load, and the same +2 load slack as affinity so a hot
+        # prefix overflows to siblings (who then migrate it) instead of
+        # dogpiling its first host
+        if directory_hint:
+            cand = [r for r in eligible if r.rid in directory_hint]
+            if cand:
+                min_load = min(self._load(r.rid) for r in eligible)
+                best = max(cand, key=lambda r: (directory_hint[r.rid],
+                                                -self._load(r.rid)))
+                if self._load(best.rid) <= min_load + 2:
+                    self._m.dir_placements.inc()
+                    self._c["directory_placements"] += 1
+                    return best
         # prefix affinity: a stable hash over the block-aligned prefix
         # names the preferred replica so shared prefixes keep hitting the
         # same engine's prefix cache
@@ -1133,6 +1439,21 @@ class FleetRouter:
             self._on_stats(rep, ev.get("stats") or {})
             if ev.get("spans"):
                 self._absorb_spans(rep, ev["spans"])
+        elif kind == "kv_blocks":
+            # a pending migration fetch's answer (only the fetch-table
+            # lock: a submit waiting on this may hold nothing, and token
+            # events must never queue behind frame payloads)
+            with self._fetch_lock:
+                pend = self._fetches.get(ev.get("fid"))
+            if pend is not None:
+                pend["frames"] = ev.get("frames") or []
+                pend["error"] = ev.get("error")
+                pend["ev"].set()
+        elif kind == "kv_ingested":
+            telemetry.record_event(
+                "router.kv_ingested", replica=rep.rid,
+                ingested=ev.get("ingested"), corrupt=ev.get("corrupt"),
+                errors=ev.get("errors"))
         elif kind == "hello":
             rep.pid = ev.get("pid", rep.pid)
             rep.last_heartbeat = time.monotonic()
@@ -1290,9 +1611,19 @@ class FleetRouter:
             self._m.deaths.inc()
             self._c["replica_deaths"] += 1
             self._sync_health_gauge()
-            orphans = [self._requests[g]
-                       for g in sorted(self._inflight.get(rep.rid, set()))
-                       if not self._requests[g].terminal]
+            # fail pending KV fetches against this replica so a
+            # migrating submit does not sit out its full timeout on a
+            # donor that just died mid-fetch
+            with self._fetch_lock:
+                for pend in self._fetches.values():
+                    if pend["rid"] == rep.rid and not pend["ev"].is_set():
+                        pend["error"] = (f"donor {rep.rid} unhealthy: "
+                                         f"{reason}")
+                        pend["ev"].set()
+            orphans = [rr for rr in
+                       (self._requests.get(g) for g in
+                        sorted(self._inflight.get(rep.rid, set())))
+                       if rr is not None and not rr.terminal]
             self._inflight[rep.rid] = set()
             telemetry.record_event("router.replica_unhealthy",
                                    replica=rep.rid, reason=reason,
@@ -1431,9 +1762,10 @@ class FleetRouter:
                     break
             time.sleep(0.01)
         with self._lock:
-            leftovers = [self._requests[g]
-                         for g in sorted(self._inflight.get(rid, set()))
-                         if not self._requests[g].terminal]
+            leftovers = [rr for rr in
+                         (self._requests.get(g) for g in
+                          sorted(self._inflight.get(rid, set())))
+                         if rr is not None and not rr.terminal]
             self._inflight[rid] = set()
             for rr in leftovers:
                 self._failover(rr, exclude={rid})
@@ -1558,6 +1890,11 @@ class FleetRouter:
                     "breaker": br.state if br is not None else None,
                     "breaker_trips": br.trips if br is not None else 0,
                     "slo": (rep.stats or {}).get("slo"),
+                    # per-replica prefix-cache block straight off the
+                    # heartbeat: the fleet-wide hit-rate / occupancy
+                    # view serving_bench --fleet and cluster_status
+                    # --kv aggregate
+                    "prefix_cache": (rep.stats or {}).get("prefix_cache"),
                     "stats": {k: v for k, v in (rep.stats or {}).items()
                               if k not in ("slo", "prefix_cache")},
                 }

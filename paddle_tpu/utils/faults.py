@@ -61,6 +61,12 @@ Grammar (``;``-separated specs)::
                       writes half a frame and raises JournalTornWrite —
                       simulated process death mid-append (recovery must
                       detect the torn record by CRC and skip it)
+           stale      inject() returns "stale"; the site behaves as if
+                      its advertised state aged out from under the
+                      caller (at ``serving.kv.fetch`` the donor answers
+                      a KV-block fetch with zero frames even though the
+                      fleet directory still lists the prefix — the
+                      admitting replica falls back to local prefill)
     @start 1-based call index at which the spec starts firing (default 1)
     xcount how many consecutive calls fire (default 1; ``x*`` = forever)
     %prob  instead of @/x determinism, fire each call with probability
@@ -87,6 +93,14 @@ Known sites (see docs/ROBUSTNESS.md for the full table):
                           => the CRC check reports a mismatch — entry
                           dropped, never wrong tokens; delay => a slow
                           host->device copy)
+    serving.kv.fetch      donor-side KV-block export for a cross-replica
+                          migration (error => the fetch fails; delay =>
+                          a slow donor — the router's fetch timeout
+                          fires; stale => zero frames despite a
+                          directory listing; corrupt => one exported
+                          frame bit-rots in transit after its CRC stamp
+                          — the admitting replica's CRC check drops it.
+                          Every kind degrades to local prefill)
     serving.admit         per admission attempt
     serving.compile       once per NEW prefill/decode trace creation
                           (error => compile fails; isolation boundary
@@ -146,7 +160,7 @@ class FaultError(RuntimeError):
 _SPEC_RE = re.compile(
     r"^(?P<site>[\w.\-]+):"
     r"(?P<kind>error|delay|exhaust|nan_grads|bad_batch|stale_hash"
-    r"|torn_write|corrupt)"
+    r"|torn_write|corrupt|stale)"
     r"(?:=(?P<arg>[^@x%;]+))?"
     r"(?:@(?P<start>\d+))?"
     r"(?:x(?P<count>\d+|\*))?"
@@ -181,7 +195,7 @@ class FaultSpec:
     # nan_grads => poisoned gradients, bad_batch => NaN batch,
     # stale_hash => prefix index resolved wrong content)
     TOKEN_KINDS = ("exhaust", "nan_grads", "bad_batch", "stale_hash",
-                   "torn_write", "corrupt")
+                   "torn_write", "corrupt", "stale")
 
     def __post_init__(self):
         if self.kind not in ("error", "delay") + self.TOKEN_KINDS:

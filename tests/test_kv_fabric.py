@@ -1,0 +1,820 @@
+"""Cluster-scale KV fabric (ISSUE 15): fleet-wide prefix directory +
+CRC-verified cross-replica KV-block migration that can only ever degrade
+to prefill.
+
+Five layers of coverage:
+
+- the wire format: versioned frames round-trip a block's K/V exactly,
+  and every malformation — bit rot after the CRC stamp, a wrong version,
+  garbage fields — is refused at decode, never promoted;
+- export/ingest between two real caches: the longest consecutive chain
+  ships, gaps/caps stop the walk, corrupt frames drop the tail but keep
+  the verified prefix, and a full receiver degrades without leaking;
+- the directory: publish/lookup, lease expiry (a SIGKILL'd publisher's
+  entries age out), epoch fencing (a zombie incarnation's documents are
+  ignored), unpublish-on-eviction, garbage documents (the
+  ``TCPStore.get_json`` / ``StoreCorruptValue`` contract), and the
+  roster;
+- the router: directory-aware placement, the pull-migration protocol on
+  fake replicas (fetch -> frames -> ingest -> add), dead-donor fast
+  failure, the fetch budget, and engine-level token parity — a request
+  served off migrated blocks equals the fabric-off stream exactly;
+- a seeded randomized storm over publish / evict / migrate /
+  replica-death interleavings asserting the directory-is-advisory
+  invariant after every operation: each block a fabric ever installs
+  holds exactly the content its content-address promises (a corrupted
+  transfer is dropped, a clean one is bit-exact), and the device
+  partition/refcount invariants never drift.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu.distributed.tcp_store import StoreCorruptValue
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+from paddle_tpu.serving import (
+    FleetRouter, LLMEngine, PagedKVCache, ReplicaState, SamplingParams,
+    kv_fabric as kvf)
+from paddle_tpu.utils import faults
+from paddle_tpu.utils.faults import FaultError, FaultPlan
+
+pytestmark = pytest.mark.kvfabric
+
+BS = 4
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults.deactivate()
+
+
+def _cache(num_blocks=13, block_size=BS, spill_blocks=8):
+    return PagedKVCache(num_layers=1, num_blocks=num_blocks, kv_heads=1,
+                        block_size=block_size, head_dim=4,
+                        prefix_cache=True, spill_blocks=spill_blocks)
+
+
+def _expected(h: str) -> float:
+    """The content every block is painted with, derived from its chain
+    hash — so a wrong-content promotion is detectable anywhere."""
+    return (int(h[:8], 16) % 997) / 7.0
+
+
+def _serve(cache, tokens, seq="s"):
+    """Simulate serving ``tokens``: allocate (prefix hits included),
+    paint every *newly materialized* full block with its hash-derived
+    content, commit, free. Returns the chain hashes."""
+    import jax.numpy as jnp
+
+    hs = kvf.chain_hashes(tokens, cache.block_size)
+    assert cache.allocate(seq, len(tokens), tokens=tokens)
+    matched = cache.seq_cached_tokens[seq] // cache.block_size
+    table = list(cache.tables[seq])
+    pool = np.array(cache.pool)
+    for i in range(matched, len(hs)):
+        pool[:, table[i]] = _expected(hs[i])
+    cache.pool = jnp.asarray(pool)
+    cache.commit_prefix(seq, tokens)
+    cache.free_seq(seq)
+    return hs
+
+
+def _toks(rng, n_blocks, vocab=61):
+    """A template of n_blocks full blocks + 1 (the +1 keeps the whole
+    block-aligned prefix shareable — match is capped at len-1)."""
+    return [int(t) for t in rng.randint(0, vocab, n_blocks * BS + 1)]
+
+
+def _check_partition(cache):
+    a = cache.allocator
+    free, cached = set(a._free), set(a._cached)
+    live = {b for b, rc in a._rc.items() if rc > 0}
+    assert not (free & set(a._rc))
+    assert not (live & cached)
+    assert live | cached | free == set(range(1, a.num_blocks))
+    assert len(cache._spill) <= max(cache.spill_blocks, 0)
+    counts = {}
+    for t in cache.tables.values():
+        for b in t:
+            counts[b] = counts.get(b, 0) + 1
+    assert counts == {b: rc for b, rc in a._rc.items() if rc > 0}
+
+
+def _check_content(cache):
+    """The advisory invariant: every indexed block and every spill entry
+    holds exactly the content its content-address promises."""
+    pool = np.array(cache.pool)
+    for b, h in cache._block_hash.items():
+        assert np.allclose(pool[:, b], _expected(h)), \
+            f"block {b} content does not match its hash"
+    for entry in cache._spill.values():
+        assert np.allclose(entry.kv, _expected(entry.hash))
+
+
+# ---------------------------------------------------------------------------
+# wire frames
+# ---------------------------------------------------------------------------
+
+class TestFrames:
+    def test_round_trip_bit_exact(self):
+        rng = np.random.RandomState(0)
+        c = _cache()
+        hs = _serve(c, _toks(rng, 3))
+        [frame] = kvf.export_frames(c, hs[:1])
+        entry = kvf.decode_frame(frame)
+        assert entry.hash == hs[0]
+        assert np.allclose(entry.kv, _expected(hs[0]))
+        import zlib
+
+        assert zlib.crc32(entry.kv.tobytes()) == entry.crc
+
+    def test_corrupt_payload_refused(self):
+        rng = np.random.RandomState(1)
+        c = _cache()
+        hs = _serve(c, _toks(rng, 2))
+        [frame] = kvf.export_frames(c, hs[:1])
+        kvf.corrupt_frame(frame)
+        with pytest.raises(kvf.FrameCorrupt):
+            kvf.decode_frame(frame)
+
+    def test_wrong_version_and_malformed_refused(self):
+        rng = np.random.RandomState(2)
+        c = _cache()
+        hs = _serve(c, _toks(rng, 2))
+        [frame] = kvf.export_frames(c, hs[:1])
+        v2 = dict(frame, v=2)
+        with pytest.raises(kvf.FrameError):
+            kvf.decode_frame(v2)
+        with pytest.raises(kvf.FrameError):
+            kvf.decode_frame("not a dict")
+        broken = dict(frame)
+        del broken["data"]
+        with pytest.raises(kvf.FrameError):
+            kvf.decode_frame(broken)
+        bad64 = dict(frame, data="!!!not base64!!!")
+        with pytest.raises(kvf.FrameError):
+            kvf.decode_frame(bad64)
+
+    def test_chain_hashes_match_the_cache_index(self):
+        rng = np.random.RandomState(3)
+        c = _cache()
+        toks = _toks(rng, 3)
+        hs = kvf.chain_hashes(toks, BS)
+        assert len(hs) == 3
+        _serve(c, toks)
+        assert set(hs) == set(c._block_hash.values())
+        # the cap: the last position never hashes (it always prefills)
+        assert len(kvf.chain_hashes(toks[:BS], BS)) == 0
+        assert len(kvf.chain_hashes(toks[:BS + 1], BS)) == 1
+
+
+# ---------------------------------------------------------------------------
+# export / ingest
+# ---------------------------------------------------------------------------
+
+class TestExportIngest:
+    def test_content_round_trip_through_ingest(self):
+        rng = np.random.RandomState(4)
+        donor, recv = _cache(), _cache()
+        toks = _toks(rng, 3)
+        hs = _serve(donor, toks)
+        frames = kvf.export_frames(donor, hs)
+        assert len(frames) == 3
+        rep = kvf.ingest_frames(recv, frames)
+        assert rep == {"ingested": 3, "corrupt": 0, "errors": 0}
+        matched, _ = recv.match_prefix(toks)
+        assert len(matched) == 3
+        _check_content(recv)
+        _check_partition(recv)
+        assert recv.fabric_ingested_blocks == 3
+
+    def test_export_stops_at_chain_gap_and_caps(self):
+        rng = np.random.RandomState(5)
+        donor = _cache()
+        hs = _serve(donor, _toks(rng, 3))
+        assert len(kvf.export_frames(donor, [hs[0], "bogus", hs[1]])) == 1
+        assert len(kvf.export_frames(donor, hs, max_frames=2)) == 2
+        assert len(kvf.export_frames(donor, hs, max_bytes=1)) == 1
+        assert kvf.export_frames(donor, ["bogus"]) == []
+
+    def test_export_serves_spill_tier_entries(self):
+        rng = np.random.RandomState(6)
+        donor, recv = _cache(num_blocks=8, spill_blocks=8), _cache()
+        toks = _toks(rng, 3)
+        hs = _serve(donor, toks)
+        # flood the tiny pool so the committed chain demotes to spill
+        assert donor.allocate("flood", 6 * BS)
+        donor.free_seq("flood")
+        assert donor.spills >= 1
+        frames = kvf.export_frames(donor, hs)
+        assert len(frames) == 3
+        rep = kvf.ingest_frames(recv, frames)
+        assert rep["ingested"] == 3
+        _check_content(recv)
+
+    def test_corrupt_frame_drops_tail_keeps_verified_prefix(self):
+        rng = np.random.RandomState(7)
+        donor, recv = _cache(), _cache()
+        toks = _toks(rng, 3)
+        hs = _serve(donor, toks)
+        frames = kvf.export_frames(donor, hs)
+        kvf.corrupt_frame(frames[-1])
+        rep = kvf.ingest_frames(recv, frames)
+        assert rep == {"ingested": 2, "corrupt": 1, "errors": 0}
+        matched, _ = recv.match_prefix(toks)
+        assert len(matched) == 2            # the verified prefix survives
+        _check_content(recv)
+        assert recv.fabric_ingest_corrupt == 1
+
+    def test_ingest_is_idempotent_for_present_content(self):
+        rng = np.random.RandomState(8)
+        donor, recv = _cache(), _cache()
+        hs = _serve(donor, _toks(rng, 2))
+        frames = kvf.export_frames(donor, hs)
+        kvf.ingest_frames(recv, frames)
+        before = dict(recv._index)
+        rep = kvf.ingest_frames(recv, frames)
+        assert rep["ingested"] == 2          # resolves to existing blocks
+        assert dict(recv._index) == before   # no duplicate registrations
+        _check_partition(recv)
+
+    def test_full_receiver_degrades_without_leaking(self):
+        rng = np.random.RandomState(9)
+        donor = _cache()
+        # receiver so small the chain cannot fit: 3 usable blocks, all
+        # referenced by a live sequence -> promotion finds the pool dry
+        recv = _cache(num_blocks=4, spill_blocks=4)
+        assert recv.allocate("pin", 3 * BS)
+        hs = _serve(donor, _toks(rng, 3))
+        frames = kvf.export_frames(donor, hs)
+        rep = kvf.ingest_frames(recv, frames)
+        assert rep["ingested"] == 0 and rep["errors"] >= 1
+        _check_partition(recv)
+        recv.free_seq("pin")
+        _check_partition(recv)
+
+    def test_promote_fault_counts_as_ingest_error(self):
+        rng = np.random.RandomState(10)
+        donor, recv = _cache(), _cache()
+        hs = _serve(donor, _toks(rng, 2))
+        frames = kvf.export_frames(donor, hs)
+        with FaultPlan.parse("serving.kv.promote:error@1"):
+            rep = kvf.ingest_frames(recv, frames)
+        assert rep["ingested"] == 0 and rep["errors"] == 1
+        _check_partition(recv)
+
+
+# ---------------------------------------------------------------------------
+# store get_json contract (ISSUE 15 satellite)
+# ---------------------------------------------------------------------------
+
+class TestStoreGetJson:
+    def test_memstore_absent_vs_garbage(self):
+        store = kvf.MemStore()
+        assert store.get_json("missing") is None
+        store.set("bad", b"\x01 not json \xff")
+        with pytest.raises(StoreCorruptValue) as ei:
+            store.get_json("bad")
+        assert "bad" in str(ei.value)
+        store.set_json("ok", {"a": 1})
+        assert store.get_json("ok") == {"a": 1}
+
+    def test_tcpstore_absent_vs_garbage(self):
+        from paddle_tpu.distributed.tcp_store import TCPStore
+
+        try:
+            master = TCPStore(is_master=True)
+        except RuntimeError:
+            pytest.skip("native TCPStore unavailable")
+        try:
+            assert master.get_json("missing") is None
+            master.set("bad", b"{half a doc")
+            with pytest.raises(StoreCorruptValue) as ei:
+                master.get_json("bad")
+            msg = str(ei.value)
+            assert "bad" in msg and "not valid JSON" in msg
+            master.set_json("ok", {"rid": "r0", "n": 3})
+            assert master.get_json("ok") == {"rid": "r0", "n": 3}
+        finally:
+            master.close()
+
+
+# ---------------------------------------------------------------------------
+# directory
+# ---------------------------------------------------------------------------
+
+def _publisher(store, rid, cache, **cfg_kw):
+    return kvf.DirectoryPublisher(store, rid, cache,
+                                  cfg=kvf.FabricConfig(**cfg_kw))
+
+
+def _reader(store, **cfg_kw):
+    cfg_kw.setdefault("cache_ttl_s", 0.0)
+    return kvf.KVDirectory(store, cfg=kvf.FabricConfig(**cfg_kw))
+
+
+class TestDirectory:
+    def test_publish_lookup_depth_and_roster(self):
+        rng = np.random.RandomState(11)
+        store = kvf.MemStore()
+        c0, c1 = _cache(), _cache()
+        t_long = _toks(rng, 3)
+        hs = _serve(c0, t_long)
+        _serve(c1, t_long[:BS + 1])          # only the first block
+        p0 = _publisher(store, "r0", c0)
+        p1 = _publisher(store, "r1", c1)
+        assert p0.maybe_publish() and p1.maybe_publish()
+        d = _reader(store)
+        assert sorted(d.roster()) == ["r0", "r1"]
+        assert d.lookup(hs) == {"r0": 3, "r1": 1}
+        assert d.lookup([]) == {}
+        assert d.lookup(["nope"]) == {}
+
+    def test_change_publishes_and_eviction_unpublishes(self):
+        rng = np.random.RandomState(12)
+        store = kvf.MemStore()
+        c = _cache(num_blocks=8, spill_blocks=0)   # eviction destroys
+        pub = _publisher(store, "r0", c, refresh_s=3600.0)
+        assert pub.maybe_publish()
+        hs = _serve(c, _toks(rng, 3))
+        assert pub.maybe_publish()           # inventory changed -> publish
+        d = _reader(store)
+        assert d.lookup(hs, rids=["r0"]) == {"r0": 3}
+        # flood: the chain is destroyed (no spill tier) -> next beat
+        # unpublishes despite the huge refresh interval
+        assert c.allocate("flood", 6 * BS)
+        c.free_seq("flood")
+        assert pub.maybe_publish()
+        assert _reader(store).lookup(hs, rids=["r0"]) == {}
+
+    def test_spill_hashes_stay_published_after_demotion(self):
+        rng = np.random.RandomState(13)
+        store = kvf.MemStore()
+        c = _cache(num_blocks=8, spill_blocks=8)
+        pub = _publisher(store, "r0", c)
+        hs = _serve(c, _toks(rng, 3))
+        assert c.allocate("flood", 6 * BS)
+        c.free_seq("flood")
+        assert c.spills >= 1
+        assert pub.maybe_publish()
+        assert _reader(store).lookup(hs, rids=["r0"]) == {"r0": 3}
+
+    def test_lease_expiry_fences_a_dead_publisher(self):
+        rng = np.random.RandomState(14)
+        store = kvf.MemStore()
+        c = _cache()
+        hs = _serve(c, _toks(rng, 2))
+        _publisher(store, "r0", c, lease_s=0.05).maybe_publish()
+        d = _reader(store)
+        assert d.lookup(hs, rids=["r0"]) == {"r0": 2}
+        time.sleep(0.08)
+        assert d.lookup(hs, rids=["r0"]) == {}
+        assert d.fenced_docs >= 1
+
+    def test_epoch_fencing_ignores_zombie_incarnations(self):
+        rng = np.random.RandomState(15)
+        store = kvf.MemStore()
+        c = _cache()
+        hs = _serve(c, _toks(rng, 2))
+        pub = _publisher(store, "r0", c)
+        pub.maybe_publish()
+        d = _reader(store)
+        assert d.lookup(hs, rids=["r0"]) == {"r0": 2}
+        # a zombie (lower-epoch) incarnation overwrites the document
+        # with a valid lease: the reader must ignore it
+        store.set_json(f"{kvf.DIR_PREFIX}/dir/r0", {
+            "v": 1, "rid": "r0", "epoch": pub.epoch - 100.0,
+            "published_unix": time.time(),
+            "lease_until": time.time() + 60.0,
+            "block_size": BS, "hashes": list(hs), "spill_hashes": [],
+            "truncated": False})
+        assert d.lookup(hs, rids=["r0"]) == {}
+        assert d.fenced_docs >= 1
+
+    def test_garbage_document_is_skipped_and_counted(self):
+        rng = np.random.RandomState(16)
+        store = kvf.MemStore()
+        c = _cache()
+        hs = _serve(c, _toks(rng, 2))
+        _publisher(store, "r0", c).maybe_publish()
+        store.set(f"{kvf.DIR_PREFIX}/dir/r1", b"\x00 garbage \xff")
+        store.set_json(f"{kvf.DIR_PREFIX}/dir/r2", {"not": "a doc"})
+        d = _reader(store)
+        assert d.lookup(hs, rids=["r0", "r1", "r2"]) == {"r0": 2}
+        assert d.corrupt_docs >= 2
+
+    def test_graceful_close_tombstones_the_entry(self):
+        rng = np.random.RandomState(17)
+        store = kvf.MemStore()
+        c = _cache()
+        hs = _serve(c, _toks(rng, 2))
+        pub = _publisher(store, "r0", c)
+        pub.maybe_publish()
+        pub.close()
+        assert _reader(store).lookup(hs, rids=["r0"]) == {}
+
+    def test_document_cache_ttl_bounds_store_reads(self):
+        rng = np.random.RandomState(18)
+        store = kvf.MemStore()
+        c = _cache()
+        hs = _serve(c, _toks(rng, 2))
+        _publisher(store, "r0", c).maybe_publish()
+        d = kvf.KVDirectory(store, cfg=kvf.FabricConfig(cache_ttl_s=60.0))
+        assert d.lookup(hs, rids=["r0"]) == {"r0": 2}
+        store.delete_key(f"{kvf.DIR_PREFIX}/dir/r0")
+        # within the TTL the cached verdict stands (advisory staleness)
+        assert d.lookup(hs, rids=["r0"]) == {"r0": 2}
+
+    def test_snapshot_reports_validity_and_counts(self):
+        rng = np.random.RandomState(19)
+        store = kvf.MemStore()
+        c = _cache()
+        _serve(c, _toks(rng, 2))
+        _publisher(store, "r0", c).maybe_publish()
+        store.set(f"{kvf.DIR_PREFIX}/dir/rX", b"junk{{")
+        snap = _reader(store).snapshot(rids=["r0", "rX"])
+        assert snap["r0"]["valid"] and snap["r0"]["device_hashes"] == 2
+        assert not snap["rX"]["valid"]
+
+    def test_document_truncation_caps_size(self):
+        rng = np.random.RandomState(20)
+        store = kvf.MemStore()
+        c = _cache(num_blocks=13)
+        hs = _serve(c, _toks(rng, 3))
+        pub = _publisher(store, "r0", c, max_hashes=2)
+        assert pub.maybe_publish()
+        doc = store.get_json(f"{kvf.DIR_PREFIX}/dir/r0")
+        assert doc["truncated"] and len(doc["hashes"]) == 2
+        # a truncated doc still answers for the prefix it kept
+        assert _reader(store).lookup(hs, rids=["r0"]) == {"r0": 2}
+
+
+# ---------------------------------------------------------------------------
+# router: fake replicas (protocol state machines, no engines)
+# ---------------------------------------------------------------------------
+
+class FakeReplica:
+    kind = "fake"
+
+    def __init__(self, rid):
+        self.rid = rid
+        self.state = ReplicaState.HEALTHY
+        self.stats = {"slo": {"shed": False}}
+        self.last_heartbeat = time.monotonic()
+        self.pid = 0
+        self.sent = []
+        self.alive = True
+        self._on_event = None
+
+    def start(self, on_event):
+        self._on_event = on_event
+        self.state = ReplicaState.HEALTHY
+
+    def send(self, cmd):
+        if not self.alive:
+            raise BrokenPipeError(self.rid)
+        self.sent.append(cmd)
+
+    def stop(self, graceful=True, timeout=0):
+        pass
+
+    def kill(self):
+        self.alive = False
+
+    def ops(self, op):
+        return [c for c in self.sent if c.get("op") == op]
+
+
+def _write_doc(store, rid, hashes, *, epoch=1.0, lease_s=30.0):
+    store.set_json(f"{kvf.DIR_PREFIX}/dir/{rid}", {
+        "v": 1, "rid": rid, "epoch": epoch,
+        "published_unix": time.time(),
+        "lease_until": time.time() + lease_s,
+        "block_size": BS, "hashes": list(hashes), "spill_hashes": [],
+        "truncated": False})
+
+
+def _fabric_router(store, n=2, **fab_kw):
+    fab = {"store": store, "fetch_timeout_s": 2.0, "cache_ttl_s": 0.0}
+    fab.update(fab_kw)
+    reps = [FakeReplica(f"f{i}") for i in range(n)]
+    router = FleetRouter(reps, affinity_block_size=BS, kv_fabric=fab)
+    for r in reps:
+        r.start(router._on_event)      # no probe thread: tests drive events
+    return router, reps
+
+
+class TestRouterFabric:
+    PROMPT = list(range(2 * BS + 1))   # 2 full shareable blocks
+
+    def test_directory_placement_lands_on_the_holder(self):
+        store = kvf.MemStore()
+        router, reps = _fabric_router(store)
+        hs = kvf.chain_hashes(self.PROMPT, BS)
+        _write_doc(store, "f1", hs)
+        for _ in range(4):
+            rr = router.submit(self.PROMPT, None)
+            assert rr.replica == "f1"
+            reps[1]._on_event(reps[1], {
+                "ev": "done", "gid": rr.gid, "state": "finished",
+                "reason": "length", "error": None, "n": 0})
+        st = router.stats()
+        assert st["directory_hits"] == 4
+        assert st["directory_placements"] == 4
+        assert st["migrations"] == 0       # the prefix is already there
+
+    def test_migration_fetch_ingest_then_add(self):
+        store = kvf.MemStore()
+        router, reps = _fabric_router(store)
+        hs = kvf.chain_hashes(self.PROMPT, BS)
+        _write_doc(store, "f0", hs)
+        # f0 overloaded: placement must take f1, which lacks the prefix
+        with router._lock:
+            for g in range(6):
+                router._inflight["f0"].add(9000 + g)
+        box = {}
+
+        def go():
+            box["rr"] = router.submit(self.PROMPT, None)
+
+        t = threading.Thread(target=go, daemon=True)
+        t.start()
+        deadline = time.time() + 5
+        while time.time() < deadline and not reps[0].ops("kv_fetch"):
+            time.sleep(0.002)
+        [fetch] = reps[0].ops("kv_fetch")
+        assert fetch["hashes"] == hs
+        frames = [{"v": 1, "fake": i} for i in range(2)]
+        reps[0]._on_event(reps[0], {"ev": "kv_blocks",
+                                    "fid": fetch["fid"],
+                                    "frames": frames, "error": None})
+        t.join(5)
+        rr = box["rr"]
+        assert rr.replica == "f1"
+        [ingest] = reps[1].ops("kv_ingest")
+        assert ingest["frames"] == frames
+        # the ingest lands BEFORE the add dispatch (admission must see
+        # the migrated blocks)
+        assert reps[1].sent.index(ingest) < reps[1].sent.index(
+            reps[1].ops("add")[0])
+        st = router.stats()
+        assert st["migrations"] == 1 and st["migrated_blocks"] == 2
+
+    def test_dead_donor_fails_the_fetch_fast(self):
+        store = kvf.MemStore()
+        router, reps = _fabric_router(store, fetch_timeout_s=30.0)
+        hs = kvf.chain_hashes(self.PROMPT, BS)
+        _write_doc(store, "f0", hs)
+        with router._lock:
+            for g in range(6):
+                router._inflight["f0"].add(9000 + g)
+        box = {}
+
+        def go():
+            t0 = time.monotonic()
+            box["rr"] = router.submit(self.PROMPT, None)
+            box["wall"] = time.monotonic() - t0
+
+        t = threading.Thread(target=go, daemon=True)
+        t.start()
+        deadline = time.time() + 5
+        while time.time() < deadline and not reps[0].ops("kv_fetch"):
+            time.sleep(0.002)
+        # the donor dies mid-fetch: pending fetch must fail immediately,
+        # nowhere near the 30s timeout
+        reps[0].kill()
+        reps[0]._on_event(reps[0], {"ev": "dead", "error": "sigkill"})
+        t.join(10)
+        assert box["rr"].replica == "f1"
+        assert box["wall"] < 5.0
+        assert not reps[1].ops("kv_ingest")     # nothing arrived
+        st = router.stats()
+        assert st["migration_failures"] == 1
+        assert st["directory_stale"] == 1
+
+    def test_fetch_budget_skips_migration(self):
+        store = kvf.MemStore()
+        router, reps = _fabric_router(store, max_fetches_per_window=0)
+        hs = kvf.chain_hashes(self.PROMPT, BS)
+        _write_doc(store, "f0", hs)
+        with router._lock:
+            for g in range(6):
+                router._inflight["f0"].add(9000 + g)
+        rr = router.submit(self.PROMPT, None)   # no fetch: dispatch direct
+        assert rr.replica == "f1"
+        assert not reps[0].ops("kv_fetch")
+        st = router.stats()
+        assert st["fetch_skipped"] == 1 and st["migrations"] == 0
+
+    def test_expired_or_shallow_hints_fall_back_to_affinity(self):
+        store = kvf.MemStore()
+        router, reps = _fabric_router(store, min_match_blocks=2)
+        hs = kvf.chain_hashes(self.PROMPT, BS)
+        _write_doc(store, "f1", hs, lease_s=-1.0)      # already expired
+        _write_doc(store, "f0", hs[:1])                # depth 1 < min 2
+        rr = router.submit(self.PROMPT, None)
+        st = router.stats()
+        assert st["directory_misses"] == 1
+        assert st["directory_placements"] == 0
+        assert rr.replica in ("f0", "f1")              # affinity/p2c
+
+    def test_fabric_disabled_on_bad_store(self):
+        router = FleetRouter([FakeReplica("f0")], affinity_block_size=BS,
+                             kv_fabric={"store": 123})
+        assert router._fabric is None
+        rep = router.replicas["f0"]
+        rep.start(router._on_event)
+        rr = router.submit(self.PROMPT, None)          # plain placement
+        assert rr.replica == "f0"
+
+
+# ---------------------------------------------------------------------------
+# engine-level parity: migrated blocks serve the exact fabric-off stream
+# ---------------------------------------------------------------------------
+
+def _tiny_model():
+    paddle_tpu.seed(0)
+    cfg = llama_tiny(vocab=61, hidden=32, layers=2, heads=4, kv_heads=2,
+                     inter=64, seq=128)
+    return LlamaForCausalLM(cfg)
+
+
+def _tiny_engine(**kw):
+    return LLMEngine(_tiny_model(), block_size=8, max_slots=2,
+                     max_model_len=56, **kw)
+
+
+class TestEngineParity:
+    def test_ingested_prefix_serves_token_identical(self):
+        rng = np.random.RandomState(0)
+        shared = [int(t) for t in rng.randint(0, 61, 24)]
+        prompts = [shared + [int(t) for t in rng.randint(0, 61, 4)]
+                   for _ in range(2)]
+        sp = SamplingParams(max_new_tokens=8, temperature=0.0)
+        ref = _tiny_engine()                  # fabric-off oracle
+        refs = ref.generate(prompts, sp)
+
+        donor = _tiny_engine()
+        assert donor.generate([prompts[0]], sp)[0] == refs[0]
+        hs = kvf.chain_hashes(prompts[1], 8)
+        frames = donor.export_kv_frames(hs)
+        assert frames                          # the shared blocks shipped
+
+        recv = _tiny_engine()
+        rep = recv.ingest_kv_frames(frames)
+        assert rep["ingested"] == len(frames) and rep["corrupt"] == 0
+        out = recv.generate([prompts[1]], sp)[0]
+        assert out == refs[1]                  # token-for-token
+        st = recv.cache.prefix_stats()
+        assert st["hits"] == 1                 # served off migrated blocks
+        assert st["fabric"]["ingested_blocks"] == len(frames)
+
+    def test_fetch_fault_kinds_degrade_cleanly(self):
+        rng = np.random.RandomState(1)
+        prompt = [int(t) for t in rng.randint(0, 61, 25)]
+        sp = SamplingParams(max_new_tokens=4, temperature=0.0)
+        donor = _tiny_engine()
+        donor.generate([prompt], sp)
+        hs = kvf.chain_hashes(prompt, 8)
+        with FaultPlan.parse("serving.kv.fetch:error@1"):
+            with pytest.raises(FaultError):
+                donor.export_kv_frames(hs)
+        with FaultPlan.parse("serving.kv.fetch:stale@1"):
+            assert donor.export_kv_frames(hs) == []
+        with FaultPlan.parse("serving.kv.fetch:corrupt@1"):
+            frames = donor.export_kv_frames(hs)
+        recv = _tiny_engine()
+        rep = recv.ingest_kv_frames(frames)
+        assert rep["corrupt"] == 1
+        assert rep["ingested"] == len(frames) - 1
+        # and the receiver still serves the exact stream (partial chain
+        # reused, corrupted tail re-prefilled)
+        ref = _tiny_engine().generate([prompt], sp)[0]
+        assert recv.generate([prompt], sp)[0] == ref
+
+
+# ---------------------------------------------------------------------------
+# the storm (ISSUE 15 satellite): publish/evict/migrate/death interleavings
+# ---------------------------------------------------------------------------
+
+class TestStorm:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_advisory_invariant_under_interleavings(self, seed):
+        """Randomized publish / serve / evict / migrate / kill-restart
+        storm over three cache+publisher 'replicas' and one directory.
+        After EVERY operation: the device partition is exact, and every
+        block the fabric ever installed holds exactly the content its
+        content-address promises — migrations either promote verified
+        bytes or fall back cleanly (corrupt transfers and faulted
+        promotions are dropped, dead donors export nothing)."""
+        rng = np.random.RandomState(seed)
+        store = kvf.MemStore()
+
+        class Rep:
+            def __init__(self, rid, epoch=None):
+                self.rid = rid
+                self.cache = _cache(num_blocks=11, spill_blocks=6)
+                self.pub = _publisher(store, rid, self.cache,
+                                      lease_s=120.0, refresh_s=0.0)
+                if epoch is not None:
+                    self.pub.epoch = epoch
+                self.alive = True
+
+        reps = {f"r{i}": Rep(f"r{i}", epoch=float(i)) for i in range(3)}
+        directory = _reader(store)
+        templates = [_toks(rng, int(rng.randint(1, 4))) for _ in range(5)]
+        outcomes = {"served": 0, "migrated": 0, "fallback": 0,
+                    "corrupt_dropped": 0, "killed": 0}
+
+        with FaultPlan.parse("serving.kv.promote:error%0.08;"
+                             "serving.kv.spill:error%0.05", seed=seed):
+            for step in range(160):
+                rep = reps[f"r{int(rng.randint(3))}"]
+                op = rng.choice(["serve", "serve", "evict", "publish",
+                                 "migrate", "migrate", "kill"],
+                                p=[.3, .2, .15, .1, .1, .1, .05])
+                if not rep.alive and op != "kill":
+                    continue
+                if op == "serve":
+                    toks = templates[int(rng.randint(len(templates)))]
+                    _serve(rep.cache, toks, seq=f"s{step}")
+                    outcomes["served"] += 1
+                elif op == "evict":
+                    n = int(rng.randint(1, 5))
+                    if rep.cache.allocate(f"fl{step}", n * BS):
+                        rep.cache.free_seq(f"fl{step}")
+                elif op == "publish":
+                    rep.pub.maybe_publish(force=True)
+                elif op == "migrate":
+                    toks = templates[int(rng.randint(len(templates)))]
+                    hs = kvf.chain_hashes(toks, BS)
+                    donors = directory.lookup(
+                        hs, rids=[r for r in reps])
+                    donors.pop(rep.rid, None)
+                    if not donors:
+                        outcomes["fallback"] += 1
+                        continue
+                    did = max(donors, key=donors.get)
+                    donor = reps[did]
+                    if not donor.alive:
+                        # the directory lied (stale entry of a corpse):
+                        # the router's fetch would fail -> fallback
+                        outcomes["fallback"] += 1
+                        continue
+                    frames = kvf.export_frames(donor.cache,
+                                               hs[:donors[did]])
+                    if frames and rng.rand() < 0.25:
+                        kvf.corrupt_frame(
+                            frames[int(rng.randint(len(frames)))])
+                    res = kvf.ingest_frames(rep.cache, frames)
+                    assert res["ingested"] + res["corrupt"] + \
+                        res["errors"] <= len(frames) or not frames
+                    outcomes["migrated"] += res["ingested"] > 0
+                    outcomes["corrupt_dropped"] += res["corrupt"]
+                    if res["ingested"] == 0:
+                        outcomes["fallback"] += 1
+                elif op == "kill":
+                    # SIGKILL + restart: fresh cache, HIGHER epoch (the
+                    # old document is a zombie until overwritten/fenced)
+                    old_epoch = rep.pub.epoch
+                    reps[rep.rid] = Rep(rep.rid, epoch=old_epoch + 1.0)
+                    outcomes["killed"] += 1
+                # the advisory invariant, after every single operation
+                for r in reps.values():
+                    _check_partition(r.cache)
+                    _check_content(r.cache)
+
+        assert outcomes["served"] > 20
+        assert outcomes["migrated"] >= 1       # the fabric really moved
+        assert outcomes["fallback"] >= 1       # and really degraded
+        assert outcomes["corrupt_dropped"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# chaos_run scenario catalog (--list / --scenario)
+# ---------------------------------------------------------------------------
+
+class TestChaosCatalog:
+    def test_kvfabric_battery_is_registered(self):
+        from tools import chaos_run
+
+        names = chaos_run.SUITE_SCENARIOS["kvfabric"]()
+        assert names == ["stale_directory", "donor_kill_mid_fetch",
+                         "corrupt_frame", "fetch_storm"]
+        assert "kvfabric" in chaos_run.SUITE_SCENARIOS
+
+    def test_scenario_filtering_matches_the_functions(self):
+        from tools import chaos_run
+
+        fns = (chaos_run._kvf_stale_directory,
+               chaos_run._kvf_donor_kill_mid_fetch,
+               chaos_run._kvf_corrupt_frame,
+               chaos_run._kvf_fetch_storm)
+        got = chaos_run._filter_scenarios(fns, "_kvf_", "corrupt_frame")
+        assert got == [chaos_run._kvf_corrupt_frame]
+        with pytest.raises(SystemExit):
+            chaos_run._filter_scenarios(fns, "_kvf_", "nope")

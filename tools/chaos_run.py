@@ -1,7 +1,7 @@
 """Chaos sweep: drive the runtime through batteries of deterministic fault
 plans and report survival / degradation stats per plan.
 
-Eight suites:
+Nine suites:
 
 ``--suite serving`` (default) — the continuous-batching engine under fault
 plans. For every plan the same request fleet runs on a fresh engine; the
@@ -83,6 +83,18 @@ around it, and a HALF_OPEN probe restores it after it heals; (4) a
 fleet-wide fault plan exhausts the global retry budget — requests
 fast-fail with bounded re-dispatch volume instead of a retry storm.
 
+``--suite kvfabric`` — the cluster-scale KV fabric (docs/SERVING.md "KV
+fabric"): the fleet-wide prefix directory + cross-replica KV-block
+migration under every failure mode it claims to survive, all held to
+token-for-token parity vs a fabric-off engine: (1) stale directory
+entries (the donor answers with zero frames; garbage documents sit in
+the store) degrade to local prefill; (2) SIGKILL the donor process
+mid-fetch (real ProcReplicas over a real TCPStore directory) — the
+pending fetch fails fast and the dead donor's lease ages its entry out;
+(3) a corrupt frame is refused by the receiver's CRC check — the
+verified chain prefix is kept, zero wrong tokens; (4) a hot-prefix fetch
+storm stays inside the migration budget with the retry budget untouched.
+
 ``--suite straggler`` — the cluster observability plane
 (docs/OBSERVABILITY.md "Cluster observability"): a 4-rank job over a real
 TCPStore where one rank carries a ``collective:delay`` fault plan.
@@ -96,7 +108,8 @@ recorder + stack snapshot.
 
 Usage:
     python tools/chaos_run.py
-        [--suite serving|prefix|spill|train|straggler|perf|serve-fleet|durable]
+        [--suite serving|prefix|spill|train|straggler|perf|serve-fleet|
+                 durable|kvfabric]
         [--requests 6] [--prompt-len 24] [--max-new 16]
         [--slots 3] [--block-size 8] [--plan NAME:SPEC ...] [--json OUT.json]
         [--list] [--scenario NAME]
@@ -1795,6 +1808,409 @@ def run_train_suite(workdir=None, scenario=None):
 
 # scenario catalog per suite, for ``--list`` and ``--scenario`` selection
 # ("perf" runs as one interdependent battery and cannot be sliced)
+# -- the kvfabric battery --------------------------------------------------
+#
+# ``--suite kvfabric`` (docs/SERVING.md "KV fabric"): the fleet-wide prefix
+# directory + cross-replica KV-block migration under its failure modes,
+# every scenario held to token-for-token parity against a fabric-off
+# engine — the fabric is advisory and may only ever degrade to prefill:
+# (1) stale directory: the donor answers a fetch with zero frames
+# (serving.kv.fetch:stale) while a garbage document and a ghost roster
+# entry sit in the store — every request prefills locally; (2) SIGKILL
+# the donor *process* mid-fetch (a real ProcReplica fleet over a real
+# TCPStore directory, the fetch delayed by serving.kv.fetch:delay so the
+# kill lands inside the transfer window) — the pending fetch fails fast,
+# the target prefills, and the dead donor's directory entry ages out with
+# its lease; (3) corrupt frame: one exported frame bit-rots after its CRC
+# stamp (serving.kv.fetch:corrupt) — the receiver's CRC check refuses it,
+# the surviving chain prefix is still used, zero wrong tokens; (4) fetch
+# storm: a hot-prefix burst against a tiny migration budget — fetches are
+# capped, the overflow prefills locally, and the router's retry budget is
+# untouched (a fetch storm must not become a dispatch storm).
+
+def _kvf_build_model(spec):
+    from paddle_tpu.serving.replica_worker import build_model
+
+    return build_model(spec)
+
+
+def _kvf_reference(spec, prompts, sp):
+    """Fabric-off parity oracle: one plain engine, same weights."""
+    eng = LLMEngine(_kvf_build_model(spec), **spec["engine"])
+    outs = eng.generate(prompts, [sp] * len(prompts))
+    eng.close()
+    return outs
+
+
+def _kvf_local_fleet(spec, store, n, *, router_kw=None, fabric_kw=None):
+    from paddle_tpu.serving import FleetRouter, LocalReplica
+
+    fab = {"store": store, "lease_s": 5.0, "refresh_s": 0.05}
+    fab.update(fabric_kw or {})
+
+    def factory():
+        return LLMEngine(_kvf_build_model(spec), **spec["engine"])
+
+    reps = [LocalReplica(f"l{i}", factory, stats_interval_s=0.02,
+                         fabric=fab, warmup=spec.get("warmup"))
+            for i in range(n)]
+    kw = dict(probe_interval_s=0.1, probe_timeout_s=30.0,
+              affinity_block_size=spec["engine"]["block_size"],
+              kv_fabric={"store": store, "fetch_timeout_s": 10.0,
+                         "cache_ttl_s": 0.02})
+    kw.update(router_kw or {})
+    router = FleetRouter(reps, **kw).start(wait_healthy_s=600)
+    unhealthy = [r.rid for r in reps if r.state.value != "healthy"]
+    if unhealthy:
+        router.close()
+        raise RuntimeError(f"kvfabric fleet never became healthy: "
+                           f"{unhealthy}")
+    return router, reps
+
+
+def _kvf_workload(args, shared=None):
+    """Shared-prefix prompts: one common template covering >= 2 full
+    blocks (the migratable chain), divergent tails."""
+    rng = np.random.RandomState(7)
+    bs = args.block_size
+    n_shared = max(2 * bs, (int(args.prompt_len * 0.75) // bs) * bs)
+    if shared is None:
+        shared = [int(t) for t in rng.randint(0, args.vocab, n_shared)]
+    tail = max(2, args.prompt_len - len(shared))
+    return [list(shared) + [int(t) for t in rng.randint(0, args.vocab,
+                                                        tail)]
+            for _ in range(args.requests)], shared
+
+
+def _kvf_overload(router, rid, n=6):
+    """Pile phantom in-flight load onto one replica so placement (and
+    thus migration) must spread the hot prefix to its siblings."""
+    with router._lock:
+        for g in range(n):
+            router._inflight[rid].add(900_000 + g)
+
+
+def _kvf_release(router, rid, n=6):
+    with router._lock:
+        for g in range(n):
+            router._inflight[rid].discard(900_000 + g)
+
+
+def _kvf_wave(router, prompts, sp, timeout=600):
+    """Submit every prompt from its own thread (a genuinely concurrent
+    burst: lookups race migrations, like real traffic) and wait all."""
+    rrs = [None] * len(prompts)
+    errs = [None] * len(prompts)
+
+    def one(i):
+        try:
+            rrs[i] = router.submit(prompts[i], sp)
+        except Exception as e:         # shed/no-capacity is a lost request
+            errs[i] = f"{type(e).__name__}: {e}"
+
+    threads = [threading.Thread(target=one, args=(i,), daemon=True)
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+    for rr in rrs:
+        if rr is not None:
+            rr.wait(timeout)
+    return rrs, errs
+
+
+def _kvf_parity(rrs, refs, skip=()):
+    bad = []
+    for i, rr in enumerate(rrs):
+        if i in skip or rr is None:
+            continue
+        if rr.state != "finished" or rr.tokens != refs[i]:
+            bad.append(i)
+    return bad
+
+
+def _kvf_fabric_totals(router):
+    """Sum the per-replica fabric counters off the heartbeated stats."""
+    tot = {}
+    for v in router.stats()["replicas"].values():
+        fab = ((v.get("prefix_cache") or {}).get("fabric")) or {}
+        for k, x in fab.items():
+            tot[k] = tot.get(k, 0) + int(x or 0)
+    return tot
+
+
+def _kvf_stale_directory(args, workdir, spec, max_len):
+    """A directory that lies — stale entries (donor answers no frames)
+    plus garbage documents — must cost only prefills, never tokens."""
+    from paddle_tpu.serving import kv_fabric as kvf
+
+    sp = SamplingParams(max_new_tokens=args.max_new, temperature=0.0)
+    prompts, _ = _kvf_workload(args)
+    refs = _kvf_reference(spec, prompts, sp)
+    store = kvf.MemStore()
+    router, reps = _kvf_local_fleet(spec, store, 2)
+    try:
+        r0 = router.submit(prompts[0], sp)
+        assert r0.wait(300) and r0.state == "finished", r0.error
+        owner = r0.replica
+        time.sleep(0.4)                 # directory beat
+        # store-level garbage the reader must skip: an undecodable
+        # document under a roster entry (StoreCorruptValue path)
+        store.set(f"{kvf.DIR_PREFIX}/dir/ghost", b"\x01 not json \xff")
+        roster = store.get_json(f"{kvf.DIR_PREFIX}/roster") or []
+        store.set_json(f"{kvf.DIR_PREFIX}/roster", roster + ["ghost"])
+        _kvf_overload(router, owner)
+        try:
+            with FaultPlan.parse("serving.kv.fetch:stale@1x*"):
+                rrs, errs = _kvf_wave(router, prompts[1:], sp)
+        finally:
+            _kvf_release(router, owner)
+        st = router.stats()
+        bad = _kvf_parity(rrs, refs[1:])
+        lost = [i for i, rr in enumerate(rrs) if rr is None] + bad
+        ok = (not lost and not any(errs)
+              and r0.tokens == refs[0]
+              and st["directory_hits"] >= 1
+              and st["directory_stale"] >= 1
+              and st["migrations"] == 0
+              and _kvf_fabric_totals(router).get("ingested_blocks",
+                                                 0) == 0)
+        return {"scenario": "stale_directory", "survived": bool(ok),
+                "lost_requests": len(lost), "parity_failures": len(bad),
+                "directory_hits": st["directory_hits"],
+                "directory_stale": st["directory_stale"],
+                "migrations": st["migrations"],
+                "migration_failures": st["migration_failures"]}
+    finally:
+        router.close()
+
+
+def _kvf_donor_kill_mid_fetch(args, workdir, spec, max_len):
+    """SIGKILL the donor *process* while a migration fetch is in flight
+    (real ProcReplicas, real TCPStore directory): the pending fetch fails
+    fast, the target prefills, the dead donor's lease ages its directory
+    entry out, and every stream stays token-for-token correct."""
+    from paddle_tpu.distributed.tcp_store import TCPStore
+    from paddle_tpu.serving import FleetRouter, ProcReplica
+    from paddle_tpu.serving import kv_fabric as kvf
+
+    sp = SamplingParams(max_new_tokens=args.max_new, temperature=0.0)
+    master = TCPStore(is_master=True)
+    endpoint = f"127.0.0.1:{master.port}"
+    lease_s = 2.0
+    fspec = dict(spec)
+    fspec["fabric"] = {"store": endpoint, "lease_s": lease_s,
+                       "refresh_s": 0.2}
+    reps = [ProcReplica(
+        f"p{i}", fspec,
+        env=({"FLAGS_fault_plan": "serving.kv.fetch:delay=30@1x*"}
+             if i == 0 else {}),
+        log_path=os.path.join(workdir, f"kvfabric-p{i}.log"))
+        for i in range(2)]
+    router = FleetRouter(
+        reps, probe_interval_s=0.1, probe_timeout_s=30.0,
+        affinity_block_size=spec["engine"]["block_size"],
+        kv_fabric={"store": endpoint, "fetch_timeout_s": 60.0,
+                   "cache_ttl_s": 0.02}).start(wait_healthy_s=600)
+    try:
+        unhealthy = [r.rid for r in reps if r.state.value != "healthy"]
+        if unhealthy:
+            raise RuntimeError(f"fleet never became healthy: {unhealthy}")
+        rng = np.random.RandomState(11)
+        shared = _affinity_prompt(
+            router, rng, 2 * args.block_size, args.vocab, "p0")
+        prompts, _ = _kvf_workload(args, shared=shared)
+        refs = _kvf_reference(spec, prompts, sp)
+        r0 = router.submit(prompts[0], sp)      # affinity -> p0, publishes
+        assert r0.wait(600) and r0.state == "finished", r0.error
+        assert r0.replica == "p0", f"warm request landed on {r0.replica}"
+        time.sleep(0.5)                          # directory beat
+        _kvf_overload(router, "p0")
+        killed_mid_fetch = False
+        t_fail = None
+        try:
+            done = threading.Event()
+            box = {}
+
+            def second():
+                t0 = time.monotonic()
+                rr = router.submit(prompts[1], sp)
+                rr.wait(600)
+                box["rr"] = rr
+                box["wall"] = time.monotonic() - t0
+                done.set()
+
+            threading.Thread(target=second, daemon=True).start()
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                with router._fetch_lock:
+                    pending = bool(router._fetches)
+                if pending:
+                    reps[0].kill()               # SIGKILL mid-fetch
+                    killed_mid_fetch = True
+                    break
+                time.sleep(0.005)
+            assert done.wait(600), "second request never finished"
+            rr1 = box["rr"]
+            t_fail = box["wall"]
+        finally:
+            _kvf_release(router, "p0")
+        # the dead donor's lease must age its directory entry out
+        time.sleep(lease_s + 0.5)
+        directory = kvf.KVDirectory(
+            kvf.connect_store(endpoint),
+            cfg=kvf.FabricConfig(cache_ttl_s=0.0))
+        hashes = kvf.chain_hashes(prompts[2], args.block_size)
+        donors_after = directory.lookup(hashes, rids=["p0", "p1"])
+        # and the fleet keeps serving the prefix from the survivor
+        rrs, errs = _kvf_wave(router, prompts[2:], sp)
+        st = router.stats()
+        bad = _kvf_parity(rrs, refs[2:])
+        lost = [i for i, rr in enumerate(rrs) if rr is None] + bad
+        ok = (killed_mid_fetch and not lost and not any(errs)
+              and rr1.state == "finished" and rr1.tokens == refs[1]
+              and t_fail is not None and t_fail < 30.0
+              and st["migration_failures"] >= 1
+              and st["directory_stale"] >= 1
+              and st["replica_deaths"] >= 1
+              and "p0" not in donors_after)
+        return {"scenario": "donor_kill_mid_fetch", "survived": bool(ok),
+                "killed_mid_fetch": killed_mid_fetch,
+                "lost_requests": len(lost), "parity_failures": len(bad),
+                "second_request_wall_s": (round(t_fail, 2)
+                                          if t_fail else None),
+                "migration_failures": st["migration_failures"],
+                "directory_stale": st["directory_stale"],
+                "replica_deaths": st["replica_deaths"],
+                "donors_after_lease": sorted(donors_after)}
+    finally:
+        router.close()
+        master.close()
+
+
+def _kvf_corrupt_frame(args, workdir, spec, max_len):
+    """One migrated frame bit-rots in transit (after its CRC stamp): the
+    receiver must refuse it, keep the verified chain prefix, and the
+    request's tokens must be exactly the fabric-off stream."""
+    from paddle_tpu.serving import kv_fabric as kvf
+
+    sp = SamplingParams(max_new_tokens=args.max_new, temperature=0.0)
+    prompts, _ = _kvf_workload(args)
+    refs = _kvf_reference(spec, prompts, sp)
+    store = kvf.MemStore()
+    router, reps = _kvf_local_fleet(spec, store, 2)
+    try:
+        r0 = router.submit(prompts[0], sp)
+        assert r0.wait(300) and r0.state == "finished", r0.error
+        owner = r0.replica
+        time.sleep(0.4)
+        _kvf_overload(router, owner)
+        try:
+            with FaultPlan.parse("serving.kv.fetch:corrupt@1x*"):
+                rrs, errs = _kvf_wave(router, prompts[1:], sp)
+        finally:
+            _kvf_release(router, owner)
+        st = router.stats()
+        tot = _kvf_fabric_totals(router)
+        bad = _kvf_parity(rrs, refs[1:])
+        lost = [i for i, rr in enumerate(rrs) if rr is None] + bad
+        ok = (not lost and not any(errs)
+              and r0.tokens == refs[0]
+              and st["migrations"] >= 1
+              and tot.get("ingest_corrupt", 0) >= 1)
+        return {"scenario": "corrupt_frame", "survived": bool(ok),
+                "lost_requests": len(lost), "parity_failures": len(bad),
+                "migrations": st["migrations"],
+                "migrated_blocks": st["migrated_blocks"],
+                "ingest_corrupt": tot.get("ingest_corrupt", 0),
+                "ingested_blocks": tot.get("ingested_blocks", 0)}
+    finally:
+        router.close()
+
+
+def _kvf_fetch_storm(args, workdir, spec, max_len):
+    """A hot-prefix burst against a tiny migration budget: fetch volume
+    stays capped, the overflow prefills locally, the router's retry
+    budget is untouched, and nothing is lost."""
+    from paddle_tpu.serving import kv_fabric as kvf
+
+    sp = SamplingParams(max_new_tokens=args.max_new, temperature=0.0)
+    budget = 1
+    prompts, shared = _kvf_workload(args)
+    storm = prompts + prompts[1:]          # double the burst
+    refs = _kvf_reference(spec, storm, sp)
+    store = kvf.MemStore()
+    router, reps = _kvf_local_fleet(
+        spec, store, 3,
+        router_kw={"kv_fabric": {
+            "store": store, "fetch_timeout_s": 10.0, "cache_ttl_s": 0.02,
+            "fetch_window_s": 60.0, "max_fetches_per_window": budget}},
+        fabric_kw={"refresh_s": 0.5})
+    try:
+        r0 = router.submit(storm[0], sp)
+        assert r0.wait(300) and r0.state == "finished", r0.error
+        owner = r0.replica
+        time.sleep(0.6)
+        _kvf_overload(router, owner)
+        try:
+            rrs, errs = _kvf_wave(router, storm[1:], sp)
+        finally:
+            _kvf_release(router, owner)
+        st = router.stats()
+        bad = _kvf_parity(rrs, refs[1:])
+        lost = [i for i, rr in enumerate(rrs) if rr is None] + bad
+        ok = (not lost and not any(errs)
+              and r0.tokens == refs[0]
+              and st["migrations"] <= budget
+              and st["fetch_skipped"] >= 1
+              and st["retry_budget_denied"] == 0)
+        return {"scenario": "fetch_storm", "survived": bool(ok),
+                "lost_requests": len(lost), "parity_failures": len(bad),
+                "burst": len(storm),
+                "migrations": st["migrations"],
+                "fetch_skipped": st["fetch_skipped"],
+                "directory_placements": st["directory_placements"],
+                "retry_budget_denied": st["retry_budget_denied"]}
+    finally:
+        router.close()
+
+
+def run_kvfabric_suite(args, workdir=None, scenario=None):
+    import tempfile
+
+    workdir = workdir or tempfile.mkdtemp(prefix="chaos-kvfabric-")
+    max_len = args.prompt_len + args.max_new
+    spec = _fleet_spec(args, workdir, max_len)
+    rows = []
+    fns = _filter_scenarios(
+        (_kvf_stale_directory, _kvf_donor_kill_mid_fetch,
+         _kvf_corrupt_frame, _kvf_fetch_storm), "_kvf_", scenario)
+    for fn in fns:
+        try:
+            rows.append(fn(args, workdir, spec, max_len))
+        except Exception as e:
+            rows.append({"scenario": fn.__name__[len("_kvf_"):],
+                         "survived": False,
+                         "crashed": f"{type(e).__name__}: {e}"})
+    survived = sum(1 for r in rows if r["survived"])
+    zero_lost = all(r.get("lost_requests", 0) == 0 for r in rows)
+    dump_path = telemetry.dump(reason="kvfabric chaos suite complete")
+    return {
+        "suite": "kvfabric",
+        "workdir": workdir,
+        "config": {"requests": args.requests, "prompt_len": args.prompt_len,
+                   "max_new_tokens": args.max_new, "slots": args.slots,
+                   "block_size": args.block_size},
+        "plans_run": len(rows),
+        "plans_survived": survived,
+        "all_survived": survived == len(rows),
+        "zero_lost_requests": bool(zero_lost),
+        "flight_recorder_dump": dump_path,
+        "results": rows,
+    }
+
+
 SUITE_SCENARIOS = {
     "serving": lambda: [n for n, _ in DEFAULT_PLANS],
     "prefix": lambda: [n for n, _ in PREFIX_PLANS],
@@ -1804,6 +2220,8 @@ SUITE_SCENARIOS = {
                             "drain_restart"],
     "durable": lambda: ["gateway_sigkill", "torn_journal_tail",
                         "breaker_trip", "retry_budget_storm"],
+    "kvfabric": lambda: ["stale_directory", "donor_kill_mid_fetch",
+                         "corrupt_frame", "fetch_storm"],
     "train": lambda: ["kill_worker", "nan_injection", "torn_checkpoint"],
     "straggler": lambda: ["straggler", "hang"],
 }
@@ -1832,7 +2250,8 @@ def run_sweep(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--suite",
                     choices=["serving", "prefix", "spill", "train",
-                             "straggler", "perf", "serve-fleet", "durable"],
+                             "straggler", "perf", "serve-fleet", "durable",
+                             "kvfabric"],
                     default="serving")
     ap.add_argument("--list", action="store_true",
                     help="print every suite's scenario names and exit")
@@ -1865,7 +2284,7 @@ def run_sweep(argv=None):
                          "and cannot be sliced with --scenario")
 
     if args.suite in ("train", "straggler", "prefix", "spill", "perf",
-                      "serve-fleet", "durable"):
+                      "serve-fleet", "durable", "kvfabric"):
         report = (run_train_suite(scenario=args.scenario)
                   if args.suite == "train"
                   else run_straggler_suite(scenario=args.scenario)
@@ -1876,6 +2295,8 @@ def run_sweep(argv=None):
                   if args.suite == "serve-fleet"
                   else run_durable_suite(args, scenario=args.scenario)
                   if args.suite == "durable"
+                  else run_kvfabric_suite(args, scenario=args.scenario)
+                  if args.suite == "kvfabric"
                   else run_spill_suite(args, scenario=args.scenario)
                   if args.suite == "spill"
                   else run_prefix_suite(args, scenario=args.scenario))
@@ -1938,7 +2359,8 @@ def main(argv=None):
     for r in report["results"]:
         status = "OK " if r["survived"] else "DIED"
         if report.get("suite") in ("train", "straggler", "perf",
-                                   "serve-fleet", "durable", "spill"):
+                                   "serve-fleet", "durable", "spill",
+                                   "kvfabric"):
             detail = " ".join(f"{k}={v}" for k, v in r.items()
                               if k not in ("scenario", "survived"))
             print(f"[{status}] {r['scenario']:<26} {detail}",

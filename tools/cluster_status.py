@@ -14,6 +14,14 @@ offset — plus the monitor's straggler / desync / hang diagnosis.
         [--postmortem DIR]         # force-collect a bundle right now
         [--merge-traces OUT.json --trace R:PATH ...]   # one row per rank
 
+``--kv`` switches to the KV-fabric directory view (``--world`` not
+needed): per replica, the published prefix-directory entry — epoch/lease
+validity, device vs spill hash counts, document bytes — plus the
+migration/fallback counters each replica publishes alongside its
+inventory (exports served, blocks ingested, CRC-refused frames):
+
+    python tools/cluster_status.py --master 127.0.0.1:PORT --kv
+
 ``--merge-traces`` aligns each rank's exported Chrome trace with the
 clock offsets the ranks published (their meta records), so a comm/compute
 overlap regression is visible as a picture — one timeline, one row per
@@ -63,11 +71,41 @@ def render(report: dict) -> str:
     return "\n".join(lines)
 
 
+def render_kv(snap: dict) -> str:
+    """The ``--kv`` table: one row per published directory entry."""
+    lines = [f"kv fabric directory: {len(snap)} replica(s) on roster"]
+    lines.append(f"{'replica':<10} {'valid':<6} {'age':>8} {'lease':>8} "
+                 f"{'dev':>5} {'spill':>5} "
+                 f"{'exp':>5} {'ing':>5} {'crc-drop':>8} {'err':>5}")
+    for rid, v in sorted(snap.items()):
+        if not v.get("valid"):
+            lines.append(f"{rid:<10} {'NO':<6} (absent, garbage, lease "
+                         f"expired, or epoch-fenced)")
+            continue
+        c = v.get("counters") or {}
+        lines.append(
+            f"{rid:<10} {'yes':<6} {v['age_s']:>7.1f}s "
+            f"{v['lease_remaining_s']:>7.1f}s "
+            f"{v['device_hashes']:>5} {v['spill_hashes']:>5} "
+            f"{c.get('exports', '-'):>5} "
+            f"{c.get('ingested_blocks', '-'):>5} "
+            f"{c.get('ingest_corrupt', '-'):>8} "
+            f"{c.get('ingest_errors', '-'):>5}"
+            + ("  TRUNCATED" if v.get("truncated") else ""))
+    return "\n".join(lines)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--master", required=True, help="telemetry store "
                     "host:port (the launcher's --cluster_telemetry store)")
-    ap.add_argument("--world", type=int, required=True)
+    ap.add_argument("--world", type=int, default=None,
+                    help="rank count (required for the fleet view; "
+                    "not needed with --kv)")
+    ap.add_argument("--kv", action="store_true",
+                    help="print the KV-fabric prefix-directory view "
+                    "(entry counts, bytes, migration counters per "
+                    "replica) instead of the rank fleet table")
     ap.add_argument("--watch", type=float, default=None,
                     help="refresh every N seconds until interrupted")
     ap.add_argument("--straggler-threshold-ms", type=float, default=200.0)
@@ -88,6 +126,27 @@ def main(argv=None):
 
     host, _, port = args.master.rpartition(":")
     store = TCPStore(host or "127.0.0.1", int(port))
+
+    if args.kv:
+        from paddle_tpu.serving.kv_fabric import KVDirectory
+
+        directory = KVDirectory(store)
+        while True:
+            report = directory.snapshot()
+            print(render_kv(report))
+            if args.watch is None:
+                break
+            time.sleep(args.watch)
+            print()
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump({"kv_directory": report}, f, indent=1,
+                          default=str)
+            print(f"# kv directory json -> {args.json}", file=sys.stderr)
+        return 0
+
+    if args.world is None:
+        ap.error("--world is required for the fleet view (or pass --kv)")
     agg = ClusterAggregator(store, args.world)
     mon = ClusterMonitor(
         store, args.world,

@@ -59,6 +59,13 @@ DIRECTIONS = {
     "fleet_tok_per_sec": "higher",
     "fleet_ttft_mean_s": "lower",
     "fleet_ttft_p95_s": "lower",
+    # cluster KV fabric (ISSUE 15): fleet-wide prefix-cache hit rate on a
+    # shared-prefix workload with the directory + migration on — the
+    # whole point of the fabric is that this beats affinity-only routing
+    # and must not erode; throughput/TTFT of the fabric pass ride along
+    "fleet_prefix_hit_rate": "higher",
+    "fleet_fabric_tok_per_sec": "higher",
+    "fleet_fabric_ttft_mean_s": "lower",
     # tiered KV spill (ISSUE 14): warm TTFT after the shared prefix was
     # evicted from a small device pool — with the spill tier it promotes
     # back (fast), without it the fleet re-prefills cold; the speedup is
@@ -94,6 +101,16 @@ def extract_metrics(doc: dict) -> tuple[str, dict]:
         return "train", metrics
     if doc.get("mode") == "fleet" or isinstance(doc.get("fleet"), dict):
         f = doc.get("fleet") or {}
+        if isinstance(f.get("prefix"), dict):
+            # the KV-fabric variant (--kv-fabric on) is its own bench
+            # kind: its workload is a staggered shared-prefix A/B and
+            # its numbers measure directory routing + migration, not the
+            # plain fleet path — they must not cross-gate
+            p = f["prefix"]
+            put("fleet_prefix_hit_rate", p.get("fleet_hit_rate"))
+            put("fleet_fabric_tok_per_sec", f.get("tok_per_sec"))
+            put("fleet_fabric_ttft_mean_s", f.get("ttft_mean_s"))
+            return "serving_fleet_fabric", metrics
         put("fleet_tok_per_sec", f.get("tok_per_sec"))
         put("fleet_ttft_mean_s", f.get("ttft_mean_s"))
         put("fleet_ttft_p95_s", f.get("ttft_p95_s"))

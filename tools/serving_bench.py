@@ -315,12 +315,47 @@ def run_prefix_bench(args, slo_kw):
             "prefix-cache-on outputs diverged from prefix-cache-off")
 
 
+def _fleet_prefix_view(st: dict) -> tuple[float, dict]:
+    """Fleet-wide prefix-cache hit rate + per-replica cache occupancy
+    off the router's heartbeat view (the ROADMAP gate's numbers)."""
+    per = {}
+    hits = misses = 0
+    for rid, v in st["replicas"].items():
+        pc = v.get("prefix_cache") or {}
+        s = v.get("stats") or {}
+        h, m = int(pc.get("hits") or 0), int(pc.get("misses") or 0)
+        used = int(s.get("blocks_used") or 0)
+        cached = int(s.get("blocks_cached") or 0)
+        usable = int(s.get("blocks_usable") or 0)
+        per[rid] = {
+            "hits": h, "misses": m,
+            "hit_rate": h / (h + m) if h + m else 0.0,
+            "blocks_used": used,
+            "cached_blocks": cached,
+            "blocks_usable": usable,
+            "occupancy": ((used + cached) / usable) if usable else None,
+            "fabric": pc.get("fabric"),
+        }
+        hits += h
+        misses += m
+    rate = hits / (hits + misses) if hits + misses else 0.0
+    return rate, per
+
+
 def run_fleet_bench(args, slo_kw):
     """``--fleet N``: drive the HTTP gateway over N LocalReplica engines
     with streaming clients — the client-measured numbers (TTFT to first
     SSE chunk, end-to-end tokens/s) plus the router's fleet view
     (per-replica SLO blocks, shed/failover/affinity counts), gateable by
-    ``tools/perf_gate.py`` as bench kind ``serving_fleet``."""
+    ``tools/perf_gate.py`` as bench kind ``serving_fleet``.
+
+    ``--prefix-share F`` shapes the workload as shared-prefix traffic;
+    ``--kv-fabric on`` additionally runs the SAME prompts twice — an
+    affinity-hash-only fleet, then a KV-fabric fleet (fleet-wide prefix
+    directory + cross-replica block migration, docs/SERVING.md "KV
+    fabric") — and reports both fleet-wide hit rates plus per-replica
+    cache occupancy (bench kind ``serving_fleet_fabric``; outputs must
+    be token-identical between the passes)."""
     import http.client
     import threading
 
@@ -329,6 +364,9 @@ def run_fleet_bench(args, slo_kw):
     plen = args.prompt_len if args.prompt_len is not None else 32
     slots = args.slots if args.slots is not None else 4
     max_len = plen + args.max_new
+    if args.kv_fabric == "on" and args.journal != "off":
+        raise SystemExit("--kv-fabric on does not compose with --journal "
+                         "(run the passes separately)")
 
     def build_model():
         paddle_tpu.seed(0)
@@ -341,17 +379,34 @@ def run_fleet_bench(args, slo_kw):
         return LLMEngine(build_model(), block_size=args.block_size,
                          max_slots=slots, max_model_len=max_len, **slo_kw)
 
-    reps = [LocalReplica(f"r{i}", factory, stats_interval_s=0.05,
-                         warmup=list(range(1, plen + 1)))
-            for i in range(args.fleet)]
-    router = FleetRouter(reps, probe_interval_s=0.2, probe_timeout_s=30.0,
-                         affinity_block_size=args.block_size).start(
-        wait_healthy_s=600)
-    gateway = Gateway(router).start()
+    def make_fleet(fabric_store=None):
+        fab = ({"store": fabric_store, "lease_s": 30.0, "refresh_s": 0.1}
+               if fabric_store is not None else None)
+        reps = [LocalReplica(f"r{i}", factory, stats_interval_s=0.05,
+                             warmup=list(range(1, plen + 1)), fabric=fab)
+                for i in range(args.fleet)]
+        kw = {}
+        if fabric_store is not None:
+            kw["kv_fabric"] = {"store": fabric_store,
+                               "fetch_timeout_s": 60.0,
+                               "cache_ttl_s": 0.02}
+        r = FleetRouter(reps, probe_interval_s=0.2, probe_timeout_s=30.0,
+                        affinity_block_size=args.block_size,
+                        **kw).start(wait_healthy_s=600)
+        return r, Gateway(r).start()
+
+    router, gateway = make_fleet(None)
 
     rng = np.random.RandomState(0)
-    prompts = [[int(t) for t in rng.randint(0, args.vocab, plen)]
-               for _ in range(args.requests)]
+    if args.prefix_share is not None:
+        n_shared = int(plen * args.prefix_share)
+        shared = [int(t) for t in rng.randint(0, args.vocab, n_shared)]
+        prompts = [shared + [int(t) for t in rng.randint(
+            0, args.vocab, plen - n_shared)]
+            for _ in range(args.requests)]
+    else:
+        prompts = [[int(t) for t in rng.randint(0, args.vocab, plen)]
+                   for _ in range(args.requests)]
 
     class Client(threading.Thread):
         def __init__(self, prompt, gw=None):
@@ -394,15 +449,62 @@ def run_fleet_bench(args, slo_kw):
                     pass
             conn.close()
 
-    try:
-        t0 = time.perf_counter()
-        clients = [Client(p) for p in prompts]
-        for c in clients:
+    def run_pass(gw, stagger_s=0.0):
+        """One full client wave against ``gw``; returns (clients, wall)."""
+        t1 = time.perf_counter()
+        cs = [Client(p, gw=gw) for p in prompts]
+        for c in cs:
             c.start()
-        for c in clients:
+            if stagger_s:
+                time.sleep(stagger_s)
+        for c in cs:
             c.join(600)
-        dt = time.perf_counter() - t0
-        st = router.stats()
+        return cs, time.perf_counter() - t1
+
+    try:
+        prefix_block = None
+        if args.kv_fabric == "on":
+            from paddle_tpu.serving import kv_fabric as kvf
+
+            # pass A — affinity-hash-only placement, the baseline the
+            # ROADMAP gate compares against. Arrivals are lightly
+            # staggered (identically in both passes) so placement sees
+            # load build up the way sustained traffic does, not one
+            # instantaneous cold burst.
+            clients_a, _ = run_pass(gateway, stagger_s=0.05)
+            st_a = router.stats()
+            hit_a, per_a = _fleet_prefix_view(st_a)
+            outs_a = [c.tokens for c in clients_a]
+            errors_a = sum(1 for c in clients_a
+                           if c.status != 200 or c.error)
+            gateway.stop()
+            router.close()
+            # pass B — the same prompts through a KV-fabric fleet:
+            # directory-aware placement + cross-replica block migration
+            store = kvf.MemStore()
+            router, gateway = make_fleet(store)
+            clients, dt = run_pass(gateway, stagger_s=0.05)
+            st = router.stats()
+            hit_b, per_b = _fleet_prefix_view(st)
+            prefix_block = {
+                "share": args.prefix_share,
+                "fleet_hit_rate": hit_b,
+                "fleet_hit_rate_affinity_only": hit_a,
+                "hit_rate_gain": hit_b - hit_a,
+                "outputs_match_fabric_off":
+                    [c.tokens for c in clients] == outs_a,
+                "affinity_http_errors": errors_a,
+                "directory_hits": st["directory_hits"],
+                "directory_placements": st["directory_placements"],
+                "migrations": st["migrations"],
+                "migration_failures": st["migration_failures"],
+                "migrated_blocks": st["migrated_blocks"],
+                "per_replica": per_b,
+                "per_replica_affinity_only": per_a,
+            }
+        else:
+            clients, dt = run_pass(gateway)
+            st = router.stats()
         n_tokens = sum(len(c.tokens) for c in clients)
         ttfts = sorted(c.ttft for c in clients if c.ttft is not None)
         journal_block = None
@@ -479,6 +581,10 @@ def run_fleet_bench(args, slo_kw):
                 # (docs/ROBUSTNESS.md "Durable requests"); perf_gate
                 # gates journal_overhead_frac against the baseline
                 "journal": journal_block,
+                # --kv-fabric on: fleet-wide prefix hit rate (fabric vs
+                # affinity-only) + per-replica cache occupancy — bench
+                # kind serving_fleet_fabric (docs/SERVING.md "KV fabric")
+                "prefix": prefix_block,
             },
             "__meta__": _perf.run_meta(),
         }
@@ -494,6 +600,11 @@ def run_fleet_bench(args, slo_kw):
         print(f"# metrics snapshot -> {args.metrics_out}", file=sys.stderr)
     if result["fleet"]["http_errors"]:
         raise SystemExit("fleet bench saw failed requests")
+    if prefix_block is not None and \
+            not prefix_block["outputs_match_fabric_off"]:
+        raise SystemExit(
+            "kv-fabric fleet outputs diverged from the affinity-only "
+            "fleet — migration changed tokens")
 
 
 def main():
@@ -540,6 +651,16 @@ def main():
                          "(streaming clients; reports client-side TTFT, "
                          "tokens/s, per-replica SLO blocks, shed/failover "
                          "counts — docs/SERVING.md \"Fleet serving\")")
+    ap.add_argument("--kv-fabric", choices=("off", "on"), default="off",
+                    help="--fleet only: run the workload twice — an "
+                         "affinity-hash-only fleet, then a KV-fabric "
+                         "fleet (fleet-wide prefix directory + "
+                         "cross-replica block migration) — and report "
+                         "both fleet-wide prefix hit rates plus "
+                         "per-replica cache occupancy (bench kind "
+                         "serving_fleet_fabric; pair with "
+                         "--prefix-share for a shared-prefix workload — "
+                         "docs/SERVING.md \"KV fabric\")")
     ap.add_argument("--journal", choices=("off", "interval", "always"),
                     default="off",
                     help="--fleet only: run a second pass through a "
