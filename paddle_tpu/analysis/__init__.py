@@ -1,0 +1,28 @@
+"""Concurrency & JIT sanitizer suite (docs/ANALYSIS.md).
+
+Two cooperating halves, both built to make the hand-proofs of recent PRs
+mechanical:
+
+- :mod:`paddle_tpu.analysis.locksan` — **LockSan**, a runtime lock-order
+  sanitizer ("mini-TSan for our threading"): an instrumented lock factory
+  adopted by every lock-holding module in the package. Armed via
+  ``FLAGS_locksan`` (env or ``paddle.set_flags``) it records per-thread
+  acquisition stacks, builds the global lock-order graph, and reports
+  order-inversion cycles (potential deadlocks) and blocking calls made
+  while holding a lock (socket/pipe/fsync/``time.sleep`` — the exact bug
+  class the router's pending-fetch table was hand-designed around).
+  Off (the default) it hands back raw ``threading`` locks: zero overhead.
+
+- :mod:`paddle_tpu.analysis.lint` — an AST static-lint framework with
+  pluggable passes for the failure modes unique to a JAX serving stack
+  (tracer leaks, host syncs in hot paths, wall-clock time inside jitted
+  code, silently-swallowed exceptions, unnamed threads, fault-site /
+  metric doc drift). Findings are keyed and suppressible via the
+  checked-in ``analysis/baseline.json`` so the gate starts green and
+  ratchets: new findings fail ``tests/test_static_analysis.py`` in
+  tier-1, and ``tools/lint.py --check`` is the CI entry point.
+"""
+from . import locksan  # noqa: F401
+from .locksan import Lock, RLock, allow_blocking  # noqa: F401
+
+__all__ = ["locksan", "Lock", "RLock", "allow_blocking"]

@@ -12,8 +12,9 @@ import ctypes
 import os
 import subprocess
 import threading
+from ..analysis import locksan
 
-_LOCK = threading.Lock()
+_LOCK = locksan.Lock("native.load")
 _LIB = None
 _TRIED = False
 

@@ -186,7 +186,7 @@ class AutoTuner:
                     dt = self._run_trial(cand, model_fn, data_fn, steps)
                     self.recorder.add(cand, dt)
                     n_ok += 1
-                except Exception as e:  # OOM/invalid-shape trials recorded
+                except Exception as e:  # lint: allow-silent(OOM/invalid-shape trial is recorded with its error)
                     self.recorder.add(cand, float("inf"), error=repr(e))
         finally:
             # trials set the global topology per candidate; don't leak the

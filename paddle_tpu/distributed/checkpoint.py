@@ -43,6 +43,7 @@ import jax
 
 from .. import telemetry
 from ..utils import faults
+from ..analysis import locksan
 
 
 def _ckpt_metrics():
@@ -75,7 +76,7 @@ class CheckpointCorrupt(RuntimeError):
 # (engine.save_checkpoint creates a fresh saver per call).
 _PENDING_WRITES: dict[str, threading.Thread] = {}
 _PENDING_ERRORS: dict[str, BaseException] = {}
-_PENDING_LOCK = threading.Lock()
+_PENDING_LOCK = locksan.Lock("checkpoint.pending")
 
 
 def _wait_path(path, reraise=False):
@@ -338,11 +339,12 @@ class DistributedSaver:
             def _write_logged():
                 try:
                     _write()
-                except BaseException as e:   # surfaced by wait()/_wait_path
+                except BaseException as e:  # lint: allow-silent(error surfaced by wait()/_wait_path)
                     with _PENDING_LOCK:
                         _PENDING_ERRORS[final] = e
 
-            t = threading.Thread(target=_write_logged, daemon=False)
+            t = threading.Thread(target=_write_logged, daemon=False,
+                                 name=f"ckpt-writer:{os.path.basename(final)}")
             with _PENDING_LOCK:
                 _PENDING_WRITES[final] = t
             self._pending = (final, t)

@@ -161,7 +161,7 @@ def unshard(t):
 def _rank_of(g: Group) -> int:
     try:
         return int(g.hcg._coord(g.axis))
-    except Exception:
+    except Exception:  # lint: allow-silent(no hcg topology; process index is the rank)
         return int(jax.process_index())
 
 
@@ -235,7 +235,7 @@ def _guard_timeout(invoke, op: str, g: Group, timeout: float):
     def target():
         try:
             result[0] = invoke()
-        except BaseException as e:  # surfaced on the caller thread
+        except BaseException as e:  # lint: allow-silent(error re-raised on the caller thread)
             error[0] = e
         finally:
             done.set()

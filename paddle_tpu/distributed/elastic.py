@@ -52,7 +52,9 @@ class Heartbeat:
             while not self._stop.wait(self.interval):
                 self.store.add(f"beat/{self.rank}", 1)
 
-        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread = threading.Thread(
+            target=run, daemon=True,
+            name=f"elastic-heartbeat:rank{self.rank}")
         self._thread.start()
         return self
 
@@ -105,9 +107,9 @@ class ElasticManager:
 
     def wait_for_all(self, timeout=60.0):
         """Block until every rank has registered a first heartbeat."""
-        deadline = time.time() + timeout
+        deadline = time.monotonic() + timeout
         for r in range(self.world_size):
-            remain = max(0.1, deadline - time.time())
+            remain = max(0.1, deadline - time.monotonic())
             if not self.store.wait(f"beat/{r}", timeout=remain):
                 raise TimeoutError(f"rank {r} never heartbeat")
 
@@ -167,7 +169,8 @@ class ElasticManager:
                         self.on_failure(dead)
                     self.rearm(dead)
 
-        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="elastic-monitor")
         self._thread.start()
         return self
 

@@ -109,7 +109,7 @@ def model_parallel_random_seed(seed=None):
             mp_rank = hcg.get_model_parallel_rank()
             pp_rank = hcg.get_stage_id()
             pp_size = hcg.get_pipe_parallel_world_size()
-    except Exception:
+    except Exception:  # lint: allow-silent(no fleet topology; global-stream defaults apply)
         pass
     # reference offset formula (mpu/random.py model_parallel_random_seed):
     # the +1 keeps the mp stream distinct from the global stream even at

@@ -252,7 +252,7 @@ def launch(argv):
                     with open(path, "w") as f:
                         _json.dump(tele_agg.merged_snapshot(), f, indent=1,
                                    default=str)
-                except Exception:
+                except Exception:  # lint: allow-silent(final snapshot dump is best-effort at teardown)
                     pass
                 tele_agg.stop()
                 tele_store.close()

@@ -53,8 +53,8 @@ def init_parallel_env(strategy: DistributedStrategy | None = None):
         if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
             try:
                 jax.config.update("jax_cpu_collectives_implementation", "gloo")
-            except Exception:
-                pass  # older jax without the knob: mpi/none fallback
+            except Exception:  # lint: allow-silent(older jax without the knob; mpi/none fallback)
+                pass
         try:
             jax.distributed.initialize(
                 coordinator_address=coord,

@@ -13,12 +13,14 @@ as paddle_tpu.distributed.rpc.
 from __future__ import annotations
 
 import pickle
+import queue
 import socket
 import threading
 
 import numpy as np
 
 from .rpc import _recv_msg, _send_msg
+from ..analysis import locksan
 
 __all__ = ["ParameterServer", "PSClient", "GeoCommunicator"]
 
@@ -149,7 +151,7 @@ class _SSDSparseTable(_SparseTable):
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except Exception:  # lint: allow-silent(interpreter-teardown close; nothing to report to)
             pass
 
 
@@ -158,7 +160,7 @@ class ParameterServer:
 
     def __init__(self, port=0):
         self._tables = {}
-        self._lock = threading.Lock()
+        self._lock = locksan.Lock("ps.server")
         self._barrier_count = 0
         self._barrier_gen = 0
         self._cv = threading.Condition(self._lock)
@@ -167,7 +169,8 @@ class ParameterServer:
         self._listener.bind(("0.0.0.0", int(port)))
         self._listener.listen(64)
         self.port = self._listener.getsockname()[1]
-        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread = threading.Thread(target=self._serve, daemon=True,
+                                        name=f"ps-server:{self.port}")
         self._thread.start()
 
     # -- table management (server-side API) ------------------------------
@@ -198,7 +201,7 @@ class ParameterServer:
             except OSError:
                 return
             threading.Thread(target=self._handle, args=(conn,),
-                             daemon=True).start()
+                             daemon=True, name="ps-conn").start()
 
     def _handle(self, conn):
         with conn:
@@ -208,7 +211,8 @@ class ParameterServer:
                     out = self._dispatch(req)
                     try:
                         payload = pickle.dumps(out)
-                    except Exception as e:  # unpicklable error object: the
+                    except Exception as e:  # lint: allow-silent(error is pickled into the reply)
+                        # unpicklable error object: the
                         # client must still get a response on this channel
                         payload = pickle.dumps(
                             {"ok": False, "error": RuntimeError(
@@ -278,7 +282,7 @@ class ParameterServer:
                                 "ps barrier timed out (a trainer died?)")}
                 return {"ok": True}
             return {"ok": False, "error": ValueError(f"unknown op {op!r}")}
-        except Exception as e:
+        except Exception as e:  # lint: allow-silent(error object is returned to the client)
             return {"ok": False, "error": e}
 
     def stop(self):
@@ -299,7 +303,7 @@ class PSClient:
         self._addr = (host, int(port))
         self._timeout = timeout
         self._sock = socket.create_connection(self._addr, timeout=timeout)
-        self._lock = threading.Lock()
+        self._lock = locksan.Lock("ps.client")
 
     def _call(self, _sock_timeout=None, **req):
         with self._lock:
@@ -384,8 +388,6 @@ class GeoCommunicator:
     """
 
     def __init__(self, client: PSClient, geo_steps=10):
-        import queue
-
         self.client = client
         self.geo_steps = int(geo_steps)
         self._baseline: dict[str, np.ndarray] = {}
@@ -393,19 +395,20 @@ class GeoCommunicator:
         self._q: "queue.Queue" = queue.Queue()
         self._stop = threading.Event()
         self._err = None
-        self._thread = threading.Thread(target=self._drain, daemon=True)
+        self._thread = threading.Thread(target=self._drain, daemon=True,
+                                        name="geo-drain")
         self._thread.start()
 
     def _drain(self):
         while not self._stop.is_set():
             try:
                 item = self._q.get(timeout=0.1)
-            except Exception:
+            except queue.Empty:
                 continue
             try:
                 table, delta = item
                 self.client.push_dense_delta(table, delta)
-            except Exception as e:  # surfaced on the next sync
+            except Exception as e:  # lint: allow-silent(stored in _err; surfaced on the next sync)
                 self._err = e
             finally:
                 self._q.task_done()

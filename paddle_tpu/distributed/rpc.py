@@ -17,6 +17,7 @@ import struct
 import threading
 from concurrent.futures import Future
 from dataclasses import dataclass
+from ..analysis import locksan
 
 __all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
            "get_worker_info", "get_all_worker_infos", "WorkerInfo"]
@@ -32,7 +33,7 @@ class WorkerInfo:
 
 _state = {"server": None, "store": None, "workers": {}, "me": None,
           "conns": {}}  # name -> (socket, lock): persistent per-peer channel
-_conns_lock = threading.Lock()
+_conns_lock = locksan.Lock("rpc.conns")
 
 
 def _send_msg(sock, payload: bytes):
@@ -62,7 +63,8 @@ def _serve(listener):
             conn, _ = listener.accept()
         except OSError:
             return  # shutdown
-        threading.Thread(target=_handle, args=(conn,), daemon=True).start()
+        threading.Thread(target=_handle, args=(conn,), daemon=True,
+                         name="rpc-conn").start()
 
 
 def _handle(conn):
@@ -76,11 +78,12 @@ def _handle(conn):
                 fn, args, kwargs = req["fn"], req["args"], req["kwargs"]
                 try:
                     out = {"ok": True, "value": fn(*args, **kwargs)}
-                except Exception as e:  # deliver remote exceptions to caller
+                except Exception as e:  # lint: allow-silent(remote exception is delivered to the caller)
                     out = {"ok": False, "error": e}
                 try:
                     payload = pickle.dumps(out)
-                except Exception as e:  # unpicklable result/exception: the
+                except Exception as e:  # lint: allow-silent(a real error reply still reaches the caller)
+                    # unpicklable result/exception: the
                     # caller must still get a real error, not a dead socket
                     payload = pickle.dumps(
                         {"ok": False,
@@ -122,7 +125,8 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
         workers[nm] = WorkerInfo(nm, r, ip, int(p))
     _state.update(store=store, me=info, workers=workers)
     _state["server"] = listener
-    threading.Thread(target=_serve, args=(listener,), daemon=True).start()
+    threading.Thread(target=_serve, args=(listener,), daemon=True,
+                     name="rpc-server").start()
     return info
 
 
@@ -144,7 +148,7 @@ def _peer_conn(to, timeout):
         if entry is None:
             w = _state["workers"][to]
             s = socket.create_connection((w.ip, w.port), timeout=timeout)
-            entry = (s, threading.Lock())
+            entry = (s, locksan.Lock("rpc.conn"))
             _state["conns"][to] = entry
     return entry
 
@@ -211,7 +215,7 @@ def rpc_async(to, fn, args=None, kwargs=None, timeout=60.0):
         except Exception as e:
             fut.set_exception(e)
 
-    threading.Thread(target=run, daemon=True).start()
+    threading.Thread(target=run, daemon=True, name="rpc-async").start()
     fut.wait = fut.result  # reference API spells it .wait()
     return fut
 
@@ -232,8 +236,8 @@ def shutdown():
         store.barrier("rpc/shutdown", n, timeout=60.0)
         acks = store.add("rpc/shutdown_acks", 1)
         if me is not None and me.rank == 0:
-            deadline = time.time() + 30.0
-            while acks < n and time.time() < deadline:
+            deadline = time.monotonic() + 30.0
+            while acks < n and time.monotonic() < deadline:
                 time.sleep(0.05)
                 acks = store.add("rpc/shutdown_acks", 0)
     finally:

@@ -29,6 +29,7 @@ import time
 from .. import telemetry
 from ..core import native
 from ..utils import faults
+from ..analysis import locksan
 
 __all__ = ["TCPStore", "StoreTimeout", "StoreCorruptValue"]
 
@@ -100,7 +101,7 @@ class TCPStore:
         # ctypes releases the GIL: one in-flight request per connection, or
         # interleaved partial writes corrupt the wire protocol (heartbeat
         # threads share the store with the main thread)
-        self._io_lock = threading.Lock()
+        self._io_lock = locksan.Lock("tcp_store.io")
 
     # -- retry machinery ---------------------------------------------------
     def _connect_with_retry(self, timeout: float) -> int:
@@ -286,5 +287,5 @@ class TCPStore:
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except Exception:  # lint: allow-silent(interpreter-teardown close; nothing to report to)
             pass

@@ -102,6 +102,12 @@ register_flag("FLAGS_fault_plan", "",
               "chaos harness: ';'-separated fault specs "
               "(site:kind[=arg][@start][xcount][%prob]) armed at every "
               "paddle_tpu.utils.faults.inject site — see docs/ROBUSTNESS.md")
+register_flag("FLAGS_locksan", False,
+              "arm the LockSan runtime lock-order sanitizer "
+              "(paddle_tpu.analysis.locksan): instrumented locks record "
+              "acquisition order and blocking-calls-under-lock — set at "
+              "process start so module-level locks are created armed; see "
+              "docs/ANALYSIS.md")
 register_flag("FLAGS_collective_timeout_s", 0.0,
               "when > 0, every eager collective runs under a watchdog that "
               "raises CollectiveTimeoutError naming the op/group/rank if the "

@@ -87,7 +87,7 @@ class ProgBarLogger(Callback):
     def on_epoch_begin(self, epoch, logs=None):
         self.epoch = epoch
         self.steps = self.params.get("steps")
-        self._start = time.time()
+        self._start = time.monotonic()
         if self.verbose and self.epochs:
             print(f"Epoch {epoch + 1}/{self.epochs}")
 
@@ -107,7 +107,7 @@ class ProgBarLogger(Callback):
 
     def on_epoch_end(self, epoch, logs=None):
         if self.verbose:
-            dt = time.time() - self._start
+            dt = time.monotonic() - self._start
             print(f"Epoch {epoch + 1}: {self._fmt(logs)} ({dt:.1f}s)")
 
     def on_eval_end(self, logs=None):

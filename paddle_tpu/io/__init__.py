@@ -460,7 +460,8 @@ class DataLoader:
                     except queue.Full:
                         continue
 
-        t = threading.Thread(target=producer, daemon=True)
+        t = threading.Thread(target=producer, daemon=True,
+                             name="dataloader-producer")
         t.start()
         try:
             while True:

@@ -839,7 +839,9 @@ def flash_attention_pallas(q, k, v, attn_mask=None, dropout_p=0.0,
         return x.transpose(0, 2, 1, 3).reshape(B * Hq, x.shape[1], D)
 
     out, _ = _flash_core(to_bhsd(q), to_bhsd(k), to_bhsd(v), None, None,
-                         mask, seed, is_causal, sm_scale, float(dropout_p),
+                         mask, seed, is_causal, sm_scale,
+                         # lint: allow-host-sync(dropout_p is a Python scalar at trace time)
+                         float(dropout_p),
                          Hq, mask_mode)
     return out.reshape(B, Hq, Sq, D).transpose(0, 2, 1, 3)
 
@@ -910,5 +912,7 @@ def flash_attn_varlen_pallas(q, k, v, cu_seqlens_q, cu_seqlens_k,
     seed = _dropout_seed(fixed_seed) if dropout_p > 0 else None
     out, _ = _flash_core(to_hsd(q, Pq, Tq), to_hsd(k, Pk, Tk),
                          to_hsd(v, Pk, Tk), qseg, kseg, None, seed,
-                         causal, sm_scale, float(dropout_p), Hq)
+                         causal, sm_scale,
+                         # lint: allow-host-sync(dropout_p is a Python scalar at trace time)
+                         float(dropout_p), Hq)
     return out.transpose(1, 0, 2)[:Tq]

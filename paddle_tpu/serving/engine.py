@@ -565,7 +565,7 @@ class LLMEngine:
                 self._suspend_trace_counts = True
                 est = telemetry.cost.estimate_fn_cost(
                     self._py_fns[py_key], *call_args)
-            except Exception:
+            except Exception:  # lint: allow-silent(cost estimate is advisory; absence skips one log line)
                 est = None
             finally:
                 self._suspend_trace_counts = False
@@ -994,6 +994,7 @@ class LLMEngine:
         def decode(params, buffers, pool, tokens, bt, ctx,
                    temps, top_ks, top_ps, seeds, step_idx):
             if not self._suspend_trace_counts:
+                # lint: allow-tracer-leak(trace-time compile counter, runs once per trace)
                 self.decode_traces += 1
             view = PagedCacheView(pool, bt, ctx, self.block_size)
             logits, _ = functional_call(

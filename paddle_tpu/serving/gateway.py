@@ -72,6 +72,7 @@ from ..telemetry import reqtrace
 from ..utils import faults
 from .journal import Journal, JournalError
 from .router import NoHealthyReplica, RouterShed
+from ..analysis import locksan
 
 __all__ = ["Gateway"]
 
@@ -105,6 +106,10 @@ def _gateway_metrics() -> SimpleNamespace:
         idem_hits=reg.counter(
             "gateway_idempotent_hits_total",
             "requests deduplicated by Idempotency-Key", ("outcome",)),
+        conn_errors=reg.counter(
+            "gateway_conn_errors_total",
+            "connections dropped by an unexpected error in the serve loop "
+            "(client vanished mid-request, protocol desync)"),
     )
 
 
@@ -228,7 +233,7 @@ class Gateway:
         self._recover_on_start = bool(recover)
         self.recovery_report: dict | None = None
         self._m = _gateway_metrics()
-        self._slock = threading.Lock()
+        self._slock = locksan.Lock("gateway.streams")
         self._streams: dict[str, _Stream] = {}    # jid AND rid -> stream
         self._stream_order: list[str] = []        # jids, acceptance order
         self._idem: dict[str, str] = {}           # idempotency key -> jid
@@ -367,12 +372,18 @@ class Gateway:
     # -- router callbacks (replica reader threads) -------------------------
     def _stream_cbs(self, st: _Stream):
         def push(subs, item):
-            for loop, q in subs:
-                try:
-                    loop.call_soon_threadsafe(q.put_nowait, item)
-                except RuntimeError:
-                    pass     # loop gone (gateway stopped/crashed): the
-                             # subscriber is dead, the stream lives on
+            # router callbacks may arrive with router.state held (terminal
+            # _finish fan-out): the loop wakeup is a self-pipe write to a
+            # non-blocking socketpair, so holding a lock across it is safe
+            with locksan.allow_blocking(
+                    "asyncio call_soon_threadsafe self-pipe wakeup: "
+                    "non-blocking socketpair write, never blocks"):
+                for loop, q in subs:
+                    try:
+                        loop.call_soon_threadsafe(q.put_nowait, item)
+                    except RuntimeError:
+                        pass  # loop gone (gateway stopped/crashed): the
+                              # subscriber is dead, the stream lives on
 
         def on_token(rr, tok):
             with self._slock:
@@ -448,6 +459,7 @@ class Gateway:
         on_token, on_wm, on_fin = self._stream_cbs(st)
         try:
             if self.journal is not None:
+                # lint: allow-wallclock(deadline_unix is journaled and must survive process restarts)
                 deadline_unix = (time.time() + p["deadline_s"]
                                  if p["deadline_s"] is not None else None)
                 self.journal.accept(
@@ -478,12 +490,15 @@ class Gateway:
                 if idem and self._idem.get(idem) == jid:
                     del self._idem[idem]
             st.done.set()
-            for loop, q in subs:
-                try:
-                    loop.call_soon_threadsafe(q.put_nowait,
-                                              ("done", None, None))
-                except RuntimeError:
-                    pass
+            with locksan.allow_blocking(
+                    "asyncio call_soon_threadsafe self-pipe wakeup: "
+                    "non-blocking socketpair write, never blocks"):
+                for loop, q in subs:
+                    try:
+                        loop.call_soon_threadsafe(q.put_nowait,
+                                                  ("done", None, None))
+                    except RuntimeError:
+                        pass
             if journaled:
                 try:
                     self.journal.end(jid, state="rejected",
@@ -539,6 +554,7 @@ class Gateway:
             jid = e["jid"]
             remaining = None
             if a.get("deadline_unix") is not None:
+                # lint: allow-wallclock(deadline_unix in the journal is a wall stamp by design)
                 remaining = float(a["deadline_unix"]) - time.time()
                 if remaining <= 0:
                     # the deadline passed while no gateway was alive:
@@ -628,12 +644,14 @@ class Gateway:
                 if not keep:
                     break
         except Exception:
-            pass
+            # client vanished mid-request or the stream desynced: drop the
+            # connection, but never invisibly
+            self._m.conn_errors.inc()
         finally:
             try:
                 writer.close()
                 await writer.wait_closed()
-            except Exception:
+            except Exception:  # lint: allow-silent(socket teardown; peer may already be gone)
                 pass
 
     async def _read_request(self, reader):

@@ -52,7 +52,7 @@ def main() -> int:
                               spec["jax_cache_dir"])
             jax.config.update(
                 "jax_persistent_cache_min_compile_time_secs", 0.5)
-        except Exception:
+        except Exception:  # lint: allow-silent(persistent compile cache is optional; worker runs without it)
             pass
     from .engine import LLMEngine
     from .gateway import Gateway

@@ -60,6 +60,7 @@ from types import SimpleNamespace
 
 from .. import telemetry
 from ..utils import faults
+from ..analysis import locksan
 
 __all__ = ["Journal", "JournalError", "JournalTornWrite", "scan_dir"]
 
@@ -263,7 +264,7 @@ class Journal:
         self.compact_segments = int(compact_segments)
         self.retain_terminal = int(retain_terminal)
         self._m = _metrics()
-        self._lock = threading.Lock()
+        self._lock = locksan.Lock("journal.state")
         os.makedirs(root, exist_ok=True)
         self.recovered = scan_dir(root)
         self._state = self.recovered      # keeps absorbing live appends
@@ -326,7 +327,10 @@ class Journal:
                     # trip over.
                     self._f.write(frame[:max(1, len(frame) // 2)])
                     self._f.flush()
-                    os.fsync(self._f.fileno())
+                    with locksan.allow_blocking(
+                            "durability barrier: the torn half-frame must "
+                            "really reach disk for recovery to trip over"):
+                        os.fsync(self._f.fileno())
                     self._needs_resync = True
                     raise JournalTornWrite(
                         f"simulated torn write of {rec.get('t')!r} record")
@@ -355,7 +359,13 @@ class Journal:
             return
         faults.inject("gateway.journal.fsync")
         try:
-            os.fsync(self._f.fileno())
+            # fsync under the journal lock is the durability contract:
+            # an append must not be acknowledged (or reordered past a
+            # later append) before its frame is on disk
+            with locksan.allow_blocking(
+                    "durability barrier: appends serialize with their "
+                    "fsync by design"):
+                os.fsync(self._f.fileno())
         except OSError:
             pass                          # never turn a sync hiccup fatal
         self._last_fsync = now

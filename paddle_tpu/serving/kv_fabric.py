@@ -55,6 +55,7 @@ import zlib
 from .. import telemetry
 from ..distributed.tcp_store import StoreCorruptValue
 from .kv_cache import _SpillEntry
+from ..analysis import locksan
 
 __all__ = [
     "FRAME_VERSION", "DIR_PREFIX", "MemStore", "FrameError", "FrameCorrupt",
@@ -316,7 +317,7 @@ class MemStore:
 
     def __init__(self):
         self._kv: dict[str, bytes] = {}
-        self._lock = threading.Lock()
+        self._lock = locksan.Lock("kv_fabric.memstore")
 
     def set(self, key: str, value) -> None:
         v = value if isinstance(value, bytes) else str(value).encode()
@@ -450,7 +451,7 @@ class DirectoryPublisher:
         if self.counters_fn is not None:
             try:
                 doc["counters"] = self.counters_fn()
-            except Exception:
+            except Exception:  # lint: allow-silent(operator counters_fn is advisory; doc publishes without it)
                 pass
         return doc
 
@@ -512,7 +513,7 @@ class DirectoryPublisher:
             self.store.set_json(_dir_key(self.rid), self._doc(
                 [], [], time.time(), 0.0))
             _fabric_metrics().unpublishes.inc()
-        except Exception:
+        except Exception:  # lint: allow-silent(best-effort unpublish at teardown; lease expiry fences the doc anyway)
             pass
 
 
@@ -529,7 +530,7 @@ class KVDirectory:
         self._docs: dict[str, tuple[float, dict | None]] = {}
         self._epoch_seen: dict[str, float] = {}
         self._sets: dict[str, set] = {}       # rid -> published hash set
-        self._lock = threading.Lock()
+        self._lock = locksan.Lock("kv_fabric.directory")
         self.corrupt_docs = 0
         self.fenced_docs = 0
 
@@ -563,6 +564,7 @@ class KVDirectory:
                 # zombie incarnation still writing under a newer one
                 self.fenced_docs += 1
                 fm.dir_fenced.inc()
+            # lint: allow-wallclock(lease_until is a cross-process wall stamp in the store)
             elif float(raw.get("lease_until") or 0.0) < time.time():
                 # SIGKILL'd/restarted publisher: the lease ran out
                 self.fenced_docs += 1
@@ -587,6 +589,8 @@ class KVDirectory:
             _fabric_metrics().dir_corrupt.inc()
             return []
         except Exception:
+            self.corrupt_docs += 1
+            _fabric_metrics().dir_corrupt.inc()
             return []
         return [str(x) for x in r] if isinstance(r, list) else []
 

@@ -66,6 +66,7 @@ import queue
 import sys
 import threading
 import time
+from ..analysis import locksan
 
 
 def build_model(spec: dict):
@@ -99,7 +100,7 @@ def main() -> int:
                               spec["jax_cache_dir"])
             jax.config.update(
                 "jax_persistent_cache_min_compile_time_secs", 0.5)
-        except Exception:
+        except Exception:  # lint: allow-silent(persistent compile cache is optional; worker runs without it)
             pass
     from ..telemetry import reqtrace
     from . import kv_fabric
@@ -140,7 +141,7 @@ def main() -> int:
         engine.generate([list(warmup)],
                         SamplingParams(max_new_tokens=2, temperature=0.0))
 
-    out_lock = threading.Lock()
+    out_lock = locksan.Lock("replica_worker.stdout")
 
     def emit(ev: dict):
         with out_lock:
@@ -161,7 +162,8 @@ def main() -> int:
                       file=sys.stderr)
         cmds.put({"op": "close"})          # router hung up
 
-    threading.Thread(target=read_stdin, daemon=True).start()
+    threading.Thread(target=read_stdin, daemon=True,
+                     name="replica-stdin-reader").start()
     emit({"ev": "hello", "pid": os.getpid()})
 
     tracked: dict[int, object] = {}        # gid -> engine Request
@@ -198,8 +200,8 @@ def main() -> int:
         if publisher is not None:
             try:
                 publisher.maybe_publish()
-            except Exception:
-                pass                       # advisory: never kill the beat
+            except Exception:  # lint: allow-silent(advisory publish; never kill the beat)
+                pass
 
     last_pub = 0.0
     closing = False
@@ -245,7 +247,7 @@ def main() -> int:
             elif op == "kv_ingest":
                 try:
                     rep = engine.ingest_kv_frames(cmd.get("frames") or [])
-                except Exception as e:
+                except Exception as e:  # lint: allow-silent(error is captured into the kv_ingested reply)
                     rep = {"ingested": 0, "corrupt": 0, "errors": 1,
                            "error": f"{type(e).__name__}: {e}"}
                 emit({"ev": "kv_ingested", **rep})

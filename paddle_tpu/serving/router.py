@@ -95,6 +95,7 @@ from .. import telemetry
 from ..telemetry import reqtrace
 from ..utils import faults
 from .scheduler import SamplingParams
+from ..analysis import locksan
 
 __all__ = [
     "FleetRouter", "RouterRequest", "ReplicaState", "LocalReplica",
@@ -331,7 +332,7 @@ def replica_stats(engine) -> dict:
 # building concurrently would interleave draws and end up with different
 # weights — silently breaking failover replay parity (ProcReplica is
 # immune: each child process owns its RNG).
-_BUILD_LOCK = threading.Lock()
+_BUILD_LOCK = locksan.Lock("router.build")
 
 
 class LocalReplica:
@@ -467,8 +468,8 @@ class LocalReplica:
             if publisher is not None and not self._killed:
                 try:
                     publisher.maybe_publish()
-                except Exception:
-                    pass                  # advisory: never kill the beat
+                except Exception:  # lint: allow-silent(advisory publish; never kill the beat)
+                    pass
 
         def on_token(gid):
             def cb(req, tok):
@@ -524,7 +525,7 @@ class LocalReplica:
                     try:
                         rep = engine.ingest_kv_frames(
                             cmd.get("frames") or [])
-                    except Exception as e:
+                    except Exception as e:  # lint: allow-silent(error is captured into the kv_ingested reply)
                         rep = {"ingested": 0, "corrupt": 0, "errors": 1,
                                "error": f"{type(e).__name__}: {e}"}
                     self._emit(gen, {"ev": "kv_ingested", **rep})
@@ -588,7 +589,7 @@ class ProcReplica:
         self._on_event = None
         self._gen = 0
         self._stopping = False
-        self._wlock = threading.Lock()
+        self._wlock = locksan.Lock("replica.pipe_write")
 
     def start(self, on_event):
         self._on_event = on_event
@@ -838,7 +839,7 @@ class FleetRouter:
         self.auto_restart = bool(auto_restart)
         self.verify_replay = bool(verify_replay)
         self._rng = random.Random(rng_seed)
-        self._lock = threading.RLock()
+        self._lock = locksan.RLock("router.state")
         self._gids = itertools.count()
         self._requests: dict[int, RouterRequest] = {}
         # terminal handles are kept for introspection but bounded — a
@@ -884,7 +885,7 @@ class FleetRouter:
                 telemetry.record_event(
                     "router.fabric_disabled",
                     error=f"{type(e).__name__}: {e}")
-        self._fetch_lock = threading.Lock()
+        self._fetch_lock = locksan.Lock("router.pending_fetch")
         self._fetch_ids = itertools.count()
         self._fetches: dict[int, dict] = {}     # fid -> pending fetch
         self._fetch_log: list[float] = []       # migration budget window
@@ -1719,7 +1720,7 @@ class FleetRouter:
     def _do_restart(self, rep):
         try:
             rep.stop(graceful=False, timeout=2.0)
-        except Exception:
+        except Exception:  # lint: allow-silent(force-restart; the old proc may already be dead)
             pass
         rep.stats = {}
         rep.last_heartbeat = 0.0

@@ -144,7 +144,7 @@ def stack_snapshot() -> dict:
             label = f"{names.get(ident, 'thread')}-{ident}"
             out[label] = [ln.rstrip("\n")
                           for ln in traceback.format_stack(frame)]
-    except Exception:
+    except Exception:  # lint: allow-silent(stack snapshot never raises; partial dump beats none)
         pass
     return out
 
@@ -231,8 +231,8 @@ class ClockResponder:
             while not self._stop.wait(self.poll_s):
                 try:
                     self.serve_once()
-                except Exception:
-                    pass   # transient store error: retry next tick
+                except Exception:  # lint: allow-silent(transient store error; retry next tick)
+                    pass
         self._thread = threading.Thread(target=run, daemon=True,
                                         name="cluster-clock-responder")
         self._thread.start()
@@ -331,8 +331,8 @@ class RankPublisher:
                 self.clock_estimate = estimate_clock_offset(
                     self.store, self.rank, probes=self.clock_probes,
                     clock=self._clock)
-            except Exception:
-                self.clock_estimate = None   # no responder: offsets unknown
+            except Exception:  # lint: allow-silent(no clock responder; offsets recorded as unknown)
+                self.clock_estimate = None
         self.publish_once()
         install(self)
 
@@ -467,7 +467,7 @@ def trigger_postmortem(reason: str) -> str | None:
         return None
     try:
         return p.trigger_postmortem(reason)
-    except Exception:
+    except Exception:  # lint: allow-silent(best-effort postmortem; None = no publisher installed)
         return None
 
 
@@ -539,7 +539,7 @@ class ClusterMonitor:
     def _read(self, rank: int, leaf: str):
         try:
             return _get_json(self.store, _k(rank, leaf))
-        except Exception:
+        except Exception:  # lint: allow-silent(unreachable rank reads as absent; staleness is surfaced upstream)
             return None
 
     def offset(self, rank: int) -> float:
@@ -820,7 +820,7 @@ class ClusterAggregator:
                                 if r not in payloads],
                 }, f, indent=1)
             return bundle
-        except Exception:
+        except Exception:  # lint: allow-silent(aggregation is best-effort; None = bundle unavailable)
             return None
 
 
@@ -950,6 +950,7 @@ def demo_worker():  # pragma: no cover - subprocess entry, tested end-to-end
     scen = os.environ.get("DEMO_SCENARIO", "demo")
     skew = float(os.environ.get("DEMO_CLOCK_SKEW", "0") or 0)
     trace_out = os.environ.get("DEMO_TRACE_OUT")
+    # lint: allow-wallclock(demo deliberately skews the published wall clock)
     clock = (lambda: time.time() + skew) if skew else time.time
 
     store_main = TCPStore(host or "127.0.0.1", int(port))

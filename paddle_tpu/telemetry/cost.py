@@ -43,6 +43,7 @@ import threading
 import numpy as np
 
 from .metrics import registry
+from ..analysis import locksan
 
 __all__ = [
     "jaxpr_cost", "estimate_fn_cost", "xla_cost_analysis",
@@ -70,14 +71,14 @@ def _aval_elems(aval) -> int:
         for s in aval.shape:
             n *= int(s)
         return n
-    except Exception:
+    except Exception:  # lint: allow-silent(cost model is advisory; unknown aval counts as 0)
         return 0
 
 
 def _aval_bytes(aval) -> int:
     try:
         return _aval_elems(aval) * np.dtype(aval.dtype).itemsize
-    except Exception:
+    except Exception:  # lint: allow-silent(cost model is advisory; unknown dtype counts as 0)
         return 0
 
 
@@ -196,7 +197,7 @@ def xla_cost_analysis(fn, *args, **kwargs) -> dict | None:
         if isinstance(ca, (list, tuple)):      # older jax: one per device
             ca = ca[0] if ca else None
         return dict(ca) if ca else None
-    except Exception:
+    except Exception:  # lint: allow-silent(xla cost analysis is version-dependent; None = unavailable)
         return None
 
 
@@ -204,7 +205,7 @@ def xla_cost_analysis(fn, *args, **kwargs) -> dict | None:
 # trace-cost registry (per callable+bucket, fingerprinted)
 # ---------------------------------------------------------------------------
 
-_LOCK = threading.Lock()
+_LOCK = locksan.Lock("cost.registry")
 _TRACES: dict[tuple, dict] = {}     # (callable, bucket) -> entry
 _CM = None
 
@@ -287,7 +288,7 @@ def platform_peaks(platform: str | None = None) -> dict:
             import jax
 
             platform = jax.devices()[0].platform
-        except Exception:
+        except Exception:  # lint: allow-silent(no devices; cpu peaks are the fallback)
             platform = "cpu"
     flops, bw = _PEAKS.get(platform, _PEAKS["cpu"])
     try:

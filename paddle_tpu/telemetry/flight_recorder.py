@@ -35,6 +35,7 @@ import time
 from collections import deque
 
 from .metrics import ENABLED
+from ..analysis import locksan
 
 __all__ = ["FlightRecorder", "flight", "record_event", "dump",
            "install_excepthook"]
@@ -46,7 +47,7 @@ class FlightRecorder:
     def __init__(self, capacity: int = 4096):
         self.capacity = int(capacity)
         self._buf: deque[dict] = deque(maxlen=self.capacity)
-        self._lock = threading.Lock()
+        self._lock = locksan.Lock("flight.ring")
         self._seq = 0
         self.num_dumps = 0
         self.last_dump_path: str | None = None
@@ -116,7 +117,7 @@ class FlightRecorder:
             self.num_dumps += 1
             self.last_dump_path = path
             return path
-        except Exception:
+        except Exception:  # lint: allow-silent(dump is best-effort; None tells the caller it failed)
             return None
 
 

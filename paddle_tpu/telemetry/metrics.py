@@ -29,6 +29,7 @@ import json
 import os
 import threading
 import time
+from ..analysis import locksan
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
@@ -85,7 +86,7 @@ class _Child:
     __slots__ = ("_lock",)
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = locksan.Lock("metrics.child")
 
 
 class CounterChild(_Child):
@@ -186,7 +187,7 @@ class _Metric:
         self.label_names = tuple(label_names)
         self._opts = opts
         self._children: dict[tuple, _Child] = {}
-        self._lock = threading.Lock()
+        self._lock = locksan.Lock("metrics.family")
         if not self.label_names:
             self._default = self._make_child()
             self._children[()] = self._default
@@ -271,7 +272,7 @@ class MetricsRegistry:
 
     def __init__(self):
         self._metrics: dict[str, _Metric] = {}
-        self._lock = threading.Lock()
+        self._lock = locksan.Lock("metrics.registry")
 
     def _get_or_create(self, kind, name, help, label_names, **opts):
         with self._lock:

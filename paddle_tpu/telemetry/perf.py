@@ -55,6 +55,7 @@ from types import SimpleNamespace
 
 from .flight_recorder import record_event
 from .metrics import ENABLED, registry
+from ..analysis import locksan
 
 __all__ = [
     "CompileWatcher", "MemoryMonitor", "StepTimeline",
@@ -194,7 +195,7 @@ class CompileWatcher:
             storm_window_s if storm_window_s is not None
             else os.environ.get("PADDLE_TPU_STORM_WINDOW_S", 60.0))
         self.max_signatures = int(max_signatures)
-        self._lock = threading.Lock()
+        self._lock = locksan.Lock("perf.compile_watcher")
         # name -> OrderedDict[signature -> hit count] (insertion-ordered:
         # the last two keys are the last two distinct signatures)
         self._sigs: dict[str, OrderedDict] = {}
@@ -377,7 +378,7 @@ class MemoryMonitor:
     """
 
     def __init__(self, timeline_cap: int = 1024, leak_window: int = 8):
-        self._lock = threading.Lock()
+        self._lock = locksan.Lock("perf.memory_monitor")
         self._live: dict[str, float] = {}
         self._peak: dict[str, float] = {}
         self._total_peak = 0.0
@@ -463,7 +464,7 @@ class MemoryMonitor:
         try:
             import jax
             return jax.local_devices()[0].memory_stats()
-        except Exception:
+        except Exception:  # lint: allow-silent(memory_stats unsupported on this backend)
             return None
 
     def snapshot(self) -> dict:
@@ -631,7 +632,7 @@ class StepTimeline:
         self.window = int(window)
         self.regress_factor = float(regress_factor)
         self.min_baseline = int(min_baseline)
-        self._lock = threading.Lock()
+        self._lock = locksan.Lock("perf.step_timeline")
         self._totals: deque = deque(maxlen=self.window)
         self._phases: dict[str, deque] = {}
         self.steps = 0
@@ -738,7 +739,7 @@ class StepTimeline:
 _WATCHER = CompileWatcher()
 _MEMORY = MemoryMonitor()
 _TIMELINES: dict[str, StepTimeline] = {}
-_TIMELINES_LOCK = threading.Lock()
+_TIMELINES_LOCK = locksan.Lock("perf.timelines")
 _MONITORING_ARMED = [False]
 
 
@@ -788,7 +789,7 @@ def arm_jax_monitoring():
             record_event("compile.backend", seconds=round(duration, 6))
 
         jmon.register_event_duration_secs_listener(_listener)
-    except Exception:
+    except Exception:  # lint: allow-silent(older jax without the monitoring listener API)
         pass
 
 
@@ -821,7 +822,7 @@ def run_meta() -> dict:
         import jax
         meta["jax_version"] = jax.__version__
         meta["platform"] = jax.devices()[0].platform
-    except Exception:
+    except Exception:  # lint: allow-silent(absence is recorded as None in the report)
         meta["jax_version"] = meta["platform"] = None
     try:
         repo = os.path.dirname(os.path.dirname(os.path.dirname(
@@ -829,7 +830,7 @@ def run_meta() -> dict:
         meta["git_sha"] = subprocess.run(
             ["git", "rev-parse", "--short", "HEAD"], cwd=repo, timeout=5,
             capture_output=True, text=True).stdout.strip() or None
-    except Exception:
+    except Exception:  # lint: allow-silent(absence is recorded as None in the report)
         meta["git_sha"] = None
     return meta
 

@@ -33,6 +33,7 @@ import time
 from collections import deque
 
 from .metrics import ENABLED, registry
+from ..analysis import locksan
 
 __all__ = ["SLOTracker"]
 
@@ -106,7 +107,7 @@ class SLOTracker:
         # violation); trace_id is the request-trace exemplar the summary's
         # p99s link back to (telemetry.reqtrace)
         self._win: deque[tuple] = deque(maxlen=int(max_samples))
-        self._lock = threading.Lock()
+        self._lock = locksan.Lock("slo.tracker")
         # external pressure overlay (e.g. the scheduler's KV-pool
         # watermark latch): while set, the shed verdict is forced
         # regardless of latency percentiles or min_samples — a pool out

@@ -28,6 +28,7 @@ import threading
 import time
 
 from .metrics import ENABLED
+from ..analysis import locksan
 
 __all__ = ["Span", "Tracer", "tracer", "span", "trace_id", "epoch_unix",
            "mono_to_unix", "set_device_trace_active", "device_trace_active"]
@@ -49,6 +50,7 @@ def epoch_unix() -> float:
     module-load monotonic epoch). Cross-rank trace merge
     (:func:`telemetry.cluster.merge_traces`) uses this plus a per-rank
     clock offset to place every rank's events on one shared timeline."""
+    # lint: allow-wallclock(this IS the wall<->mono offset computation)
     return time.time() - (time.monotonic() - _EPOCH)
 
 
@@ -107,7 +109,7 @@ class Tracer:
     def __init__(self, capacity: int = 65536):
         self.capacity = int(capacity)
         self._spans: list[Span] = []
-        self._lock = threading.Lock()
+        self._lock = locksan.Lock("tracing.ring")
         self.dropped = 0
 
     # -- recording -------------------------------------------------------
@@ -219,8 +221,8 @@ class _SpanCtx:
 
                 self._ann = jax.profiler.TraceAnnotation(self.name)
                 self._ann.__enter__()
-            except Exception:
-                self._ann = None   # never let telemetry break the caller
+            except Exception:  # lint: allow-silent(never let telemetry break the caller)
+                self._ann = None
         self.t0 = time.monotonic()
         return self
 

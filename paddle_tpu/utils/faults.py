@@ -141,6 +141,7 @@ import threading
 import time
 import zlib
 from dataclasses import dataclass, field
+from ..analysis import locksan
 
 __all__ = ["FaultError", "FaultSpec", "FaultPlan", "inject", "activate",
            "deactivate", "active_plan", "site_matches"]
@@ -251,7 +252,7 @@ class FaultPlan:
         self.seed = int(seed)
         self.calls: dict[str, int] = {}      # site -> total inject() calls
         self.fired: list[_Firing] = []
-        self._lock = threading.Lock()
+        self._lock = locksan.Lock("faults.plan")
 
     # -- construction ------------------------------------------------------
     @classmethod

@@ -435,7 +435,7 @@ class TestChaosScenarioSelection:
         assert "baseline_spill" in names and "spill_storm" in names
         assert set(chaos_run.SUITE_SCENARIOS) == {
             "serving", "prefix", "spill", "perf", "serve-fleet",
-            "durable", "train", "straggler", "kvfabric"}
+            "durable", "train", "straggler", "kvfabric", "locksan"}
 
     def test_function_scenario_filtering(self):
         from tools import chaos_run

@@ -1,7 +1,7 @@
 """Chaos sweep: drive the runtime through batteries of deterministic fault
 plans and report survival / degradation stats per plan.
 
-Nine suites:
+Ten suites:
 
 ``--suite serving`` (default) — the continuous-batching engine under fault
 plans. For every plan the same request fleet runs on a fresh engine; the
@@ -95,6 +95,20 @@ pending fetch fails fast and the dead donor's lease ages its entry out;
 verified chain prefix is kept, zero wrong tokens; (4) a hot-prefix fetch
 storm stays inside the migration budget with the retry budget untouched.
 
+``--suite locksan`` — the runtime lock-order sanitizer
+(docs/ANALYSIS.md): LockSan armed over real multi-threaded fleet
+surfaces, in-process so every lock acquisition is observed. (1)
+``fleet_under_load`` — journal appends (``fsync='always'``, crossing
+the annotated durability-barrier waiver on every record) + directory
+publish/lookup/snapshot from six named threads, **zero violations**
+required; (2) ``telemetry_threads`` — a fresh metrics registry and
+flight-recorder ring under concurrent inc/observe/record/dump traffic,
+zero violations; (3) ``inversion_canary`` — a deliberate A→B/B→A
+inversion across two named threads plus a ``time.sleep`` under a lock,
+which LockSan **must report** (both thread names in the inversion's
+edges) — proves the detector in this battery is live, not vacuously
+quiet.
+
 ``--suite straggler`` — the cluster observability plane
 (docs/OBSERVABILITY.md "Cluster observability"): a 4-rank job over a real
 TCPStore where one rank carries a ``collective:delay`` fault plan.
@@ -109,7 +123,7 @@ recorder + stack snapshot.
 Usage:
     python tools/chaos_run.py
         [--suite serving|prefix|spill|train|straggler|perf|serve-fleet|
-                 durable|kvfabric]
+                 durable|kvfabric|locksan]
         [--requests 6] [--prompt-len 24] [--max-new 16]
         [--slots 3] [--block-size 8] [--plan NAME:SPEC ...] [--json OUT.json]
         [--list] [--scenario NAME]
@@ -885,8 +899,8 @@ def _scenario_sigkill(args, workdir, spec, max_len):
     killed = None
     try:
         clients = [_SSEClient(gateway, p, s) for p, s in zip(prompts, sps)]
-        deadline = time.time() + 300
-        while time.time() < deadline and killed is None:
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline and killed is None:
             streamed = sum(len(c.tokens) for c in clients)
             if streamed >= 3:
                 st = router.stats()
@@ -1056,8 +1070,8 @@ def _scenario_shed(args, workdir, spec, max_len):
     gateway = Gateway(router).start()
     try:
         streams = [_SSEClient(gateway, p, sp) for p in fill]
-        deadline = time.time() + 120
-        while time.time() < deadline:           # both streams in flight
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:           # both streams in flight
             st = router.stats()
             if all(v["inflight"] >= 1 for v in st["replicas"].values()):
                 break
@@ -1111,8 +1125,8 @@ def _scenario_drain_restart(args, workdir, spec, max_len):
     try:
         clients = [_SSEClient(gateway, p, sp) for p in prompts]
         target = None
-        deadline = time.time() + 300
-        while time.time() < deadline and target is None:
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline and target is None:
             st = router.stats()
             for rid, v in st["replicas"].items():
                 if v["inflight"] > 0:
@@ -1122,8 +1136,8 @@ def _scenario_drain_restart(args, workdir, spec, max_len):
         report = router.drain_and_restart(target, budget_s=600.0)
         for c in clients:
             c.join(600)
-        t0 = time.time()
-        while time.time() - t0 < 300 and \
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 300 and \
                 router.replicas[target].state.value != "healthy":
             time.sleep(0.05)
         extra = _SSEClient(gateway, prompts[0], sp)
@@ -1238,8 +1252,8 @@ def _spawn_gateway_worker(gspec, workdir, *, tag, fault_plan=None):
 
 
 def _wait_gateway_ready(ready_file, proc, timeout=600):
-    deadline = time.time() + timeout
-    while time.time() < deadline:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
         if proc.poll() is not None:
             raise RuntimeError(
                 f"gateway worker exited rc={proc.returncode} before ready")
@@ -1351,8 +1365,8 @@ def _scenario_gateway_sigkill(args, workdir, spec, max_len):
         info = _wait_gateway_ready(ready, proc)
         clients = [_DurableClient(info["port"], p, s, key=f"dur-{i}")
                    for i, (p, s) in enumerate(zip(prompts, sps))]
-        deadline = time.time() + 300
-        while time.time() < deadline:
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
             if sum(len(c.tokens) for c in clients) >= 3:
                 killed_at = sum(len(c.tokens) for c in clients)
                 os.kill(proc.pid, 9)           # the real thing
@@ -1431,8 +1445,8 @@ def _scenario_torn_journal_tail(args, workdir, spec, max_len):
     try:
         with FaultPlan.parse("serving.decode:delay=0.05x*"):
             client = _DurableClient(gw.port, prompt, sp, key="torn-1")
-            deadline = time.time() + 300
-            while time.time() < deadline and len(client.tokens) < 2:
+            deadline = time.monotonic() + 300
+            while time.monotonic() < deadline and len(client.tokens) < 2:
                 time.sleep(0.02)
             gw.crash()                      # no terminal records written
             client.join(30)
@@ -1497,10 +1511,10 @@ def _scenario_breaker_trip(args, workdir, spec, max_len):
         parity = [i for i, c in enumerate(clients) if c.tokens != refs[i]]
         # the plan is exhausted (4 fires); keep offering affinity traffic
         # until the half-open probe lands and the breaker closes again
-        deadline = time.time() + 120
+        deadline = time.monotonic() + 120
         recovered = False
         extra_lost = 0
-        while time.time() < deadline and not recovered:
+        while time.monotonic() < deadline and not recovered:
             c = _SSEClient(gateway, prompts[0], sp)
             c.join(600)
             if c.status != 200 or c.error or c.tokens != refs[0]:
@@ -1546,11 +1560,11 @@ def _scenario_retry_budget_storm(args, workdir, spec, max_len):
         prompts = [[int(t) for t in rng.randint(0, args.vocab,
                                                 args.prompt_len)]
                    for _ in range(n_clients)]
-        t0 = time.time()
+        t0 = time.monotonic()
         clients = [_SSEClient(gateway, p, sp) for p in prompts]
         for c in clients:
             c.join(600)
-        wall = time.time() - t0
+        wall = time.monotonic() - t0
         st = router.stats()
         unanswered = [i for i, c in enumerate(clients)
                       if c.status is None
@@ -1715,9 +1729,9 @@ def _hang_scenario(store, workdir, world=4, steps=8, hung_rank=1,
         endpoint, world, steps, "hang", workdir,
         plans={hung_rank: f"collective:delay=120@{hang_at_step + 1}"})
     report, bundle = None, None
-    deadline = time.time() + 60.0
+    deadline = time.monotonic() + 60.0
     try:
-        while time.time() < deadline:
+        while time.monotonic() < deadline:
             report = mon.poll()
             if report["hang"]["hung"]:
                 break
@@ -1908,7 +1922,8 @@ def _kvf_wave(router, prompts, sp, timeout=600):
         except Exception as e:         # shed/no-capacity is a lost request
             errs[i] = f"{type(e).__name__}: {e}"
 
-    threads = [threading.Thread(target=one, args=(i,), daemon=True)
+    threads = [threading.Thread(target=one, args=(i,), daemon=True,
+                                name=f"kvf-wave:{i}")
                for i in range(len(prompts))]
     for t in threads:
         t.start()
@@ -2041,9 +2056,10 @@ def _kvf_donor_kill_mid_fetch(args, workdir, spec, max_len):
                 box["wall"] = time.monotonic() - t0
                 done.set()
 
-            threading.Thread(target=second, daemon=True).start()
-            deadline = time.time() + 60
-            while time.time() < deadline:
+            threading.Thread(target=second, daemon=True,
+                             name="kvf-second-admit").start()
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
                 with router._fetch_lock:
                     pending = bool(router._fetches)
                 if pending:
@@ -2211,6 +2227,248 @@ def run_kvfabric_suite(args, workdir=None, scenario=None):
     }
 
 
+# -- the locksan battery ---------------------------------------------------
+#
+# ``--suite locksan`` (docs/ANALYSIS.md): arm the runtime lock-order
+# sanitizer and drive real multi-threaded fleet surfaces in-process —
+# the components' own locks (journal.state, kv_fabric.directory,
+# metrics.*, flight.ring) are created *after* arming so every
+# acquisition is observed. Two load scenarios must come back with zero
+# violations; the inversion canary deliberately violates to prove the
+# detector is live (a sanitizer that never fires proves nothing).
+
+
+def _locksan_fleet_under_load(workdir):
+    """Journal appends + directory publish/lookup from six named threads
+    with LockSan armed: the serving tier's lock discipline under real
+    contention. The journal runs ``fsync='always'`` so every append
+    crosses its annotated durability barrier — the waiver path counts in
+    ``locksan_allowed_blocking_total`` instead of reporting."""
+    from paddle_tpu.analysis import locksan
+    from paddle_tpu.serving.journal import Journal
+    from paddle_tpu.serving.kv_fabric import (KVDirectory, MemStore,
+                                              _ROSTER_KEY, _dir_key)
+
+    locksan.reset()
+    root = os.path.join(workdir, "locksan-journal")
+    journal = Journal(root, fsync="always")
+    store = MemStore()
+    directory = KVDirectory(store)
+    rids = ["r0", "r1", "r2"]
+    store.set_json(_ROSTER_KEY, rids)
+    chain = [f"h{i:03d}" for i in range(16)]
+
+    def publish(rid, depth, epoch):
+        store.set_json(_dir_key(rid), {
+            "v": 1, "rid": rid, "epoch": epoch,
+            "published_unix": time.time(),
+            # lint: allow-wallclock(lease_until is a cross-process wall stamp in the store)
+            "lease_until": time.time() + 60.0,
+            "block_size": 8, "hashes": chain[:depth],
+            "spill_hashes": [], "truncated": False,
+        })
+
+    for i, rid in enumerate(rids):
+        publish(rid, 4 * (i + 1), 1.0)
+
+    stop = threading.Event()
+    errors = []
+
+    def appender(tag):
+        try:
+            for i in range(150):
+                journal.append({"t": "accepted", "jid": f"{tag}-{i}"})
+        except Exception as e:  # lint: allow-silent(captured into thread_errors; any entry fails the scenario)
+            errors.append(f"{tag}: {type(e).__name__}: {e}")
+
+    def looker(tag):
+        try:
+            n = 0
+            while not stop.is_set():
+                directory.lookup(chain, rids)
+                n += 1
+                if n % 7 == 0:
+                    directory.snapshot(rids)
+        except Exception as e:  # lint: allow-silent(captured into thread_errors; any entry fails the scenario)
+            errors.append(f"{tag}: {type(e).__name__}: {e}")
+
+    def publisher():
+        try:
+            epoch = 2.0
+            while not stop.is_set():
+                for i, rid in enumerate(rids):
+                    publish(rid, 4 * (i + 1), epoch)
+                epoch += 1.0
+        except Exception as e:  # lint: allow-silent(captured into thread_errors; any entry fails the scenario)
+            errors.append(f"publisher: {type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=appender, args=(f"append-{i}",),
+                                name=f"locksan-append-{i}")
+               for i in range(2)]
+    threads += [threading.Thread(target=looker, args=(f"lookup-{i}",),
+                                 name=f"locksan-lookup-{i}")
+                for i in range(3)]
+    threads.append(threading.Thread(target=publisher,
+                                    name="locksan-publisher"))
+    for t in threads:
+        t.start()
+    for t in threads[:2]:       # appenders run a fixed count
+        t.join(60)
+    stop.set()
+    for t in threads[2:]:
+        t.join(60)
+    journal.close()
+
+    rep = locksan.report()
+    vs = locksan.violations()
+    ok = (not errors and not vs
+          and "journal.state" in rep["locks_tracked"]
+          and "kv_fabric.directory" in rep["locks_tracked"]
+          and "kv_fabric.memstore" in rep["locks_tracked"])
+    return {"scenario": "fleet_under_load", "survived": bool(ok),
+            "violations": len(vs),
+            "violation_summaries": [v["summary"] for v in vs],
+            "locks_tracked": len(rep["locks_tracked"]),
+            "edges": rep["num_edges"],
+            "thread_errors": errors}
+
+
+def _locksan_telemetry_threads(workdir):
+    """A fresh metrics registry + flight recorder hammered from four
+    named threads — the lock-per-child metric family tree and the
+    recorder ring under concurrent inc/observe/record/dump traffic.
+    Zero violations expected."""
+    from paddle_tpu.analysis import locksan
+    from paddle_tpu.telemetry.flight_recorder import FlightRecorder
+    from paddle_tpu.telemetry.metrics import MetricsRegistry
+
+    locksan.reset()
+    reg = MetricsRegistry()
+    reqs = reg.counter("locksan_chaos_requests_total",
+                       "locksan chaos suite scratch counter",
+                       labels=("path",))
+    depth = reg.gauge("locksan_chaos_depth", "scratch gauge")
+    rec = FlightRecorder(capacity=512)
+    errors = []
+
+    def worker(tag):
+        try:
+            for i in range(400):
+                reqs.labels(path=tag).inc()
+                depth.set(i)
+                rec.record("locksan.chaos", tag=tag, i=i)
+                if i % 97 == 0:
+                    rec.dump(os.path.join(workdir, f"rec-{tag}.json"),
+                             reason="locksan chaos checkpoint")
+        except Exception as e:  # lint: allow-silent(captured into thread_errors; any entry fails the scenario)
+            errors.append(f"{tag}: {type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=worker, args=(f"w{i}",),
+                                name=f"locksan-telemetry-{i}")
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+
+    vs = locksan.violations()
+    rep = locksan.report()
+    ok = (not errors and not vs
+          and any(n.startswith("metrics.") for n in rep["locks_tracked"])
+          and "flight.ring" in rep["locks_tracked"])
+    return {"scenario": "telemetry_threads", "survived": bool(ok),
+            "violations": len(vs),
+            "violation_summaries": [v["summary"] for v in vs],
+            "locks_tracked": len(rep["locks_tracked"]),
+            "edges": rep["num_edges"],
+            "thread_errors": errors}
+
+
+def _locksan_inversion_canary(workdir):
+    """Deliberately violate both detector halves — an A→B/B→A
+    inversion across two named threads and a ``time.sleep`` under a
+    lock — and require LockSan to report both. Proves the armed
+    detector in *this* battery actually fires; a clean suite with a
+    dead detector would be vacuous."""
+    from paddle_tpu.analysis import locksan
+
+    locksan.reset()
+    a = locksan.Lock("canary.A")
+    b = locksan.Lock("canary.B")
+    order = threading.Barrier(2, timeout=10)
+
+    def take_ab():
+        with a:
+            with b:
+                pass
+        order.wait()
+
+    def take_ba():
+        order.wait()        # strictly after the A->B edge exists
+        with b:
+            with a:
+                pass
+
+    t1 = threading.Thread(target=take_ab, name="canary-ab")
+    t2 = threading.Thread(target=take_ba, name="canary-ba")
+    t1.start()
+    t2.start()
+    t1.join(30)
+    t2.join(30)
+
+    hold = locksan.Lock("canary.hold")
+    with hold:
+        time.sleep(0)       # the blocking-call half
+
+    vs = locksan.violations()
+    kinds = sorted({v["type"] for v in vs})
+    inv = [v for v in vs if v["type"] == "lock_order_inversion"]
+    both_named = bool(inv) and \
+        {"canary-ab", "canary-ba"} <= {e["thread"] for e in inv[0]["edges"]}
+    ok = (kinds == ["blocking_call_under_lock", "lock_order_inversion"]
+          and both_named)
+    out = {"scenario": "inversion_canary", "survived": bool(ok),
+           "violations_reported": len(vs), "types": kinds,
+           "both_threads_named": both_named}
+    locksan.reset()         # the canary's graph must not leak onward
+    return out
+
+
+def run_locksan_suite(workdir=None, scenario=None):
+    import tempfile
+
+    from paddle_tpu.analysis import locksan
+
+    workdir = workdir or tempfile.mkdtemp(prefix="chaos-locksan-")
+    fns = _filter_scenarios(
+        (_locksan_fleet_under_load, _locksan_telemetry_threads,
+         _locksan_inversion_canary), "_locksan_", scenario)
+    locksan.arm()
+    rows = []
+    try:
+        for fn in fns:
+            try:
+                rows.append(fn(workdir))
+            except Exception as e:  # lint: allow-silent(the crash is the row: survived=False fails the battery)
+                rows.append({"scenario": fn.__name__[len("_locksan_"):],
+                             "survived": False,
+                             "crashed": f"{type(e).__name__}: {e}"})
+    finally:
+        locksan.reset()
+        locksan.disarm()
+    survived = sum(1 for r in rows if r["survived"])
+    dump_path = telemetry.dump(reason="locksan chaos suite complete")
+    return {
+        "suite": "locksan",
+        "workdir": workdir,
+        "plans_run": len(rows),
+        "plans_survived": survived,
+        "all_survived": survived == len(rows),
+        "flight_recorder_dump": dump_path,
+        "results": rows,
+    }
+
+
 SUITE_SCENARIOS = {
     "serving": lambda: [n for n, _ in DEFAULT_PLANS],
     "prefix": lambda: [n for n, _ in PREFIX_PLANS],
@@ -2224,6 +2482,8 @@ SUITE_SCENARIOS = {
                          "corrupt_frame", "fetch_storm"],
     "train": lambda: ["kill_worker", "nan_injection", "torn_checkpoint"],
     "straggler": lambda: ["straggler", "hang"],
+    "locksan": lambda: ["fleet_under_load", "telemetry_threads",
+                        "inversion_canary"],
 }
 
 
@@ -2251,7 +2511,7 @@ def run_sweep(argv=None):
     ap.add_argument("--suite",
                     choices=["serving", "prefix", "spill", "train",
                              "straggler", "perf", "serve-fleet", "durable",
-                             "kvfabric"],
+                             "kvfabric", "locksan"],
                     default="serving")
     ap.add_argument("--list", action="store_true",
                     help="print every suite's scenario names and exit")
@@ -2284,11 +2544,13 @@ def run_sweep(argv=None):
                          "and cannot be sliced with --scenario")
 
     if args.suite in ("train", "straggler", "prefix", "spill", "perf",
-                      "serve-fleet", "durable", "kvfabric"):
+                      "serve-fleet", "durable", "kvfabric", "locksan"):
         report = (run_train_suite(scenario=args.scenario)
                   if args.suite == "train"
                   else run_straggler_suite(scenario=args.scenario)
                   if args.suite == "straggler"
+                  else run_locksan_suite(scenario=args.scenario)
+                  if args.suite == "locksan"
                   else run_perf_suite(args) if args.suite == "perf"
                   else run_serve_fleet_suite(args,
                                              scenario=args.scenario)
@@ -2360,7 +2622,7 @@ def main(argv=None):
         status = "OK " if r["survived"] else "DIED"
         if report.get("suite") in ("train", "straggler", "perf",
                                    "serve-fleet", "durable", "spill",
-                                   "kvfabric"):
+                                   "kvfabric", "locksan"):
             detail = " ".join(f"{k}={v}" for k, v in r.items()
                               if k not in ("scenario", "survived"))
             print(f"[{status}] {r['scenario']:<26} {detail}",

@@ -37,11 +37,28 @@ import time
 sys.path.insert(0, ".")
 
 from paddle_tpu.telemetry.cluster import (  # noqa: E402
-    ClusterAggregator, ClusterMonitor, merge_traces)
+    ClusterAggregator, ClusterMonitor, _k, merge_traces)
 
 
 def _fmt_age(s):
     return "-" if s is None else f"{s:7.2f}s"
+
+
+def probe_parse_errors(store, world: int) -> list:
+    """Docs that are *present but unparseable* in the store — the rows
+    the monitor silently renders as 'never-reported' / omits from the
+    merged snapshot. Surfaced so garbage is never mistaken for absence."""
+    bad = []
+    for r in range(world):
+        for leaf in ("meta", "coll", "metrics"):
+            raw = store.get(_k(r, leaf))
+            if raw is None:
+                continue
+            try:
+                json.loads(raw)
+            except (ValueError, TypeError):
+                bad.append(f"rank{r}:{leaf}")
+    return bad
 
 
 def render(report: dict) -> str:
@@ -156,6 +173,10 @@ def main(argv=None):
     while True:
         report = mon.poll()
         print(render(report))
+        bad = probe_parse_errors(store, args.world)
+        if bad:
+            print(f"tool_parse_errors: {len(bad)} "
+                  f"(unparseable store docs: {', '.join(bad)})")
         if args.watch is None:
             break
         time.sleep(args.watch)

@@ -1,0 +1,94 @@
+"""CI entry point for the AST lint suite (docs/ANALYSIS.md).
+
+    python tools/lint.py --check                 # exit 1 naming new findings
+    python tools/lint.py --check --json          # machine-readable report
+    python tools/lint.py --baseline-update       # ratchet the baseline
+    python tools/lint.py --check --pass silent-except --pass bare-thread
+
+``--check`` compares the tree against ``paddle_tpu/analysis/baseline.json``:
+grandfathered findings pass, anything new fails with its key, location and
+message. Stale baseline entries (findings you fixed) are reported too —
+run ``--baseline-update`` to prune them; once the tree is clean the
+baseline only ever shrinks.
+
+The lint engine (``paddle_tpu/analysis/lint.py``) is pure stdlib, so this
+tool loads it by path — no jax import, runs anywhere in <1s.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(REPO, "paddle_tpu", "analysis", "baseline.json")
+
+
+def _load_lint():
+    path = os.path.join(REPO, "paddle_tpu", "analysis", "lint.py")
+    spec = importlib.util.spec_from_file_location("pt_analysis_lint", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["pt_analysis_lint"] = mod   # dataclasses looks itself up
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="paddle_tpu AST lint suite (see docs/ANALYSIS.md)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 if findings not in the baseline exist")
+    ap.add_argument("--baseline-update", action="store_true",
+                    help="rewrite the baseline to the current findings "
+                         "(the ratchet: run after fixing findings)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as JSON on stdout")
+    ap.add_argument("--pass", dest="passes", action="append", default=None,
+                    metavar="PASS", help="run only this pass (repeatable)")
+    ap.add_argument("--root", default=REPO, help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+    if not (args.check or args.baseline_update):
+        args.check = True
+
+    lint = _load_lint()
+    findings = lint.run(args.root, passes=args.passes)
+
+    if args.baseline_update:
+        payload = lint.baseline_payload(findings)
+        with open(BASELINE, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"baseline updated: {len(findings)} grandfathered finding(s) "
+              f"-> {os.path.relpath(BASELINE, args.root)}")
+        return 0
+
+    baseline = lint.load_baseline(BASELINE)
+    new, stale = lint.diff_against_baseline(findings, baseline)
+
+    if args.json:
+        print(json.dumps({
+            "total": len(findings),
+            "grandfathered": len(findings) - len(new),
+            "new": [f.as_dict() for f in new],
+            "stale_baseline_keys": stale,
+        }, indent=1, sort_keys=True))
+    else:
+        print(f"lint: {len(findings)} finding(s), "
+              f"{len(findings) - len(new)} grandfathered, {len(new)} new")
+        for f in new:
+            print(f"  NEW {f.path}:{f.line} [{f.pass_id}] {f.message}"
+                  f"\n      key: {f.key}")
+        if stale:
+            print(f"  {len(stale)} stale baseline entr"
+                  f"{'y' if len(stale) == 1 else 'ies'} (fixed findings) — "
+                  "prune with: python tools/lint.py --baseline-update")
+            for k in stale[:10]:
+                print(f"      stale: {k}")
+
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

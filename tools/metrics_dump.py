@@ -80,12 +80,14 @@ def format_snapshot(snap: dict, name_filter: str = "") -> str:
     lines = []
     scalars = []
     hists = []
+    bad_fams = []
     for name, fam in sorted(snap.items()):
         if name.startswith("__"):        # __meta__ capture stamp
             continue
         if name_filter and name_filter not in name:
             continue
-        if not isinstance(fam, dict):    # unknown family annotation
+        if not isinstance(fam, dict):    # unknown family annotation:
+            bad_fams.append(name)        # skipped, but never invisibly
             continue
         for s in fam.get("series", []):
             if fam.get("type") == "histogram":
@@ -126,6 +128,10 @@ def format_snapshot(snap: dict, name_filter: str = "") -> str:
                 f"{_exemplar_note(s)}")
     if not lines:
         lines.append("(no metrics matched)")
+    if bad_fams:
+        lines.append(f"tool_parse_errors: {len(bad_fams)} "
+                     f"(unparseable families skipped: "
+                     f"{', '.join(bad_fams)})")
     return "\n".join(lines)
 
 
@@ -152,12 +158,14 @@ def format_diff(a: dict, b: dict, name_filter: str = "") -> str:
     lines = [f"interval: {dt:.3f}s" if dt else
              "interval: unknown (no __meta__.wall_time; rates omitted)"]
     rows = []
+    bad_fams = []
     for name, fam in sorted(b.items()):
         if name.startswith("__"):
             continue
         if name_filter and name_filter not in name:
             continue
-        if not isinstance(fam, dict):
+        if not isinstance(fam, dict):    # a row that would silently vanish
+            bad_fams.append(name)
             continue
         old = _series_map(a.get(name, {"series": []}))
         for key, s in sorted(_series_map(fam).items()):
@@ -194,6 +202,10 @@ def format_diff(a: dict, b: dict, name_filter: str = "") -> str:
                 rows.append(f"{name:<40} {lbl:<28} {frm} -> "
                             f"{vb:.6g}{dlt}")
     lines.extend(rows or ["(no changed series matched)"])
+    if bad_fams:
+        lines.append(f"tool_parse_errors: {len(bad_fams)} "
+                     f"(unparseable families skipped: "
+                     f"{', '.join(bad_fams)})")
     return "\n".join(lines)
 
 
