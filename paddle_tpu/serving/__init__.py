@@ -34,9 +34,21 @@ Above the single engine sits the fleet plane (docs/SERVING.md
   KV-block migration (``kv_fetch``/``kv_ingest`` pipe verbs) so hot
   prefixes replicate instead of re-prefilling — strictly advisory,
   every failure mode degrades to local prefill.
+- :mod:`.tenancy` — multi-tenant QoS (docs/SERVING.md "Multi-tenancy &
+  autoscaling"): API-key -> tenant resolution, per-tenant token-bucket
+  rate limits, deficit-round-robin weighted-fair admission
+  (:class:`FairQueue`), per-tenant prefix-cache block quotas, and
+  roofline cost attribution (FLOPs / HBM bytes / a $-proxy) with
+  per-tenant SLO windows.
+- :mod:`.autoscaler` — the closed loop over the fleet: Little's-law
+  pressure from :meth:`FleetRouter.load_signal` drives replica
+  scale-up (gated by the ElasticSupervisor restart budget, warmed via
+  the fleet compile cache + KV-fabric migration) and hysteresis-guarded
+  scale-down, every decision recorded in the JobLedger.
 """
 from . import kv_fabric  # noqa: F401
-from .engine import LLMEngine, naive_generate  # noqa: F401
+from .autoscaler import Autoscaler  # noqa: F401
+from .engine import LLMEngine, STATS_KEYS, naive_generate  # noqa: F401
 from .gateway import Gateway  # noqa: F401
 from .journal import Journal, JournalError, JournalTornWrite  # noqa: F401
 from .kv_cache import (  # noqa: F401
@@ -65,9 +77,17 @@ from .scheduler import (  # noqa: F401
     SamplingParams,
     Scheduler,
 )
+from .tenancy import (  # noqa: F401
+    AuthError,
+    FairQueue,
+    Tenant,
+    TenantRegistry,
+    TokenBucket,
+)
 
 __all__ = [
-    "LLMEngine", "naive_generate", "BlockAllocator", "PagedKVCache",
+    "LLMEngine", "naive_generate", "STATS_KEYS", "BlockAllocator",
+    "PagedKVCache",
     "PagedCacheView", "DenseKVCache", "Request", "RequestState",
     "SamplingParams", "Scheduler", "EngineClosed", "QueueFull",
     "DeadlineExceeded", "PreemptionStorm",
@@ -75,4 +95,6 @@ __all__ = [
     "RouterRequest", "RouterShed", "NoHealthyReplica", "Gateway",
     "CircuitBreaker", "Journal", "JournalError", "JournalTornWrite",
     "kv_fabric",
+    "Tenant", "TenantRegistry", "TokenBucket", "FairQueue", "AuthError",
+    "Autoscaler",
 ]

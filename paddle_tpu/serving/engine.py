@@ -73,8 +73,21 @@ from ..utils import faults
 from .kv_cache import PagedCacheView, PagedKVCache
 from .scheduler import (DeadlineExceeded, Request, RequestState,
                         SamplingParams, Scheduler)
+from .tenancy import TenantAccounting, TenantRegistry
 
-__all__ = ["LLMEngine", "naive_generate"]
+__all__ = ["LLMEngine", "naive_generate", "STATS_KEYS"]
+
+# canonical stats() schema — the single source of truth the gateway /stats
+# endpoint and the telemetry tests assert against (satellite: defined once,
+# imported everywhere, so adding a key is a one-line change here)
+STATS_KEYS = frozenset({
+    "queue_depth", "num_running", "num_finished", "num_failed",
+    "num_cancelled", "num_rejected", "blocks_used", "blocks_free",
+    "block_high_water", "cache_utilization", "num_preemptions",
+    "decode_traces", "prefill_traces", "total_generated_tokens",
+    "tokens_per_sec", "mean_ttft", "watchdog_trips", "last_decode_s",
+    "slo", "prefix_cache", "perf", "tenancy",
+})
 
 # distinguishes concurrent engines' series in the process-global registry
 _ENGINE_IDS = itertools.count()
@@ -204,7 +217,8 @@ class LLMEngine:
                  watchdog_timeout_s=None, stall_limit=8,
                  slo_ttft_s=None, slo_tpot_s=None, slo_window_s=120.0,
                  prefix_cache=True, kv_spill_blocks=None,
-                 kv_high_watermark=None, kv_low_watermark=None):
+                 kv_high_watermark=None, kv_low_watermark=None,
+                 tenancy=None):
         cfg = model.config
         self.model = model
         self.block_size = int(block_size)
@@ -235,13 +249,25 @@ class LLMEngine:
         self.slo = telemetry.SLOTracker(
             ttft_slo_s=slo_ttft_s, tpot_slo_s=slo_tpot_s,
             window_s=slo_window_s, engine_label=self.engine_label)
+        # multi-tenant QoS (serving.tenancy): the registry defines weights
+        # and quotas (a plain dict rides through a ProcReplica spec); with
+        # tenancy=None everything runs as the "anonymous" tenant and the
+        # fair queue degrades to exact FIFO — no feature flag, one path.
+        if isinstance(tenancy, dict):
+            tenancy = TenantRegistry.from_dict(tenancy)
+        self.tenancy = tenancy if tenancy is not None else TenantRegistry()
+        self.cache.set_tenant_quotas(self.tenancy.block_quotas())
+        self._tenancy_acct = TenantAccounting(
+            self.tenancy, self.engine_label, ttft_slo_s=slo_ttft_s,
+            tpot_slo_s=slo_tpot_s, window_s=slo_window_s)
         self.scheduler = Scheduler(
             self.cache, self.max_slots, self.max_model_len,
             max_queue=max_queue,
             max_preemptions_per_request=max_preemptions_per_request,
             on_event=self._on_sched_event,
             high_watermark=kv_high_watermark,
-            low_watermark=kv_low_watermark)
+            low_watermark=kv_low_watermark,
+            tenancy=self.tenancy)
 
         self._next_rid = 0
         self._decode_fn = None
@@ -309,7 +335,8 @@ class LLMEngine:
                     on_token=None, deadline_s: float | None = None,
                     trace_id: str | None = None,
                     trace_parent: int | None = None,
-                    on_watermark=None, watermark_every: int = 8) -> Request:
+                    on_watermark=None, watermark_every: int = 8,
+                    tenant: str = "anonymous", priority: int = 0) -> Request:
         """Queue a prompt (list/array of token ids); returns the live
         request handle (``output_tokens`` grows as the engine steps;
         ``on_token(req, tok)`` streams each new token). ``deadline_s``
@@ -321,18 +348,25 @@ class LLMEngine:
         ``on_watermark(req, n)`` fires whenever the output length crosses
         a multiple of ``watermark_every`` — the coarse durable-progress
         signal the gateway's write-ahead journal records
-        (docs/ROBUSTNESS.md "Durable requests")."""
+        (docs/ROBUSTNESS.md "Durable requests"). ``tenant`` attributes the
+        request to a tenant for weighted-fair admission, quota accounting
+        and cost attribution (docs/SERVING.md "Multi-tenancy"); ``priority``
+        orders requests *within* a tenant only — fairness across tenants is
+        the scheduler's job, never the caller's."""
         req = Request(rid=self._next_rid, prompt=[int(t) for t in prompt],
                       sampling=sampling or SamplingParams(),
                       on_token=on_token, trace_id=trace_id,
                       trace_parent=trace_parent,
                       on_watermark=on_watermark,
-                      watermark_every=watermark_every)
+                      watermark_every=watermark_every,
+                      tenant=str(tenant or "anonymous"),
+                      priority=int(priority))
         if deadline_s is not None:
             req.deadline = time.monotonic() + float(deadline_s)
         self._next_rid += 1
         self.scheduler.add(req)           # raises EngineClosed / QueueFull
         self._requests[req.rid] = req
+        self._tenancy_acct.note_request(req.tenant)
         return req
 
     def cancel(self, rid: int, reason: str = "cancelled") -> bool:
@@ -532,6 +566,10 @@ class LLMEngine:
             # signature diff), the decode step's phase breakdown, and the
             # per-tag memory accounting incl. the leak sentinel
             "perf": self._perf_block(),
+            # per-tenant counters, roofline cost attribution and tenant
+            # SLO windows (serving.tenancy.TenantAccounting.summary());
+            # requests without a tenant land under "anonymous"
+            "tenancy": self._tenancy_acct.summary(),
         }
 
     def _perf_block(self) -> dict:
@@ -593,6 +631,18 @@ class LLMEngine:
         self._m.roofline.labels(engine=self.engine_label, kind=kind).set(
             sum(fracs) / len(fracs))
 
+    def _charge_tenant(self, tenant: str, kind: str, bucket: str,
+                       share: float = 1.0):
+        """Attribute one executed step's roofline-modeled cost to a tenant:
+        a prefill charges its request's tenant in full; a fused decode step
+        splits evenly across the batch snapshot (``share=1/batch``), so the
+        per-tenant FLOPs always sum back to the engine's total."""
+        est = self._trace_costs.get((kind, bucket))
+        if est is None:
+            return
+        self._tenancy_acct.note_cost(
+            tenant, est["flops"] * share, est["bytes"] * share)
+
     def _roofline_block(self) -> dict:
         """stats()["perf"]["roofline"]: per-kind modeled cost + achieved
         fraction — the serving analogue of the training MFU headline."""
@@ -642,6 +692,10 @@ class LLMEngine:
             m.preemptions.inc()
         elif kind == "admit" and req is not None:
             m.queue_time.observe(req.admit_time - req.arrival_time)
+            # admitted-token attribution mirrors the DRR charge: the
+            # worst-case tokens this admission occupies the engine for
+            self._tenancy_acct.note_admitted(
+                req.tenant, len(req.prompt) + req.sampling.max_new_tokens)
         elif kind == "deadline_queued" and req is not None:
             # scheduler fail-fast: the request expired while still queued
             # and never reached a prefill slot — it is CANCELLED with
@@ -703,6 +757,7 @@ class LLMEngine:
             return
         req._spans_recorded = True
         self._record_slo(req)
+        self._tenancy_acct.note_terminal(req)
         tr = telemetry.tracer()
         tid = 100_000 + req.rid
         tid_name = f"request-{req.rid}"
@@ -925,6 +980,7 @@ class LLMEngine:
             wall_s=wall if new_trace else None, cost=cost_est)
         if not new_trace:
             self._note_roofline("prefill", f"P{P}", wall)
+        self._charge_tenant(req.tenant, "prefill", f"P{P}")
         self.cache.pool = pool
         self.cache.commit_prefix(req.rid, toks)
         self._emit(slot, req, int(tok))
@@ -978,6 +1034,7 @@ class LLMEngine:
             wall_s=wall if new_trace else None, cost=cost_est)
         if not new_trace:
             self._note_roofline("prefill", bucket, wall)
+        self._charge_tenant(req.tenant, "prefill", bucket)
         self.cache.pool = pool
         self.cache.commit_prefix(req.rid, toks)
         self._emit(slot, req, int(tok))
@@ -1102,6 +1159,9 @@ class LLMEngine:
                     limit_s=self.watchdog_timeout_s)
         if not new_trace:
             self._note_roofline("decode", "decode", self.last_decode_s)
+        share = 1.0 / len(running)
+        for req in running.values():
+            self._charge_tenant(req.tenant, "decode", "decode", share)
         self.cache.pool = pool
         if self.prefix_cache:
             # a decode write that just filled its block completes another
@@ -1119,6 +1179,7 @@ class LLMEngine:
         self._progressed = True
         self._total_generated += 1
         self._m.tokens.inc()
+        self._tenancy_acct.note_tokens(req.tenant)
         if len(req.output_tokens) == 1:
             # the trace-id exemplar links a slow TTFT bucket straight to
             # the request trace that landed in it (OpenMetrics exemplars)

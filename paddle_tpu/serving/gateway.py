@@ -50,11 +50,25 @@ Durable request lifecycle (docs/ROBUSTNESS.md "Durable requests"), on when
   keeps running for the reconnect) unless ``cancel_on_disconnect`` says
   otherwise.
 
+Multi-tenancy (docs/SERVING.md "Multi-tenancy & autoscaling"), on when a
+``tenancy=`` :class:`~paddle_tpu.serving.tenancy.TenantRegistry` is
+passed: the ``Authorization`` header (``Bearer <key>`` or a bare key)
+resolves to a tenant identity — a missing or unknown key answers ``401``
+with ``{"error": {"type": "authentication_error", ...}}`` when any API
+key is configured — and each tenant's token bucket rate-limits admission
+(``429`` whose ``Retry-After`` is that tenant's own bucket-refill
+horizon, not the fleet-wide estimate). The resolved tenant rides the
+submit into the scheduler's weighted-fair queue and the per-tenant cost
+attribution, and ``GET /stats`` gains ``tenancy`` (registry + admission
+counts) and, when an ``autoscaler=`` is attached, ``autoscaler`` blocks.
+
 The server runs on a daemon thread with its own event loop so synchronous
 tools (``tools/serving_bench.py --fleet``, the chaos suite, tests) can
 ``start()``/``stop()`` it around plain-socket clients. Chaos sites:
 ``gateway.request`` fires per parsed request (an injected error answers
-500 — the connection layer survives); ``gateway.journal.append`` /
+500 — the connection layer survives); ``gateway.auth`` fires per tenant
+resolution and fails **closed** (an injected error answers 401, never
+admits as anonymous); ``gateway.journal.append`` /
 ``gateway.journal.fsync`` live in the journal.
 """
 from __future__ import annotations
@@ -72,6 +86,7 @@ from ..telemetry import reqtrace
 from ..utils import faults
 from .journal import Journal, JournalError
 from .router import NoHealthyReplica, RouterShed
+from .tenancy import AuthError, TenantRegistry
 from ..analysis import locksan
 
 __all__ = ["Gateway"]
@@ -110,6 +125,15 @@ def _gateway_metrics() -> SimpleNamespace:
             "gateway_conn_errors_total",
             "connections dropped by an unexpected error in the serve loop "
             "(client vanished mid-request, protocol desync)"),
+        auth_failures=reg.counter(
+            "gateway_auth_failures_total",
+            "requests answered 401 (missing/unknown API key, or the "
+            "gateway.auth fault site failing closed)"),
+        tenant_shed=reg.counter(
+            "gateway_tenant_shed_total",
+            "requests answered 429 by the tenant's own token bucket "
+            "(fleet-wide sheds count in gateway_shed_total only)",
+            ("tenant",)),
     )
 
 
@@ -127,10 +151,12 @@ def _parse_tokens(v, what: str) -> list[int]:
 
 class _HTTPError(Exception):
     def __init__(self, status: int, message: str, headers=(),
-                 close: bool = False):
+                 close: bool = False, err_type: str | None = None):
         super().__init__(message)
         self.status = status
         self.headers = list(headers)
+        self.err_type = err_type          # overrides the status-derived
+                                          # "type" in the error JSON body
         # close=True: the connection's framing can no longer be trusted
         # (unread body bytes, garbled request line) — answering and then
         # parsing the leftover bytes as a "request" would wedge the
@@ -138,7 +164,8 @@ class _HTTPError(Exception):
         self.close = close
 
 
-_REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found",
+_REASONS = {200: "OK", 400: "Bad Request", 401: "Unauthorized",
+            404: "Not Found",
             405: "Method Not Allowed", 408: "Request Timeout",
             429: "Too Many Requests", 500: "Internal Server Error",
             503: "Service Unavailable"}
@@ -153,7 +180,8 @@ class _Stream:
 
     def __init__(self, jid: str, *, chat: bool, created: int,
                  prompt_len: int, idem: str | None = None,
-                 priority: int = 0, recovered: bool = False):
+                 priority: int = 0, recovered: bool = False,
+                 tenant: str = "anonymous"):
         self.jid = jid
         self.chat = chat
         self.created = created
@@ -161,6 +189,7 @@ class _Stream:
         self.idem = idem
         self.priority = priority
         self.recovered = recovered
+        self.tenant = tenant
         self.rr = None                    # live RouterRequest (may be None
         self.rid: str | None = None       # for journal-replayed terminals)
         self.tokens: list[int] = []
@@ -215,8 +244,17 @@ class Gateway:
                  gateway_id: str | None = None,
                  resume_retention: int = 512,
                  cancel_on_disconnect: bool | None = None,
-                 recover: bool = True):
+                 recover: bool = True,
+                 tenancy=None, autoscaler=None):
         self.router = router
+        # multi-tenant front door (serving.tenancy): API-key -> tenant
+        # resolution (401 on unknown keys when any key is configured) and
+        # per-tenant token-bucket admission (429 with a bucket-refill
+        # Retry-After). tenancy=None runs everything as "anonymous".
+        if isinstance(tenancy, dict):
+            tenancy = TenantRegistry.from_dict(tenancy)
+        self.tenancy = tenancy if tenancy is not None else TenantRegistry()
+        self.autoscaler = autoscaler      # optional: surfaces in /stats
         self.host = host
         self.port = int(port)
         self.default_deadline_s = default_deadline_s
@@ -445,7 +483,8 @@ class Gateway:
         created = int(time.time())
         st = _Stream(jid, chat=chat, created=created,
                      prompt_len=len(p["prompt"]), idem=idem,
-                     priority=p["priority"])
+                     priority=p["priority"],
+                     tenant=p.get("tenant") or "anonymous")
         with self._slock:
             if idem:
                 existing = self._idem.get(idem)
@@ -466,14 +505,15 @@ class Gateway:
                     jid, gateway_id=self.gateway_id, prompt=p["prompt"],
                     sampling=p["sampling"], priority=p["priority"],
                     deadline_unix=deadline_unix, idem=idem, chat=chat,
-                    created=created)
+                    created=created, tenant=st.tenant)
                 journaled = True
             rr = self.router.submit(
                 p["prompt"], p["sampling"], priority=p["priority"],
                 deadline_s=p["deadline_s"], on_token=on_token,
                 on_finish=on_fin, trace_id=jid,
                 on_watermark=on_wm if self.journal is not None else None,
-                watermark_every=self.journal_watermark_every)
+                watermark_every=self.journal_watermark_every,
+                tenant=st.tenant)
         except Exception as e:
             # the client is getting an error response right now — undo
             # the reservation, and make sure a future recovery does not
@@ -585,7 +625,8 @@ class Gateway:
                          created=int(a.get("created") or 0),
                          prompt_len=len(a.get("prompt") or ()),
                          idem=a.get("idem"), priority=a.get("priority", 0),
-                         recovered=True)
+                         recovered=True,
+                         tenant=a.get("tenant") or "anonymous")
             st.tokens = list(e["tokens"])
             st.marked = e["n"]
             on_token, on_wm, on_fin = self._stream_cbs(st)
@@ -596,7 +637,8 @@ class Gateway:
                     on_token=on_token, on_finish=on_fin, trace_id=jid,
                     on_watermark=on_wm,
                     watermark_every=self.journal_watermark_every,
-                    replay_tokens=e["tokens"], bypass_shed=True)
+                    replay_tokens=e["tokens"], bypass_shed=True,
+                    tenant=st.tenant)
             except Exception as ex:        # fleet not ready: keep journaled
                 report["failed"] += 1
                 telemetry.record_event("gateway.recover_failed", jid=jid,
@@ -715,6 +757,12 @@ class Gateway:
             if req.path == "/stats":
                 doc = self.router.stats()
                 doc["gateway"] = self.gateway_stats()
+                # fleet-facing tenancy view: registry config + admission
+                # decisions; the per-engine "tenancy" blocks (cost, SLO)
+                # ride inside each replica's stats under doc["replicas"]
+                doc["tenancy"] = self.tenancy.snapshot()
+                if self.autoscaler is not None:
+                    doc["autoscaler"] = self.autoscaler.stats()
                 await self._write_response(writer, 200, doc)
                 return True
             if req.path == "/v1/models":
@@ -736,18 +784,24 @@ class Gateway:
         except _HTTPError as e:
             await self._write_response(
                 writer, e.status, {"error": {"message": str(e),
-                                             "type": "invalid_request_error"
-                                             if e.status < 500 else
-                                             "server_error"}},
+                                             "type": e.err_type or
+                                             ("invalid_request_error"
+                                              if e.status < 500 else
+                                              "server_error")}},
                 headers=e.headers)
             return e.status < 500 and not e.close
         except RouterShed as e:
             self._m.shed.inc()
+            if e.tenant is not None:
+                # the tenant's own bucket shed this — count it against the
+                # tenant, and the Retry-After below is its refill horizon
+                self._m.tenant_shed.labels(tenant=e.tenant).inc()
             retry = max(1, math.ceil(e.retry_after_s))
             await self._write_response(
                 writer, 429,
                 {"error": {"message": str(e), "type": "overloaded_error",
-                           "retry_after_s": e.retry_after_s}},
+                           "retry_after_s": e.retry_after_s,
+                           "tenant": e.tenant}},
                 headers=[("Retry-After", str(retry))])
             return True
         except NoHealthyReplica as e:
@@ -825,6 +879,29 @@ class Gateway:
         return True
 
     # -- completions -------------------------------------------------------
+    def _resolve_tenant(self, req) -> str:
+        """``Authorization`` header -> tenant name, or 401.
+
+        The documented 401 body shape is
+        ``{"error": {"message": ..., "type": "authentication_error"}}``
+        with a ``WWW-Authenticate: Bearer`` header. The ``gateway.auth``
+        fault site fails **closed**: an injected auth-backend error denies
+        the request (401) rather than admitting it as anonymous."""
+        try:
+            faults.inject("gateway.auth")
+            return self.tenancy.resolve(req.headers.get("authorization"))
+        except AuthError as e:
+            self._m.auth_failures.inc()
+            raise _HTTPError(401, str(e),
+                             headers=[("WWW-Authenticate", "Bearer")],
+                             err_type="authentication_error")
+        except Exception as e:
+            self._m.auth_failures.inc()
+            raise _HTTPError(401,
+                            f"auth unavailable: {type(e).__name__}: {e}",
+                            headers=[("WWW-Authenticate", "Bearer")],
+                            err_type="authentication_error")
+
     def _parse_body(self, req, chat: bool) -> dict:
         try:
             doc = json.loads(req.body.decode() or "{}")
@@ -880,7 +957,21 @@ class Gateway:
             raise _HTTPError(400, f"bad Last-Event-ID {v!r}")
 
     async def _route_completions(self, req, writer, chat: bool) -> bool:
+        tenant = self._resolve_tenant(req)          # 401 before parsing
         p = self._parse_body(req, chat)
+        p["tenant"] = tenant
+        # per-tenant token bucket: the admission charge is the worst-case
+        # tokens this request occupies the engine for (prompt + output
+        # budget, the same cost the scheduler's DRR uses). A bucket shed
+        # carries the *tenant's own* refill horizon as Retry-After, not
+        # the fleet-wide Little's-law estimate.
+        cost = len(p["prompt"]) + p["sampling"]["max_new_tokens"]
+        retry = self.tenancy.admit(tenant, cost)
+        if retry is not None:
+            raise RouterShed(
+                f"tenant {tenant!r} over its rate limit "
+                f"({cost} tokens requested)",
+                retry_after_s=retry, tenant=tenant)
         idem = req.headers.get("idempotency-key")
         t_req0 = time.monotonic()
         st, fresh = self._accept(p, chat, idem)

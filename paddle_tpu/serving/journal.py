@@ -380,13 +380,14 @@ class Journal:
     def accept(self, jid: str, *, gateway_id: str, prompt, sampling: dict,
                priority: int = 0, deadline_unix: float | None = None,
                idem: str | None = None, chat: bool = False,
-               created: int | None = None):
+               created: int | None = None, tenant: str = "anonymous"):
         self.append({
             "t": "accept", "jid": jid, "gw": gateway_id,
             "prompt": [int(t) for t in prompt], "sampling": dict(sampling),
             "priority": int(priority), "deadline_unix": deadline_unix,
             "idem": idem, "chat": bool(chat),
             "created": int(created if created is not None else time.time()),
+            "tenant": str(tenant or "anonymous"),
         })
 
     def bind(self, jid: str, rid: str):

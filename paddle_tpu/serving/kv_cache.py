@@ -124,6 +124,14 @@ def _prefix_metrics() -> SimpleNamespace:
                 "kv_spill_blocks", "blocks resident in the host spill pool"),
             spilled_bytes=reg.gauge(
                 "kv_spill_bytes", "host-RAM bytes held by the spill pool"),
+            t_cached=reg.gauge(
+                "tenant_cached_blocks",
+                "rc==0 cached prefix blocks held, by owning tenant",
+                ("tenant",)),
+            t_quota_evict=reg.counter(
+                "tenant_quota_evictions_total",
+                "cached blocks evicted ahead of LRU order because their "
+                "tenant exceeded its block quota", ("tenant",)),
         )
     return _PM
 
@@ -331,6 +339,18 @@ class PagedKVCache:
         # promotion allocating mid-walk must not evict them out from
         # under the caller (``_evict_one`` skips pinned entries)
         self._pinned: set[int] = set()
+        # per-tenant prefix-block quotas (serving/tenancy.py): every
+        # cached (rc==0, LRU-parked) block is attributed to the tenant
+        # whose sequence parked it; past a tenant's quota its blocks are
+        # FIRST in eviction order (oldest of that tenant), so one
+        # tenant's giant system prompt cannot evict the fleet's shared
+        # working set
+        self._seq_tenant: dict[object, str] = {}
+        self._block_tenant: dict[int, str] = {}
+        self._tenant_cached: dict[str, int] = {}
+        self._tenant_quota: dict[str, int] = {}
+        self._park_tenant: str | None = None   # allocate() in progress
+        self.quota_evictions: dict[str, int] = {}
         self._block_nbytes = int(self.pool.nbytes) // max(int(num_blocks), 1)
         # running totals (prefix_stats(); the telemetry counters mirror them)
         self.prefix_hits = 0
@@ -462,18 +482,81 @@ class PagedKVCache:
             self._register(table[i], parent, toks)
             hashes.append(_chain_hash(parent, toks))
 
+    # -- per-tenant quota bookkeeping --------------------------------------
+    def set_tenant_quotas(self, quotas) -> None:
+        """Arm per-tenant cached-block quotas (``{tenant: max_blocks}``,
+        from ``TenantRegistry.block_quotas()``). Enforcement is an
+        *eviction-order* policy: an over-quota tenant's cached blocks go
+        first (oldest of that tenant), live references are never touched."""
+        self._tenant_quota = {str(t): int(q)
+                              for t, q in (quotas or {}).items()}
+
+    def _lru_park(self, block: int, tenant: str | None = None) -> None:
+        """A block entered the evictable LRU: attribute it to its tenant."""
+        self._lru[block] = None
+        t = tenant or self._park_tenant or "anonymous"
+        self._block_tenant[block] = t
+        n = self._tenant_cached.get(t, 0) + 1
+        self._tenant_cached[t] = n
+        if telemetry.enabled():
+            _prefix_metrics().t_cached.labels(tenant=t).set(n)
+
+    def _lru_unpark(self, block: int) -> None:
+        """A block left the LRU (shared back in, or evicted)."""
+        if block not in self._lru:
+            return
+        del self._lru[block]
+        t = self._block_tenant.pop(block, None)
+        if t is None:
+            return
+        n = max(0, self._tenant_cached.get(t, 1) - 1)
+        if n:
+            self._tenant_cached[t] = n
+        else:
+            self._tenant_cached.pop(t, None)
+        if telemetry.enabled():
+            _prefix_metrics().t_cached.labels(tenant=t).set(n)
+
+    def _quota_victim(self) -> int | None:
+        """The oldest unpinned cached block of any over-quota tenant, or
+        None when every tenant is within quota (plain LRU order rules)."""
+        if not self._tenant_quota:
+            return None
+        over = {t for t, q in self._tenant_quota.items()
+                if self._tenant_cached.get(t, 0) > q}
+        if not over:
+            return None
+        return next((b for b in self._lru
+                     if b not in self._pinned
+                     and self._block_tenant.get(b) in over), None)
+
     def _evict_one(self) -> int | None:
-        """Reclaim the least-recently-released cached block: drop its index
-        entry, return it to the free list. Only rc==0 blocks live in the
-        LRU, so eviction can never touch a referenced block. With a spill
-        tier armed, the block's K/V is demoted to the host pool first —
-        eviction becomes a tier transition, not a destruction. Returns
-        None when every LRU entry is pinned by an in-progress match walk
-        (nothing safely evictable)."""
-        block = next((b for b in self._lru if b not in self._pinned), None)
+        """Reclaim a cached block: drop its index entry, return it to the
+        free list. An over-quota tenant's blocks evict first (its oldest);
+        otherwise the least-recently-released block goes. Only rc==0
+        blocks live in the LRU, so eviction can never touch a referenced
+        block. With a spill tier armed, the block's K/V is demoted to the
+        host pool first — eviction becomes a tier transition, not a
+        destruction. Returns None when every LRU entry is pinned by an
+        in-progress match walk (nothing safely evictable)."""
+        block = self._quota_victim()
+        over_quota = block is not None
+        if block is None:
+            block = next((b for b in self._lru if b not in self._pinned),
+                         None)
         if block is None:
             return None
-        del self._lru[block]
+        tenant = self._block_tenant.get(block)
+        self._lru_unpark(block)
+        if over_quota:
+            self.quota_evictions[tenant] = \
+                self.quota_evictions.get(tenant, 0) + 1
+            if telemetry.enabled():
+                _prefix_metrics().t_quota_evict.labels(
+                    tenant=tenant).inc()
+            telemetry.record_event(
+                "kv.quota_evict", block=block, tenant=tenant,
+                cached=self._tenant_cached.get(tenant, 0))
         key = self._block_key.pop(block, None)
         if key is not None and self._index.get(key) == block:
             del self._index[key]
@@ -603,7 +686,7 @@ class PagedKVCache:
         self._block_key[block] = entry.key
         self._block_hash[block] = entry.hash
         self.allocator.release([block])          # rc 1 -> 0: parked cached
-        self._lru[block] = None
+        self._lru_park(block)
         self.promotes += 1
         pm.promotes.inc()
         pm.cached.set(self.allocator.num_cached)
@@ -625,33 +708,42 @@ class PagedKVCache:
         return out
 
     # -- sequence lifecycle ------------------------------------------------
-    def allocate(self, seq_id, num_tokens: int, tokens=None) -> bool:
+    def allocate(self, seq_id, num_tokens: int, tokens=None,
+                 tenant: str | None = None) -> bool:
         """Give ``seq_id`` a table covering ``num_tokens`` tokens. With the
         prefix cache on and the token ids supplied, the longest cached
         block-aligned prefix is mapped in as shared blocks and only the
         tail is freshly allocated; ``seq_cached_tokens[seq_id]`` records
-        the hit for the caller's tail-only prefill."""
+        the hit for the caller's tail-only prefill. ``tenant`` attributes
+        the sequence's eventually-cached blocks for quota enforcement."""
         if seq_id in self.tables:
             raise ValueError(f"sequence {seq_id!r} already has a table")
         matched: list[int] = []
         hashes: list[str] = []
-        if self.prefix_cache and tokens is not None:
-            matched, hashes = self.match_prefix(tokens)
-        if matched:
-            self.allocator.share(matched)        # promotes cached ones
-            for b in matched:
-                self._lru.pop(b, None)
-        need = self.blocks_for(num_tokens) - len(matched)
-        tail = self._alloc_evict(need)
-        if tail is None:
-            # roll back the shares; registered blocks park back in the LRU
+        self._park_tenant = tenant
+        try:
+            if self.prefix_cache and tokens is not None:
+                matched, hashes = self.match_prefix(tokens)
             if matched:
-                for b in self.allocator.release(matched):
-                    self._lru[b] = None
-                _prefix_metrics().cached.set(self.allocator.num_cached)
-            return False
+                self.allocator.share(matched)    # promotes cached ones
+                for b in matched:
+                    self._lru_unpark(b)
+            need = self.blocks_for(num_tokens) - len(matched)
+            tail = self._alloc_evict(need)
+            if tail is None:
+                # roll back the shares; registered blocks park back in
+                # the LRU
+                if matched:
+                    for b in self.allocator.release(matched):
+                        self._lru_park(b, tenant)
+                    _prefix_metrics().cached.set(self.allocator.num_cached)
+                return False
+        finally:
+            self._park_tenant = None
         self.tables[seq_id] = matched + tail
         self._seq_hashes[seq_id] = list(hashes)
+        if tenant is not None:
+            self._seq_tenant[seq_id] = str(tenant)
         cached_tokens = len(matched) * self.block_size
         self.seq_cached_tokens[seq_id] = cached_tokens
         if self.prefix_cache and tokens is not None:
@@ -739,6 +831,8 @@ class PagedKVCache:
         self.tables[child_id] = list(table)
         self._seq_hashes[child_id] = list(self._seq_hashes.get(parent_id, []))
         self.seq_cached_tokens[child_id] = 0
+        if parent_id in self._seq_tenant:
+            self._seq_tenant[child_id] = self._seq_tenant[parent_id]
 
     def free_seq(self, seq_id):
         """Drop ``seq_id``'s references. Indexed blocks whose rc reaches 0
@@ -754,13 +848,14 @@ class PagedKVCache:
         table = self.tables.pop(seq_id)
         self._seq_hashes.pop(seq_id, None)
         self.seq_cached_tokens.pop(seq_id, None)
+        tenant = self._seq_tenant.pop(seq_id, None)
         registered = [b for b in table if b in self._block_key]
         plain = [b for b in table if b not in self._block_key]
         if plain:
             self.allocator.free(plain)
         if registered:
             for b in self.allocator.release(registered):
-                self._lru[b] = None              # newest end of the LRU
+                self._lru_park(b, tenant)        # newest end of the LRU
             _prefix_metrics().cached.set(self.allocator.num_cached)
 
     def utilization(self) -> float:
@@ -780,6 +875,13 @@ class PagedKVCache:
             "stale_drops": self.stale_drops,
             "cached_blocks": self.allocator.num_cached,
             "indexed_blocks": len(self._block_key),
+            "tenants": {
+                t: {"cached_blocks": self._tenant_cached.get(t, 0),
+                    "quota": self._tenant_quota.get(t),
+                    "quota_evictions": self.quota_evictions.get(t, 0)}
+                for t in sorted(set(self._tenant_cached)
+                                | set(self._tenant_quota)
+                                | set(self.quota_evictions))},
             "spill": {
                 "enabled": self.spill_blocks > 0,
                 "limit_blocks": self.spill_blocks,

@@ -16,7 +16,8 @@ Protocol (one JSON object per line):
 
     stdin  <- {"op": "add", "gid": 7, "prompt": [...],
                "sampling": {...}, "deadline_s": 1.5 | null,
-               "trace_id": "req-ab12cd" | null}
+               "trace_id": "req-ab12cd" | null,
+               "tenant": "acme" | null, "priority": 0}
               {"op": "cancel", "gid": 7}
               {"op": "kv_fetch", "fid": 3, "hashes": [...],
                "max_frames": 64, "max_bytes": 33554432}
@@ -223,7 +224,9 @@ def main() -> int:
                         sampling_from_dict(cmd.get("sampling")),
                         on_token=on_token(gid),
                         deadline_s=cmd.get("deadline_s"),
-                        trace_id=cmd.get("trace_id"))
+                        trace_id=cmd.get("trace_id"),
+                        tenant=cmd.get("tenant") or "anonymous",
+                        priority=cmd.get("priority") or 0)
                 except Exception as e:
                     emit({"ev": "done", "gid": gid, "state": "failed",
                           "reason": "add_failed",

@@ -107,11 +107,18 @@ __all__ = [
 class RouterShed(RuntimeError):
     """The router refused a new request (every healthy replica is shedding
     and the request's priority does not bypass). Carries ``retry_after_s``
-    so the gateway can answer 429 + Retry-After."""
+    so the gateway can answer 429 + Retry-After. ``tenant`` names who was
+    shed and by what: a fleet-wide shed leaves it None; a tenant shed by
+    *its own token bucket* (serving/tenancy.py) carries its name, and its
+    ``retry_after_s`` is the bucket refill time — not the fleet-wide
+    Little's-law estimate, which would tell a rate-limited tenant to
+    retry straight back into the same limit."""
 
-    def __init__(self, message: str, retry_after_s: float = 1.0):
+    def __init__(self, message: str, retry_after_s: float = 1.0,
+                 tenant: str | None = None):
         super().__init__(message)
         self.retry_after_s = float(retry_after_s)
+        self.tenant = tenant
 
 
 class NoHealthyReplica(RuntimeError):
@@ -240,11 +247,13 @@ class RouterRequest:
     def __init__(self, gid: int, prompt, sampling: dict, *, priority=0,
                  deadline: float | None = None, on_token=None,
                  on_finish=None, trace_id: str | None = None,
-                 on_watermark=None, watermark_every: int = 8):
+                 on_watermark=None, watermark_every: int = 8,
+                 tenant: str = "anonymous"):
         self.gid = gid
         self.prompt = [int(t) for t in prompt]
         self.sampling = dict(sampling)
         self.priority = int(priority)
+        self.tenant = str(tenant or "anonymous")
         self.deadline = deadline            # absolute time.monotonic()
         self.on_token = on_token            # callable(rr, token)
         self.on_finish = on_finish          # callable(rr)
@@ -324,6 +333,9 @@ def replica_stats(engine) -> dict:
         "generated_tokens": engine._total_generated,
         "slo": engine.slo.summary(),
         "prefix_cache": engine.cache.prefix_stats(),
+        # per-tenant counters + cost attribution + tenant SLO windows —
+        # the fleet aggregation the gateway /stats and autoscaler read
+        "tenancy": engine._tenancy_acct.summary(),
     }
 
 
@@ -497,7 +509,9 @@ class LocalReplica:
                             sampling_from_dict(cmd.get("sampling")),
                             on_token=on_token(gid),
                             deadline_s=cmd.get("deadline_s"),
-                            trace_id=cmd.get("trace_id"))
+                            trace_id=cmd.get("trace_id"),
+                            tenant=cmd.get("tenant") or "anonymous",
+                            priority=cmd.get("priority") or 0)
                         tracked[gid] = req
                     except Exception as e:
                         self._emit(gen, {
@@ -957,7 +971,8 @@ class FleetRouter:
                trace_id: str | None = None,
                on_watermark=None, watermark_every: int = 8,
                replay_tokens=None,
-               bypass_shed: bool = False) -> RouterRequest:
+               bypass_shed: bool = False,
+               tenant: str = "anonymous") -> RouterRequest:
         """Place and dispatch one request; returns the live
         :class:`RouterRequest`. Raises :class:`RouterShed` (shed — retry
         later) or :class:`NoHealthyReplica` (no capacity at all).
@@ -985,7 +1000,7 @@ class FleetRouter:
                            priority=priority, deadline=deadline,
                            on_token=on_token, on_finish=on_finish,
                            trace_id=trace_id, on_watermark=on_watermark,
-                           watermark_every=watermark_every)
+                           watermark_every=watermark_every, tenant=tenant)
         if replay_tokens:
             rr.tokens = [int(t) for t in replay_tokens]
             rr.suppress = len(rr.tokens)
@@ -1385,7 +1400,8 @@ class FleetRouter:
                               if rr.deadline is not None else None)
                 rep.send({"op": "add", "gid": rr.gid, "prompt": rr.prompt,
                           "sampling": rr.sampling, "deadline_s": deadline_s,
-                          "trace_id": rr.trace_id})
+                          "trace_id": rr.trace_id, "tenant": rr.tenant,
+                          "priority": rr.priority})
             except (BrokenPipeError, faults.FaultError) as e:
                 self._breaker_record(rep.rid, ok=False)
                 exclude.add(rep.rid)
@@ -1872,6 +1888,35 @@ class FleetRouter:
                   "tokens": len(rr.tokens)})
 
     # -- introspection -----------------------------------------------------
+    def load_signal(self) -> dict:
+        """The demand snapshot the :class:`~.autoscaler.Autoscaler` ticks
+        on: replica rids by state, dispatched + replica-queued work, and
+        the same Little's-law wait estimate the 429 Retry-After carries
+        (``inf`` with no healthy replica — an unserved queue is an
+        infinite wait)."""
+        with self._lock:
+            by_state: dict[str, list[str]] = {
+                "healthy": [], "starting": [], "draining": [],
+                "unhealthy": [], "stopped": []}
+            queued = 0
+            for rid in self._order:
+                rep = self.replicas[rid]
+                by_state[rep.state.value].append(rid)
+                if rep.state is ReplicaState.HEALTHY:
+                    queued += int((rep.stats or {}).get("queue_depth") or 0)
+            healthy_reps = [self.replicas[r] for r in by_state["healthy"]]
+            inflight_by_rid = {r: len(s)
+                               for r, s in self._inflight.items() if s}
+            est = (self._derive_retry_after(healthy_reps)
+                   if healthy_reps else float("inf"))
+            return {
+                **by_state,
+                "inflight": sum(inflight_by_rid.values()),
+                "inflight_by_rid": inflight_by_rid,
+                "queued": queued,
+                "est_wait_s": est,
+            }
+
     def stats(self) -> dict:
         """The fleet view a gateway /stats endpoint serves: per-replica
         state + heartbeat age + SLO block + in-flight, and router totals."""

@@ -1,6 +1,8 @@
 """Continuous-batching request scheduler.
 
-Requests queue in arrival order; the scheduler admits them into a fixed set
+Requests queue through a weighted-fair :class:`~.tenancy.FairQueue`
+(deficit round robin over tenants — with a single tenant it degenerates
+to exact arrival-order FIFO); the scheduler admits them into a fixed set
 of decode *slots* under admission control against the block pool (a request
 enters only when its prefill blocks plus one decode block of headroom are
 free). Running requests join the batched decode step; when one finishes its
@@ -32,12 +34,12 @@ from __future__ import annotations
 
 import enum
 import time
-from collections import deque
 from dataclasses import dataclass, field
 
 from .. import telemetry
 from ..utils import faults
 from .kv_cache import PagedKVCache
+from .tenancy import FairQueue
 
 __all__ = ["SamplingParams", "Request", "RequestState", "Scheduler",
            "EngineClosed", "QueueFull", "DeadlineExceeded",
@@ -98,6 +100,12 @@ class Request:
     # records without paying one append per token
     on_watermark: object = None
     watermark_every: int = 8
+    # tenancy (serving/tenancy.py): the tenant this request is accounted
+    # to (weighted-fair admission, cache quota, cost attribution) and its
+    # priority *within* that tenant — fairness arbitrates across tenants,
+    # priority orders one tenant's own line
+    tenant: str = "anonymous"
+    priority: int = 0
     state: RequestState = RequestState.WAITING
     output_tokens: list[int] = field(default_factory=list)
     cached_tokens: int = 0             # prefix-cache hit at last admission
@@ -156,8 +164,13 @@ class Scheduler:
                  max_model_len: int, max_queue: int | None = None,
                  max_preemptions_per_request: int = 16, on_event=None,
                  high_watermark: float | None = None,
-                 low_watermark: float | None = None):
+                 low_watermark: float | None = None,
+                 tenancy=None):
         self.cache = cache
+        # weighted-fair admission: ``tenancy`` is a TenantRegistry whose
+        # weights drive the DRR queue; without one every request is the
+        # anonymous tenant and the queue IS the FIFO deque it replaced
+        self.tenancy = tenancy
         # telemetry hook: the owning engine passes a callback(kind, **ctx)
         # so scheduler decisions feed its labeled metrics; standalone
         # schedulers (tests) run without one
@@ -191,7 +204,8 @@ class Scheduler:
             self.low_watermark = None
         self.mem_pressure = False
         self.num_pressure_events = 0
-        self.waiting: deque[Request] = deque()
+        self.waiting: FairQueue = FairQueue(
+            weight_fn=tenancy.weight if tenancy is not None else None)
         self.running: dict[int, Request] = {}       # slot -> request
         self._free_slots = list(range(max_slots))
         self.num_preemptions = 0
@@ -307,7 +321,8 @@ class Scheduler:
             self.waiting.popleft()
             slot = self._free_slots.pop(0)
             if not self.cache.allocate(req.rid, len(req.prefill_tokens),
-                                       tokens=req.prefill_tokens):
+                                       tokens=req.prefill_tokens,
+                                       tenant=req.tenant):
                 # effective-free check passed but alloc failed (injected
                 # exhaustion): put everything back and retry next step
                 self._free_slots.insert(0, slot)
@@ -427,9 +442,9 @@ class Scheduler:
                error: BaseException | None = None) -> bool:
         """Cancel a waiting or running request by id. Returns False if the
         request is unknown or already terminal."""
-        for i, req in enumerate(self.waiting):
+        for req in list(self.waiting):
             if req.rid == rid:
-                del self.waiting[i]
+                self.waiting.remove(req)
                 req.state = RequestState.CANCELLED
                 req.finish_time = time.monotonic()
                 req.finish_reason = reason

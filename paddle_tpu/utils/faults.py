@@ -109,6 +109,13 @@ Known sites (see docs/ROBUSTNESS.md for the full table):
     gateway.request       per parsed HTTP request in the serving gateway
                           (error => that request answers 500; the
                           connection layer and every other stream survive)
+    gateway.auth          per tenant resolution on a completions request
+                          (error => fails CLOSED: the request answers 401
+                          authentication_error, never admits as anonymous)
+    autoscaler.scale      per autoscale decision, before it executes
+                          (error => that scale-up/scale-down is skipped
+                          and counted; the serving path and the next tick
+                          are untouched)
     gateway.journal.append per journal record append (error => the append
                           raises and the gateway refuses the request —
                           durability is never silently dropped;

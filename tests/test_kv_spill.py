@@ -435,7 +435,8 @@ class TestChaosScenarioSelection:
         assert "baseline_spill" in names and "spill_storm" in names
         assert set(chaos_run.SUITE_SCENARIOS) == {
             "serving", "prefix", "spill", "perf", "serve-fleet",
-            "durable", "train", "straggler", "kvfabric", "locksan"}
+            "durable", "train", "straggler", "kvfabric", "locksan",
+            "tenancy"}
 
     def test_function_scenario_filtering(self):
         from tools import chaos_run

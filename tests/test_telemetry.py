@@ -29,6 +29,7 @@ import paddle_tpu
 from paddle_tpu import telemetry
 from paddle_tpu.models import LlamaForCausalLM, llama_tiny
 from paddle_tpu.serving import LLMEngine, SamplingParams
+from paddle_tpu.serving import engine as engine_mod
 from paddle_tpu.telemetry.flight_recorder import FlightRecorder
 from paddle_tpu.telemetry.metrics import MetricsRegistry
 from paddle_tpu.telemetry.tracing import Tracer
@@ -327,18 +328,11 @@ def test_fault_firing_lands_in_flight_recorder():
 # engine integration: histograms + lifecycle spans vs stats()
 # ---------------------------------------------------------------------------
 
-_STATS_KEYS = {
-    "queue_depth", "num_running", "num_finished", "num_failed",
-    "num_cancelled", "num_rejected", "blocks_used", "blocks_free",
-    "block_high_water", "cache_utilization", "num_preemptions",
-    "decode_traces", "prefill_traces", "total_generated_tokens",
-    "tokens_per_sec", "mean_ttft", "watchdog_trips", "last_decode_s",
-    "slo",   # PR 6: rolling-window SLO block (tests/test_cluster_telemetry)
-    "prefix_cache",   # PR 8: prefix-cache hit/CoW/eviction block
-                      # (tests/test_prefix_cache.py)
-    "perf",  # PR 9: compile/memory/step-phase observability block
-             # (tests/test_perf_observability.py)
-}
+# the canonical stats() schema now lives with the engine (ISSUE 17); the
+# per-block coverage stays with its own suite (slo: test_cluster_telemetry,
+# prefix_cache: test_prefix_cache, perf: test_perf_observability,
+# tenancy: test_tenancy)
+_STATS_KEYS = engine_mod.STATS_KEYS
 
 
 def _tiny_engine(**kw):

@@ -1,0 +1,647 @@
+"""Multi-tenant QoS + elastic autoscaling (ISSUE 17).
+
+Four layers of coverage, all deterministic (fake clocks everywhere time
+matters):
+
+- policy primitives: token-bucket refill math, registry identity
+  resolution (Bearer / bare key / 401 paths), rate-limit admission
+  bookkeeping, and JSON round-tripping;
+- the ISSUE's fairness properties: DRR over 3 tenants with 1:2:4
+  weights converges to 1:2:4 served-token shares under saturation, an
+  idle tenant's unused share redistributes (and its banked deficit is
+  forfeited, not cashed later), and with a single tenant the FairQueue
+  is operation-for-operation identical to the plain deque it replaced;
+- per-tenant prefix-cache quotas: an over-quota tenant's cached blocks
+  evict first even when another tenant's blocks are older in the LRU;
+- engine + autoscaler integration: token-for-token parity with the
+  untenanted reference decode, per-tenant roofline attribution that
+  reconciles with the engine totals, and the autoscaler control loop
+  (scale-up under pressure, restart-budget gate, cooldown + idle-hold
+  hysteresis, least-loaded victim, fault-site fail-static, mid-warm
+  loss re-decided from demand).
+"""
+import collections
+import json
+import random
+
+import pytest
+
+import paddle_tpu
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+from paddle_tpu.resilience.supervisor import ElasticSupervisor, JobLedger
+from paddle_tpu.serving import (
+    AuthError, Autoscaler, FairQueue, LLMEngine, PagedKVCache, STATS_KEYS,
+    SamplingParams, Tenant, TenantRegistry, TokenBucket, naive_generate)
+from paddle_tpu.serving.router import RouterShed
+from paddle_tpu.serving.scheduler import Request
+from paddle_tpu.serving.tenancy import TenantAccounting, dollars_for
+from paddle_tpu.utils import faults
+from paddle_tpu.utils.faults import FaultPlan
+
+pytestmark = pytest.mark.tenancy
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults.deactivate()
+
+
+class _Clock:
+    """Injectable monotonic clock."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = float(t)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float):
+        self.t += dt
+
+
+def _req(rid, tenant="anonymous", priority=0, prompt_len=10, new=6):
+    return Request(rid=rid, prompt=[0] * prompt_len,
+                   sampling=SamplingParams(max_new_tokens=new),
+                   tenant=tenant, priority=priority)
+
+
+# ---------------------------------------------------------------------------
+# token bucket + registry
+# ---------------------------------------------------------------------------
+
+class TestTokenBucket:
+    def test_refill_math(self):
+        clk = _Clock()
+        b = TokenBucket(rate=10.0, burst=20.0, clock=clk)
+        assert b.level == 20.0                 # starts full
+        assert b.try_acquire(15)
+        assert b.level == 5.0
+        assert not b.try_acquire(10)           # 5 < 10
+        assert b.retry_after(10) == pytest.approx(0.5)
+        clk.advance(0.5)
+        assert b.try_acquire(10)               # exactly refilled
+        assert b.retry_after(20) == pytest.approx(2.0)
+
+    def test_oversized_cost_clamps_to_burst(self):
+        # a request bigger than the whole bucket pays a full-bucket drain
+        # instead of never admitting
+        clk = _Clock()
+        b = TokenBucket(rate=1.0, burst=8.0, clock=clk)
+        assert b.try_acquire(10_000)
+        assert b.level == 0.0
+        assert b.retry_after(10_000) == pytest.approx(8.0)  # clamped too
+
+    def test_level_never_exceeds_burst(self):
+        clk = _Clock()
+        b = TokenBucket(rate=100.0, burst=5.0, clock=clk)
+        clk.advance(60)
+        assert b.level == 5.0
+
+    def test_bad_rate_rejected(self):
+        with pytest.raises(ValueError):
+            TokenBucket(rate=0.0)
+
+
+class TestTenantRegistry:
+    def _registry(self, clk=None):
+        return TenantRegistry([
+            Tenant(name="acme", weight=4.0, rate_tokens_per_s=10.0,
+                   burst_tokens=20.0, api_keys=("sk-acme",)),
+            Tenant(name="beta", weight=1.0, block_quota=2,
+                   api_keys=("sk-beta", "sk-beta2")),
+        ], clock=clk or _Clock())
+
+    def test_keyless_registry_is_open(self):
+        reg = TenantRegistry()
+        assert not reg.require_auth
+        assert reg.resolve(None) == "anonymous"
+        assert reg.resolve("Bearer whatever") == "anonymous"
+
+    def test_resolve_bearer_and_bare_keys(self):
+        reg = self._registry()
+        assert reg.require_auth
+        assert reg.resolve("Bearer sk-acme") == "acme"
+        assert reg.resolve("bearer sk-beta") == "beta"   # case-insensitive
+        assert reg.resolve("sk-beta2") == "beta"         # bare key
+        with pytest.raises(AuthError):
+            reg.resolve(None)                            # missing
+        with pytest.raises(AuthError):
+            reg.resolve("Bearer sk-nope")                # unknown
+
+    def test_admit_charges_bucket_and_counts(self):
+        clk = _Clock()
+        reg = self._registry(clk)
+        assert reg.admit("acme", 15) is None             # burst 20 covers it
+        retry = reg.admit("acme", 15)                    # 5 left < 15
+        assert retry == pytest.approx(1.0)               # (15-5)/10
+        assert reg.accepted["acme"] == 1 and reg.shed["acme"] == 1
+        clk.advance(1.0)
+        assert reg.admit("acme", 15) is None
+        # unlimited tenants always admit
+        for _ in range(50):
+            assert reg.admit("beta", 10_000) is None
+        assert reg.accepted["beta"] == 50 and "beta" not in reg.shed
+
+    def test_unknown_names_fall_back_to_anonymous_policy(self):
+        reg = self._registry()
+        assert reg.weight("acme") == 4.0
+        assert reg.weight("stranger") == 1.0             # never KeyErrors
+        assert reg.get(None).name == "anonymous"
+        assert reg.admit("stranger", 10_000) is None     # unlimited
+
+    def test_duplicate_names_and_keys_rejected(self):
+        with pytest.raises(ValueError, match="duplicate tenant"):
+            TenantRegistry([Tenant(name="a"), Tenant(name="a")])
+        with pytest.raises(ValueError, match="already belongs"):
+            TenantRegistry([Tenant(name="a", api_keys=("k",)),
+                            Tenant(name="b", api_keys=("k",))])
+        with pytest.raises(ValueError, match="weight"):
+            Tenant(name="a", weight=0.0)
+
+    def test_roundtrip_and_key_redaction(self):
+        reg = self._registry()
+        doc = reg.to_dict()
+        reg2 = TenantRegistry.from_dict(json.loads(json.dumps(doc)),
+                                        clock=_Clock())
+        assert reg2.resolve("Bearer sk-acme") == "acme"
+        assert reg2.weight("acme") == 4.0
+        assert reg2.block_quotas() == {"beta": 2}
+        redacted = reg.to_dict(keys=False)
+        assert all(d["api_keys"] == [] for d in redacted["tenants"])
+
+    def test_snapshot_shape(self):
+        clk = _Clock()
+        reg = self._registry(clk)
+        reg.admit("acme", 20)
+        reg.admit("acme", 20)
+        snap = reg.snapshot()
+        assert snap["require_auth"] is True
+        acme = snap["tenants"]["acme"]
+        assert acme["accepted"] == 1 and acme["shed"] == 1
+        assert acme["bucket_level"] == 0.0
+        assert snap["tenants"]["anonymous"]["rate_tokens_per_s"] is None
+
+
+# ---------------------------------------------------------------------------
+# weighted-fair queuing (the ISSUE's property tests)
+# ---------------------------------------------------------------------------
+
+class TestFairQueue:
+    def _weights(self, w):
+        return FairQueue(weight_fn=lambda t: w.get(t, 1.0))
+
+    def test_drr_converges_to_weighted_shares(self):
+        """Three saturated tenants at 1:2:4 weights serve 1:2:4 tokens."""
+        w = {"a": 1.0, "b": 2.0, "c": 4.0}
+        fq = self._weights(w)
+        rid = 0
+        for t in w:
+            for _ in range(200):                 # saturation: never drains
+                fq.append(_req(rid, tenant=t))   # cost 16 each
+                rid += 1
+        for _ in range(350):
+            fq.popleft()
+        assert set(fq.depths()) == set(w)        # nobody drained
+        served = fq.served_cost
+        assert served["b"] / served["a"] == pytest.approx(2.0, rel=0.15)
+        assert served["c"] / served["a"] == pytest.approx(4.0, rel=0.15)
+        assert sum(served.values()) == pytest.approx(350 * 16)
+
+    def test_idle_tenant_share_redistributes(self):
+        """With 'c' absent, 'a' and 'b' split the machine 1:2 — c's paper
+        share is not reserved."""
+        w = {"a": 1.0, "b": 2.0, "c": 4.0}
+        fq = self._weights(w)
+        rid = 0
+        for t in ("a", "b"):
+            for _ in range(200):
+                fq.append(_req(rid, tenant=t))
+                rid += 1
+        for _ in range(250):
+            fq.popleft()
+        served = fq.served_cost
+        assert served["b"] / served["a"] == pytest.approx(2.0, rel=0.15)
+
+    def test_drained_tenant_forfeits_deficit(self):
+        """A tenant that drains leaves the rotation with no banked credit:
+        rejoining later starts from zero deficit, so idle time never
+        converts into a burst."""
+        fq = self._weights({"a": 1.0, "b": 1.0})
+        fq.append(_req(0, tenant="a"))
+        for i in range(1, 8):
+            fq.append(_req(i, tenant="b"))
+        # pop until a's single request served and its queue drained
+        while "a" in fq.depths():
+            fq.popleft()
+        assert "a" not in fq._deficit            # forfeited with the queue
+        fq.append(_req(99, tenant="a"))
+        assert fq._deficit["a"] == 0.0           # rejoins with zero credit
+
+    def test_single_tenant_is_exactly_fifo(self):
+        """Operation-for-operation identical to the plain deque the
+        scheduler used before tenancy (satellite 3c)."""
+        rng = random.Random(7)
+        fq, dq = FairQueue(), collections.deque()
+        live = []
+        for step in range(2000):
+            op = rng.random()
+            if op < 0.45 or not live:
+                r = _req(step, prompt_len=rng.randrange(1, 30),
+                         new=rng.randrange(1, 20))
+                fq.append(r), dq.append(r), live.append(r)
+            elif op < 0.65:
+                # the preemption-requeue path: a (previously popped)
+                # request rejoins at the front
+                r = _req(10_000 + step, prompt_len=rng.randrange(1, 30))
+                fq.appendleft(r), dq.appendleft(r), live.append(r)
+            elif op < 0.85:
+                assert fq[0] is dq[0]
+                a, b = fq.popleft(), dq.popleft()
+                assert a is b
+                live.remove(a)
+            else:
+                r = live.pop(rng.randrange(len(live)))
+                fq.remove(r), dq.remove(r)
+            assert len(fq) == len(dq) and bool(fq) == bool(dq)
+            assert list(fq) == list(dq)
+        while dq:
+            assert fq.popleft() is dq.popleft()
+        assert not fq and len(fq) == 0
+
+    def test_priority_orders_within_tenant_only(self):
+        fq = self._weights({"a": 1.0})
+        r0, r1 = _req(0, "a"), _req(1, "a")
+        hi = _req(2, "a", priority=5)
+        hi2 = _req(3, "a", priority=5)
+        fq.append(r0), fq.append(r1), fq.append(hi), fq.append(hi2)
+        # priority jumps the tenant's own line; equal priorities stay FIFO
+        assert [fq.popleft() for _ in range(4)] == [hi, hi2, r0, r1]
+
+    def test_resume_stack_served_first_and_uncharged(self):
+        """appendleft is the preemption-requeue path: served before any
+        fairness arbitration and never charged to served_cost."""
+        fq = self._weights({"a": 1.0, "b": 8.0})
+        fq.append(_req(0, "b"))
+        pre = _req(1, "a")
+        fq.appendleft(pre)
+        assert fq[0] is pre
+        assert fq.popleft() is pre
+        assert "a" not in fq.served_cost         # resume pops are free
+        fq.popleft()
+        assert list(fq.served_cost) == ["b"]
+
+    def test_remove_unknown_raises(self):
+        fq = FairQueue()
+        fq.append(_req(0))
+        with pytest.raises(ValueError):
+            fq.remove(_req(1))
+        with pytest.raises(IndexError):
+            FairQueue().popleft()
+
+
+# ---------------------------------------------------------------------------
+# prefix-cache quotas
+# ---------------------------------------------------------------------------
+
+def _cache(num_blocks=17, block_size=4):
+    return PagedKVCache(num_layers=1, num_blocks=num_blocks, kv_heads=1,
+                        block_size=block_size, head_dim=4,
+                        prefix_cache=True)
+
+
+def _park(cache, seq_id, tokens, tenant):
+    """Allocate + commit + free: the tokens' blocks land in the evictable
+    LRU attributed to ``tenant``."""
+    assert cache.allocate(seq_id, len(tokens), tokens=tokens, tenant=tenant)
+    cache.commit_prefix(seq_id, tokens)
+    cache.free_seq(seq_id)
+
+
+class TestTenantQuota:
+    def test_over_quota_blocks_evict_before_older_lru(self):
+        c = _cache()                              # 16 usable blocks
+        c.set_tenant_quotas({"hog": 1})
+        _park(c, "bg", [7 + i for i in range(8)], "bg")    # 2 blocks, OLDER
+        _park(c, "hog", [40 + i for i in range(8)], "hog")  # 2 blocks, newer
+        st = c.prefix_stats()["tenants"]
+        assert st["bg"]["cached_blocks"] == 2
+        assert st["hog"]["cached_blocks"] == 2    # over its quota of 1
+        # 12 free; demand 13 forces exactly one eviction — the over-quota
+        # tenant's oldest block, not bg's strictly older ones
+        assert c.allocate("big", 13 * 4)
+        st = c.prefix_stats()["tenants"]
+        assert c.quota_evictions == {"hog": 1}
+        assert st["hog"]["cached_blocks"] == 1
+        assert st["hog"]["quota_evictions"] == 1
+        assert st["bg"]["cached_blocks"] == 2     # untouched
+        c.free_seq("big")
+
+    def test_within_quota_falls_back_to_plain_lru(self):
+        c = _cache()
+        c.set_tenant_quotas({"hog": 4})
+        _park(c, "bg", [7 + i for i in range(8)], "bg")
+        _park(c, "hog", [40 + i for i in range(8)], "hog")
+        assert c.allocate("big", 13 * 4)          # everyone within quota:
+        assert c.quota_evictions == {}            # oldest (bg) goes instead
+        assert c.prefix_stats()["tenants"]["bg"]["cached_blocks"] == 1
+
+    def test_quota_never_touches_live_references(self):
+        c = _cache(num_blocks=9)                  # 8 usable
+        c.set_tenant_quotas({"hog": 0})           # everything is over quota
+        toks = [40 + i for i in range(8)]
+        assert c.allocate("live", len(toks), tokens=toks, tenant="hog")
+        c.commit_prefix("live", toks)             # cached AND referenced
+        # a demand that would need eviction finds nothing evictable: the
+        # live sequence's blocks are not in the LRU
+        assert c.allocate("big", 7 * 4) is False
+        assert c.quota_evictions == {}
+        assert "live" in c.tables
+
+
+# ---------------------------------------------------------------------------
+# accounting
+# ---------------------------------------------------------------------------
+
+class TestTenantAccounting:
+    def test_totals_reconcile_with_per_tenant_sums(self):
+        acct = TenantAccounting(TenantRegistry(), "eng-test")
+        acct.note_request("a"), acct.note_request("b"), acct.note_request("a")
+        acct.note_tokens("a", 5), acct.note_tokens("b", 3)
+        acct.note_cost("a", 1e9, 2e6)
+        acct.note_cost("b", 3e9, 4e6)
+        acct.note_cost("b", 0.0, 0.0)             # no-op, not a key
+        s = acct.summary()
+        t = s["tenants"]
+        assert t["a"]["requests"] == 2 and t["b"]["requests"] == 1
+        assert s["totals"]["flops"] == pytest.approx(
+            t["a"]["cost"]["flops"] + t["b"]["cost"]["flops"])
+        assert s["totals"]["flops"] == pytest.approx(4e9)
+        assert s["totals"]["generated_tokens"] == 8
+        assert t["a"]["cost"]["dollars"] == pytest.approx(
+            dollars_for(1e9, 2e6))
+
+    def test_dollars_scale_with_rate(self):
+        assert dollars_for(1e12, 1e9, rate_per_h=8.4) == pytest.approx(
+            2 * dollars_for(1e12, 1e9, rate_per_h=4.2))
+        assert dollars_for(0.0, 0.0) == 0.0
+
+
+class TestRouterShed:
+    def test_carries_tenant_and_retry_after(self):
+        e = RouterShed("tenant 'acme' over its rate limit",
+                       retry_after_s=1.5, tenant="acme")
+        assert e.retry_after_s == 1.5 and e.tenant == "acme"
+        assert RouterShed("fleet saturated").tenant is None
+
+
+# ---------------------------------------------------------------------------
+# engine integration: parity + attribution
+# ---------------------------------------------------------------------------
+
+def _tiny_model(vocab=61, hidden=32, layers=2, heads=4, kv_heads=2, seq=64):
+    paddle_tpu.seed(0)
+    cfg = llama_tiny(vocab=vocab, hidden=hidden, layers=layers, heads=heads,
+                     kv_heads=kv_heads, inter=2 * hidden, seq=seq)
+    return LlamaForCausalLM(cfg)
+
+
+class TestEngineTenancy:
+    def test_multitenant_parity_and_attribution(self):
+        """Tenant labels change accounting, never tokens: multi-tenant
+        engine output is token-for-token the untenanted reference, and
+        the per-tenant roofline attribution reconciles with the engine's
+        own totals."""
+        model = _tiny_model()
+        eng = LLMEngine(model, block_size=8, max_slots=3, max_model_len=64,
+                        tenancy={"tenants": [
+                            {"name": "a", "weight": 4.0},
+                            {"name": "b", "weight": 1.0}]})
+        prompts = [[3, 1, 4, 1, 5], [2, 7, 1, 8, 2, 8], [1, 1, 2, 3, 5, 8],
+                   [9, 8, 7]]
+        sp = SamplingParams(max_new_tokens=4)
+        tenants = ["a", "b", "a", "anonymous"]
+        handles = [eng.add_request(p, sp, tenant=t)
+                   for p, t in zip(prompts, tenants)]
+        eng.run()
+        refs = [naive_generate(model, p, sp) for p in prompts]
+        assert [h.output_tokens for h in handles] == refs
+
+        st = eng.stats()
+        assert set(st) == STATS_KEYS
+        ten = st["tenancy"]["tenants"]
+        assert ten["a"]["requests"] == 2 and ten["a"]["finished"] == 2
+        assert ten["b"]["generated_tokens"] == 4
+        assert ten["anonymous"]["requests"] == 1
+        # attribution reconciles: per-tenant FLOPs are all real and sum
+        # exactly to the engine-wide total (acceptance asks within 5%)
+        totals = st["tenancy"]["totals"]
+        assert all(ten[t]["cost"]["flops"] > 0 for t in ("a", "b",
+                                                         "anonymous"))
+        assert sum(ten[t]["cost"]["flops"] for t in ten) == pytest.approx(
+            totals["flops"])
+        assert totals["generated_tokens"] == 16
+        assert ten["a"]["slo"]["goodput_ratio"] == 1.0
+        eng.close()
+
+    def test_queue_full_not_counted_as_tenant_request(self):
+        model = _tiny_model()
+        eng = LLMEngine(model, block_size=8, max_slots=1, max_model_len=64,
+                        max_queue=2)
+        sp = SamplingParams(max_new_tokens=2)
+        eng.add_request([1, 2, 3], sp, tenant="a")
+        eng.add_request([4, 5, 6], sp, tenant="a")   # queued
+        with pytest.raises(Exception):
+            eng.add_request([7, 8, 9], sp, tenant="a")
+        eng.run()
+        assert eng.stats()["tenancy"]["tenants"]["a"]["requests"] == 2
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# autoscaler control loop
+# ---------------------------------------------------------------------------
+
+class _StubRouter:
+    """A scripted FleetRouter: tests set the load signal, the autoscaler
+    actuates against it."""
+
+    def __init__(self, healthy=("r0",), stopped=("r1", "r2")):
+        self.state = {r: "healthy" for r in healthy}
+        self.state.update({r: "stopped" for r in stopped})
+        self.replicas = {r: None for r in self.state}
+        self.inflight_by_rid = {}
+        self.queued = 0
+        self.est_wait_s = 0.0
+        self.restarts, self.drains = [], []
+
+    def load_signal(self):
+        by_state = {"healthy": [], "starting": [], "draining": [],
+                    "unhealthy": [], "stopped": []}
+        for rid in sorted(self.state):
+            by_state[self.state[rid]].append(rid)
+        inflight = {r: n for r, n in self.inflight_by_rid.items() if n}
+        return {**by_state, "inflight": sum(inflight.values()),
+                "inflight_by_rid": inflight, "queued": self.queued,
+                "est_wait_s": (self.est_wait_s if by_state["healthy"]
+                               else float("inf"))}
+
+    def restart(self, rid):
+        self.restarts.append(rid)
+        self.state[rid] = "starting"
+
+    def drain(self, rid, stop_replica=False):
+        self.drains.append(rid)
+        self.state[rid] = "stopped"
+        return {"drained": True, "failed_over": 0}
+
+
+def _scaler(router, clk, tmp_path=None, max_restarts=5, **kw):
+    sup = None
+    if tmp_path is not None:
+        sup = ElasticSupervisor(
+            world_size=1, max_restarts=max_restarts,
+            ledger=JobLedger(str(tmp_path / "job_state.json")))
+    kw.setdefault("scale_up_wait_s", 5.0)
+    kw.setdefault("cooldown_s", 5.0)
+    kw.setdefault("down_hold_s", 10.0)
+    return Autoscaler(router, supervisor=sup, clock=clk, **kw)
+
+
+class TestAutoscaler:
+    def test_scale_up_and_time_to_healthy(self, tmp_path):
+        r, clk = _StubRouter(), _Clock()
+        a = _scaler(r, clk, tmp_path)
+        r.est_wait_s, r.queued = 12.0, 8
+        d = a.tick()
+        assert d["action"] == "up" and d["replica"] == "r1"
+        assert r.restarts == ["r1"]
+        assert a.stats()["pending"] == ["r1"]
+        clk.advance(2.0)
+        r.state["r1"] = "healthy"
+        r.est_wait_s = 0.0                      # pressure relieved
+        a.tick()                                # settles the pending watch
+        ups = a.stats()["scale_ups"]
+        assert ups and ups[-1] == {"replica": "r1",
+                                   "time_to_healthy_s": pytest.approx(2.0)}
+        events = [e["event"] for e in
+                  a.supervisor.ledger.read()["events"]]
+        assert events == ["scale_up", "scale_up_healthy"]
+
+    def test_budget_exhausted_refuses_scale_up(self, tmp_path):
+        r, clk = _StubRouter(), _Clock()
+        a = _scaler(r, clk, tmp_path, max_restarts=1, cooldown_s=0.0)
+        r.est_wait_s, r.queued = 12.0, 8
+        assert a.tick()["action"] == "up"       # consumes the one restart
+        clk.advance(1.0)
+        d = a.tick()
+        assert d["action"] == "budget_exhausted"
+        assert r.restarts == ["r1"]             # r2 never actuated
+        assert a.stats()["budget_remaining"] == 0
+        assert a.stats()["decisions"]["budget_exhausted"] == 1
+        assert "scale_up_denied" in [
+            e["event"] for e in a.supervisor.ledger.read()["events"]]
+
+    def test_cooldown_spaces_actions(self):
+        r, clk = _StubRouter(), _Clock()
+        a = _scaler(r, clk)
+        r.est_wait_s, r.queued = 12.0, 8
+        assert a.tick()["action"] == "up"
+        clk.advance(1.0)
+        assert a.tick()["action"] == "none"     # in cooldown despite demand
+        clk.advance(10.0)
+        assert a.tick()["action"] == "up"       # cooldown over: r2 revives
+        assert r.restarts == ["r1", "r2"]
+
+    def test_stale_est_wait_without_queue_is_not_demand(self):
+        # post-burst: the SLO-window-derived wait estimate is still hot
+        # but the queues are already empty — acting on the stale estimate
+        # would flap (scale-down on idle, scale-up on the estimate,
+        # repeat); the chaos suite's burst scenario caught this cycle
+        r, clk = _StubRouter(), _Clock()
+        a = _scaler(r, clk, cooldown_s=0.0)
+        r.est_wait_s, r.queued = 12.0, 0
+        assert a.tick()["action"] == "none"
+        assert r.restarts == []
+
+    def test_settle_restarts_idle_hold(self):
+        # idle accumulated while a revival warmed (pending blocks the
+        # down) must not authorize a scale-down in the very tick the
+        # revival settles — the hold measures the NEW fleet shape
+        r = _StubRouter(healthy=("r0", "r1"), stopped=("r2",))
+        clk = _Clock(100.0)
+        a = _scaler(r, clk, cooldown_s=0.0, down_hold_s=1.5)
+        r.est_wait_s, r.queued = 12.0, 8
+        assert a.tick()["action"] == "up"       # r2 pending
+        r.est_wait_s, r.queued = 0.0, 0         # burst drained: idle
+        clk.advance(5.0)
+        assert a.tick()["action"] == "none"     # pending blocks the down
+        clk.advance(5.0)
+        r.state["r2"] = "healthy"
+        assert a.tick()["action"] == "none"     # settle tick: hold resets
+        clk.advance(1.0)
+        assert a.tick()["action"] == "none"     # fresh hold not yet met
+        clk.advance(1.0)
+        assert a.tick()["action"] == "down"     # a full hold later
+
+    def test_scale_down_needs_sustained_idle(self):
+        r = _StubRouter(healthy=("r0", "r1", "r2", "r3"), stopped=())
+        clk = _Clock(100.0)
+        a = _scaler(r, clk, min_replicas=1)
+        r.inflight_by_rid = {"r0": 1}           # util 0.25 == threshold
+        assert a.tick()["action"] == "none"     # idle clock starts
+        clk.advance(5.0)
+        r.queued = 3                            # busy blip resets the hold
+        assert a.tick()["action"] == "none"
+        r.queued = 0
+        clk.advance(1.0)
+        assert a.tick()["action"] == "none"     # hold restarted at t=106
+        clk.advance(8.0)
+        assert a.tick()["action"] == "none"     # 8s < down_hold_s
+        clk.advance(3.0)
+        d = a.tick()                            # 11s idle: drain one
+        assert d["action"] == "down"
+        assert d["replica"] == "r1"             # least-loaded, not r0
+        assert r.drains == ["r1"] and d["drain"]["drained"]
+
+    def test_never_below_min_replicas(self):
+        r = _StubRouter(healthy=("r0",), stopped=())
+        clk = _Clock()
+        a = _scaler(r, clk, min_replicas=1, down_hold_s=1.0)
+        for _ in range(10):
+            clk.advance(5.0)
+            assert a.tick()["action"] == "none"
+        assert r.drains == []
+
+    def test_fault_site_fails_static(self):
+        r, clk = _StubRouter(), _Clock()
+        a = _scaler(r, clk)
+        r.est_wait_s, r.queued = 12.0, 8
+        with FaultPlan.parse("autoscaler.scale:error"):
+            assert a.tick()["action"] == "fault"
+        assert r.restarts == []                 # nothing actuated
+        assert a.stats()["decisions"]["fault"] == 1
+        assert a.tick()["action"] == "up"       # next tick re-decides
+
+    def test_mid_warm_death_redecided_from_demand(self):
+        r, clk = _StubRouter(), _Clock()
+        a = _scaler(r, clk, cooldown_s=0.0)
+        r.est_wait_s, r.queued = 12.0, 8
+        assert a.tick()["action"] == "up"
+        clk.advance(1.0)
+        r.state["r1"] = "stopped"               # SIGKILL'd mid-warm
+        d = a.tick()                            # watch dropped; demand
+        assert a.stats()["scale_ups"] == []     # never counted healthy
+        assert d["action"] == "up"              # re-decides immediately
+        assert r.restarts in (["r1", "r1"], ["r1", "r2"])
+
+    def test_pending_blocks_scale_down(self):
+        r = _StubRouter(healthy=("r0", "r1"), stopped=("r2",))
+        clk = _Clock()
+        a = _scaler(r, clk, cooldown_s=0.0, down_hold_s=0.0)
+        r.est_wait_s, r.queued = 12.0, 8
+        assert a.tick()["action"] == "up"       # r2 pending
+        r.est_wait_s, r.queued = 0.0, 0
+        clk.advance(50.0)
+        assert a.tick()["action"] == "none"     # pending warm-up holds fire
+        assert r.drains == []

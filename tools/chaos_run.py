@@ -813,15 +813,17 @@ class _SSEClient(threading.Thread):
     """One streaming HTTP client: POSTs a completion with stream=true and
     collects every token chunk until [DONE]."""
 
-    def __init__(self, gw, prompt, sp, priority=0):
+    def __init__(self, gw, prompt, sp, priority=0, api_key=None):
         super().__init__(daemon=True)
         self.gw, self.prompt, self.sp = gw, list(prompt), sp
         self.priority = priority
+        self.api_key = api_key            # tenant identity (Bearer key)
         self.status = None
         self.tokens: list[int] = []
         self.finish = None
         self.error = None
         self.retry_after = None
+        self.shed_tenant = None           # the 429 body's tenant field
         self.start()
 
     def run(self):
@@ -834,16 +836,20 @@ class _SSEClient(threading.Thread):
                 "top_k": self.sp.top_k, "top_p": self.sp.top_p,
                 "seed": self.sp.seed, "priority": self.priority,
                 "stream": True}
+        headers = {"Content-Type": "application/json"}
+        if self.api_key:
+            headers["Authorization"] = f"Bearer {self.api_key}"
         try:
             conn = http.client.HTTPConnection(self.gw.host, self.gw.port,
                                               timeout=600)
             conn.request("POST", "/v1/completions", _json.dumps(body),
-                         {"Content-Type": "application/json"})
+                         headers)
             resp = conn.getresponse()
             self.status = resp.status
             if resp.status != 200:
                 doc = _json.loads(resp.read())
                 self.error = doc.get("error", {}).get("message")
+                self.shed_tenant = doc.get("error", {}).get("tenant")
                 self.retry_after = resp.getheader("Retry-After")
                 conn.close()
                 return
@@ -1192,6 +1198,315 @@ def run_serve_fleet_suite(args, workdir=None, scenario=None):
         "suite": "serve-fleet",
         "workdir": workdir,
         "config": {"requests": args.requests, "prompt_len": args.prompt_len,
+                   "max_new_tokens": args.max_new, "slots": args.slots,
+                   "block_size": args.block_size},
+        "plans_run": len(rows),
+        "plans_survived": survived,
+        "all_survived": survived == len(rows),
+        "zero_lost_requests": bool(zero_lost),
+        "flight_recorder_dump": dump_path,
+        "results": rows,
+    }
+
+
+# -- the tenancy battery ---------------------------------------------------
+#
+# ``--suite tenancy`` (docs/ROBUSTNESS.md "Fleet degradation", ISSUE 17):
+# multi-tenant QoS under abuse, and the autoscaler's closed loop under
+# infrastructure failure. Two scenarios: (1) a noisy neighbor floods the
+# gateway at ~10x its rate limit while background tenants keep their SLO
+# windows — only the hot tenant is shed (per-tenant 429s with its own
+# bucket-refill Retry-After), per-tenant roofline cost attribution
+# reconciles with the fleet-total FLOPs, and a follow-up prefix-evict
+# storm from an over-quota tenant degrades that tenant's cache hit rate,
+# nobody else's correctness; (2) a demand burst drives the Autoscaler to
+# revive a parked replica through the ElasticSupervisor restart budget,
+# the new replica is SIGKILLed mid-warm (degrades to another cold
+# revival, never lost requests), and sustained idle scales back down with
+# hysteresis — the whole story recorded in the JobLedger.
+
+def _tenant_registry_spec():
+    """The battery's tenant table: a rate-limited hot tenant, two
+    SLO-tracked background tenants, and a quota-capped spiky tenant."""
+    from paddle_tpu.serving import Tenant, TenantRegistry
+
+    return TenantRegistry([
+        # burst covers exactly 2 requests at cost 40 (24 prompt + 16 new);
+        # refill is negligible over the scenario, so a 20-request flood is
+        # ~10x the tenant's admissible rate
+        Tenant(name="hot", weight=1.0, rate_tokens_per_s=0.01,
+               burst_tokens=80.0, api_keys=("sk-hot",)),
+        Tenant(name="bg1", weight=4.0, ttft_slo_s=60.0, tpot_slo_s=5.0,
+               api_keys=("sk-bg1",)),
+        Tenant(name="bg2", weight=4.0, ttft_slo_s=60.0, tpot_slo_s=5.0,
+               api_keys=("sk-bg2",)),
+        Tenant(name="spiky", weight=1.0, block_quota=1,
+               api_keys=("sk-spiky",)),
+    ])
+
+
+def _scenario_noisy_neighbor(args, workdir, spec, max_len):
+    """Hot tenant floods at 10x its rate limit: background tenants hold
+    their SLO windows and token parity, only the hot tenant is shed, and
+    per-tenant cost attribution sums to the fleet's roofline FLOPs."""
+    from paddle_tpu.serving import (FleetRouter, Gateway, LLMEngine as _E,
+                                    LocalReplica)
+    from paddle_tpu.serving.replica_worker import build_model
+
+    # a modest block pool: phase 2's quota storm must actually evict
+    spec = dict(spec, engine=dict(spec["engine"], num_blocks=26))
+    reg = _tenant_registry_spec()
+
+    def factory():
+        return _E(build_model(spec), **spec["engine"], tenancy=reg.to_dict())
+
+    sp = SamplingParams(max_new_tokens=args.max_new, temperature=0.0)
+    rng = np.random.RandomState(11)
+
+    def prompt():
+        return [int(t) for t in rng.randint(0, args.vocab, args.prompt_len)]
+
+    bg_prompts = [prompt() for _ in range(4)]
+    hot_prompts = [prompt() for _ in range(20)]
+    refs = _fleet_reference(spec, bg_prompts, [sp] * len(bg_prompts))
+    reps = [LocalReplica(f"p{i}", factory, stats_interval_s=0.05,
+                         warmup=spec["warmup"]) for i in range(2)]
+    router = FleetRouter(reps, probe_interval_s=0.1, probe_timeout_s=30.0,
+                         affinity_block_size=spec["engine"]["block_size"]
+                         ).start(wait_healthy_s=600)
+    gateway = Gateway(router, tenancy=reg).start()
+    try:
+        # -- phase 1: queue flood ------------------------------------------
+        bg = [_SSEClient(gateway, p, sp,
+                         api_key="sk-bg1" if i % 2 else "sk-bg2")
+              for i, p in enumerate(bg_prompts)]
+        hot = [_SSEClient(gateway, p, sp, api_key="sk-hot")
+               for p in hot_prompts]
+        for c in bg + hot:
+            c.join(600)
+        hot_ok = [c for c in hot if c.status == 200]
+        hot_shed = [c for c in hot if c.status == 429]
+        bg_lost = [i for i, c in enumerate(bg)
+                   if c.status != 200 or c.error or c.tokens != refs[i]]
+        shed_ok = (len(hot_ok) == 2 and len(hot_shed) == 18
+                   and all(c.shed_tenant == "hot" and c.retry_after
+                           for c in hot_shed))
+
+        # per-tenant cost attribution vs the fleet total: every prompt in
+        # phase 1 has the same length, so each engine ran exactly one
+        # prefill bucket and the one decode bucket — bucket cost x execution
+        # count reconstructs the engine's whole roofline spend. samples
+        # counts steady-state steps only; the bucket's compile-step
+        # execution (real work, charged to its tenant) is the +1
+        attributed, modeled, single_bucket = 0.0, 0.0, True
+        tenant_flops: dict[str, float] = {}
+        for rep in reps:
+            st = rep.engine.stats()
+            for name, row in st["tenancy"]["tenants"].items():
+                f = row["cost"]["flops"]
+                attributed += f
+                tenant_flops[name] = tenant_flops.get(name, 0.0) + f
+            for kind in ("prefill", "decode"):
+                entry = st["perf"]["roofline"][kind]
+                if len(entry["buckets"]) != 1:
+                    single_bucket = False
+                    continue
+                (est,) = entry["buckets"].values()
+                modeled += est["flops"] * (entry["samples"] + 1)
+        cost_ok = (single_bucket and modeled > 0
+                   and abs(attributed - modeled) / modeled <= 0.05)
+
+        # background SLO windows held (per-tenant trackers, worst replica)
+        slo_ok, bg_p99 = True, 0.0
+        for rep in reps:
+            ten = rep.engine.stats()["tenancy"]["tenants"]
+            for name in ("bg1", "bg2"):
+                row = ten.get(name)
+                if row is None or row["slo"] is None:
+                    continue
+                if row["slo"]["goodput_ratio"] < 1.0:
+                    slo_ok = False
+                bg_p99 = max(bg_p99, row["slo"]["ttft"]["p99"] or 0.0)
+        slo_ok = slo_ok and bg_p99 < 60.0
+
+        # -- phase 2: prefix-evict storm from an over-quota tenant ---------
+        shared = [int(t) for t in rng.randint(0, args.vocab, 16)]
+        spiky = [_SSEClient(gateway, shared + prompt()[:8], sp,
+                            api_key="sk-spiky") for _ in range(10)]
+        bg2 = [_SSEClient(gateway, p, sp,
+                          api_key="sk-bg1" if i % 2 else "sk-bg2")
+               for i, p in enumerate(bg_prompts[:2])]
+        for c in spiky + bg2:
+            c.join(600)
+        quota_evictions = sum(
+            rep.engine.cache.prefix_stats()["tenants"]
+            .get("spiky", {}).get("quota_evictions", 0) for rep in reps)
+        storm_ok = (all(c.status == 200 and not c.error for c in spiky)
+                    and all(c.status == 200 and c.tokens == refs[i]
+                            for i, c in enumerate(bg2))
+                    and quota_evictions >= 1)
+
+        gw_stats = json.loads(_http_get(gateway, "/stats"))
+        snap = gw_stats["tenancy"]["tenants"]
+        counts_ok = (snap["hot"]["shed"] == 18
+                     and all(snap[t]["shed"] == 0
+                             for t in ("bg1", "bg2", "spiky")))
+        ok = (shed_ok and not bg_lost and cost_ok and slo_ok and storm_ok
+              and counts_ok)
+        return {
+            "scenario": "noisy_neighbor",
+            "survived": bool(ok),
+            "hot_admitted": len(hot_ok),
+            "hot_shed_429": len(hot_shed),
+            "lost_requests": len(bg_lost),
+            "bg_ttft_p99_s": round(bg_p99, 4),
+            "bg_slo_held": bool(slo_ok),
+            "flops_attributed": attributed,
+            "flops_modeled": modeled,
+            "cost_attribution_ok": bool(cost_ok),
+            "spiky_quota_evictions": quota_evictions,
+            "per_tenant_shed": {t: snap[t]["shed"] for t in snap},
+        }
+    finally:
+        gateway.stop()
+        router.close()
+
+
+def _http_get(gw, path):
+    import http.client
+
+    conn = http.client.HTTPConnection(gw.host, gw.port, timeout=120)
+    conn.request("GET", path)
+    body = conn.getresponse().read()
+    conn.close()
+    return body
+
+
+def _scenario_autoscale_burst_kill(args, workdir, spec, max_len):
+    """Closed-loop autoscaling under failure: a burst revives a parked
+    replica through the restart budget, the new replica is SIGKILLed
+    mid-warm (the autoscaler degrades to another revival), every stream
+    completes with parity, and sustained idle scales back down without
+    flapping — all of it in the JobLedger."""
+    from paddle_tpu.resilience import ElasticSupervisor, JobLedger
+    from paddle_tpu.serving import Autoscaler
+
+    ledger = JobLedger(os.path.join(workdir, "autoscale_job_state.json"))
+    supervisor = ElasticSupervisor(world_size=3, max_restarts=6,
+                                   ledger=ledger)
+    # longer decodes keep the burst's queue deep through the kill window
+    spec = dict(spec, engine=dict(
+        spec["engine"], max_model_len=args.prompt_len + 2 * args.max_new))
+    sp = SamplingParams(max_new_tokens=2 * args.max_new, temperature=0.0)
+    rng = np.random.RandomState(13)
+    prompts = [[int(t) for t in rng.randint(0, args.vocab, args.prompt_len)]
+               for _ in range(16)]
+    refs = _fleet_reference(spec, prompts, [sp] * len(prompts))
+    router, gateway, reps = _start_fleet(workdir, spec, 3,
+                                         scenario="autoscale",
+                                         supervisor=supervisor)
+    scaler = Autoscaler(router, supervisor=supervisor, min_replicas=1,
+                        max_replicas=3, scale_up_wait_s=1.2,
+                        cooldown_s=0.25, down_hold_s=1.5)
+    killed = None
+    try:
+        # park p1+p2: the warm pool the autoscaler may draw on (their jit
+        # traces are in the shared compile cache, so a revival is warm)
+        for rid in ("p1", "p2"):
+            router.drain(rid, stop_replica=True)
+        # wave 1 builds the pressure that revives the first parked
+        # replica; wave 2 lands right after the SIGKILL so the queue
+        # stays deep while the replacement warms (the scale-up signal is
+        # queued work — a drained queue is not demand)
+        clients = [_SSEClient(gateway, p, sp) for p in prompts[:8]]
+        ups, deadline = [], time.monotonic() + 240
+        while time.monotonic() < deadline:
+            d = scaler.tick()
+            if d["action"] == "up":
+                ups.append(d["replica"])
+                if killed is None:
+                    # SIGKILL the revival mid-warm: it must degrade to a
+                    # second revival, never to a lost request
+                    killed = d["replica"]
+                    router.replicas[killed].kill()
+                    clients += [_SSEClient(gateway, p, sp)
+                                for p in prompts[8:]]
+            if scaler.stats()["scale_ups"]:
+                break                      # a revival reached HEALTHY
+            time.sleep(0.05)
+        for c in clients:
+            c.join(600)
+        lost = [i for i, c in enumerate(clients)
+                if c.status != 200 or c.error]
+        parity = [i for i, c in enumerate(clients) if c.tokens != refs[i]]
+        settled = scaler.stats()["scale_ups"]
+
+        # sustained idle: hold the loop until exactly one scale-down fires,
+        # then keep ticking — cooldown + down-hold must prevent flapping
+        downs, t0 = 0, time.monotonic()
+        while time.monotonic() - t0 < 8.0:
+            d = scaler.tick()
+            if d["action"] == "down":
+                downs += 1
+            time.sleep(0.05)
+        healthy = [r.rid for r in reps if r.state.value == "healthy"]
+        events = [e["event"] for e in ledger.read()["events"]]
+        sig = router.load_signal()
+        last_signal = {k: sig[k] for k in (
+            "healthy", "starting", "stopped", "unhealthy", "queued",
+            "inflight", "est_wait_s")}
+        ok = (killed is not None and len(ups) >= 2 and settled
+              and not lost and not parity and downs >= 1
+              and len(healthy) >= scaler.min_replicas
+              and supervisor.budget.used == len(ups)
+              and events.count("scale_up") == len(ups)
+              and "scale_up_healthy" in events
+              and "scale_down" in events)
+        return {
+            "scenario": "autoscale_burst_kill",
+            "survived": bool(ok),
+            "killed_mid_warm": killed,
+            "scale_ups": ups,
+            "time_to_healthy_s": [round(s["time_to_healthy_s"], 3)
+                                  for s in settled],
+            "lost_requests": len(lost),
+            "parity_failures": len(parity),
+            "scale_downs": downs,
+            "budget_used": supervisor.budget.used,
+            "healthy_at_end": healthy,
+            "last_signal": last_signal,
+            "ledger_events": events,
+        }
+    finally:
+        scaler.close()
+        gateway.stop()
+        router.close()
+
+
+def run_tenancy_suite(args, workdir=None, scenario=None):
+    import tempfile
+
+    workdir = workdir or tempfile.mkdtemp(prefix="chaos-tenancy-")
+    max_len = args.prompt_len + args.max_new
+    spec = _fleet_spec(args, workdir, max_len)
+    rows = []
+    fns = _filter_scenarios(
+        (_scenario_noisy_neighbor, _scenario_autoscale_burst_kill),
+        "_scenario_", scenario)
+    for fn in fns:
+        try:
+            rows.append(fn(args, workdir, spec, max_len))
+        except Exception as e:  # lint: allow-silent(the crash is the row: survived=False fails the battery)
+            rows.append({"scenario": fn.__name__[len("_scenario_"):],
+                         "survived": False,
+                         "crashed": f"{type(e).__name__}: {e}"})
+    survived = sum(1 for r in rows if r["survived"])
+    zero_lost = all(r.get("lost_requests", 0) == 0 for r in rows)
+    dump_path = telemetry.dump(reason="tenancy chaos suite complete")
+    return {
+        "suite": "tenancy",
+        "workdir": workdir,
+        "config": {"prompt_len": args.prompt_len,
                    "max_new_tokens": args.max_new, "slots": args.slots,
                    "block_size": args.block_size},
         "plans_run": len(rows),
@@ -2480,6 +2795,7 @@ SUITE_SCENARIOS = {
                         "breaker_trip", "retry_budget_storm"],
     "kvfabric": lambda: ["stale_directory", "donor_kill_mid_fetch",
                          "corrupt_frame", "fetch_storm"],
+    "tenancy": lambda: ["noisy_neighbor", "autoscale_burst_kill"],
     "train": lambda: ["kill_worker", "nan_injection", "torn_checkpoint"],
     "straggler": lambda: ["straggler", "hang"],
     "locksan": lambda: ["fleet_under_load", "telemetry_threads",
@@ -2511,7 +2827,7 @@ def run_sweep(argv=None):
     ap.add_argument("--suite",
                     choices=["serving", "prefix", "spill", "train",
                              "straggler", "perf", "serve-fleet", "durable",
-                             "kvfabric", "locksan"],
+                             "kvfabric", "tenancy", "locksan"],
                     default="serving")
     ap.add_argument("--list", action="store_true",
                     help="print every suite's scenario names and exit")
@@ -2544,7 +2860,8 @@ def run_sweep(argv=None):
                          "and cannot be sliced with --scenario")
 
     if args.suite in ("train", "straggler", "prefix", "spill", "perf",
-                      "serve-fleet", "durable", "kvfabric", "locksan"):
+                      "serve-fleet", "durable", "kvfabric", "tenancy",
+                      "locksan"):
         report = (run_train_suite(scenario=args.scenario)
                   if args.suite == "train"
                   else run_straggler_suite(scenario=args.scenario)
@@ -2559,6 +2876,8 @@ def run_sweep(argv=None):
                   if args.suite == "durable"
                   else run_kvfabric_suite(args, scenario=args.scenario)
                   if args.suite == "kvfabric"
+                  else run_tenancy_suite(args, scenario=args.scenario)
+                  if args.suite == "tenancy"
                   else run_spill_suite(args, scenario=args.scenario)
                   if args.suite == "spill"
                   else run_prefix_suite(args, scenario=args.scenario))
@@ -2622,7 +2941,7 @@ def main(argv=None):
         status = "OK " if r["survived"] else "DIED"
         if report.get("suite") in ("train", "straggler", "perf",
                                    "serve-fleet", "durable", "spill",
-                                   "kvfabric", "locksan"):
+                                   "kvfabric", "tenancy", "locksan"):
             detail = " ".join(f"{k}={v}" for k, v in r.items()
                               if k not in ("scenario", "survived"))
             print(f"[{status}] {r['scenario']:<26} {detail}",

@@ -78,6 +78,14 @@ DIRECTIONS = {
     # and growth past tolerance means durability started taxing the
     # serving hot path
     "journal_overhead_frac": "lower",
+    # multi-tenant QoS (ISSUE 17): throughput of the DRR-admitted
+    # multi-tenant workload, the background tenants' p99 TTFT under the
+    # hot noisy neighbor (the isolation headline), and the Jain fairness
+    # index over weight-normalized served tokens (1.0 = perfectly
+    # weighted-fair; erosion means the scheduler stopped honoring weights)
+    "multitenant_tok_per_sec": "higher",
+    "multitenant_bg_ttft_p99_s": "lower",
+    "multitenant_fairness_index": "higher",
     # roofline cost model (PR 11): the serving analogue of MFU — fraction
     # of the roofline-model step time actually achieved — and the decode
     # trace's arithmetic intensity (higher = more compute per HBM byte,
@@ -99,6 +107,13 @@ def extract_metrics(doc: dict) -> tuple[str, dict]:
         put("train_tok_per_sec", doc.get("value"))
         put("mfu", (doc.get("extra") or {}).get("mfu"))
         return "train", metrics
+    if doc.get("mode") == "multitenant" or \
+            isinstance(doc.get("multitenant"), dict):
+        m = doc.get("multitenant") or {}
+        put("multitenant_tok_per_sec", m.get("tok_per_sec"))
+        put("multitenant_bg_ttft_p99_s", m.get("bg_ttft_p99_s"))
+        put("multitenant_fairness_index", m.get("fairness_index"))
+        return "serving_multitenant", metrics
     if doc.get("mode") == "fleet" or isinstance(doc.get("fleet"), dict):
         f = doc.get("fleet") or {}
         if isinstance(f.get("prefix"), dict):

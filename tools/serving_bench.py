@@ -17,7 +17,7 @@ Usage:
         [--metrics-out METRICS.json] [--telemetry on|off]
         [--slo-ttft-ms 200 --slo-tpot-ms 50]
         [--prefix-share 0.9] [--kv-spill-blocks 64] [--num-blocks N]
-        [--fleet 2]
+        [--fleet 2] [--tenants 3 --tenant-mix 8,1,1]
 
 ``--prefix-share`` + ``--kv-spill-blocks`` benches the host-RAM spill
 tier under memory pressure: a small device pool, a flood that evicts the
@@ -45,6 +45,14 @@ mode ``--prompt-len`` defaults to 256 (long mostly-shared prompts are what
 prefix caching is for), ``--slots`` defaults to ``--requests`` so warm
 TTFT measures prefill work rather than queue position, and the O(T^2)
 naive baseline is skipped.
+
+``--tenants N`` + ``--tenant-mix`` runs the multi-tenant QoS workload:
+N tenants (tenant 0 the deliberately hot noisy neighbor) with equal
+demand through per-tenant DRR admission; the JSON gains a
+``multitenant`` block (Jain fairness index over weight-normalized served
+tokens sampled mid-contention, background-tenant p99 TTFT, per-tenant
+roofline cost attribution) gated by ``tools/perf_gate.py`` as bench kind
+``serving_multitenant``.
 
 ``--slo-ttft-ms``/``--slo-tpot-ms`` arm the engine's rolling-window SLO
 tracker: the result JSON gains a ``slo`` block (TTFT/TPOT/queue p50/p95/
@@ -313,6 +321,159 @@ def run_prefix_bench(args, slo_kw):
     if not match:
         raise SystemExit(
             "prefix-cache-on outputs diverged from prefix-cache-off")
+
+
+def run_multitenant_bench(args, slo_kw):
+    """``--tenants N [--tenant-mix W0,W1,...]``: the multi-tenant QoS
+    workload (docs/SERVING.md "Multi-tenant QoS"). Tenant ``t0`` is the
+    deliberately hot noisy neighbor (default mix ``8,1,...``); every
+    tenant submits the same demand through per-tenant DRR admission, so
+    under weighted-fair scheduling each tenant's weight-normalized
+    service rate is equal while everyone is backlogged. The bench
+    snapshots per-tenant served tokens mid-contention (before the hot
+    tenant can drain) and reports:
+
+    - ``fairness_index``: Jain's index over served_tokens/weight at the
+      snapshot (1.0 = perfectly weighted-fair; a FIFO scheduler serving
+      tenants at equal rates scores visibly lower),
+    - ``bg_ttft_p99_s``: p99 TTFT across the background tenants — the
+      isolation headline the noisy neighbor must not move,
+    - ``tok_per_sec`` and per-tenant roofline cost attribution.
+
+    Gated by ``tools/perf_gate.py`` as bench kind ``serving_multitenant``
+    (``multitenant_tok_per_sec``, ``multitenant_bg_ttft_p99_s``,
+    ``multitenant_fairness_index``)."""
+    import threading
+
+    paddle_tpu.seed(0)
+    plen = args.prompt_len if args.prompt_len is not None else 32
+    slots = args.slots if args.slots is not None else 4
+    max_len = plen + args.max_new
+    if args.tenant_mix:
+        weights = [float(w) for w in args.tenant_mix.split(",")]
+        if len(weights) != args.tenants:
+            raise SystemExit(f"--tenant-mix has {len(weights)} weights "
+                             f"but --tenants is {args.tenants}")
+    else:
+        weights = [8.0] + [1.0] * (args.tenants - 1)
+    if args.tenants < 2:
+        raise SystemExit("--tenants wants >= 2 (one hot + background)")
+    names = [f"t{i}" for i in range(args.tenants)]
+    cfg = llama_tiny(vocab=args.vocab, hidden=args.hidden,
+                     layers=args.layers, heads=4, kv_heads=2,
+                     inter=2 * args.hidden, seq=2 * max_len)
+    model = LlamaForCausalLM(cfg)
+    eng = LLMEngine(model, block_size=args.block_size, max_slots=slots,
+                    max_model_len=max_len,
+                    tenancy={"tenants": [
+                        {"name": n, "weight": w}
+                        for n, w in zip(names, weights)]}, **slo_kw)
+    rng = np.random.RandomState(0)
+    sp = SamplingParams(max_new_tokens=args.max_new, temperature=0.0)
+    # primer compiles the prefill + decode traces so the timed run below
+    # is steady-state (it lands under the "anonymous" tenant)
+    eng.generate([list(rng.randint(0, args.vocab, plen))], sp)
+
+    # equal demand per tenant, submitted round-robin so arrival order
+    # carries no tenant bias — what DRR does with it is the measurement.
+    # One DRR round serves ~weight requests per tenant (quantum x weight
+    # over a cost of prompt+max_new), so demand must span several rounds
+    # or the hot tenant's whole backlog fits one deficit grant and the
+    # fairness index measures batch granularity instead of the scheduler
+    n_req = max(args.requests, 4 * int(-(-max(weights) // 1)))
+    per_tenant = {n: [list(rng.randint(0, args.vocab, plen))
+                      for _ in range(n_req)] for n in names}
+    # under perfect WFQ the hot tenant drains first, at total served
+    # ~ demand * sum(w)/max(w); snapshot at 75% of that keeps every
+    # tenant backlogged when fairness is measured
+    target = int(0.75 * n_req * args.max_new
+                 * sum(weights) / max(weights))
+    snap: dict[str, float] = {}
+    stop = threading.Event()
+
+    def sample():
+        while not stop.wait(0.005):
+            ten = eng.stats()["tenancy"]["tenants"]
+            served = {n: float(ten[n]["generated_tokens"])
+                      for n in names if n in ten}
+            if sum(served.values()) >= target:
+                snap.update(served)
+                return
+
+    t0 = time.perf_counter()
+    handles = {n: [] for n in names}
+    for i in range(n_req):
+        for n in names:
+            handles[n].append(eng.add_request(per_tenant[n][i], sp,
+                                              tenant=n))
+    sampler = threading.Thread(target=sample, daemon=True,
+                               name="bench-fairness-sampler")
+    sampler.start()
+    eng.run()
+    dt = time.perf_counter() - t0
+    stop.set()
+    sampler.join(5)
+
+    n_tokens = sum(len(r.output_tokens) for hs in handles.values()
+                   for r in hs)
+    fairness = None
+    if snap:
+        xs = [snap.get(n, 0.0) / w for n, w in zip(names, weights)]
+        sq = sum(x * x for x in xs)
+        fairness = (sum(xs) ** 2 / (len(xs) * sq)) if sq else None
+    bg_ttfts = sorted(r.ttft for n in names[1:] for r in handles[n]
+                      if r.ttft is not None)
+    st = eng.stats()
+    ten = st["tenancy"]
+    result = {
+        "mode": "multitenant",
+        "requests": n_req,
+        "prompt_len": plen,
+        "max_new_tokens": args.max_new,
+        "telemetry": args.telemetry,
+        "multitenant": {
+            "tenants": args.tenants,
+            "mix": weights,
+            "tok_per_sec": n_tokens / dt if dt > 0 else 0.0,
+            "generated_tokens": n_tokens,
+            "wall_sec": dt,
+            "fairness_index": fairness,
+            "fairness_snapshot_tokens": snap or None,
+            "fairness_snapshot_target": target,
+            "bg_ttft_p99_s": (bg_ttfts[int(0.99 * (len(bg_ttfts) - 1))]
+                              if bg_ttfts else None),
+            "hot_ttft_mean_s": _mean([r.ttft for r in handles[names[0]]]),
+            # per-tenant roofline cost attribution + SLO windows straight
+            # off the engine's tenancy block (TenantAccounting.summary())
+            "per_tenant": {
+                n: {"weight": w,
+                    "requests": row["requests"],
+                    "generated_tokens": row["generated_tokens"],
+                    "mean_ttft_s": _mean([r.ttft for r in handles[n]]),
+                    "cost": row["cost"]}
+                for n, w in zip(names, weights)
+                for row in (ten["tenants"][n],)},
+            "cost_totals": ten["totals"],
+        },
+        "slo": st["slo"],
+        "__meta__": _perf.run_meta(),
+    }
+    print(json.dumps(result, indent=2))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(result, f, indent=2)
+    if args.metrics_out:
+        telemetry.registry().snapshot_json(args.metrics_out)
+        print(f"# metrics snapshot -> {args.metrics_out}", file=sys.stderr)
+    unfinished = sum(1 for hs in handles.values() for r in hs
+                     if len(r.output_tokens) != args.max_new)
+    if unfinished:
+        raise SystemExit(f"multitenant bench: {unfinished} request(s) "
+                         "did not finish")
+    if not snap:
+        print("# fairness snapshot missed (run drained before the "
+              "sampler hit its target) — fairness_index omitted",
+              file=sys.stderr)
 
 
 def _fleet_prefix_view(st: dict) -> tuple[float, dict]:
@@ -661,6 +822,17 @@ def main():
                          "serving_fleet_fabric; pair with "
                          "--prefix-share for a shared-prefix workload — "
                          "docs/SERVING.md \"KV fabric\")")
+    ap.add_argument("--tenants", type=int, default=None, metavar="N",
+                    help="multi-tenant QoS workload: N tenants (t0 is the "
+                         "hot noisy neighbor) through per-tenant DRR "
+                         "admission; reports the Jain fairness index over "
+                         "weight-normalized served tokens, background p99 "
+                         "TTFT, and per-tenant cost attribution — bench "
+                         "kind serving_multitenant (docs/SERVING.md "
+                         "\"Multi-tenant QoS\")")
+    ap.add_argument("--tenant-mix", default=None, metavar="W0,W1,...",
+                    help="comma-separated tenant weights for --tenants "
+                         "(default 8,1,1,... — tenant 0 hot)")
     ap.add_argument("--journal", choices=("off", "interval", "always"),
                     default="off",
                     help="--fleet only: run a second pass through a "
@@ -678,6 +850,9 @@ def main():
                     if args.slo_ttft_ms is not None else None),
         slo_tpot_s=(args.slo_tpot_ms / 1e3
                     if args.slo_tpot_ms is not None else None))
+    if args.tenants is not None:
+        run_multitenant_bench(args, slo_kw)
+        return
     if args.fleet is not None:
         run_fleet_bench(args, slo_kw)
         return
