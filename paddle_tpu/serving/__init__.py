@@ -45,6 +45,12 @@ Above the single engine sits the fleet plane (docs/SERVING.md
   scale-up (gated by the ElasticSupervisor restart budget, warmed via
   the fleet compile cache + KV-fabric migration) and hysteresis-guarded
   scale-down, every decision recorded in the JobLedger.
+- :mod:`.workload` — the trace-driven workload engine
+  (docs/WORKLOADS.md): seeded, byte-replayable arrival processes
+  (Poisson / bursty MMPP / diurnal), heavy-tailed length
+  distributions, tenant & prefix-share mixes, and open/closed-loop
+  runners that the bench, the soak harness (:mod:`.soak`), and the
+  capacity planner all replay from one :class:`WorkloadSpec`.
 """
 from . import kv_fabric  # noqa: F401
 from .autoscaler import Autoscaler  # noqa: F401
@@ -77,6 +83,14 @@ from .scheduler import (  # noqa: F401
     SamplingParams,
     Scheduler,
 )
+from .workload import (  # noqa: F401
+    ClosedLoopRunner,
+    OpenLoopRunner,
+    Workload,
+    WorkloadError,
+    WorkloadRequest,
+    WorkloadSpec,
+)
 from .tenancy import (  # noqa: F401
     AuthError,
     FairQueue,
@@ -97,4 +111,6 @@ __all__ = [
     "kv_fabric",
     "Tenant", "TenantRegistry", "TokenBucket", "FairQueue", "AuthError",
     "Autoscaler",
+    "WorkloadSpec", "WorkloadRequest", "Workload", "WorkloadError",
+    "OpenLoopRunner", "ClosedLoopRunner",
 ]

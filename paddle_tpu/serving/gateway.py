@@ -240,6 +240,7 @@ class Gateway:
                  model_name: str = "paddle-tpu",
                  journal_dir: str | None = None,
                  journal_fsync: str = "interval",
+                 journal_kwargs: dict | None = None,
                  journal_watermark_every: int = 8,
                  gateway_id: str | None = None,
                  resume_retention: int = 512,
@@ -261,7 +262,12 @@ class Gateway:
         self.max_body_bytes = int(max_body_bytes)
         self.model_name = model_name
         self.gateway_id = gateway_id or f"gw-{uuid.uuid4().hex[:8]}"
-        self.journal = (Journal(journal_dir, fsync=journal_fsync)
+        # journal_kwargs passes segment/compaction/retention knobs
+        # through (segment_max_records, compact_segments,
+        # retain_terminal, ...) — the soak harness shrinks them so
+        # compaction cycles happen on test timescales
+        self.journal = (Journal(journal_dir, fsync=journal_fsync,
+                                **(journal_kwargs or {}))
                         if journal_dir else None)
         self.journal_watermark_every = int(journal_watermark_every)
         self.resume_retention = int(resume_retention)

@@ -336,6 +336,11 @@ def replica_stats(engine) -> dict:
         # per-tenant counters + cost attribution + tenant SLO windows —
         # the fleet aggregation the gateway /stats and autoscaler read
         "tenancy": engine._tenancy_acct.summary(),
+        # leak-sentinel flags only (the full perf/memory block is a
+        # registry sweep — too heavy per beat): non-empty means the
+        # MemoryMonitor saw its high watermark climb across every drained
+        # step in the window. The soak harness asserts this stays empty.
+        "leaks": sorted(engine._mm.leak_report()),
     }
 
 

@@ -614,8 +614,9 @@ class TenantAccounting:
                     lk = dict(engine=self.engine_label, tenant=name)
                     self._m.ttft_p99.labels(**lk).set(
                         slo_sum["ttft"]["p99"] or 0.0)
-                    self._m.goodput.labels(**lk).set(
-                        slo_sum["goodput_ratio"])
+                    if slo_sum["goodput_ratio"] is not None:
+                        self._m.goodput.labels(**lk).set(
+                            slo_sum["goodput_ratio"])
             entry = {
                 "requests": int(c.get("requests", 0)),
                 "finished": int(c.get("finished", 0)),

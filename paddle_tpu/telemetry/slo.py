@@ -166,6 +166,10 @@ class SLOTracker:
         """The window digested: percentiles, goodput, and the admit/shed
         verdict. Also refreshes the ``slo_*`` gauges."""
         win = self._window()
+        # a window with zero observations carries no information: mark it
+        # `empty` and report goodput as None rather than echoing a vacuous
+        # 1.0 that reads like "the last populated window was healthy"
+        empty = not win
         # key on the value alone: trace ids may be None and must not be
         # drawn into tie-break comparisons
         ttft_pairs = sorted(((v[1], v[6]) for v in win if v[1] is not None),
@@ -201,6 +205,7 @@ class SLOTracker:
         out = {
             "window_s": self.window_s,
             "window_requests": len(win),
+            "empty": empty,
             "ttft_slo_s": self.ttft_slo_s,
             "tpot_slo_s": self.tpot_slo_s,
             "ttft": ttft_p,
@@ -208,10 +213,11 @@ class SLOTracker:
             "queue_time": queue_p,
             "total_tokens": total_tokens,
             "goodput_tokens": good_tokens,
-            "goodput_ratio": (good_tokens / total_tokens
-                              if total_tokens else 1.0),
-            "request_goodput_ratio": (good_requests / len(win)
-                                      if win else 1.0),
+            "goodput_ratio": (None if empty else
+                              (good_tokens / total_tokens
+                               if total_tokens else 1.0)),
+            "request_goodput_ratio": (None if empty
+                                      else good_requests / len(win)),
             "healthy": healthy,
             "shed": not healthy,
             "shed_reason": shed_reason,
@@ -227,8 +233,11 @@ class SLOTracker:
             m["ttft_p99"].set(ttft_p["p99"] or 0.0)
             m["tpot_p99"].set(tpot_p["p99"] or 0.0)
             m["queue_p99"].set(queue_p["p99"] or 0.0)
-            m["goodput"].set(out["goodput_ratio"])
-            m["req_goodput"].set(out["request_goodput_ratio"])
+            # empty window: gauges fall back to the idle-engine defaults
+            # (goodput 1.0 = nothing to re-serve) rather than None
+            m["goodput"].set(1.0 if empty else out["goodput_ratio"])
+            m["req_goodput"].set(
+                1.0 if empty else out["request_goodput_ratio"])
             m["healthy"].set(1.0 if healthy else 0.0)
             m["window_requests"].set(len(win))
         return out

@@ -109,6 +109,17 @@ which LockSan **must report** (both thread names in the inversion's
 edges) — proves the detector in this battery is live, not vacuously
 quiet.
 
+``--suite soak`` — the rolling-chaos soak (docs/WORKLOADS.md "Soak pass
+criteria"): the seeded trace-driven workload replayed epoch after epoch
+against a real fleet + gateway while the chaos action *rotates* —
+fault-plan degradation, replica SIGKILL, drain/restart churn, explicit
+journal compaction — with every epoch re-asserting zero lost accepted
+requests, a quiet leak sentinel, journal segment/byte/retention bounds,
+and the per-tenant goodput floor. ``degrade`` runs in-process (1
+LocalReplica, degradation + compaction — the tier-1 smoke's shape);
+``rolling`` is the full battery on 2 SIGKILL-able ProcReplicas. The
+long-form driver with time budgets is ``tools/soak_run.py``.
+
 ``--suite straggler`` — the cluster observability plane
 (docs/OBSERVABILITY.md "Cluster observability"): a 4-rank job over a real
 TCPStore where one rank carries a ``collective:delay`` fault plan.
@@ -123,7 +134,7 @@ recorder + stack snapshot.
 Usage:
     python tools/chaos_run.py
         [--suite serving|prefix|spill|train|straggler|perf|serve-fleet|
-                 durable|kvfabric|locksan]
+                 durable|kvfabric|locksan|soak]
         [--requests 6] [--prompt-len 24] [--max-new 16]
         [--slots 3] [--block-size 8] [--plan NAME:SPEC ...] [--json OUT.json]
         [--list] [--scenario NAME]
@@ -1323,6 +1334,8 @@ def _scenario_noisy_neighbor(args, workdir, spec, max_len):
             for name in ("bg1", "bg2"):
                 row = ten.get(name)
                 if row is None or row["slo"] is None:
+                    continue
+                if row["slo"].get("empty"):      # window aged out: no data
                     continue
                 if row["slo"]["goodput_ratio"] < 1.0:
                     slo_ok = False
@@ -2784,6 +2797,104 @@ def run_locksan_suite(workdir=None, scenario=None):
     }
 
 
+def run_soak_suite(args, workdir=None, scenario=None):
+    """Rolling-chaos soak (docs/WORKLOADS.md "Soak pass criteria"): the
+    trace-driven workload replayed epoch after epoch against a real
+    fleet while the chaos action rotates, every epoch re-asserting zero
+    lost accepted requests, leak-sentinel silence, journal bounds, and
+    the per-tenant goodput floor.
+
+    ``rolling`` is the full battery — 2 ProcReplicas + gateway, with
+    SIGKILL and drain/restart churn in the rotation; ``degrade`` is the
+    in-process variant (1 LocalReplica, fault-plan degradation +
+    compaction only) that mirrors the tier-1 smoke.
+    """
+    import tempfile
+
+    from paddle_tpu.serving.soak import SoakConfig, run_soak
+    from paddle_tpu.serving.workload import preset
+
+    workdir = workdir or tempfile.mkdtemp(prefix="chaos-soak-")
+
+    def _cfg(name):
+        spec = preset("burst")
+        spec.vocab = args.vocab
+        spec.prompt_len["max"] = 32
+        spec.output_len["max"] = 16
+        # generous SLO: the soak's goodput floor guards liveness under
+        # chaos (did requests finish at all), not latency — a shared-core
+        # proc fleet mid-SIGKILL legitimately runs seconds of TTFT
+        spec.slo = {"ttft_s": 10.0, "tpot_s": 2.0}
+        max_len = 48
+        fleet_spec = {
+            "seed": 0,
+            "llama_tiny": {"vocab": args.vocab, "hidden": args.hidden,
+                           "layers": args.layers, "heads": 4,
+                           "kv_heads": 2, "inter": 2 * args.hidden,
+                           "seq": 2 * max_len},
+            "engine": {"block_size": args.block_size,
+                       "max_slots": args.slots, "max_model_len": max_len},
+            # one prompt per power-of-two prefill bucket up to the
+            # prompt cap (32 needs a >16-token warmup to compile P=32)
+            "warmup": [4, 8, 16, 24, 32],
+            "stats_interval_s": 0.05,
+            "jax_cache_dir": os.path.join(workdir, "jax-cache"),
+        }
+        degrade = [
+            {"kind": "plan",
+             "plan": "gateway.journal.append:delay=0.01%0.2"},
+            {"kind": "compact"},
+            {"kind": "plan", "plan": "serving.decode:delay=0.005%0.1"},
+        ]
+        rolling = [
+            {"kind": "plan",
+             "plan": "gateway.journal.append:delay=0.01%0.2"},
+            {"kind": "kill"},
+            {"kind": "plan", "plan": "serving.decode:delay=0.005%0.1"},
+            {"kind": "churn"},
+            {"kind": "compact"},
+            {"kind": "plan", "plan": "router.probe:delay=0.05%0.2"},
+        ]
+        chaos = rolling if name == "rolling" else degrade
+        return SoakConfig(
+            spec=spec, fleet_spec=fleet_spec,
+            workdir=os.path.join(workdir, name),
+            epochs=len(chaos), chaos=chaos,
+            replicas=2 if name == "rolling" else 1,
+            fleet="proc" if name == "rolling" else "local",
+            epoch_wait_s=120.0,
+            journal={"segment_max_records": 16, "compact_segments": 2,
+                     "retain_terminal": 32},
+            goodput_floor=0.3,
+            kill_allowed=(name == "rolling"))
+
+    names = [n for n in ("degrade", "rolling")
+             if scenario is None or n == scenario]
+    rows = []
+    for name in names:
+        rep = run_soak(_cfg(name))
+        rows.append({
+            "scenario": name,
+            "survived": rep["passed"],
+            "epochs": len(rep["epochs"]),
+            "lost": sum(r["lost"] for r in rep["epochs"]),
+            "compaction_cycles": rep["compaction_cycles_observed"],
+            "wall_sec": round(rep["wall_s"], 1),
+            "violations": rep["violations"],
+        })
+    survived = sum(1 for r in rows if r["survived"])
+    dump_path = telemetry.dump(reason="soak chaos suite complete")
+    return {
+        "suite": "soak",
+        "workdir": workdir,
+        "plans_run": len(rows),
+        "plans_survived": survived,
+        "all_survived": survived == len(rows),
+        "flight_recorder_dump": dump_path,
+        "results": rows,
+    }
+
+
 SUITE_SCENARIOS = {
     "serving": lambda: [n for n, _ in DEFAULT_PLANS],
     "prefix": lambda: [n for n, _ in PREFIX_PLANS],
@@ -2800,6 +2911,7 @@ SUITE_SCENARIOS = {
     "straggler": lambda: ["straggler", "hang"],
     "locksan": lambda: ["fleet_under_load", "telemetry_threads",
                         "inversion_canary"],
+    "soak": lambda: ["degrade", "rolling"],
 }
 
 
@@ -2827,7 +2939,7 @@ def run_sweep(argv=None):
     ap.add_argument("--suite",
                     choices=["serving", "prefix", "spill", "train",
                              "straggler", "perf", "serve-fleet", "durable",
-                             "kvfabric", "tenancy", "locksan"],
+                             "kvfabric", "tenancy", "locksan", "soak"],
                     default="serving")
     ap.add_argument("--list", action="store_true",
                     help="print every suite's scenario names and exit")
@@ -2858,10 +2970,24 @@ def run_sweep(argv=None):
     if args.scenario is not None and args.suite == "perf":
         raise SystemExit("--suite perf runs as one interdependent battery "
                          "and cannot be sliced with --scenario")
+    if args.scenario is not None:
+        # one validation gate for every suite, before any fleet spins
+        # up: an unknown name exits non-zero naming the whole catalog
+        valid = ([n for n, _ in args.plan]
+                 if args.suite == "serving" and args.plan
+                 else SUITE_SCENARIOS[args.suite]())
+        if args.scenario not in valid:
+            catalog = "\n".join(
+                f"  --suite {s}: {', '.join(f())}"
+                for s, f in SUITE_SCENARIOS.items())
+            raise SystemExit(
+                f"unknown scenario {args.scenario!r} for --suite "
+                f"{args.suite} (valid: {', '.join(valid)})\n"
+                f"full catalog:\n{catalog}")
 
     if args.suite in ("train", "straggler", "prefix", "spill", "perf",
                       "serve-fleet", "durable", "kvfabric", "tenancy",
-                      "locksan"):
+                      "locksan", "soak"):
         report = (run_train_suite(scenario=args.scenario)
                   if args.suite == "train"
                   else run_straggler_suite(scenario=args.scenario)
@@ -2878,6 +3004,8 @@ def run_sweep(argv=None):
                   if args.suite == "kvfabric"
                   else run_tenancy_suite(args, scenario=args.scenario)
                   if args.suite == "tenancy"
+                  else run_soak_suite(args, scenario=args.scenario)
+                  if args.suite == "soak"
                   else run_spill_suite(args, scenario=args.scenario)
                   if args.suite == "spill"
                   else run_prefix_suite(args, scenario=args.scenario))
@@ -2941,7 +3069,8 @@ def main(argv=None):
         status = "OK " if r["survived"] else "DIED"
         if report.get("suite") in ("train", "straggler", "perf",
                                    "serve-fleet", "durable", "spill",
-                                   "kvfabric", "tenancy", "locksan"):
+                                   "kvfabric", "tenancy", "locksan",
+                                   "soak"):
             detail = " ".join(f"{k}={v}" for k, v in r.items()
                               if k not in ("scenario", "survived"))
             print(f"[{status}] {r['scenario']:<26} {detail}",

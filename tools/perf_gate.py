@@ -92,6 +92,21 @@ DIRECTIONS = {
     # i.e. better batching of the memory-bound step)
     "serving_roofline_frac": "higher",
     "decode_ai": "higher",
+    # trace-driven workload bench (ISSUE 18, serving_bench --workload):
+    # distribution-level gates replacing steady-state-mean-only gating.
+    # p99 TTFT of requests arriving in MMPP burst phases, within-SLO
+    # completions over *offered* load under sustained overload (sheds
+    # count against it — the open-loop framing), how long after the
+    # burst until every replica's rolling SLO window is healthy again,
+    # and the replay's token throughput. One baseline per workload spec
+    # (bench kind serving_workload_<spec>): a burst spec's p99 and an
+    # overload spec's goodput measure different failure modes and must
+    # not cross-gate
+    "workload_tok_per_sec": "higher",
+    "workload_ttft_p99_s": "lower",
+    "p99_under_burst": "lower",
+    "goodput_under_overload": "higher",
+    "time_to_healthy_under_burst_s": "lower",
 }
 
 
@@ -107,6 +122,18 @@ def extract_metrics(doc: dict) -> tuple[str, dict]:
         put("train_tok_per_sec", doc.get("value"))
         put("mfu", (doc.get("extra") or {}).get("mfu"))
         return "train", metrics
+    if doc.get("mode") == "workload" or \
+            isinstance(doc.get("workload"), dict):
+        w = doc.get("workload") or {}
+        put("workload_tok_per_sec", w.get("workload_tok_per_sec"))
+        put("workload_ttft_p99_s", w.get("ttft_p99_s"))
+        put("p99_under_burst", w.get("p99_under_burst"))
+        put("goodput_under_overload", w.get("goodput_under_overload"))
+        put("time_to_healthy_under_burst_s",
+            w.get("time_to_healthy_under_burst_s"))
+        # one baseline slot per spec: serving_workload_burst and
+        # serving_workload_overload gate different distributions
+        return f"serving_workload_{w.get('spec') or 'custom'}", metrics
     if doc.get("mode") == "multitenant" or \
             isinstance(doc.get("multitenant"), dict):
         m = doc.get("multitenant") or {}

@@ -112,7 +112,7 @@ def run_spill_prefix_bench(args, slo_kw):
     ``prefix.spill`` block records both TTFTs and the speedup, gated by
     ``tools/perf_gate.py`` as bench kind ``serving_prefix_spill``.
     Outputs must match token-for-token across the two sides."""
-    paddle_tpu.seed(0)
+    paddle_tpu.seed(args.seed)
     plen = args.prompt_len if args.prompt_len is not None else 256
     slots = args.slots if args.slots is not None else args.requests
     max_len = plen + args.max_new
@@ -133,7 +133,7 @@ def run_spill_prefix_bench(args, slo_kw):
                      layers=args.layers, heads=4, kv_heads=2,
                      inter=2 * args.hidden, seq=2 * max_len)
     model = LlamaForCausalLM(cfg)
-    rng = np.random.RandomState(0)
+    rng = np.random.RandomState(args.seed)
     shared = list(rng.randint(0, args.vocab, n_shared))
 
     def shared_prompt():
@@ -236,7 +236,7 @@ def run_spill_prefix_bench(args, slo_kw):
 def run_prefix_bench(args, slo_kw):
     """Shared-prefix workload: same fleet through a prefix-cache-on and a
     prefix-cache-off engine, cache-warm TTFT compared head to head."""
-    paddle_tpu.seed(0)
+    paddle_tpu.seed(args.seed)
     plen = args.prompt_len if args.prompt_len is not None else 256
     slots = args.slots if args.slots is not None else args.requests
     max_len = plen + args.max_new
@@ -244,7 +244,7 @@ def run_prefix_bench(args, slo_kw):
                      heads=4, kv_heads=2, inter=2 * args.hidden,
                      seq=2 * max_len)
     model = LlamaForCausalLM(cfg)
-    rng = np.random.RandomState(0)
+    rng = np.random.RandomState(args.seed)
     n_shared = int(plen * args.prefix_share)
     shared = list(rng.randint(0, args.vocab, n_shared))
     prompts = [shared + list(rng.randint(0, args.vocab, plen - n_shared))
@@ -345,7 +345,7 @@ def run_multitenant_bench(args, slo_kw):
     ``multitenant_fairness_index``)."""
     import threading
 
-    paddle_tpu.seed(0)
+    paddle_tpu.seed(args.seed)
     plen = args.prompt_len if args.prompt_len is not None else 32
     slots = args.slots if args.slots is not None else 4
     max_len = plen + args.max_new
@@ -368,7 +368,7 @@ def run_multitenant_bench(args, slo_kw):
                     tenancy={"tenants": [
                         {"name": n, "weight": w}
                         for n, w in zip(names, weights)]}, **slo_kw)
-    rng = np.random.RandomState(0)
+    rng = np.random.RandomState(args.seed)
     sp = SamplingParams(max_new_tokens=args.max_new, temperature=0.0)
     # primer compiles the prefill + decode traces so the timed run below
     # is steady-state (it lands under the "anonymous" tenant)
@@ -530,7 +530,7 @@ def run_fleet_bench(args, slo_kw):
                          "(run the passes separately)")
 
     def build_model():
-        paddle_tpu.seed(0)
+        paddle_tpu.seed(args.seed)
         cfg = llama_tiny(vocab=args.vocab, hidden=args.hidden,
                          layers=args.layers, heads=4, kv_heads=2,
                          inter=2 * args.hidden, seq=2 * max_len)
@@ -558,7 +558,7 @@ def run_fleet_bench(args, slo_kw):
 
     router, gateway = make_fleet(None)
 
-    rng = np.random.RandomState(0)
+    rng = np.random.RandomState(args.seed)
     if args.prefix_share is not None:
         n_shared = int(plen * args.prefix_share)
         shared = [int(t) for t in rng.randint(0, args.vocab, n_shared)]
@@ -611,13 +611,20 @@ def run_fleet_bench(args, slo_kw):
             conn.close()
 
     def run_pass(gw, stagger_s=0.0):
-        """One full client wave against ``gw``; returns (clients, wall)."""
+        """One full client wave against ``gw``; returns (clients, wall).
+        Stagger jitter draws from its own per-pass RandomState seeded
+        off ``--seed``, so A/B passes see byte-identical arrival times
+        and identical spec+seed runs replay exactly."""
+        jrng = np.random.RandomState(args.seed + 1)
         t1 = time.perf_counter()
         cs = [Client(p, gw=gw) for p in prompts]
         for c in cs:
             c.start()
             if stagger_s:
-                time.sleep(stagger_s)
+                jitter = (float(jrng.uniform(-1.0, 1.0))
+                          * args.stagger_jitter if args.stagger_jitter
+                          else 0.0)
+                time.sleep(stagger_s * (1.0 + jitter))
         for c in cs:
             c.join(600)
         return cs, time.perf_counter() - t1
@@ -768,6 +775,167 @@ def run_fleet_bench(args, slo_kw):
             "fleet — migration changed tokens")
 
 
+def run_workload_bench(args, slo_kw):
+    """``--workload SPEC``: replay a trace-driven :class:`WorkloadSpec`
+    (preset name or JSON path — docs/WORKLOADS.md) against a
+    LocalReplica fleet through the router's submit surface, open- or
+    closed-loop per the spec, and report *distribution-level* serving
+    numbers rather than steady-state means:
+
+    - ``p99_under_burst`` — p99 TTFT of the requests that arrived in a
+      burst phase of the MMPP arrival process (bursty specs only),
+    - ``goodput_under_overload`` — within-SLO completions over offered
+      load (sheds and failures count against it — the open-loop
+      framing; the closed-loop number would flatter overload),
+    - ``time_to_healthy_s`` — how long after the last arrival until
+      every replica's rolling SLO window reports healthy again,
+    - ``workload_tok_per_sec`` and TTFT percentiles.
+
+    Gated by ``tools/perf_gate.py`` as bench kind
+    ``serving_workload_<spec name>``."""
+    from paddle_tpu.serving import FleetRouter, LocalReplica
+    from paddle_tpu.serving.workload import (
+        ClosedLoopRunner, OpenLoopRunner, generate, load_spec, summarize)
+
+    spec = load_spec(args.workload)
+    if args.seed_given:
+        spec.seed = args.seed
+    if spec.vocab > args.vocab:
+        spec.vocab = args.vocab
+    slots = args.slots if args.slots is not None else 4
+    pmax = int(spec.prompt_len.get("max", 96))
+    omax = int(spec.output_len.get("max", 48))
+    max_len = pmax + omax
+    slo = dict(slo_kw)
+    if slo.get("slo_ttft_s") is None and spec.slo:
+        slo["slo_ttft_s"] = spec.slo.get("ttft_s")
+        slo["slo_tpot_s"] = spec.slo.get("tpot_s")
+
+    def build_model():
+        paddle_tpu.seed(args.seed)
+        cfg = llama_tiny(vocab=args.vocab, hidden=args.hidden,
+                         layers=args.layers, heads=4, kv_heads=2,
+                         inter=2 * args.hidden, seq=2 * max_len)
+        return LlamaForCausalLM(cfg)
+
+    def factory():
+        # short SLO window: time-to-healthy after a burst must be
+        # measurable on bench timescales, not the 120 s default
+        return LLMEngine(build_model(), block_size=args.block_size,
+                         max_slots=slots, max_model_len=max_len,
+                         slo_window_s=6.0, **slo)
+
+    n = args.fleet if args.fleet is not None else 1
+    workload = generate(spec, max_model_len=max_len)
+    # one warmup prompt per power-of-two prefill bucket: a mid-replay
+    # compile stall would read as a multi-second TTFT outlier and poison
+    # the distribution-level gates
+    warm, p = [], args.block_size
+    while p < pmax:
+        warm.append(p)
+        p *= 2
+    warm.append(pmax)
+    reps = [LocalReplica(f"w{i}", factory, stats_interval_s=0.05,
+                         warmup=warm)
+            for i in range(n)]
+    router = FleetRouter(reps, probe_interval_s=0.1,
+                         probe_timeout_s=30.0,
+                         affinity_block_size=args.block_size,
+                         ).start(wait_healthy_s=600)
+
+    def submit(wreq):
+        sp = SamplingParams(max_new_tokens=wreq.max_new_tokens,
+                            temperature=0.0)
+        # RouterShed propagates to the runner, which records "shed"
+        rr = router.submit(list(wreq.prompt), sp, tenant=wreq.tenant)
+
+        def finish():
+            done = rr.wait(timeout=600)
+            if rr.state == "finished":
+                return {"outcome": "ok", "ttft": rr.ttft,
+                        "tokens": len(rr.tokens)}
+            if not done:
+                return {"outcome": "lost", "tokens": len(rr.tokens),
+                        "error": "no terminal state"}
+            return {"outcome": "failed", "ttft": rr.ttft,
+                    "tokens": len(rr.tokens), "error": rr.error}
+        return finish
+
+    try:
+        t0 = time.perf_counter()
+        if spec.mode == "closed":
+            results = ClosedLoopRunner(workload, submit,
+                                       max_wait_s=600).run()
+        else:
+            results = OpenLoopRunner(workload, submit,
+                                     time_scale=args.time_scale,
+                                     max_wait_s=600).run()
+        wall = time.perf_counter() - t0
+
+        # time-to-healthy: poll the fleet's rolling SLO windows until
+        # every replica reports healthy (or its window drains empty)
+        t_drain = time.monotonic()
+        while time.monotonic() - t_drain < 30.0:
+            st = router.stats()
+            unhealthy = [
+                rid for rid, v in st["replicas"].items()
+                if v.get("slo") and not v["slo"].get("empty")
+                and not v["slo"]["healthy"]]
+            if not unhealthy:
+                break
+            time.sleep(0.1)
+        tth = time.monotonic() - t_drain
+        fleet_st = router.stats()
+    finally:
+        router.close()
+
+    summ = summarize(results, slo=spec.slo)
+    wl = {
+        "spec": spec.name,
+        "seed": spec.seed,
+        "mode": spec.mode,
+        "fingerprint": workload.fingerprint(),
+        "requests": len(workload),
+        "replicas": n,
+        "offered_qps": workload.offered_qps / max(args.time_scale, 1e-9),
+        "wall_sec": wall,
+        "outcomes": summ["outcomes"],
+        "lost": summ["lost"],
+        "workload_tok_per_sec": (summ["tokens_ok"] / wall
+                                 if wall > 0 else 0.0),
+        "ttft_p50_s": summ["ttft_p50"],
+        "ttft_p99_s": summ["ttft_p99"],
+        "sched_lag_p99_s": summ["sched_lag_p99"],
+        "goodput_under_overload": summ["goodput_ratio"],
+        "time_to_healthy_s": tth,
+        "per_phase": summ["per_phase"],
+        "shed": fleet_st.get("shed", 0),
+        "failovers": fleet_st.get("failovers", 0),
+    }
+    burst = summ["per_phase"].get("burst")
+    if burst is not None and burst.get("ttft_p99") is not None:
+        wl["p99_under_burst"] = burst["ttft_p99"]
+        wl["time_to_healthy_under_burst_s"] = tth
+    result = {
+        "mode": "workload",
+        "requests": len(workload),
+        "max_new_tokens": omax,
+        "telemetry": args.telemetry,
+        "workload": wl,
+        "__meta__": _perf.run_meta(),
+    }
+    print(json.dumps(result, indent=2))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(result, f, indent=2)
+    if args.metrics_out:
+        telemetry.registry().snapshot_json(args.metrics_out)
+        print(f"# metrics snapshot -> {args.metrics_out}", file=sys.stderr)
+    if summ["lost"]:
+        raise SystemExit(f"workload bench: {summ['lost']} request(s) "
+                         "never reached a terminal state")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=8)
@@ -833,6 +1001,29 @@ def main():
     ap.add_argument("--tenant-mix", default=None, metavar="W0,W1,...",
                     help="comma-separated tenant weights for --tenants "
                          "(default 8,1,1,... — tenant 0 hot)")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="one seed for every RNG this bench draws from "
+                         "(model init, prompt generation, tenant mixes, "
+                         "stagger jitter): identical spec+seed runs "
+                         "produce byte-identical workloads. Default 0; "
+                         "with --workload an explicit value also "
+                         "overrides the spec's own seed")
+    ap.add_argument("--stagger-jitter", type=float, default=0.0,
+                    help="--fleet only: jitter each client's stagger "
+                         "sleep by up to this fraction, drawn from the "
+                         "seeded RNG (0 = the historical fixed stagger)")
+    ap.add_argument("--workload", default=None, metavar="SPEC",
+                    help="trace-driven workload mode: replay a "
+                         "WorkloadSpec (preset name or spec JSON path — "
+                         "docs/WORKLOADS.md) open- or closed-loop "
+                         "against a LocalReplica fleet and report "
+                         "distribution-level numbers (p99 under burst, "
+                         "goodput under overload, time-to-healthy) — "
+                         "bench kind serving_workload_<name>; --fleet N "
+                         "sizes the fleet (default 1)")
+    ap.add_argument("--time-scale", type=float, default=1.0,
+                    help="--workload only: compress (<1) or stretch "
+                         "(>1) the spec's arrival schedule")
     ap.add_argument("--journal", choices=("off", "interval", "always"),
                     default="off",
                     help="--fleet only: run a second pass through a "
@@ -850,6 +1041,14 @@ def main():
                     if args.slo_ttft_ms is not None else None),
         slo_tpot_s=(args.slo_tpot_ms / 1e3
                     if args.slo_tpot_ms is not None else None))
+    # --seed: None means "not explicitly given" (workload specs keep
+    # their own seed); every RNG below still draws from the default 0
+    args.seed_given = args.seed is not None
+    if args.seed is None:
+        args.seed = 0
+    if args.workload is not None:
+        run_workload_bench(args, slo_kw)
+        return
     if args.tenants is not None:
         run_multitenant_bench(args, slo_kw)
         return
@@ -866,13 +1065,13 @@ def main():
         args.prompt_len = 32
     if args.slots is None:
         args.slots = 4
-    paddle_tpu.seed(0)
+    paddle_tpu.seed(args.seed)
     max_len = args.prompt_len + args.max_new
     cfg = llama_tiny(vocab=args.vocab, hidden=args.hidden, layers=args.layers,
                      heads=4, kv_heads=2, inter=2 * args.hidden,
                      seq=2 * max_len)
     model = LlamaForCausalLM(cfg)
-    rng = np.random.RandomState(0)
+    rng = np.random.RandomState(args.seed)
     prompts = [list(rng.randint(0, args.vocab, args.prompt_len))
                for _ in range(args.requests)]
     sp = SamplingParams(max_new_tokens=args.max_new, temperature=0.0)
