@@ -78,6 +78,7 @@ import json
 import math
 import threading
 import time
+import urllib.parse
 import uuid
 from types import SimpleNamespace
 
@@ -92,6 +93,117 @@ from ..analysis import locksan
 __all__ = ["Gateway"]
 
 _SERVER = "paddle-tpu-gateway"
+
+# The /v1/dashboard page: zero external assets (no CDN fonts, no JS
+# frameworks) so it renders inside an airgapped pod. Inline JS polls the
+# JSON endpoints this same gateway serves.
+_DASHBOARD_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>paddle_tpu ops — __GATEWAY_ID__</title>
+<style>
+ body{font:13px/1.45 system-ui,sans-serif;margin:0;background:#0d1117;color:#c9d1d9}
+ h1{font-size:16px;margin:0;padding:10px 16px;background:#161b22;border-bottom:1px solid #30363d}
+ h1 small{color:#8b949e;font-weight:normal}
+ h2{font-size:13px;color:#8b949e;margin:18px 16px 6px;text-transform:uppercase;letter-spacing:.05em}
+ table{border-collapse:collapse;margin:0 16px;width:calc(100% - 32px)}
+ th,td{text-align:left;padding:3px 10px;border-bottom:1px solid #21262d;font-size:12px}
+ th{color:#8b949e;font-weight:normal}
+ .page{color:#f85149;font-weight:bold}.ticket{color:#d29922}.info{color:#58a6ff}
+ .firing{color:#f85149}.pending{color:#d29922}.resolved{color:#3fb950}
+ .ok{color:#3fb950}.muted{color:#484f58}
+ .charts{display:flex;flex-wrap:wrap;gap:10px;margin:0 16px}
+ .chart{background:#161b22;border:1px solid #30363d;border-radius:6px;padding:8px 10px}
+ .chart .name{font-size:11px;color:#8b949e}.chart .val{font-size:14px}
+ svg polyline{fill:none;stroke:#58a6ff;stroke-width:1.5}
+ .bar{background:#1f6feb;height:10px;display:inline-block;vertical-align:middle}
+ .stack{font:11px ui-monospace,monospace;white-space:nowrap;overflow:hidden;text-overflow:ellipsis;max-width:60vw;display:inline-block;vertical-align:middle}
+ #err{color:#f85149;padding:4px 16px}
+</style></head><body>
+<h1>paddle_tpu ops plane <small>· gateway __GATEWAY_ID__ · <span id="asof"
+ class="muted"></span></small></h1>
+<div id="err"></div>
+<h2>Alerts <span id="alertsum"></span></h2>
+<table id="alerts"><thead><tr><th>rule</th><th>key</th><th>severity</th>
+<th>state</th><th>value</th><th>exemplar</th><th>description</th></tr></thead>
+<tbody></tbody></table>
+<h2>History</h2><div class="charts" id="charts"></div>
+<h2>Profiler <span id="profsum" class="muted"></span></h2>
+<table id="prof"><thead><tr><th>samples</th><th>stack</th></tr></thead>
+<tbody></tbody></table>
+<script>
+const $=(s)=>document.querySelector(s);
+const fmt=(v)=>v==null?"–":(Math.abs(v)>=100?v.toFixed(0):Math.abs(v)>=1?v.toFixed(2):v.toPrecision(3));
+const scalar=(v)=>typeof v==="number"?v:(v&&(v.mean??v.p99??v.rate??v.last))??null;
+async function jget(u){const r=await fetch(u);if(!r.ok)throw new Error(u+" -> "+r.status);return r.json();}
+async function alerts(){
+ const d=await jget("/v1/alerts");
+ const tb=$("#alerts tbody");tb.innerHTML="";
+ $("#alertsum").innerHTML=d.enabled===false?'<span class=muted>(no engine attached)</span>'
+  :(d.firing?'<span class=firing>'+d.firing+' firing</span>':'<span class=ok>all clear</span>')
+  +' <span class=muted>· '+(d.pending||0)+' pending · '+(d.rules||[]).length+' rules · eval #'+(d.evaluations||0)+'</span>';
+ const rows=(d.alerts||[]).concat((d.resolved||[]).slice(-5));
+ if(!rows.length){tb.innerHTML='<tr><td colspan=7 class=muted>nothing pending, nothing firing</td></tr>';}
+ for(const a of rows){
+  const tr=document.createElement("tr");
+  const ex=a.exemplar?'<a href="/v1/traces/'+a.exemplar+'">'+a.exemplar+'</a>':"–";
+  tr.innerHTML='<td>'+a.rule+'</td><td>'+(a.key||"–")+'</td><td class='+a.severity+'>'+a.severity
+   +'</td><td class='+a.state+'>'+a.state+'</td><td>'+fmt(a.value)+'</td><td>'+ex
+   +'</td><td class=muted>'+(a.description||"")+'</td>';
+  tb.appendChild(tr);}
+}
+function spark(pts){
+ const vs=pts.map(p=>scalar(p.v)).filter(v=>v!=null);
+ if(vs.length<2)return{svg:"",last:vs[0]};
+ const w=180,h=36,mn=Math.min(...vs),mx=Math.max(...vs),span=(mx-mn)||1;
+ const xs=vs.map((v,i)=>((i/(vs.length-1))*w).toFixed(1)+","+((h-2)-(v-mn)/span*(h-4)).toFixed(1));
+ return{svg:'<svg width='+w+' height='+h+'><polyline points="'+xs.join(" ")+'"/></svg>',last:vs[vs.length-1]};
+}
+async function charts(){
+ const list=await jget("/v1/history");
+ const box=$("#charts");box.innerHTML="";
+ if(list.enabled===false){box.innerHTML='<span class=muted>(no history store attached)</span>';return;}
+ const prefer=["slo_goodput_ratio","slo_ttft_p99_seconds","slo_tpot_p99_seconds",
+  "gateway_request_seconds","gateway_requests_total","router_breaker_state",
+  "alerts_firing","journal_segments","history_overhead_frac","pyprof_overhead_frac"];
+ const have=new Set((list.families||[]).map(f=>f.family));
+ const fams=prefer.filter(f=>have.has(f)).slice(0,10);
+ for(const fam of fams){
+  const q=await jget("/v1/history?family="+fam+"&window=300");
+  for(const s of (q.series||[]).slice(0,3)){
+   const sp=spark(s.points||[]);
+   const lbl=Object.entries(s.labels||{}).map(([k,v])=>k+"="+v).join(",");
+   const div=document.createElement("div");div.className="chart";
+   div.innerHTML='<div class=name>'+fam+(lbl?"{"+lbl+"}":"")+'</div>'
+    +'<div class=val>'+fmt(sp.last)+'</div>'+sp.svg;
+   box.appendChild(div);}}
+}
+async function prof(){
+ const st=await jget("/v1/profile?format=stats");
+ const tb=$("#prof tbody");tb.innerHTML="";
+ if(st.enabled===false){$("#profsum").textContent="(no profiler attached)";return;}
+ $("#profsum").textContent=st.hz+" Hz · "+st.samples+" samples · overhead "
+  +(100*(st.overhead_frac||0)).toFixed(2)+"%";
+ const txt=await (await fetch("/v1/profile?format=folded")).text();
+ const rows=txt.trim().split("\\n").filter(Boolean).map(l=>{
+  const i=l.lastIndexOf(" ");return [l.slice(0,i),parseInt(l.slice(i+1))];})
+  .sort((a,b)=>b[1]-a[1]).slice(0,15);
+ const mx=rows.length?rows[0][1]:1;
+ for(const [stack,n] of rows){
+  const tr=document.createElement("tr");
+  tr.innerHTML='<td><span class=bar style="width:'+(80*n/mx)+'px"></span> '+n
+   +'</td><td><span class=stack title="'+stack+'">'+stack+'</span></td>';
+  tb.appendChild(tr);}
+}
+async function tick(fns){
+ try{await Promise.all(fns.map(f=>f()));$("#err").textContent="";}
+ catch(e){$("#err").textContent=String(e);}
+ $("#asof").textContent=new Date().toLocaleTimeString();
+}
+tick([alerts,charts,prof]);
+setInterval(()=>tick([alerts]),2000);
+setInterval(()=>tick([charts]),3000);
+setInterval(()=>tick([prof]),5000);
+</script></body></html>
+"""
 
 
 def _gateway_metrics() -> SimpleNamespace:
@@ -246,8 +358,16 @@ class Gateway:
                  resume_retention: int = 512,
                  cancel_on_disconnect: bool | None = None,
                  recover: bool = True,
-                 tenancy=None, autoscaler=None):
+                 tenancy=None, autoscaler=None,
+                 history=None, alerts=None, profiler=None):
         self.router = router
+        # the ops plane (telemetry.history / .alerts / .pyprof): when
+        # attached, the gateway serves /v1/history, /v1/alerts,
+        # /v1/profile, and the /v1/dashboard HTML over them. All three
+        # are optional and independent.
+        self.history = history
+        self.alerts = alerts
+        self.profiler = profiler
         # multi-tenant front door (serving.tenancy): API-key -> tenant
         # resolution (401 on unknown keys when any key is configured) and
         # per-tenant token-bucket admission (429 with a bucket-refill
@@ -786,6 +906,14 @@ class Gateway:
                 return await self._route_stream_resume(req, writer)
             if req.path.startswith("/v1/traces/"):
                 return await self._route_trace(req, writer)
+            if req.path == "/v1/alerts":
+                return await self._route_alerts(writer)
+            if req.path == "/v1/history":
+                return await self._route_history(req, writer)
+            if req.path == "/v1/profile":
+                return await self._route_profile(req, writer)
+            if req.path == "/v1/dashboard":
+                return await self._route_dashboard(writer)
             raise _HTTPError(404, f"no route {req.path}")
         except _HTTPError as e:
             await self._write_response(
@@ -847,6 +975,15 @@ class Gateway:
             "streams_retained": retained,
             "streams_live": live,
             "idempotency_keys": idem,
+            "ops": {
+                "history": (self.history.stats()
+                            if self.history is not None else None),
+                "alerts": ({"firing": len(self.alerts.firing()),
+                            "evaluations": self.alerts.evaluations}
+                           if self.alerts is not None else None),
+                "profiler": (self.profiler.stats()
+                             if self.profiler is not None else None),
+            },
         }
 
     async def _route_healthz(self, writer) -> bool:
@@ -883,6 +1020,101 @@ class Gateway:
         await writer.drain()
         self._m.responses.labels(code="200").inc()
         return True
+
+    # -- the ops plane (history / alerts / profiler / dashboard) -----------
+    async def _write_raw(self, writer, body: bytes, content_type: str,
+                         status: int = 200) -> bool:
+        head = (f"HTTP/1.1 {status} {_REASONS.get(status, 'OK')}\r\n"
+                f"Server: {_SERVER}\r\n"
+                f"Content-Type: {content_type}\r\n"
+                f"Content-Length: {len(body)}\r\n\r\n")
+        writer.write(head.encode() + body)
+        await writer.drain()
+        self._m.responses.labels(code=str(status)).inc()
+        return True
+
+    @staticmethod
+    def _query_params(req) -> dict:
+        out = {}
+        for part in (req.query or "").split("&"):
+            if not part:
+                continue
+            k, _, v = part.partition("=")
+            out[urllib.parse.unquote(k)] = urllib.parse.unquote(v)
+        return out
+
+    async def _route_alerts(self, writer) -> bool:
+        """``GET /v1/alerts``: the alert engine's full state — firing /
+        pending alerts, recent resolutions, and the rule pack."""
+        if self.alerts is None:
+            await self._write_response(
+                writer, 200, {"enabled": False, "alerts": [], "rules": [],
+                              "firing": 0, "pending": 0})
+            return True
+        doc = self.alerts.state()
+        doc["enabled"] = True
+        await self._write_response(writer, 200, doc)
+        return True
+
+    async def _route_history(self, req, writer) -> bool:
+        """``GET /v1/history``: no params lists families; ``?family=X
+        [&window=SEC][&res=raw|10s|1m][&label.<k>=<v>]`` returns points
+        (counters as rates, histograms as quantile summaries)."""
+        if self.history is None:
+            await self._write_response(
+                writer, 200, {"enabled": False, "families": []})
+            return True
+        params = self._query_params(req)
+        family = params.get("family")
+        if not family:
+            await self._write_response(writer, 200, {
+                "enabled": True,
+                "families": self.history.families(),
+                "stats": self.history.stats()})
+            return True
+        labels = {k[len("label."):]: v for k, v in params.items()
+                  if k.startswith("label.")}
+        window = params.get("window")
+        res = params.get("res", "raw")
+        try:
+            doc = self.history.query(
+                family, labels=labels or None,
+                window_s=float(window) if window else None, res=res)
+        except ValueError as e:
+            raise _HTTPError(400, str(e))
+        doc["enabled"] = True
+        await self._write_response(writer, 200, doc)
+        return True
+
+    async def _route_profile(self, req, writer) -> bool:
+        """``GET /v1/profile``: this process's continuous profile —
+        speedscope JSON by default, ``?format=folded`` for flamegraph
+        lines, ``?format=stats`` for the sampler's own counters."""
+        if self.profiler is None:
+            await self._write_response(
+                writer, 200, {"enabled": False})
+            return True
+        fmt = self._query_params(req).get("format", "speedscope")
+        if fmt == "folded":
+            return await self._write_raw(
+                writer, (self.profiler.folded() + "\n").encode(),
+                "text/plain; charset=utf-8")
+        if fmt == "stats":
+            await self._write_response(
+                writer, 200, {"enabled": True, **self.profiler.stats()})
+            return True
+        doc = self.profiler.speedscope(name=self.gateway_id)
+        doc["enabled"] = True
+        await self._write_response(writer, 200, doc)
+        return True
+
+    async def _route_dashboard(self, writer) -> bool:
+        """``GET /v1/dashboard``: a dependency-free HTML ops page —
+        alerts table, history sparklines, profiler top stacks — polling
+        the JSON endpoints above from inline JS."""
+        html = _DASHBOARD_HTML.replace("__GATEWAY_ID__", self.gateway_id)
+        return await self._write_raw(writer, html.encode(),
+                                     "text/html; charset=utf-8")
 
     # -- completions -------------------------------------------------------
     def _resolve_tenant(self, req) -> str:

@@ -38,6 +38,23 @@ And the performance layer (PR 9):
   culprit naming); ``tools/perf_gate.py`` enforces the bench trajectory
   against ``BASELINE.json``.
 
+And the ops plane (PR 19) — the detect half of detect→page→diagnose:
+
+- :mod:`.history` — ``TimeSeriesStore``: a background sampler turns the
+  instantaneous registry into bounded raw/10s/1m downsampling rings
+  (counters as rates, histograms as quantile summaries); serves the
+  gateway ``/v1/history`` + ``/v1/dashboard`` and attaches a last-window
+  slice to every flight dump and postmortem bundle.
+- :mod:`.alerts` — declarative threshold / absence / multi-window
+  SLO-burn-rate rules with a pending→firing→resolved lifecycle,
+  ``alerts_firing`` gauge, flight events, and a notifier hook
+  (``/v1/alerts``; ``chaos_run --suite alerts`` proves page timing).
+- :mod:`.pyprof` — continuous sampling profiler over
+  ``sys._current_frames()`` keyed by thread names; folded-flamegraph /
+  speedscope exports, self-measured overhead, and per-rank folded
+  profiles shipped through :mod:`.cluster` into one fleet-wide flame
+  view.
+
 :func:`disable` flips one shared flag that every write path checks first —
 the guaranteed-cheap escape hatch for benchmarking the instrumentation
 itself (``tools/serving_bench.py --telemetry off``).
@@ -83,6 +100,15 @@ from . import cost  # noqa: F401  (roofline cost model: jaxpr FLOPs/bytes
 #                                  walk + trace-cost registry — see cost.py)
 from . import reqtrace  # noqa: F401  (request-scoped trace propagation +
 #                                      per-request Chrome merge — reqtrace.py)
+from . import history  # noqa: F401  (metrics history: TimeSeriesStore
+#                                     downsampling rings — see history.py)
+from .history import TimeSeriesStore  # noqa: F401
+from . import alerts  # noqa: F401  (SLO burn-rate / threshold / absence
+#                                    rule engine — see alerts.py)
+from .alerts import AlertEngine, default_rules  # noqa: F401
+from . import pyprof  # noqa: F401  (continuous sampling profiler: folded /
+#                                    speedscope + fleet merge — pyprof.py)
+from .pyprof import SamplingProfiler  # noqa: F401
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
@@ -93,6 +119,8 @@ __all__ = [
     "enable", "disable", "enabled", "prometheus_text", "snapshot",
     "cluster", "SLOTracker", "perf", "compile_watcher", "memory_monitor",
     "step_timeline", "explain_recompile", "cost", "reqtrace",
+    "history", "TimeSeriesStore", "alerts", "AlertEngine", "default_rules",
+    "pyprof", "SamplingProfiler",
 ]
 
 

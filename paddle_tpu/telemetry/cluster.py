@@ -71,6 +71,8 @@ import traceback
 from dataclasses import dataclass
 
 from . import tracing
+from . import history as history_mod
+from . import pyprof as pyprof_mod
 from .flight_recorder import flight
 from .metrics import ENABLED, registry
 
@@ -304,12 +306,13 @@ class RankPublisher:
     def __init__(self, store, rank: int, world_size: int, *,
                  interval_s: float = 1.0, flight_tail: int = 128,
                  clock=time.time, sync_clock: bool = True,
-                 clock_probes: int = 5):
+                 clock_probes: int = 5, profile_top_n: int = 200):
         self.store = store
         self.rank = int(rank)
         self.world_size = int(world_size)
         self.interval_s = float(interval_s)
         self.flight_tail = int(flight_tail)
+        self.profile_top_n = int(profile_top_n)
         self._clock = clock
         self.sync_clock = sync_clock
         self.clock_probes = int(clock_probes)
@@ -375,6 +378,17 @@ class RankPublisher:
                       registry().snapshot())
             _set_json(self.store, _k(self.rank, "flight"),
                       flight().events()[-self.flight_tail:])
+            prof = pyprof_mod.installed()
+            if prof is not None:
+                # folded top-N rides the heartbeat: the aggregator's
+                # fleet-wide flame view is just a sum over these
+                _set_json(self.store, _k(self.rank, "pyprof"), {
+                    "rank": self.rank,
+                    "hz": prof.hz,
+                    "samples": prof.samples,
+                    "overhead_frac": prof.overhead_frac(),
+                    "folded": prof.folded_dict(self.profile_top_n),
+                })
             _M_PUBLISH.inc()
         except Exception:
             _M_PUB_ERRS.inc()
@@ -398,6 +412,8 @@ class RankPublisher:
 
     def answer_postmortem(self, pm_id: str, reason: str = ""):
         evs = flight().events()
+        hist = history_mod.installed()
+        prof = pyprof_mod.installed()
         _set_json(self.store, _k_pm(pm_id, self.rank), {
             "rank": self.rank,
             "pid": os.getpid(),
@@ -407,6 +423,12 @@ class RankPublisher:
             "stacks": stack_snapshot(),
             "flight": {"num_events": len(evs), "events": evs},
             "coll": {"seq": self.heartbeat.seq},
+            # "what was happening the last N minutes before it died" —
+            # the history last-window slice, when a store is installed
+            "history": hist.last_window() if hist is not None else None,
+            "pyprof": ({"hz": prof.hz, "samples": prof.samples,
+                        "folded": prof.folded_dict(self.profile_top_n)}
+                       if prof is not None else None),
         })
 
     def trigger_postmortem(self, reason: str) -> str:
@@ -678,6 +700,7 @@ class ClusterAggregator:
                 "metrics": _get_json(self.store, _k(r, "metrics")),
                 "flight": _get_json(self.store, _k(r, "flight")),
                 "coll": _get_json(self.store, _k(r, "coll")),
+                "pyprof": _get_json(self.store, _k(r, "pyprof")),
             }
         return {"collected_wall": self._clock(),
                 "world_size": self.world_size, "ranks": ranks}
@@ -732,6 +755,31 @@ class ClusterAggregator:
             return {"value": sum(vals)}
         return {"sum": sum(vals), "min": min(vals), "max": max(vals)}
 
+    def merged_profile(self) -> dict:
+        """The fleet-wide flame view: every rank's published folded
+        profile summed stack-wise (stacks are rooted at thread names, so
+        identical subsystems across ranks merge into one frame tower).
+        ``{"stacks": {stack: count}, "ranks": {r: {hz, samples,
+        overhead_frac}}, "total_samples": N}``."""
+        tables, ranks = [], {}
+        for r in range(self.world_size):
+            p = _get_json(self.store, _k(r, "pyprof"))
+            if not p:
+                continue
+            tables.append(p.get("folded") or {})
+            ranks[r] = {"hz": p.get("hz"), "samples": p.get("samples"),
+                        "overhead_frac": p.get("overhead_frac")}
+        stacks = pyprof_mod.merge_folded(*tables)
+        return {"stacks": stacks, "ranks": ranks,
+                "total_samples": sum(stacks.values()),
+                "collected_wall": self._clock()}
+
+    def merged_folded_text(self) -> str:
+        """The merged view as folded flamegraph lines (pipe to a
+        renderer, or reload with ``pyprof.parse_folded``)."""
+        prof = self.merged_profile()
+        return "\n".join(f"{k} {v}" for k, v in prof["stacks"].items())
+
     def prometheus_text(self) -> str:
         """Fleet exposition: every rank's series with the ``rank`` label
         injected (rollups are the scraper's `sum by`—only the raw series
@@ -772,6 +820,10 @@ class ClusterAggregator:
               manifest.json            reason, ranks collected/missing
               rank<r>-flight.json      that rank's flight-recorder dump
               rank<r>-stacks.txt       all of its threads' Python stacks
+              rank<r>-history.json     metrics-history last-window slice
+                                       (when that rank had a store)
+              rank<r>-pyprof.folded    folded CPU profile (when that rank
+                                       had a profiler)
 
         Ranks that never answer within ``timeout_s`` are listed in the
         manifest's ``missing`` — a dead process is itself a finding.
@@ -802,13 +854,27 @@ class ClusterAggregator:
             for r, p in payloads.items():
                 with open(os.path.join(bundle, f"rank{r}-flight.json"),
                           "w") as f:
-                    json.dump({k: v for k, v in p.items() if k != "stacks"},
+                    json.dump({k: v for k, v in p.items()
+                               if k not in ("stacks", "history", "pyprof")},
                               f, indent=1, default=str)
                 with open(os.path.join(bundle, f"rank{r}-stacks.txt"),
                           "w") as f:
                     for label, frames in p.get("stacks", {}).items():
                         f.write(f"== {label} ==\n")
                         f.write("\n".join(frames) + "\n\n")
+                if p.get("history"):
+                    with open(os.path.join(bundle,
+                                           f"rank{r}-history.json"),
+                              "w") as f:
+                        json.dump(p["history"], f, indent=1, default=str)
+                if p.get("pyprof"):
+                    with open(os.path.join(bundle,
+                                           f"rank{r}-pyprof.folded"),
+                              "w") as f:
+                        folded = p["pyprof"].get("folded") or {}
+                        f.write("\n".join(f"{k} {v}"
+                                          for k, v in folded.items()))
+                        f.write("\n")
             with open(os.path.join(bundle, "manifest.json"), "w") as f:
                 json.dump({
                     "id": pm_id,
@@ -818,6 +884,10 @@ class ClusterAggregator:
                     "ranks_collected": sorted(payloads),
                     "missing": [r for r in range(self.world_size)
                                 if r not in payloads],
+                    "ranks_with_history": sorted(
+                        r for r, p in payloads.items() if p.get("history")),
+                    "ranks_with_profile": sorted(
+                        r for r, p in payloads.items() if p.get("pyprof")),
                 }, f, indent=1)
             return bundle
         except Exception:  # lint: allow-silent(aggregation is best-effort; None = bundle unavailable)

@@ -38,9 +38,35 @@ from .metrics import ENABLED
 from ..analysis import locksan
 
 __all__ = ["FlightRecorder", "flight", "record_event", "dump",
-           "install_excepthook"]
+           "install_excepthook", "register_context_provider",
+           "unregister_context_provider"]
 
 _DUMP_IDS = itertools.count(1)
+
+# name -> zero-arg callable returning a JSON-able blob. Every dump calls
+# each provider and attaches the results under doc["context"][name] — how
+# the metrics history (telemetry/history.py) rides along on every
+# postmortem without the recorder knowing it exists. A provider that
+# raises contributes an error marker instead of killing the dump.
+_CONTEXT_PROVIDERS: dict[str, object] = {}
+
+
+def register_context_provider(name: str, fn):
+    _CONTEXT_PROVIDERS[str(name)] = fn
+
+
+def unregister_context_provider(name: str):
+    _CONTEXT_PROVIDERS.pop(str(name), None)
+
+
+def _gather_context() -> dict:
+    out = {}
+    for name, fn in sorted(_CONTEXT_PROVIDERS.items()):
+        try:
+            out[name] = fn()
+        except Exception as e:  # lint: allow-silent(a broken provider must not kill the postmortem; marker says which one)
+            out[name] = {"error": f"{type(e).__name__}: {e}"}
+    return out
 
 
 class FlightRecorder:
@@ -112,6 +138,8 @@ class FlightRecorder:
                 "events_dropped": max(0, self._seq - len(evs)),
                 "events": evs,
             }
+            if _CONTEXT_PROVIDERS:
+                doc["context"] = _gather_context()
             with open(path, "w") as f:
                 json.dump(doc, f, indent=1, default=str)
             self.num_dumps += 1

@@ -1,0 +1,380 @@
+"""paddle_tpu.telemetry.alerts: SLO burn-rate alerting (ISSUE 19).
+
+Burn-rate window algebra goldens (SRE-workbook multi-window rules over
+the metrics history), pending -> firing -> resolved lifecycle with
+for-duration and resolve hysteresis, absence modes (zero / flat /
+missing, presence-first), the declarative JSON rule grammar, the
+``alerts_firing`` gauge sync, and the gateway ops endpoints
+(``/v1/alerts`` / ``/v1/history`` / ``/v1/dashboard``) over a stub
+router. Everything below an HTTP socket runs on injected clocks.
+"""
+import http.client
+import json
+
+import pytest
+
+from paddle_tpu.telemetry import alerts as alerts_mod
+from paddle_tpu.telemetry.alerts import (
+    AbsenceRule, AlertEngine, BurnRateRule, ThresholdRule,
+    default_rules, rule_from_dict, rules_from_json)
+from paddle_tpu.telemetry.history import TimeSeriesStore
+from paddle_tpu.telemetry.metrics import MetricsRegistry, registry
+
+pytestmark = [pytest.mark.telemetry, pytest.mark.alerts]
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt=1.0):
+        self.t += dt
+        return self.t
+
+
+def make_store():
+    clk = FakeClock()
+    st = TimeSeriesStore(MetricsRegistry(), interval_s=1.0, clock=clk,
+                         wall_clock=lambda: clk.t + 5e8)
+    return st, clk
+
+
+def feed(st, t, fam="slo_goodput_ratio", value=1.0, labels=None,
+         kind="gauge"):
+    st._ingest({fam: {"type": kind, "help": "", "labels": [],
+                      "series": [{"labels": labels or {}, "value": value}]}},
+               t, t + 5e8)
+
+
+class TestScalar:
+    def test_floats_pass_through(self):
+        assert alerts_mod._scalar(2) == 2.0
+        assert alerts_mod._scalar(0.5) == 0.5
+
+    def test_dict_field_preference(self):
+        v = {"rate": 4.0, "mean": 2.0, "p99": 9.0}
+        assert alerts_mod._scalar(v, "p99") == 9.0
+        assert alerts_mod._scalar(v) == 2.0          # mean before rate
+        assert alerts_mod._scalar({"last": 3.0}) == 3.0
+        assert alerts_mod._scalar({"p50": None}) is None
+        assert alerts_mod._scalar("nope") is None
+
+
+class TestBurnRateAlgebra:
+    WINDOWS = ((60.0, 10.0, 10.0, "page", "fast"),)
+
+    def test_steady_burn_golden(self):
+        """Constant goodput 0.97 against a 0.99 objective burns the budget
+        at exactly 3x in every window."""
+        st, clk = make_store()
+        for i in range(70):
+            feed(st, 1000.0 + i, value=0.97)
+        clk.t = 1069.0
+        rule = BurnRateRule("r", "slo_goodput_ratio", objective=0.99,
+                            windows=self.WINDOWS)
+        [(key, sev, active, value, info)] = rule.evaluate_all(st, clk.t)
+        assert (key, sev) == ("fast", "page")
+        assert info["burn_long"] == pytest.approx(3.0)
+        assert info["burn_short"] == pytest.approx(3.0)
+        assert value == pytest.approx(3.0)
+        assert not active                            # 3x < 10x factor
+
+    def test_short_spike_needs_long_window_significance(self):
+        """10s of total outage after 55s of perfection: the short window
+        burns at 50x but the long window only at 8.3x — no page. The long
+        window is what separates a blip from a budget-threatening burn."""
+        st, clk = make_store()
+        for i in range(55):
+            feed(st, 1000.0 + i, value=1.0)
+        for i in range(10):
+            feed(st, 1055.0 + i, value=0.5)
+        clk.t = 1065.0
+        rule = BurnRateRule("r", "slo_goodput_ratio", objective=0.99,
+                            windows=self.WINDOWS)
+        [(_, _, active, _, info)] = rule.evaluate_all(st, clk.t)
+        assert info["burn_short"] == pytest.approx(50.0)
+        assert info["burn_long"] == pytest.approx((10 * 0.5 / 60) / 0.01)
+        assert not active
+
+    def test_sustained_burn_fires_both_windows(self):
+        st, clk = make_store()
+        for i in range(70):
+            feed(st, 1000.0 + i, value=0.85)         # err 0.15 -> 15x
+        clk.t = 1069.0
+        rule = BurnRateRule("r", "slo_goodput_ratio", objective=0.99,
+                            windows=self.WINDOWS)
+        [(_, _, active, value, _)] = rule.evaluate_all(st, clk.t)
+        assert active
+        assert value == pytest.approx(15.0)
+
+    def test_min_points_gate(self):
+        st, clk = make_store()
+        feed(st, 1000.0, value=0.0)
+        [(_, _, active, value, info)] = BurnRateRule(
+            "r", "slo_goodput_ratio", windows=self.WINDOWS,
+        ).evaluate_all(st, clk.t)
+        assert not active and value is None
+        assert info["burn_long"] is None
+
+    def test_time_scale_shrinks_windows(self):
+        rule = BurnRateRule("r", "slo_goodput_ratio", time_scale=0.01)
+        (long_s, short_s, factor, sev, name), slow = rule.windows
+        assert (long_s, short_s) == (36.0, 3.0)
+        assert (factor, sev, name) == (14.4, "page", "fast")
+        assert slow[3:] == ("ticket", "slow")
+
+    def test_error_ratio_signal(self):
+        st, clk = make_store()
+        for i in range(70):
+            feed(st, 1000.0 + i, fam="err_ratio", value=0.03)
+        clk.t = 1069.0
+        rule = BurnRateRule("r", "err_ratio", objective=0.99,
+                            signal="error_ratio", windows=self.WINDOWS)
+        [(_, _, _, value, _)] = rule.evaluate_all(st, clk.t)
+        assert value == pytest.approx(3.0)
+
+
+class TestThresholdAndAbsence:
+    def test_threshold_per_series(self):
+        st, clk = make_store()
+        feed(st, 1000.0, fam="breaker", value=2.0, labels={"replica": "a"})
+        feed(st, 1000.0, fam="breaker", value=0.0, labels={"replica": "b"})
+        rule = ThresholdRule("r", "breaker", ">=", 2.0)
+        out = {key: active for key, _, active, _, _
+               in rule.evaluate_all(st, clk.t)}
+        assert out == {"replica=a": True, "replica=b": False}
+
+    def test_absence_zero_mode(self):
+        st, clk = make_store()
+        rule = AbsenceRule("r", "rate", absent_for_s=5.0, mode="zero")
+        feed(st, 1000.0, fam="rate", value=3.0)
+        [(_, _, active, _, _)] = rule.evaluate_all(st, 1000.0)
+        assert not active
+        feed(st, 1008.0, fam="rate", value=0.0)      # went quiet at t=1000
+        [(key, sev, active, quiet, _)] = rule.evaluate_all(st, 1008.0)
+        assert active and sev == "page"
+        assert quiet == pytest.approx(8.0)
+
+    def test_absence_presence_first(self):
+        """A series that has never shown signal cannot be 'absent'."""
+        st, clk = make_store()
+        rule = AbsenceRule("r", "rate", absent_for_s=5.0, mode="zero")
+        feed(st, 1000.0, fam="rate", value=0.0)
+        [(_, _, active, _, _)] = rule.evaluate_all(st, 1100.0)
+        assert not active
+
+    def test_absence_flat_mode(self):
+        st, clk = make_store()
+        rule = AbsenceRule("r", "seq", absent_for_s=5.0, mode="flat")
+        feed(st, 1000.0, fam="seq", value=7.0)
+        rule.evaluate_all(st, 1000.0)                # establishes baseline
+        feed(st, 1001.0, fam="seq", value=8.0)       # changing = alive
+        [(_, _, active, _, _)] = rule.evaluate_all(st, 1001.0)
+        assert not active
+        feed(st, 1009.0, fam="seq", value=8.0)       # stuck since t=1001
+        [(_, _, active, _, _)] = rule.evaluate_all(st, 1009.0)
+        assert active
+
+    def test_absence_missing_mode(self):
+        st, clk = make_store()
+        rule = AbsenceRule("r", "hb", absent_for_s=5.0, mode="missing")
+        feed(st, 1000.0, fam="hb", value=1.0)
+        [(_, _, active, _, _)] = rule.evaluate_all(st, 1000.0)
+        assert not active                            # fresh point = alive
+        [(_, _, active, _, _)] = rule.evaluate_all(st, 1010.0)
+        assert active                                # no new points since
+
+
+class TestEngineLifecycle:
+    def make_engine(self, rule, notifier=None):
+        st, clk = make_store()
+        eng = AlertEngine(st, [rule], interval_s=999.0, clock=clk,
+                          wall_clock=lambda: clk.t + 5e8, notifier=notifier)
+        return st, clk, eng
+
+    def firing_gauge(self, rule="r", severity="page"):
+        return registry().get("alerts_firing").labels(
+            rule=rule, severity=severity).value
+
+    def test_pending_for_duration_then_firing_then_resolved(self):
+        rule = ThresholdRule("r", "depth", ">", 2.0, severity="page",
+                             for_s=5.0, resolve_s=5.0)
+        st, clk, eng = self.make_engine(rule)
+        feed(st, clk.t, fam="depth", value=9.0)
+        events = eng.evaluate_once()
+        assert [e["event"] for e in events] == ["pending"]
+        assert eng.firing() == []
+        clk.tick(5.0)                                # held for for_s
+        feed(st, clk.t, fam="depth", value=9.0)
+        events = eng.evaluate_once()
+        assert [e["event"] for e in events] == ["firing"]
+        assert len(eng.firing()) == 1
+        assert self.firing_gauge() == 1.0
+        clk.tick(1.0)                                # condition clears...
+        feed(st, clk.t, fam="depth", value=0.0)
+        assert eng.evaluate_once() == []             # ...but hysteresis holds
+        assert len(eng.firing()) == 1
+        clk.tick(5.0)                                # clear for resolve_s
+        feed(st, clk.t, fam="depth", value=0.0)
+        events = eng.evaluate_once()
+        assert [e["event"] for e in events] == ["resolved"]
+        assert eng.active() == []
+        assert self.firing_gauge() == 0.0            # pinned back to zero
+        state = eng.state()
+        assert state["resolved"][-1]["rule"] == "r"
+        assert state["resolved"][-1]["resolved_wall"] is not None
+
+    def test_blip_shorter_than_for_duration_never_pages(self):
+        rule = ThresholdRule("r", "depth", ">", 2.0, severity="page",
+                             for_s=5.0)
+        st, clk, eng = self.make_engine(rule)
+        feed(st, clk.t, fam="depth", value=9.0)
+        eng.evaluate_once()                          # pending
+        clk.tick(1.0)
+        feed(st, clk.t, fam="depth", value=0.0)
+        events = eng.evaluate_once()                 # cancelled silently
+        assert events == [] and eng.active() == []
+
+    def test_firing_alert_is_deduped_not_renotified(self):
+        got = []
+        rule = ThresholdRule("r", "depth", ">", 2.0, severity="page")
+        st, clk, eng = self.make_engine(rule, notifier=got.append)
+        for _ in range(4):
+            feed(st, clk.t, fam="depth", value=9.0)
+            eng.evaluate_once()
+            clk.tick(1.0)
+        assert [n["event"] for n in got] == ["pending", "firing"]
+        assert got[-1]["alert"]["state"] == "firing"
+
+    def test_broken_notifier_counted_not_fatal(self):
+        def boom(_):
+            raise RuntimeError("pager down")
+
+        rule = ThresholdRule("r", "depth", ">", 2.0)
+        st, clk, eng = self.make_engine(rule, notifier=boom)
+        errs0 = registry().get("alerts_notify_errors_total").value
+        feed(st, clk.t, fam="depth", value=9.0)
+        eng.evaluate_once()                          # must not raise
+        assert registry().get("alerts_notify_errors_total").value > errs0
+
+    def test_duplicate_rule_name_rejected(self):
+        st, clk, eng = self.make_engine(ThresholdRule("r", "x", ">", 1.0))
+        with pytest.raises(ValueError, match="duplicate"):
+            eng.add_rule(ThresholdRule("r", "y", ">", 1.0))
+
+
+class TestDeclarativeGrammar:
+    def test_threshold_roundtrip(self):
+        r = rule_from_dict({"type": "threshold", "name": "b", "family":
+                            "router_breaker_state", "op": ">=",
+                            "threshold": 2, "severity": "page",
+                            "for_s": 10})
+        assert isinstance(r, ThresholdRule)
+        d = r.describe()
+        assert (d["op"], d["threshold"], d["for_s"]) == (">=", 2.0, 10.0)
+
+    def test_absence_and_burn_rate(self):
+        r = rule_from_dict({"type": "absence", "name": "a",
+                            "family": "pub", "absent_for_s": 9,
+                            "mode": "flat"})
+        assert isinstance(r, AbsenceRule) and r.mode == "flat"
+        r = rule_from_dict({"type": "burn_rate", "name": "s",
+                            "family": "good", "objective": 0.999,
+                            "windows": [[60, 10, 5, "page", "w"]]})
+        assert isinstance(r, BurnRateRule)
+        assert r.windows == [(60.0, 10.0, 5.0, "page", "w")]
+
+    def test_unknown_type_rejected(self):
+        with pytest.raises(ValueError, match="unknown rule type"):
+            rule_from_dict({"type": "nope", "name": "x", "family": "y"})
+
+    def test_rules_from_json_string_and_file(self, tmp_path):
+        spec = [{"type": "threshold", "name": "t", "family": "f",
+                 "op": ">", "threshold": 1}]
+        assert len(rules_from_json(json.dumps(spec))) == 1
+        p = tmp_path / "rules.json"
+        p.write_text(json.dumps(spec))
+        assert rules_from_json(str(p))[0].name == "t"
+
+    def test_default_pack(self):
+        rules = default_rules(objective=0.999, time_scale=0.1)
+        names = {r.name for r in rules}
+        assert names == {"slo-goodput-burn", "breaker-open",
+                         "journal-growth", "leak-sentinel",
+                         "publisher-absence"}
+        burn = next(r for r in rules if r.name == "slo-goodput-burn")
+        assert burn.objective == 0.999
+        assert burn.windows[0][0] == pytest.approx(360.0)   # 1h * 0.1
+        absence = next(r for r in rules if r.name == "publisher-absence")
+        assert absence.severity == "page" and absence.mode == "zero"
+        assert absence.absent_for_s == pytest.approx(1.5)
+
+
+class StubRouter:
+    def stats(self):
+        return {"healthy": 1, "inflight": 0,
+                "replicas": {"x": {"state": "healthy"}}}
+
+
+def http_get(gw, path):
+    conn = http.client.HTTPConnection(gw.host, gw.port, timeout=30)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    body = resp.read()
+    conn.close()
+    return resp, body
+
+
+class TestGatewayOpsEndpoints:
+    @pytest.fixture()
+    def ops_gw(self):
+        from paddle_tpu.serving import Gateway
+        st, clk = make_store()
+        feed(st, clk.t, fam="depth", value=9.0)
+        rule = ThresholdRule("queue-depth", "depth", ">", 2.0,
+                             severity="page")
+        eng = AlertEngine(st, [rule], interval_s=999.0, clock=clk,
+                          wall_clock=lambda: clk.t + 5e8)
+        eng.evaluate_once()
+        gw = Gateway(StubRouter(), history=st, alerts=eng).start()
+        yield gw
+        gw.stop()
+
+    def test_v1_alerts(self, ops_gw):
+        resp, body = http_get(ops_gw, "/v1/alerts")
+        doc = json.loads(body)
+        assert resp.status == 200 and doc["enabled"]
+        assert doc["firing"] == 1                    # for_s=0: fires pass 1
+        assert doc["alerts"][0]["rule"] == "queue-depth"
+        assert [r["name"] for r in doc["rules"]] == ["queue-depth"]
+
+    def test_v1_history_list_and_query(self, ops_gw):
+        resp, body = http_get(ops_gw, "/v1/history")
+        doc = json.loads(body)
+        assert doc["enabled"]
+        assert any(f["family"] == "depth" for f in doc["families"])
+        resp, body = http_get(ops_gw, "/v1/history?family=depth")
+        doc = json.loads(body)
+        assert doc["series"][0]["points"][-1]["v"] == 9.0
+        resp, _ = http_get(ops_gw, "/v1/history?family=depth&res=bogus")
+        assert resp.status == 400
+
+    def test_v1_dashboard_is_self_contained(self, ops_gw):
+        resp, body = http_get(ops_gw, "/v1/dashboard")
+        assert resp.status == 200
+        assert resp.getheader("Content-Type").startswith("text/html")
+        assert b"/v1/alerts" in body                 # polls its own JSON
+        assert b"http://" not in body and b"https://" not in body
+
+    def test_ops_endpoints_disabled_without_engines(self):
+        from paddle_tpu.serving import Gateway
+        gw = Gateway(StubRouter()).start()
+        try:
+            for path in ("/v1/alerts", "/v1/history", "/v1/profile"):
+                _, body = http_get(gw, path)
+                assert json.loads(body)["enabled"] is False
+        finally:
+            gw.stop()

@@ -1,0 +1,250 @@
+"""paddle_tpu.telemetry.history: the TimeSeriesStore (ISSUE 19).
+
+The contract under test, per docs/OBSERVABILITY.md "Ops plane":
+
+- counters enter the rings as rates (reset-tolerant), gauges as values,
+  histograms as per-interval quantile summaries;
+- the raw ring downsamples into 10s/1m rollup rings deterministically —
+  two stores fed the same snapshot sequence at the same clock produce
+  identical series at every resolution;
+- export/import round-trips the full ring state;
+- ``last_window()`` is the compact slice flight dumps and postmortem
+  bundles carry, and ``install()`` wires it into every flight dump as a
+  context provider;
+- sources merge into families the local registry already exposes
+  (``cluster_publish_total`` exists in every process) instead of being
+  discarded.
+"""
+import json
+
+import pytest
+
+from paddle_tpu.telemetry import flight_recorder
+from paddle_tpu.telemetry import history
+from paddle_tpu.telemetry.history import TimeSeriesStore
+from paddle_tpu.telemetry.metrics import MetricsRegistry
+
+pytestmark = [pytest.mark.telemetry, pytest.mark.alerts]
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt=1.0):
+        self.t += dt
+        return self.t
+
+
+def make_store(reg=None, **kw):
+    clk = FakeClock()
+    kw.setdefault("interval_s", 1.0)
+    st = TimeSeriesStore(reg or MetricsRegistry(), clock=clk,
+                         wall_clock=lambda: clk.t + 5e8, **kw)
+    return st, clk
+
+
+class TestIngestMath:
+    def test_counter_becomes_rate(self):
+        reg = MetricsRegistry()
+        c = reg.counter("reqs_total", "requests")
+        st, clk = make_store(reg)
+        for _ in range(5):
+            c.inc(5)
+            st.sample_once()
+            clk.tick(1.0)
+        pts = st.query("reqs_total")["series"][0]["points"]
+        # first sample has no interval to rate over; the rest are 5/s
+        assert len(pts) == 4
+        assert all(abs(p["v"] - 5.0) < 1e-9 for p in pts)
+
+    def test_counter_reset_restarts_rate(self):
+        reg = MetricsRegistry()
+        c = reg.counter("reqs_total", "requests")
+        st, clk = make_store(reg)
+        c.inc(10)
+        st.sample_once()
+        clk.tick(1.0)
+        c.inc(10)
+        st.sample_once()
+        clk.tick(1.0)
+        # simulate a process restart: the counter starts over at 3
+        c._default.value = 3.0
+        st.sample_once()
+        pts = st.query("reqs_total")["series"][0]["points"]
+        assert pts[-2]["v"] == pytest.approx(10.0)
+        assert pts[-1]["v"] == pytest.approx(3.0)   # delta = v on reset
+
+    def test_gauge_recorded_verbatim(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("depth", "queue depth")
+        st, clk = make_store(reg)
+        for v in (0.0, 2.5, 1.0):
+            g.set(v)
+            st.sample_once()
+            clk.tick(1.0)
+        pts = st.query("depth")["series"][0]["points"]
+        assert [p["v"] for p in pts] == [0.0, 2.5, 1.0]
+
+    def test_histogram_becomes_quantile_summary(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_seconds", "latency",
+                          buckets=(0.1, 0.5, 1.0, 5.0))
+        st, clk = make_store(reg)
+        st.sample_once()
+        clk.tick(1.0)
+        for v in (0.05, 0.2, 0.3, 0.7, 2.0):
+            h.observe(v)
+        st.sample_once()
+        p = st.query("lat_seconds")["series"][0]["points"][-1]["v"]
+        assert p["rate"] == pytest.approx(5.0)
+        assert p["mean"] == pytest.approx((0.05 + 0.2 + 0.3 + 0.7 + 2) / 5)
+        # p50 of 5 obs interpolates inside the (0.1, 0.5] bucket
+        assert 0.1 <= p["p50"] <= 0.5
+        assert 1.0 <= p["p99"] <= 5.0
+
+    def test_quantile_from_buckets_golden(self):
+        # 10 observations: 4 in (0, 1], 4 in (1, 2], 2 in (2, 4]
+        edges, cums = [1.0, 2.0, 4.0], [4, 8, 10]
+        q = history.quantile_from_buckets
+        assert q(edges, cums, 10, 0.5) == pytest.approx(1.25)
+        assert q(edges, cums, 10, 0.9) == pytest.approx(3.0)
+        assert q(edges, cums, 10, 0.99) == pytest.approx(3.9)
+
+
+class TestRollupsAndDeterminism:
+    def _feed(self, st, snaps):
+        t = 1000.0
+        for doc in snaps:
+            st._ingest(doc, t, t + 5e8)
+            t += 1.0
+
+    def _snaps(self, n=125):
+        out = []
+        total = 0.0
+        for i in range(n):
+            total += i % 7
+            out.append({"reqs_total": {
+                "type": "counter", "help": "", "labels": [],
+                "series": [{"labels": {}, "value": total}]}})
+        return out
+
+    def test_identical_ingest_identical_rings(self):
+        a = TimeSeriesStore(MetricsRegistry())
+        b = TimeSeriesStore(MetricsRegistry())
+        snaps = self._snaps()
+        self._feed(a, snaps)
+        self._feed(b, snaps)
+        assert a.to_doc()["series"] == b.to_doc()["series"]
+        for res in ("raw", "10s", "1m"):
+            assert (a.query("reqs_total", res=res)
+                    == b.query("reqs_total", res=res))
+
+    def test_rollup_tiers_cover_and_aggregate(self):
+        st = TimeSeriesStore(MetricsRegistry())
+        self._feed(st, self._snaps(125))
+        raw = st.query("reqs_total", res="raw")["series"][0]["points"]
+        ten = st.query("reqs_total", res="10s")["series"][0]["points"]
+        one = st.query("reqs_total", res="1m")["series"][0]["points"]
+        assert len(raw) == 124                   # first counter point eaten
+        assert 12 <= len(ten) <= 13              # 124s / 10s buckets
+        assert 2 <= len(one) <= 3
+        # scalar rollups carry {n, mean, min, max, last}
+        full = next(p["v"] for p in ten if p["v"]["n"] == 10)
+        assert full["min"] <= full["mean"] <= full["max"]
+        # rollup means must conserve the raw mean over the same span
+        raw_mean = sum(p["v"] for p in raw) / len(raw)
+        ten_mean = (sum(p["v"]["mean"] * p["v"]["n"] for p in ten)
+                    / sum(p["v"]["n"] for p in ten))
+        assert ten_mean == pytest.approx(raw_mean)
+
+    def test_export_import_roundtrip(self, tmp_path):
+        st = TimeSeriesStore(MetricsRegistry())
+        self._feed(st, self._snaps(50))
+        path = st.export_json(str(tmp_path / "history.json"))
+        clone = TimeSeriesStore.import_json(path)
+        assert clone.to_doc()["series"] == st.to_doc()["series"]
+
+    def test_max_series_bound(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("g", "", labels=("i",))
+        st, clk = make_store(reg, max_series=3)
+        for i in range(6):
+            g.labels(i=str(i)).set(1.0)
+        st.sample_once()
+        assert st.stats()["series"] == 3
+
+
+class TestWindowAndSources:
+    def test_last_window_caps_and_shapes(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("depth", "")
+        st, clk = make_store(reg, flight_window_s=10.0)
+        for i in range(30):
+            g.set(float(i))
+            st.sample_once()
+            clk.tick(1.0)
+        win = st.last_window()
+        assert win["window_s"] == 10.0
+        pts = win["families"]["depth"]["series"][0]["points"]
+        assert len(pts) == 10                    # trailing window only
+        assert pts[-1][2] == 29.0                # [t, wall, v] triples
+
+    def test_source_merges_into_existing_family(self):
+        """A source family the local registry also exposes must merge its
+        series, not be discarded (cluster_publish_total exists in every
+        process; the fleet-monitor source adds per-rank series)."""
+        reg = MetricsRegistry()
+        reg.counter("pub_total", "")            # local series, forever 0
+        st, clk = make_store(reg)
+        seq = [0.0]
+        st.add_source("fleet", lambda: {"pub_total": {
+            "type": "counter",
+            "series": [{"labels": {"rank": "0"}, "value": seq[0]}]}})
+        for _ in range(4):
+            seq[0] += 10.0
+            st.sample_once()
+            clk.tick(1.0)
+        q = st.query("pub_total", labels={"rank": "0"})
+        assert q["series"] and q["series"][0]["points"][-1]["v"] == 10.0
+
+    def test_broken_source_counted_not_fatal(self):
+        st, clk = make_store()
+
+        def bad():
+            raise RuntimeError("boom")
+
+        st.add_source("bad", bad)
+        st.sample_once()                         # must not raise
+        assert st.stats()["sources"] == ["bad"]
+
+
+class TestFlightProvider:
+    def test_install_attaches_history_to_flight_dumps(self, tmp_path):
+        st, clk = make_store(MetricsRegistry())
+        try:
+            g = st.reg.gauge("depth", "")
+            g.set(3.0)
+            st.sample_once()
+            history.install(st, start=False)
+            path = flight_recorder.dump(
+                reason="test", path=str(tmp_path / "dump.json"))
+            doc = json.loads(open(path).read())
+            fams = doc["context"]["history"]["families"]
+            assert "depth" in fams
+        finally:
+            history.uninstall()
+
+    def test_provider_errors_are_marked_not_fatal(self, tmp_path):
+        flight_recorder.register_context_provider(
+            "broken", lambda: 1 / 0)
+        try:
+            path = flight_recorder.dump(
+                reason="test", path=str(tmp_path / "dump.json"))
+            doc = json.loads(open(path).read())
+            assert "ZeroDivisionError" in doc["context"]["broken"]["error"]
+        finally:
+            flight_recorder.unregister_context_provider("broken")

@@ -1,0 +1,151 @@
+"""paddle_tpu.telemetry.pyprof: the continuous sampling profiler
+(ISSUE 19). Folded stacks are keyed root-first by *thread name* (every
+background thread in this repo is named), overhead is self-measured and
+bounded, speedscope export is schema-shaped with one profile per root
+thread, and the folded algebra (parse/merge) is what the cluster
+aggregator uses to build the fleet-wide flame view.
+"""
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.telemetry.pyprof import (
+    SamplingProfiler, folded_to_speedscope, merge_folded, parse_folded)
+
+pytestmark = [pytest.mark.telemetry, pytest.mark.alerts]
+
+
+def busy_beacon(stop):
+    while not stop.is_set():
+        beacon_inner_loop(stop)
+
+
+def beacon_inner_loop(stop):
+    deadline = time.monotonic() + 0.005
+    while time.monotonic() < deadline and not stop.is_set():
+        sum(range(200))
+
+
+@pytest.fixture()
+def beacon():
+    """A named thread parked in a recognizable function."""
+    stop = threading.Event()
+    th = threading.Thread(target=busy_beacon, args=(stop,),
+                          name="test-beacon", daemon=True)
+    th.start()
+    yield th
+    stop.set()
+    th.join(timeout=5)
+
+
+class TestSampling:
+    def test_folded_contains_named_thread_and_function(self, beacon):
+        prof = SamplingProfiler(hz=200.0)
+        for _ in range(20):
+            prof.sample_once()
+            time.sleep(0.002)
+        folded = prof.folded()
+        line = next(l for l in folded.splitlines()
+                    if l.startswith("test-beacon;"))
+        # root-first: thread name, then outermost frame ... leaf frame
+        assert "test_pyprof.py:busy_beacon" in line
+        count = int(line.rsplit(" ", 1)[1])
+        assert count >= 1
+
+    def test_profiler_excludes_its_own_thread(self):
+        prof = SamplingProfiler(hz=100.0).start()
+        time.sleep(0.1)
+        prof.stop()
+        assert prof.samples > 0
+        assert not any(k.startswith("telemetry-pyprof")
+                       for k in prof.folded_dict())
+
+    def test_overhead_self_measured_and_bounded(self):
+        prof = SamplingProfiler(hz=50.0).start()
+        time.sleep(0.15)
+        prof.stop()
+        st = prof.stats()
+        assert 0.0 <= st["overhead_frac"] < 1.0
+        # a 50Hz pure-python stack walk must be cheap
+        assert st["overhead_frac"] < 0.5
+        assert st["samples"] == prof.samples > 0
+        assert st["distinct_stacks"] >= 1
+        assert st["running"] is False
+
+    def test_max_stacks_cap(self, beacon):
+        prof = SamplingProfiler(hz=100.0, max_stacks=1)
+        for _ in range(10):
+            prof.sample_once()
+            time.sleep(0.002)
+        assert prof.stats()["distinct_stacks"] <= 1
+
+    def test_bad_hz_rejected(self):
+        with pytest.raises(ValueError, match="hz"):
+            SamplingProfiler(hz=0.0)
+
+    def test_reset_clears_table(self, beacon):
+        prof = SamplingProfiler(hz=100.0)
+        prof.sample_once()
+        assert prof.stats()["distinct_stacks"] >= 1
+        prof.reset()
+        st = prof.stats()
+        assert st["distinct_stacks"] == 0 and st["samples"] == 0
+
+
+class TestFoldedAlgebra:
+    def test_parse_is_inverse_of_folded(self, beacon):
+        prof = SamplingProfiler(hz=100.0)
+        for _ in range(5):
+            prof.sample_once()
+            time.sleep(0.002)
+        assert parse_folded(prof.folded()) == prof.folded_dict()
+
+    def test_parse_skips_malformed_lines(self):
+        text = "a;b 3\n\nnot-a-count x\na;b 2\nc 1\n"
+        assert parse_folded(text) == {"a;b": 5, "c": 1}
+
+    def test_merge_sums_identical_stacks(self):
+        merged = merge_folded({"eng;step": 10, "probe;poll": 2},
+                              {"eng;step": 5, "io;read": 1})
+        assert merged == {"eng;step": 15, "probe;poll": 2, "io;read": 1}
+        # heaviest-first ordering (what the fleet flame table prints)
+        assert list(merged)[0] == "eng;step"
+
+    def test_top_n_keeps_heaviest(self):
+        prof = SamplingProfiler(hz=100.0)
+        with prof._lock:
+            prof._counts.update({"a;x": 5, "b;y": 50, "c;z": 1})
+        assert list(prof.folded_dict(top_n=2)) == ["b;y", "a;x"]
+
+
+class TestSpeedscope:
+    FOLDED = {"eng-0;engine.py:step;attn.py:paged": 7,
+              "eng-0;engine.py:step": 3,
+              "router-probe;router.py:poll": 2}
+
+    def test_schema_shape(self):
+        doc = folded_to_speedscope(self.FOLDED, name="fleet", hz=29.0)
+        assert doc["$schema"].startswith("https://www.speedscope.app")
+        assert doc["name"] == "fleet"
+        names = {f["name"] for f in doc["shared"]["frames"]}
+        assert {"eng-0", "engine.py:step", "attn.py:paged"} <= names
+
+    def test_one_profile_per_root_thread(self):
+        doc = folded_to_speedscope(self.FOLDED)
+        profs = {p["name"]: p for p in doc["profiles"]}
+        assert set(profs) == {"eng-0", "router-probe"}
+        eng = profs["eng-0"]
+        assert eng["type"] == "sampled"
+        assert sorted(eng["weights"]) == [3, 7]
+        assert eng["endValue"] == 10                 # total samples
+        # every sample's first frame index resolves to the root thread
+        frames = doc["shared"]["frames"]
+        assert all(frames[s[0]]["name"] == "eng-0"
+                   for s in eng["samples"])
+
+    def test_profiler_speedscope_uses_its_hz(self, beacon):
+        prof = SamplingProfiler(hz=31.0)
+        prof.sample_once()
+        doc = prof.speedscope(name="me")
+        assert "@31" in doc["exporter"]

@@ -120,6 +120,16 @@ LocalReplica, degradation + compaction — the tier-1 smoke's shape);
 ``rolling`` is the full battery on 2 SIGKILL-able ProcReplicas. The
 long-form driver with time budgets is ``tools/soak_run.py``.
 
+``--suite alerts`` — the ops plane's detect→page→diagnose loop
+(docs/OBSERVABILITY.md "Ops plane"): with burn windows time-scaled into
+seconds, (1) a ``serving.decode:delay`` fault on a live gateway fleet
+must trip the fast-burn SLO page within a bounded detection time, the
+page carrying an exemplar trace id and showing on ``/v1/alerts``, and
+recovery must resolve it; (2) a SIGKILL'd rank telemetry publisher must
+trip the publisher-absence page (the watchdog for the watchers); (3) the
+history sampler's and profiler's own overhead is measured A/B
+(``serving_bench --obs-overhead``) and held to the 3% bar by perf_gate.
+
 ``--suite straggler`` — the cluster observability plane
 (docs/OBSERVABILITY.md "Cluster observability"): a 4-rank job over a real
 TCPStore where one rank carries a ``collective:delay`` fault plan.
@@ -134,7 +144,7 @@ recorder + stack snapshot.
 Usage:
     python tools/chaos_run.py
         [--suite serving|prefix|spill|train|straggler|perf|serve-fleet|
-                 durable|kvfabric|locksan|soak]
+                 durable|kvfabric|locksan|soak|alerts]
         [--requests 6] [--prompt-len 24] [--max-new 16]
         [--slots 3] [--block-size 8] [--plan NAME:SPEC ...] [--json OUT.json]
         [--list] [--scenario NAME]
@@ -168,6 +178,8 @@ from paddle_tpu.models import LlamaForCausalLM, llama_tiny  # noqa: E402
 from paddle_tpu.serving import (  # noqa: E402
     LLMEngine, RequestState, SamplingParams)
 from paddle_tpu.utils.faults import FaultPlan  # noqa: E402
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # the built-in battery: one plan per degradation path the runtime claims to
 # handle (docs/ROBUSTNESS.md), plus a combined storm
@@ -2895,6 +2907,344 @@ def run_soak_suite(args, workdir=None, scenario=None):
     }
 
 
+# -- the alerts battery ----------------------------------------------------
+#
+# ``--suite alerts`` (docs/OBSERVABILITY.md "Ops plane", ISSUE 19): prove
+# the detect half of detect→page→diagnose end to end, with the SRE burn
+# windows shrunk (``time_scale``) so real page timing runs in seconds.
+# Three scenarios: (1) a ``serving.decode:delay`` fault degrades TPOT past
+# the SLO on a live gateway fleet — the fast-burn window PAGES within a
+# bounded detection time, the page names an exemplar trace id, the
+# gateway's /v1/alerts shows it, and recovery resolves the alert; (2) a
+# SIGKILL'd rank publisher trips the publisher-absence rule (the watchdog
+# for the watchers); (3) the ops plane's own cost is measured A/B and
+# gated by perf_gate within the 3% acceptance bar.
+
+def _alerts_exemplar_fn(router):
+    """The page's exemplar: the trace id behind the worst replica's
+    window p99 (``GET /v1/traces/<id>`` renders its timeline)."""
+    def fn():
+        try:
+            for rep in (router.stats().get("replicas") or {}).values():
+                ex = ((rep.get("slo") or {}).get("exemplars") or {})
+                tid = ex.get("tpot_p99") or ex.get("ttft_p99")
+                if tid:
+                    return tid
+        except Exception:  # lint: allow-silent(exemplars are garnish; the page still goes out)
+            pass
+        return None
+    return fn
+
+
+def _alerts_wait(pred, timeout_s, poll_s=0.05):
+    """Poll until pred() is truthy; returns elapsed seconds or None."""
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        if pred():
+            return time.monotonic() - t0
+        time.sleep(poll_s)
+    return None
+
+
+def _scenario_slo_burn_page(args, workdir, spec, max_len):
+    """Decode-delay fault blows the TPOT SLO on a live fleet: the
+    fast-burn window pages within a bounded detection time with an
+    exemplar trace id, /v1/alerts surfaces it, recovery resolves it."""
+    from paddle_tpu.serving import FleetRouter, Gateway, LocalReplica
+    from paddle_tpu.serving import LLMEngine as _E
+    from paddle_tpu.serving.replica_worker import build_model
+    from paddle_tpu.telemetry import alerts as alerts_mod
+    from paddle_tpu.telemetry import history as history_mod
+
+    # fast window = 14.4s long / 1.2s short; resolve hysteresis 0.12s
+    ts = 0.004
+    # a short SLO window so goodput recovers quickly once the fault
+    # lifts; the 0.5s TPOT SLO leaves a wide margin over the healthy tail
+    # (~0.08s p95 on a shared CPU host) while the 1.2s/step delay fault
+    # violates it on every token
+    spec = dict(spec, engine=dict(
+        spec["engine"], slo_tpot_s=0.5, slo_window_s=4.0))
+
+    def factory():
+        return _E(build_model(spec), **spec["engine"])
+
+    sp = SamplingParams(max_new_tokens=args.max_new, temperature=0.0)
+    rng = np.random.RandomState(5)
+
+    def prompts(n):
+        return [[int(t) for t in rng.randint(0, args.vocab,
+                                             args.prompt_len)]
+                for _ in range(n)]
+
+    reps = [LocalReplica(f"p{i}", factory, stats_interval_s=0.05,
+                         warmup=spec["warmup"]) for i in range(2)]
+    router = FleetRouter(reps, probe_interval_s=0.1, probe_timeout_s=30.0,
+                         affinity_block_size=spec["engine"]["block_size"]
+                         ).start(wait_healthy_s=600)
+
+    # warmup requests legitimately violate the TPOT SLO (they pay XLA
+    # compiles); wait for them to age out of the 4s SLO window so the
+    # history the rules read starts from a genuinely healthy fleet
+    def goodput_clean():
+        fams = telemetry.registry().snapshot().get("slo_goodput_ratio", {})
+        series = fams.get("series") or []
+        return bool(series) and all(s["value"] >= 1.0 for s in series)
+
+    if _alerts_wait(goodput_clean, 30.0, poll_s=0.2) is None:
+        router.close()
+        return {"scenario": "slo_burn_page", "survived": False,
+                "failed": "fleet goodput never settled to 1.0 post-warmup"}
+
+    hist = history_mod.TimeSeriesStore(interval_s=0.05)
+    hist.start()
+    engine = alerts_mod.AlertEngine(
+        hist,
+        alerts_mod.default_rules(objective=0.99, time_scale=ts,
+                                 exemplar_fn=_alerts_exemplar_fn(router)),
+        interval_s=0.1)
+    engine.start()
+    gateway = Gateway(router, history=hist, alerts=engine).start()
+    plan = FaultPlan.parse("serving.decode:delay=1.2x1000000")
+
+    def firing(name, key=None):
+        return next((a for a in engine.firing() if a["rule"] == name
+                     and (key is None or a["key"] == key)), None)
+
+    try:
+        # -- healthy phase: goodput 1.0, nothing may fire ------------------
+        for c in [_SSEClient(gateway, p, sp) for p in prompts(4)]:
+            c.join(600)
+        time.sleep(0.5)
+        if engine.firing():
+            return {"scenario": "slo_burn_page", "survived": False,
+                    "failed": f"fired while healthy: {engine.firing()}"}
+
+        # -- fault phase: every decode step +1.2s >> the 0.5s TPOT SLO -----
+        plan.__enter__()
+        try:
+            clients = [_SSEClient(gateway, p, sp) for p in prompts(6)]
+            detect = _alerts_wait(
+                lambda: firing("slo-goodput-burn", "fast") is not None,
+                60.0)
+            page = firing("slo-goodput-burn", "fast")
+            for c in clients:
+                c.join(600)
+        finally:
+            plan.__exit__(None, None, None)
+        if detect is None:
+            return {"scenario": "slo_burn_page", "survived": False,
+                    "failed": "fast-burn page never fired under the "
+                              "decode-delay fault",
+                    "state": engine.state()}
+        page_ok = (page["severity"] == "page" and page["key"] == "fast")
+        exemplar = page.get("exemplar")
+
+        # the operator's view: the gateway endpoint shows the same page
+        gw_doc = json.loads(_http_get(gateway, "/v1/alerts"))
+        gw_ok = any(a["rule"] == "slo-goodput-burn"
+                    and a["state"] == "firing"
+                    for a in gw_doc.get("alerts", []))
+
+        # -- recovery: healthy traffic drains the fast window (the slow
+        # 86.4s ticket window keeps burning much longer, by design) -------
+        t_lift = time.monotonic()
+        resolved = None
+        # first let the fault-era samples age out of the SLO window —
+        # traffic sent while the replicas still shed records failures,
+        # which would keep the burn alive forever
+        time.sleep(spec["engine"]["slo_window_s"] + 1.0)
+        for _ in range(20):
+            for c in [_SSEClient(gateway, p, sp) for p in prompts(2)]:
+                c.join(600)
+            if firing("slo-goodput-burn", "fast") is None:
+                resolved = time.monotonic() - t_lift
+                break
+            time.sleep(0.3)
+        return {
+            "scenario": "slo_burn_page",
+            "survived": bool(page_ok and gw_ok and exemplar
+                             and resolved is not None),
+            "detection_s": round(detect, 2),
+            "resolved_s": (round(resolved, 2)
+                           if resolved is not None else None),
+            "exemplar": exemplar,
+            "page_severity": page["severity"],
+            "gateway_alerts_ok": gw_ok,
+            "burn_at_page": page.get("value"),
+        }
+    finally:
+        engine.stop()
+        hist.stop()
+        gateway.stop()
+        router.close()
+
+
+def _scenario_publisher_absence(args, workdir, spec, max_len):
+    """SIGKILL the rank's telemetry publisher: its publish counter goes
+    flat and the zero-mode absence rule pages — the watchdog that
+    catches a silently dead observability plane."""
+    import signal
+    import subprocess
+
+    from paddle_tpu.distributed.tcp_store import TCPStore
+    from paddle_tpu.telemetry import alerts as alerts_mod
+    from paddle_tpu.telemetry import history as history_mod
+    from paddle_tpu.telemetry.cluster import _get_json, _k
+
+    store = TCPStore(is_master=True)
+    code = (
+        "import sys, time\n"
+        "sys.path.insert(0, %r)\n"
+        "from paddle_tpu.distributed.tcp_store import TCPStore\n"
+        "from paddle_tpu.telemetry.cluster import RankPublisher\n"
+        "store = TCPStore(host='127.0.0.1', port=%d)\n"
+        "RankPublisher(store, 0, 1, interval_s=0.1,\n"
+        "              sync_clock=False).start()\n"
+        "print('up', flush=True)\n"
+        "time.sleep(600)\n" % (REPO_ROOT, store.port))
+    log = open(os.path.join(workdir, "publisher.log"), "w")
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=log, stderr=subprocess.STDOUT)
+
+    # monitor side: the fleet's publish seq enters the local history as a
+    # counter — alive publisher => nonzero rate; dead => flat => absence
+    def fleet_publish_source():
+        meta = _get_json(store, _k(0, "meta")) or {}
+        seq = meta.get("publish_seq")
+        if seq is None:
+            return {}
+        return {"cluster_publish_total": {
+            "type": "counter",
+            "series": [{"labels": {"rank": "0"}, "value": float(seq)}]}}
+
+    # absence window 15s*ts = 3.0s against a 0.1s publish interval: a
+    # 30x margin so a scheduler stall on a loaded box cannot read as a
+    # dead publisher (0.05 flaked exactly that way), while a real kill
+    # still detects in ~3s
+    ts = 0.2
+    hist = history_mod.TimeSeriesStore(interval_s=0.05)
+    hist.add_source("fleet", fleet_publish_source)
+    hist.start()
+    rules = [r for r in alerts_mod.default_rules(time_scale=ts)
+             if r.name == "publisher-absence"]
+    engine = alerts_mod.AlertEngine(hist, rules, interval_s=0.1)
+    engine.start()
+
+    def firing():
+        return [a for a in engine.firing()
+                if a["rule"] == "publisher-absence"]
+
+    try:
+        alive = _alerts_wait(
+            lambda: (_get_json(store, _k(0, "meta")) or {}).get(
+                "publish_seq", 0) >= 3, 60.0)
+        if alive is None:
+            return {"scenario": "publisher_absence", "survived": False,
+                    "failed": "publisher subprocess never published"}
+        time.sleep(1.5)             # presence held under a live publisher
+        if firing():
+            return {"scenario": "publisher_absence", "survived": False,
+                    "failed": "absence fired while the publisher was alive"}
+
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+        detect = _alerts_wait(lambda: bool(firing()), 30.0)
+        if detect is None:
+            return {"scenario": "publisher_absence", "survived": False,
+                    "failed": "absence alert never fired after SIGKILL",
+                    "state": engine.state()}
+        alert = firing()[0]
+        return {
+            "scenario": "publisher_absence",
+            "survived": alert["severity"] == "page",
+            "detection_s": round(detect, 2),
+            "severity": alert["severity"],
+        }
+    finally:
+        engine.stop()
+        hist.stop()
+        if proc.poll() is None:
+            proc.kill()
+        log.close()
+        store.close()
+
+
+def _scenario_overhead_gate(args, workdir, spec, max_len):
+    """The ops plane's own bill: A/B the history sampler and profiler
+    against a bare decode pass (``serving_bench --obs-overhead``) and
+    hold both overheads to the 3% acceptance bar via perf_gate. One
+    retry absorbs shared-host bench noise."""
+    import subprocess
+
+    artifact = os.path.join(workdir, "obs_overhead.json")
+    bench = [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                          "serving_bench.py"),
+             "--obs-overhead", "--requests", "6", "--max-new", "48",
+             "--json", artifact]
+    gate = [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                         "perf_gate.py"), artifact,
+            "--tolerance", "profiler_overhead_frac=0.03",
+            "--tolerance", "history_sampler_overhead_frac=0.03"]
+    attempts = []
+    for attempt in range(2):
+        b = subprocess.run(bench, capture_output=True, text=True,
+                           timeout=900, cwd=REPO_ROOT)
+        if b.returncode != 0:
+            attempts.append({"bench_rc": b.returncode,
+                             "tail": b.stderr[-500:]})
+            continue
+        with open(artifact) as f:
+            obs = json.load(f)["observability"]
+        g = subprocess.run(gate, capture_output=True, text=True,
+                           timeout=120, cwd=REPO_ROOT)
+        attempts.append({
+            "bench_rc": 0, "gate_rc": g.returncode,
+            "profiler_overhead_frac":
+                round(obs["profiler_overhead_frac"], 4),
+            "history_sampler_overhead_frac":
+                round(obs["history_sampler_overhead_frac"], 4),
+        })
+        if g.returncode == 0:
+            break
+    last = attempts[-1] if attempts else {}
+    return {
+        "scenario": "overhead_gate",
+        "survived": last.get("gate_rc") == 0,
+        "attempts": len(attempts),
+        **{k: v for k, v in last.items() if k != "bench_rc"},
+    }
+
+
+def run_alerts_suite(args, workdir=None, scenario=None):
+    import tempfile
+
+    workdir = workdir or tempfile.mkdtemp(prefix="chaos-alerts-")
+    max_len = args.prompt_len + args.max_new
+    spec = _fleet_spec(args, workdir, max_len)
+    rows = []
+    fns = _filter_scenarios(
+        (_scenario_slo_burn_page, _scenario_publisher_absence,
+         _scenario_overhead_gate), "_scenario_", scenario)
+    for fn in fns:
+        try:
+            rows.append(fn(args, workdir, spec, max_len))
+        except Exception as e:  # lint: allow-silent(the crash is the row: survived=False fails the battery)
+            rows.append({"scenario": fn.__name__[len("_scenario_"):],
+                         "survived": False,
+                         "crashed": f"{type(e).__name__}: {e}"})
+    survived = sum(1 for r in rows if r["survived"])
+    dump_path = telemetry.dump(reason="alerts chaos suite complete")
+    return {
+        "suite": "alerts",
+        "workdir": workdir,
+        "plans_run": len(rows),
+        "plans_survived": survived,
+        "all_survived": survived == len(rows),
+        "flight_recorder_dump": dump_path,
+        "results": rows,
+    }
+
+
 SUITE_SCENARIOS = {
     "serving": lambda: [n for n, _ in DEFAULT_PLANS],
     "prefix": lambda: [n for n, _ in PREFIX_PLANS],
@@ -2912,6 +3262,8 @@ SUITE_SCENARIOS = {
     "locksan": lambda: ["fleet_under_load", "telemetry_threads",
                         "inversion_canary"],
     "soak": lambda: ["degrade", "rolling"],
+    "alerts": lambda: ["slo_burn_page", "publisher_absence",
+                       "overhead_gate"],
 }
 
 
@@ -2939,7 +3291,8 @@ def run_sweep(argv=None):
     ap.add_argument("--suite",
                     choices=["serving", "prefix", "spill", "train",
                              "straggler", "perf", "serve-fleet", "durable",
-                             "kvfabric", "tenancy", "locksan", "soak"],
+                             "kvfabric", "tenancy", "locksan", "soak",
+                             "alerts"],
                     default="serving")
     ap.add_argument("--list", action="store_true",
                     help="print every suite's scenario names and exit")
@@ -2987,7 +3340,7 @@ def run_sweep(argv=None):
 
     if args.suite in ("train", "straggler", "prefix", "spill", "perf",
                       "serve-fleet", "durable", "kvfabric", "tenancy",
-                      "locksan", "soak"):
+                      "locksan", "soak", "alerts"):
         report = (run_train_suite(scenario=args.scenario)
                   if args.suite == "train"
                   else run_straggler_suite(scenario=args.scenario)
@@ -3006,6 +3359,8 @@ def run_sweep(argv=None):
                   if args.suite == "tenancy"
                   else run_soak_suite(args, scenario=args.scenario)
                   if args.suite == "soak"
+                  else run_alerts_suite(args, scenario=args.scenario)
+                  if args.suite == "alerts"
                   else run_spill_suite(args, scenario=args.scenario)
                   if args.suite == "spill"
                   else run_prefix_suite(args, scenario=args.scenario))
@@ -3070,7 +3425,7 @@ def main(argv=None):
         if report.get("suite") in ("train", "straggler", "perf",
                                    "serve-fleet", "durable", "spill",
                                    "kvfabric", "tenancy", "locksan",
-                                   "soak"):
+                                   "soak", "alerts"):
             detail = " ".join(f"{k}={v}" for k, v in r.items()
                               if k not in ("scenario", "survived"))
             print(f"[{status}] {r['scenario']:<26} {detail}",

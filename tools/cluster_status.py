@@ -112,6 +112,77 @@ def render_kv(snap: dict) -> str:
     return "\n".join(lines)
 
 
+def render_postmortem_history(bundle: str) -> str:
+    """Summarize the metrics-history slices a postmortem bundle carries
+    (``rank<r>-history.json``, written when a rank had a
+    ``telemetry.history`` store installed): per rank, coverage and the
+    tail value of a few headline series — "what was happening the last N
+    minutes before it died", inline in the operator's terminal."""
+    import glob
+    import os
+
+    headline = ("slo_goodput_ratio", "alerts_firing",
+                "serving_engine_running", "cluster_publish_total")
+    lines = []
+    paths = sorted(glob.glob(os.path.join(bundle, "rank*-history.json")))
+    if not paths:
+        return "history slices: none (no rank had a history store)"
+    for path in paths:
+        rank = os.path.basename(path)[len("rank"):].split("-")[0]
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            lines.append(f"rank {rank}: unreadable history slice ({e})")
+            continue
+        fams = doc.get("families") or {}
+        n_series = sum(len(b.get("series", ())) for b in fams.values())
+        n_points = sum(len(s.get("points", ()))
+                       for b in fams.values()
+                       for s in b.get("series", ()))
+        lines.append(
+            f"rank {rank}: history slice — {len(fams)} families / "
+            f"{n_series} series / {n_points} points over the last "
+            f"{doc.get('window_s', '?')}s (res={doc.get('res', '?')})")
+        for fam in headline:
+            block = fams.get(fam)
+            if not block:
+                continue
+            for s in block.get("series", ())[:3]:
+                pts = s.get("points") or []
+                if not pts:
+                    continue
+                first_v, last_v = pts[0][2], pts[-1][2]
+                if isinstance(last_v, dict):
+                    last_v = last_v.get("mean", last_v.get("rate"))
+                    first_v = (first_v.get("mean", first_v.get("rate"))
+                               if isinstance(first_v, dict) else first_v)
+                lbl = ",".join(f"{k}={v}" for k, v in
+                               (s.get("labels") or {}).items())
+                lines.append(f"    {fam}{{{lbl}}}: {first_v} -> {last_v} "
+                             f"({len(pts)} pts)")
+    return "\n".join(lines)
+
+
+def render_profile(prof: dict, top_n: int = 15) -> str:
+    """The merged fleet flame view as a terminal table."""
+    stacks = prof.get("stacks") or {}
+    total = prof.get("total_samples") or 0
+    lines = [f"fleet profile: {total} samples across "
+             f"{len(prof.get('ranks') or {})} rank(s), "
+             f"{len(stacks)} distinct stacks"]
+    for rank, meta in sorted((prof.get("ranks") or {}).items()):
+        lines.append(f"  rank {rank}: {meta.get('hz', '?')}Hz, "
+                     f"{meta.get('samples', '?')} ticks, overhead "
+                     f"{100 * (meta.get('overhead_frac') or 0):.2f}%")
+    for stack, n in list(stacks.items())[:top_n]:
+        pct = 100.0 * n / total if total else 0.0
+        leaf = stack.split(";")[-1]
+        root = stack.split(";")[0]
+        lines.append(f"  {n:>7} ({pct:5.1f}%)  {root} ... {leaf}")
+    return "\n".join(lines)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--master", required=True, help="telemetry store "
@@ -132,7 +203,15 @@ def main(argv=None):
     ap.add_argument("--json", default=None,
                     help="write merged snapshot + monitor report here")
     ap.add_argument("--postmortem", default=None, metavar="DIR",
-                    help="collect a postmortem bundle from every rank now")
+                    help="collect a postmortem bundle from every rank now "
+                         "(prints each rank's metrics-history slice when "
+                         "one was published)")
+    ap.add_argument("--profile", action="store_true",
+                    help="print the fleet-wide merged CPU flame view "
+                         "(ranks publish folded pyprof profiles)")
+    ap.add_argument("--folded-out", default=None, metavar="PATH",
+                    help="with --profile: also write the merged folded "
+                         "flamegraph lines here")
     ap.add_argument("--merge-traces", default=None, metavar="OUT.json")
     ap.add_argument("--trace", action="append", default=[],
                     metavar="RANK:PATH", help="per-rank Chrome trace file "
@@ -192,10 +271,20 @@ def main(argv=None):
                        "metrics": agg.merged_snapshot()},
                       f, indent=1, default=str)
         print(f"# fleet json -> {args.json}", file=sys.stderr)
+    if args.profile:
+        prof = agg.merged_profile()
+        print(render_profile(prof))
+        if args.folded_out:
+            with open(args.folded_out, "w") as f:
+                f.write(agg.merged_folded_text() + "\n")
+            print(f"# merged folded profile -> {args.folded_out}",
+                  file=sys.stderr)
     if args.postmortem:
         bundle = agg.collect_postmortem("operator request",
                                         out_dir=args.postmortem)
         print(f"# postmortem bundle -> {bundle}", file=sys.stderr)
+        if bundle:
+            print(render_postmortem_history(bundle))
     if args.merge_traces:
         traces, bases, offs = {}, {}, {}
         view = agg.fleet_view()

@@ -6,6 +6,15 @@ into something eyeballable next to a BENCH_*.json artifact:
 
     python tools/metrics_dump.py METRICS.json [--filter serving_]
     python tools/metrics_dump.py --diff A.json B.json [--filter store_]
+    python tools/metrics_dump.py --watch 2 http://127.0.0.1:8000/metrics
+
+``--watch SEC`` is the live mode over a *running* gateway: the source may
+be a ``/metrics`` URL (the Prometheus text exposition is parsed back into
+snapshot form) or a snapshot-JSON path that keeps being rewritten. The
+first refresh pretty-prints the full snapshot; every later refresh prints
+the ``--diff`` view against the previous one — counter rates, histogram
+interval means, gauge transitions — so it reads like ``top`` for the
+serving plane.
 
 Counters and gauges print one row per labeled series; histograms print
 count / sum / mean plus a p50/p90/p99 estimate interpolated from the
@@ -34,7 +43,10 @@ from __future__ import annotations
 
 import argparse
 import json
+import re
 import sys
+import time
+import urllib.request
 
 
 def _quantile(buckets: dict, count: int, q: float):
@@ -209,6 +221,150 @@ def format_diff(a: dict, b: dict, name_filter: str = "") -> str:
     return "\n".join(lines)
 
 
+_LABELS_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+_LINE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(\S+)')
+
+
+def _parse_value(v: str) -> float:
+    if v == "NaN":
+        return float("nan")
+    if v == "+Inf":
+        return float("inf")
+    if v == "-Inf":
+        return float("-inf")
+    return float(v)
+
+
+def parse_prometheus_text(text: str) -> dict:
+    """Parse the Prometheus text exposition back into the registry
+    snapshot-dict shape (so ``format_snapshot`` / ``format_diff`` work on
+    a live gateway's ``/metrics`` body). Histogram ``_bucket`` /``_sum``/
+    ``_count`` series fold back into one series per base label set;
+    OpenMetrics exemplar suffixes (``# {...}``) are stripped. The
+    returned dict carries a fresh ``__meta__.wall_time`` stamp (the
+    scrape time) so two parses diff into rates."""
+    types: dict[str, str] = {}
+    helps: dict[str, str] = {}
+    # family -> {label key tuple -> series dict}
+    fams: dict[str, dict] = {}
+
+    def series(fam: str, labels: dict) -> dict:
+        key = tuple(sorted(labels.items()))
+        return fams.setdefault(fam, {}).setdefault(
+            key, {"labels": dict(labels)})
+
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3].strip()
+            elif len(parts) >= 4 and parts[1] == "HELP":
+                helps[parts[2]] = parts[3]
+            continue
+        line = line.split(" # ", 1)[0].strip()   # exemplar suffix
+        m = _LINE_RE.match(line)
+        if not m:
+            continue
+        name, rawlabels, rawvalue = m.groups()
+        try:
+            value = _parse_value(rawvalue)
+        except ValueError:
+            continue
+        labels = {k: v.replace('\\"', '"').replace("\\n", "\n")
+                   .replace("\\\\", "\\")
+                  for k, v in _LABELS_RE.findall(rawlabels or "")}
+        base = None
+        for suffix in ("_bucket", "_sum", "_count"):
+            if (name.endswith(suffix)
+                    and types.get(name[:-len(suffix)]) == "histogram"):
+                base = name[:-len(suffix)]
+                break
+        if base is not None:
+            le = labels.pop("le", None)
+            s = series(base, labels)
+            if name.endswith("_bucket"):
+                if le is not None and le != "+Inf":
+                    s.setdefault("buckets", {})[le] = int(value)
+            elif name.endswith("_sum"):
+                s["sum"] = value
+            else:
+                s["count"] = int(value)
+        else:
+            series(name, labels)["value"] = value
+
+    out: dict = {"__meta__": {"wall_time": time.time(),
+                              "source": "prometheus_text"}}
+    for fam, by_key in fams.items():
+        kind = types.get(fam) or (
+            "counter" if fam.endswith("_total") else "gauge")
+        ser = []
+        for _, s in sorted(by_key.items()):
+            if kind == "histogram":
+                cnt = s.get("count", 0)
+                s.setdefault("buckets", {})
+                s.setdefault("sum", 0.0)
+                s["mean"] = (s["sum"] / cnt) if cnt else None
+            ser.append(s)
+        out[fam] = {"type": kind, "help": helps.get(fam, ""),
+                    "labels": sorted({k for s in ser
+                                      for k in s.get("labels", {})}),
+                    "series": ser}
+    return out
+
+
+def fetch_snapshot(source: str, timeout_s: float = 5.0) -> dict:
+    """Load a snapshot from a URL (gateway ``/metrics`` text or any JSON
+    endpoint) or a file path (snapshot JSON, or a saved exposition)."""
+    if source.startswith(("http://", "https://")):
+        with urllib.request.urlopen(source, timeout=timeout_s) as r:
+            body = r.read().decode("utf-8", "replace")
+    else:
+        with open(source) as f:
+            body = f.read()
+    stripped = body.lstrip()
+    if stripped.startswith("{"):
+        return json.loads(body)
+    return parse_prometheus_text(body)
+
+
+def watch(source: str, interval_s: float, name_filter: str = "",
+          count: int = 0, out=None) -> int:
+    """Live-refresh: full snapshot first, then the --diff view between
+    consecutive refreshes. ``count`` bounds the refreshes (0 = until
+    interrupted). Returns 0, or 1 if the source never became readable."""
+    out = out if out is not None else sys.stdout
+    prev = None
+    n = 0
+    try:
+        while True:
+            try:
+                snap = fetch_snapshot(source)
+            except (OSError, ValueError) as e:
+                print(f"[watch] source unreadable: {e}", file=out)
+                if prev is None and count and n + 1 >= count:
+                    return 1
+                snap = None
+            if snap is not None:
+                stamp = time.strftime("%H:%M:%S")
+                if prev is None:
+                    print(f"--- {stamp} {source}", file=out)
+                    print(format_snapshot(snap, name_filter), file=out)
+                else:
+                    print(f"\n--- {stamp} (+{interval_s:g}s)", file=out)
+                    print(format_diff(prev, snap, name_filter), file=out)
+                prev = snap
+            n += 1
+            if count and n >= count:
+                return 0
+            time.sleep(interval_s)
+    except KeyboardInterrupt:
+        return 0
+
+
 def _load(path: str):
     with open(path) as f:
         return json.load(f)
@@ -223,7 +379,20 @@ def main(argv=None):
                          "to snapshot B instead of pretty-printing one")
     ap.add_argument("--filter", default="",
                     help="only metric names containing this substring")
+    ap.add_argument("--watch", type=float, metavar="SEC", default=None,
+                    help="live mode: refresh the snapshot every SEC from "
+                         "the source (a /metrics URL or a snapshot path) "
+                         "and print the rate diff between refreshes")
+    ap.add_argument("--count", type=int, default=0,
+                    help="with --watch: stop after N refreshes (0 = "
+                         "until ^C)")
     args = ap.parse_args(argv)
+    if args.watch is not None:
+        if args.snapshot is None or args.diff is not None:
+            print("--watch takes a source (URL or path), not --diff",
+                  file=sys.stderr)
+            return 2
+        return watch(args.snapshot, args.watch, args.filter, args.count)
     if (args.snapshot is None) == (args.diff is None):
         print("give exactly one of: a snapshot path, or --diff A B",
               file=sys.stderr)

@@ -107,6 +107,14 @@ DIRECTIONS = {
     "p99_under_burst": "lower",
     "goodput_under_overload": "higher",
     "time_to_healthy_under_burst_s": "lower",
+    # ops plane (ISSUE 19, serving_bench --obs-overhead): cost of the
+    # always-on observability loops, each expressed as baseline tok/s
+    # over instrumented tok/s (1.0 = free, like journal_overhead_frac).
+    # The acceptance bar is "within 3%": gate these with tolerance 0.03
+    # so a profiler or history sampler that starts taxing the decode hot
+    # path fails by name
+    "profiler_overhead_frac": "lower",
+    "history_sampler_overhead_frac": "lower",
 }
 
 
@@ -134,6 +142,13 @@ def extract_metrics(doc: dict) -> tuple[str, dict]:
         # one baseline slot per spec: serving_workload_burst and
         # serving_workload_overload gate different distributions
         return f"serving_workload_{w.get('spec') or 'custom'}", metrics
+    if doc.get("mode") == "obs_overhead" or \
+            isinstance(doc.get("observability"), dict):
+        o = doc.get("observability") or {}
+        put("profiler_overhead_frac", o.get("profiler_overhead_frac"))
+        put("history_sampler_overhead_frac",
+            o.get("history_sampler_overhead_frac"))
+        return "serving_observability", metrics
     if doc.get("mode") == "multitenant" or \
             isinstance(doc.get("multitenant"), dict):
         m = doc.get("multitenant") or {}
