@@ -50,6 +50,7 @@ import time
 from .. import telemetry
 from ..analysis import locksan
 from ..utils import faults
+from .router import ActuationBusy
 
 __all__ = ["Autoscaler"]
 
@@ -109,7 +110,8 @@ class Autoscaler:
                  scale_up_wait_s: float = 5.0,
                  scale_down_util: float = 0.25,
                  down_hold_s: float = 10.0, cooldown_s: float = 5.0,
-                 interval_s: float = 0.5, clock=time.monotonic):
+                 interval_s: float = 0.5, lease_wait_s: float = 1.0,
+                 clock=time.monotonic):
         self.router = router
         self.supervisor = supervisor
         self.min_replicas = int(min_replicas)
@@ -120,6 +122,9 @@ class Autoscaler:
         self.down_hold_s = float(down_hold_s)
         self.cooldown_s = float(cooldown_s)
         self.interval_s = float(interval_s)
+        # bounded actuation-lease wait: a rollout/remediation holding the
+        # lease beats a scale decision, which simply re-derives next tick
+        self.lease_wait_s = float(lease_wait_s)
         self._clock = clock
         self._lock = locksan.Lock("autoscaler.state")
         self._pending: dict[str, float] = {}   # rid -> scale-up decision t
@@ -256,7 +261,19 @@ class Autoscaler:
                         "autoscaler.budget_exhausted", replica=rid)
                     return {**out, "action": "budget_exhausted"}
             try:
-                self.router.restart(rid)
+                # through the router's actuation lease (bounded wait:
+                # losing the lease to a rollout/remediation mid-flight is
+                # a normal race — yield and re-decide next tick, never
+                # queue a stale scale decision behind a long drain)
+                with self.router.actuation("autoscaler", "scale_up", rid,
+                                           wait_s=self.lease_wait_s):
+                    self.router.restart(rid, owner="autoscaler")
+            except ActuationBusy as e:
+                self._count("lease_busy")
+                telemetry.record_event("autoscaler.lease_busy",
+                                       action="up", replica=rid,
+                                       holder=str(e.holder))
+                return {**out, "action": "lease_busy"}
             except (RuntimeError, KeyError) as e:
                 # raced an operator / the router (state changed under
                 # us): no harm, re-read the signal next tick
@@ -297,7 +314,17 @@ class Autoscaler:
             telemetry.record_event("autoscaler.scale_fault",
                                    action="down", error=str(e))
             return {**out, "action": "fault"}
-        report = self.router.drain(rid, stop_replica=True)
+        try:
+            with self.router.actuation("autoscaler", "scale_down", rid,
+                                       wait_s=self.lease_wait_s):
+                report = self.router.drain(rid, stop_replica=True,
+                                           owner="autoscaler")
+        except ActuationBusy as e:
+            self._count("lease_busy")
+            telemetry.record_event("autoscaler.lease_busy",
+                                   action="down", replica=rid,
+                                   holder=str(e.holder))
+            return {**out, "action": "lease_busy"}
         self._last_action = now
         self._idle_since = None
         self._count("down")

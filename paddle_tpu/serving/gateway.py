@@ -359,8 +359,19 @@ class Gateway:
                  cancel_on_disconnect: bool | None = None,
                  recover: bool = True,
                  tenancy=None, autoscaler=None,
-                 history=None, alerts=None, profiler=None):
+                 history=None, alerts=None, profiler=None,
+                 remediation=None, rollout_factory=None):
         self.router = router
+        # self-healing control plane (serving.remediation / .rollout):
+        # when attached, /stats grows remediation + rollout blocks and
+        # the /v1/admin/* endpoints (fleet_ctl's surface) come alive.
+        # rollout_factory(spec, env, **kw) -> RollingUpgrade lets the
+        # harness inject ledger/alert wiring without the gateway knowing
+        # the supervisor topology.
+        self.remediation = remediation
+        self.rollout_factory = rollout_factory
+        self._rollout = None                  # the active RollingUpgrade
+        self._rollout_thread = None
         # the ops plane (telemetry.history / .alerts / .pyprof): when
         # attached, the gateway serves /v1/history, /v1/alerts,
         # /v1/profile, and the /v1/dashboard HTML over them. All three
@@ -889,6 +900,10 @@ class Gateway:
                 doc["tenancy"] = self.tenancy.snapshot()
                 if self.autoscaler is not None:
                     doc["autoscaler"] = self.autoscaler.stats()
+                if self.remediation is not None:
+                    doc["remediation"] = self.remediation.stats()
+                if self._rollout is not None:
+                    doc["rollout"] = self._rollout.doc()
                 await self._write_response(writer, 200, doc)
                 return True
             if req.path == "/v1/models":
@@ -914,6 +929,8 @@ class Gateway:
                 return await self._route_profile(req, writer)
             if req.path == "/v1/dashboard":
                 return await self._route_dashboard(writer)
+            if req.path.startswith("/v1/admin/"):
+                return await self._route_admin(req, writer)
             raise _HTTPError(404, f"no route {req.path}")
         except _HTTPError as e:
             await self._write_response(
@@ -985,6 +1002,78 @@ class Gateway:
                              if self.profiler is not None else None),
             },
         }
+
+    async def _route_admin(self, req, writer) -> bool:
+        """The fleet control plane (``tools/fleet_ctl.py``):
+
+        - ``GET  /v1/admin/rollout``  — active rollout state (404: none)
+        - ``POST /v1/admin/rollout``  — start a rolling upgrade
+          (body: ``{"spec": {...}, "env": {...}, "canary_bake_s": N,
+          "dry_run": bool}``); 409 while one is already in flight
+        - ``POST /v1/admin/rollback`` — roll the active rollout back
+        - ``POST /v1/admin/remediate``— poke the remediation engine:
+          optional ``{"alert": {...}}`` runs one synthetic alert through
+          the playbooks; ``{"dry_run": bool}`` flips dry-run mode;
+          always sweeps bake deadlines and returns the engine stats
+        """
+        if req.path == "/v1/admin/rollout" and req.method == "GET":
+            if self._rollout is None:
+                raise _HTTPError(404, "no rollout (active or finished)")
+            await self._write_response(writer, 200, self._rollout.doc())
+            return True
+        if req.method != "POST":
+            raise _HTTPError(405, "POST only")
+        try:
+            body = json.loads(req.body.decode() or "{}")
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            raise _HTTPError(400, f"body is not JSON: {e}")
+        if not isinstance(body, dict):
+            raise _HTTPError(400, "body must be a JSON object")
+        if req.path == "/v1/admin/rollout":
+            if self.rollout_factory is None:
+                raise _HTTPError(501, "no rollout_factory wired")
+            if self._rollout is not None and \
+                    self._rollout.state not in ("done", "rolled_back",
+                                                "failed", "idle"):
+                raise _HTTPError(
+                    409, f"rollout {self._rollout.rollout_id} is "
+                         f"{self._rollout.state}")
+            spec = body.get("spec")
+            if not isinstance(spec, dict):
+                raise _HTTPError(400, "body needs a 'spec' object")
+            kw = {k: body[k] for k in
+                  ("canary_bake_s", "dry_run", "drain_budget_s",
+                   "regression_ratio", "min_goodput") if k in body}
+            ru = self.rollout_factory(spec, dict(body.get("env") or {}),
+                                      **kw)
+            self._rollout = ru
+            ru.start()
+            # rollouts run minutes; drive them off-thread and let
+            # /v1/admin/rollout (or /stats) report progress
+            self._rollout_thread = threading.Thread(
+                target=ru.run, name="gateway-rollout", daemon=True)
+            self._rollout_thread.start()
+            await self._write_response(writer, 202, ru.doc())
+            return True
+        if req.path == "/v1/admin/rollback":
+            if self._rollout is None:
+                raise _HTTPError(404, "no rollout to roll back")
+            doc = self._rollout.rollback(
+                reason=str(body.get("reason") or "operator"))
+            await self._write_response(writer, 200, doc)
+            return True
+        if req.path == "/v1/admin/remediate":
+            if self.remediation is None:
+                raise _HTTPError(501, "no remediation engine wired")
+            if "dry_run" in body:
+                self.remediation.dry_run = bool(body["dry_run"])
+            if isinstance(body.get("alert"), dict):
+                self.remediation.consider(body["alert"])
+            self.remediation.check_bakes()
+            await self._write_response(
+                writer, 200, self.remediation.stats())
+            return True
+        raise _HTTPError(404, f"no admin route {req.path}")
 
     async def _route_healthz(self, writer) -> bool:
         st = self.router.stats()

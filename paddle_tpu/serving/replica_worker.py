@@ -165,7 +165,14 @@ def main() -> int:
 
     threading.Thread(target=read_stdin, daemon=True,
                      name="replica-stdin-reader").start()
-    emit({"ev": "hello", "pid": os.getpid()})
+    # pipe-protocol handshake: the hello carries this worker's protocol
+    # version so a rolling upgrade can mix versions behind one router
+    # (PADDLE_PROTO_VERSION overrides it — how chaos exercises the
+    # router's refusal path without shipping a genuinely old binary)
+    from .router import PROTO_VERSION
+
+    proto = int(os.environ.get("PADDLE_PROTO_VERSION", PROTO_VERSION))
+    emit({"ev": "hello", "pid": os.getpid(), "proto_version": proto})
 
     tracked: dict[int, object] = {}        # gid -> engine Request
 

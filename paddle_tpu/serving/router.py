@@ -77,6 +77,7 @@ delay storms, shed, and drain/restart — zero lost requests, token parity.
 """
 from __future__ import annotations
 
+import contextlib
 import enum
 import hashlib
 import itertools
@@ -100,8 +101,18 @@ from ..analysis import locksan
 __all__ = [
     "FleetRouter", "RouterRequest", "ReplicaState", "LocalReplica",
     "ProcReplica", "RouterShed", "NoHealthyReplica", "ReplayMismatch",
-    "CircuitBreaker", "sampling_to_dict", "sampling_from_dict",
+    "ActuationBusy", "CircuitBreaker", "sampling_to_dict",
+    "sampling_from_dict", "PROTO_VERSION", "PROTO_COMPAT",
 ]
+
+# Pipe-protocol version: carried by the replica ``hello`` so a rolling
+# upgrade can run a mixed-version fleet — the router accepts any version
+# in PROTO_COMPAT and refuses (stops, never restarts into a loop) anything
+# else. 0 is the implicit version of pre-handshake workers; bump
+# PROTO_VERSION on a wire-format change and keep the old version in
+# PROTO_COMPAT for exactly one release so in-place upgrades stay possible.
+PROTO_VERSION = 1
+PROTO_COMPAT = frozenset({0, PROTO_VERSION})
 
 
 class RouterShed(RuntimeError):
@@ -119,6 +130,16 @@ class RouterShed(RuntimeError):
         super().__init__(message)
         self.retry_after_s = float(retry_after_s)
         self.tenant = tenant
+
+
+class ActuationBusy(RuntimeError):
+    """The fleet actuation lease is held by another controller and the
+    caller declined to wait. Carries the current holder's attribution so
+    the refused controller can log *who* it lost to."""
+
+    def __init__(self, message: str, holder: dict | None = None):
+        super().__init__(message)
+        self.holder = dict(holder) if holder else None
 
 
 class NoHealthyReplica(RuntimeError):
@@ -385,6 +406,10 @@ class LocalReplica:
         self.stats: dict = {}
         self.last_heartbeat = 0.0
         self.pid = os.getpid()
+        self.proto_version: int | None = None
+        # what this replica's hello claims — tests/chaos override it to
+        # exercise the router's version refusal without a real old binary
+        self.hello_proto = PROTO_VERSION
         self._gen = 0                     # incarnation counter
         self._on_event = None
         self._inbox: queue.Queue | None = None
@@ -464,7 +489,8 @@ class LocalReplica:
                 telemetry.record_event("kv.fabric.publish", rid=self.rid,
                                        ok=False, disabled=True,
                                        error=f"{type(e).__name__}: {e}")
-        self._emit(gen, {"ev": "hello", "pid": self.pid})
+        self._emit(gen, {"ev": "hello", "pid": self.pid,
+                         "proto_version": self.hello_proto})
         tracked: dict[int, object] = {}    # gid -> engine Request
         last_pub = 0.0
         closing = False
@@ -604,6 +630,7 @@ class ProcReplica:
         self.stats: dict = {}
         self.last_heartbeat = 0.0
         self.pid: int | None = None
+        self.proto_version: int | None = None
         self.proc: subprocess.Popen | None = None
         self._on_event = None
         self._gen = 0
@@ -775,6 +802,13 @@ def _router_metrics() -> SimpleNamespace:
         fetch_skipped=reg.counter(
             "router_directory_fetch_skipped_total",
             "migrations skipped by the fetch budget (storm cap)"),
+        proto_refusals=reg.counter(
+            "router_proto_refusals_total",
+            "replica hellos refused for an incompatible pipe-protocol "
+            "version"),
+        actuations=reg.counter(
+            "router_actuations_total",
+            "fleet actuation leases granted", ("owner",)),
     )
 
 
@@ -919,7 +953,18 @@ class FleetRouter:
             "breaker_trips", "breaker_probes", "retry_budget_denied",
             "directory_hits", "directory_misses", "directory_placements",
             "directory_stale", "migrations", "migration_failures",
-            "migrated_blocks", "fetch_skipped")}
+            "migrated_blocks", "fetch_skipped", "proto_refused",
+            "actuations")}
+        # single-actuator arbitration: every controller-initiated replica
+        # lifecycle transition (operator drain/restart, autoscaler scale,
+        # remediation playbook, rolling upgrade, supervisor auto-restart)
+        # serializes on ONE lease with owner attribution — two controllers
+        # can never actuate the fleet at once (no dueling restarts)
+        self._act_lock = locksan.RLock("router.actuation")
+        self._act_depth = 0
+        self._act_owner: dict | None = None
+        self._act_log: list[dict] = []      # bounded recent-lease history
+        self._act_seq = itertools.count(1)
         self._by_trace: dict[str, RouterRequest] = {}
         self._probe_thread: threading.Thread | None = None
         self._stop = threading.Event()
@@ -1477,6 +1522,11 @@ class FleetRouter:
                 ingested=ev.get("ingested"), corrupt=ev.get("corrupt"),
                 errors=ev.get("errors"))
         elif kind == "hello":
+            pv = int(ev.get("proto_version") or 0)
+            rep.proto_version = pv
+            if pv not in PROTO_COMPAT:
+                self._refuse_proto(rep, pv)
+                return
             rep.pid = ev.get("pid", rep.pid)
             rep.last_heartbeat = time.monotonic()
         elif kind == "dead":
@@ -1620,6 +1670,27 @@ class FleetRouter:
             rr._finish("failed", reason, error)
 
     # -- health ------------------------------------------------------------
+    def _refuse_proto(self, rep, pv: int):
+        """An incompatible hello: the replica is refused — stopped, its
+        scheduled restarts cancelled — rather than admitted into the fleet
+        speaking a wire format the router cannot parse. Deliberately NOT a
+        death: auto-restart would bring the same binary back in a loop."""
+        with self._lock:
+            self._c["proto_refused"] += 1
+            self._m.proto_refusals.inc()
+            self._restart_at.pop(rep.rid, None)
+            rep.state = ReplicaState.STOPPED
+            self._sync_health_gauge()
+        telemetry.record_event(
+            "router.proto_refused", replica=rep.rid, proto_version=pv,
+            supported=sorted(PROTO_COMPAT))
+        try:
+            rep.stop(graceful=False, timeout=2.0)
+        except RuntimeError:
+            # a LocalReplica's hello arrives on its own driver thread,
+            # which cannot join itself — abrupt kill instead
+            rep.kill()
+
     def _sync_health_gauge(self):
         self._m.healthy.set(sum(
             1 for r in self.replicas.values()
@@ -1730,13 +1801,24 @@ class FleetRouter:
                             rep, f"probe timeout "
                                  f"({now - rep.last_heartbeat:.2f}s since "
                                  f"last heartbeat)")
-                # due restarts
+                # due restarts — through the actuation lease (bounded
+                # wait: a busy lease means another controller is mid-
+                # transition; the restart stays due and retries next tick
+                # rather than stalling health probing behind a drain)
                 due = self._restart_at.get(rid)
                 if due is not None and now >= due and \
                         rep.state in (ReplicaState.UNHEALTHY,
                                       ReplicaState.STOPPED):
-                    del self._restart_at[rid]
-                    self._do_restart(rep)
+                    try:
+                        with self.actuation("supervisor", "auto_restart",
+                                            rid, wait_s=0.05):
+                            if self._restart_at.pop(rid, None) is not None \
+                                    and rep.state in (
+                                        ReplicaState.UNHEALTHY,
+                                        ReplicaState.STOPPED):
+                                self._do_restart(rep)
+                    except ActuationBusy:
+                        pass
 
     def _do_restart(self, rep):
         try:
@@ -1760,12 +1842,78 @@ class FleetRouter:
         self._c["replica_restarts"] += 1
         telemetry.record_event("router.replica_restart", replica=rep.rid)
 
+    # -- single-actuator arbitration ---------------------------------------
+    @contextlib.contextmanager
+    def actuation(self, owner: str, action: str = "",
+                  target: str | None = None, wait_s: float | None = None):
+        """The fleet actuation lease: ONE controller actuates replica
+        lifecycle at a time. Re-entrant per thread (a controller holding
+        the lease may call :meth:`drain`/:meth:`restart`, which re-acquire
+        it); attribution (owner/action/target) is pinned by the outermost
+        acquire and surfaced in :meth:`stats`. ``wait_s=None`` blocks;
+        a bounded wait that expires raises :class:`ActuationBusy` with the
+        current holder so the loser can log who it yielded to."""
+        got = self._act_lock.acquire(
+            timeout=(-1 if wait_s is None else float(wait_s)))
+        if not got:
+            holder = dict(self._act_owner or {})
+            raise ActuationBusy(
+                f"actuation lease held by "
+                f"{holder.get('owner', '?')}:{holder.get('action', '?')}"
+                f" (target {holder.get('target')})", holder)
+        outermost = self._act_depth == 0
+        self._act_depth += 1
+        if outermost:
+            self._act_owner = {
+                "seq": next(self._act_seq), "owner": str(owner),
+                "action": str(action), "target": target,
+                "since": time.monotonic()}
+            self._c["actuations"] += 1
+            self._m.actuations.labels(owner=str(owner)).inc()
+        # lifecycle transitions block by design while leased: a drain
+        # waits out in-flight work, a restart waits on a child process
+        blocker = locksan.allow_blocking(
+            "actuation lease: replica lifecycle transitions (drain waits, "
+            "process restarts) block by design while serialized")
+        blocker.__enter__()
+        try:
+            yield dict(self._act_owner)
+        finally:
+            blocker.__exit__(None, None, None)
+            self._act_depth -= 1
+            if self._act_depth == 0:
+                ent = self._act_owner or {}
+                self._act_owner = None
+                self._act_log.append({
+                    k: ent.get(k) for k in
+                    ("seq", "owner", "action", "target")} | {
+                    "held_s": round(
+                        time.monotonic() - ent.get("since", 0.0), 4)})
+                del self._act_log[:-16]
+            self._act_lock.release()
+
+    def actuation_stats(self) -> dict:
+        """Current lease holder + recent lease history (owner attribution
+        for every controller-initiated lifecycle transition)."""
+        cur = self._act_owner
+        if cur is not None:
+            cur = {k: cur.get(k) for k in
+                   ("seq", "owner", "action", "target")} | {
+                   "held_s": round(
+                       time.monotonic() - cur.get("since", 0.0), 4)}
+        return {"owner": cur, "recent": list(self._act_log)}
+
     # -- drain / restart (operator surface) --------------------------------
     def drain(self, rid: str, budget_s: float = 30.0,
-              stop_replica: bool = True) -> dict:
+              stop_replica: bool = True, owner: str = "operator") -> dict:
         """Stop placement to a replica, wait for its in-flight work up to
         ``budget_s``, fail over whatever is left, and (by default) stop it.
         An in-flight stream is never lost to a drain."""
+        with self.actuation(owner, "drain", rid):
+            return self._drain_leased(rid, budget_s, stop_replica)
+
+    def _drain_leased(self, rid: str, budget_s: float,
+                      stop_replica: bool) -> dict:
         rep = self.replicas[rid]
         with self._lock:
             if rep.state is not ReplicaState.HEALTHY:
@@ -1808,24 +1956,32 @@ class FleetRouter:
                 "completed_in_budget": completed_in_budget,
                 "failed_over": len(leftovers)}
 
-    def restart(self, rid: str) -> None:
+    def restart(self, rid: str, owner: str = "operator") -> None:
         """Bring a STOPPED/UNHEALTHY replica back (clean restarts — e.g.
         after an operator drain — do not consume the supervisor's restart
         budget; failure-driven restarts go through ``auto_restart``)."""
-        rep = self.replicas[rid]
-        if rep.state not in (ReplicaState.STOPPED, ReplicaState.UNHEALTHY):
-            raise RuntimeError(
-                f"replica {rid} is {rep.state.value}; drain/stop it first")
-        if self.supervisor is not None and self.supervisor.ledger is not None:
-            self.supervisor.ledger.record("replica_restart", replica=rid)
-        self._do_restart(rep)
+        with self.actuation(owner, "restart", rid):
+            rep = self.replicas[rid]
+            if rep.state not in (ReplicaState.STOPPED,
+                                 ReplicaState.UNHEALTHY):
+                raise RuntimeError(
+                    f"replica {rid} is {rep.state.value}; "
+                    f"drain/stop it first")
+            if self.supervisor is not None and \
+                    self.supervisor.ledger is not None:
+                self.supervisor.ledger.record("replica_restart", replica=rid)
+            self._do_restart(rep)
 
-    def drain_and_restart(self, rid: str, budget_s: float = 30.0) -> dict:
-        """The rolling-restart primitive: drain, stop, start again."""
-        report = self.drain(rid, budget_s=budget_s, stop_replica=True)
-        if report.get("drained"):
-            self.restart(rid)
-        return report
+    def drain_and_restart(self, rid: str, budget_s: float = 30.0,
+                          owner: str = "operator") -> dict:
+        """The rolling-restart primitive: drain, stop, start again —
+        under ONE actuation lease, so no other controller can slip a
+        transition between the stop and the start."""
+        with self.actuation(owner, "drain_and_restart", rid):
+            report = self._drain_leased(rid, budget_s, stop_replica=True)
+            if report.get("drained"):
+                self.restart(rid, owner=owner)
+            return report
 
     # -- request tracing ---------------------------------------------------
     def find_request(self, key) -> RouterRequest | None:
@@ -1935,6 +2091,7 @@ class FleetRouter:
                     "kind": rep.kind,
                     "state": rep.state.value,
                     "pid": rep.pid,
+                    "proto_version": getattr(rep, "proto_version", None),
                     "inflight": self._load(rid),
                     "heartbeat_age_s": (now - rep.last_heartbeat
                                         if rep.last_heartbeat else None),
@@ -1956,5 +2113,7 @@ class FleetRouter:
                                if r.state is ReplicaState.HEALTHY),
                 "inflight": len(live),
                 "requests_total": len(self._requests),
+                "proto_version": PROTO_VERSION,
+                "actuation": self.actuation_stats(),
                 **self._c,
             }

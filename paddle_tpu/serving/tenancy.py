@@ -198,6 +198,21 @@ class TenantRegistry:
         return self._tenants.get(name or ANONYMOUS,
                                  self._tenants[ANONYMOUS])
 
+    def drain_bucket(self, name: str) -> bool:
+        """Empty a tenant's token bucket NOW (the remediation
+        ``shed_tenant`` pressure valve): its next admissions shed with a
+        refill-derived Retry-After until the bucket recovers on its own
+        rate. Bounded and self-healing — a throttle, not a ban. Returns
+        False when the tenant has no bucket (unlimited tenants cannot be
+        shed this way)."""
+        with self._lock:
+            bucket = self._buckets.get(name)
+            if bucket is None:
+                return False
+            bucket._refill()
+            bucket._level = 0.0
+            return True
+
     def weight(self, name: str) -> float:
         return self.get(name).weight
 

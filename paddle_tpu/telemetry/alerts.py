@@ -78,7 +78,8 @@ def _m():
                 "alerts_transitions_total", "alert state transitions",
                 labels=("to",))
             notify_errors = reg.counter(
-                "alerts_notify_errors_total", "notifier callbacks that raised")
+                "alerts_notifier_errors_total",
+                "notifier callbacks that raised")
         _M[0] = NS
     return _M[0]
 
@@ -428,8 +429,11 @@ class AlertEngine:
         if self.notifier is not None:
             try:
                 self.notifier({"event": event, "alert": alert.doc()})
-            except Exception:  # lint: allow-silent(a broken pager integration must not stop evaluation; counted)
+            except Exception as exc:  # lint: allow-silent(a broken pager integration must not stop evaluation; counted)
                 _m().notify_errors.inc()
+                flight_recorder.record_event(
+                    "alert.notifier_error", rule=alert.rule, key=alert.key,
+                    event=event, error=f"{type(exc).__name__}: {exc}")
 
     def _exemplar(self, rule: Rule):
         if rule.exemplar_fn is None:

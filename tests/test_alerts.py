@@ -255,10 +255,10 @@ class TestEngineLifecycle:
 
         rule = ThresholdRule("r", "depth", ">", 2.0)
         st, clk, eng = self.make_engine(rule, notifier=boom)
-        errs0 = registry().get("alerts_notify_errors_total").value
+        errs0 = registry().get("alerts_notifier_errors_total").value
         feed(st, clk.t, fam="depth", value=9.0)
         eng.evaluate_once()                          # must not raise
-        assert registry().get("alerts_notify_errors_total").value > errs0
+        assert registry().get("alerts_notifier_errors_total").value > errs0
 
     def test_duplicate_rule_name_rejected(self):
         st, clk, eng = self.make_engine(ThresholdRule("r", "x", ">", 1.0))

@@ -436,7 +436,7 @@ class TestChaosScenarioSelection:
         assert set(chaos_run.SUITE_SCENARIOS) == {
             "serving", "prefix", "spill", "perf", "serve-fleet",
             "durable", "train", "straggler", "kvfabric", "locksan",
-            "tenancy", "soak", "alerts"}
+            "tenancy", "soak", "alerts", "heal"}
 
     def test_function_scenario_filtering(self):
         from tools import chaos_run

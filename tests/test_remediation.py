@@ -1,0 +1,750 @@
+"""Self-healing control plane: remediation interlocks as properties,
+actuation-lease arbitration, pipe-protocol handshake, and resumable
+rolling upgrades (paddle_tpu.serving.remediation / .rollout / .router).
+
+The interlock tests are *properties*: a randomized alert storm drives the
+engine against a fake fleet and the blast-radius / cooldown / global-rate
+/ flap-quarantine invariants are re-checked after EVERY event, not just
+at the end.
+"""
+import random
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from paddle_tpu.resilience.supervisor import JobLedger, RestartBudget
+from paddle_tpu.serving.remediation import (ACTIONS, Playbook,
+                                            RemediationEngine,
+                                            default_playbooks)
+from paddle_tpu.serving.rollout import RollingUpgrade
+from paddle_tpu.serving.router import (PROTO_COMPAT, PROTO_VERSION,
+                                       ActuationBusy, FleetRouter,
+                                       ReplicaState)
+from paddle_tpu.serving.tenancy import Tenant, TenantRegistry
+from paddle_tpu.telemetry import flight_recorder
+
+pytestmark = [pytest.mark.fleet, pytest.mark.heal]
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt):
+        self.t += dt
+
+
+class FakeReplica:
+    """Duck-typed ProcReplica: lifecycle state only, no child process.
+    start() jumps straight to HEALTHY so drain/restart cycles complete
+    synchronously under the router's real actuation lease."""
+
+    kind = "proc"
+
+    def __init__(self, rid, spec=None, stats_on_start=None):
+        self.rid = rid
+        self.spec = dict(spec or {"model": "v1"})
+        self.extra_env = {}
+        self.state = ReplicaState.STOPPED
+        self.stats = {}
+        self.last_heartbeat = 0.0
+        self.pid = None
+        self.proto_version = None
+        self.stats_on_start = stats_on_start
+        self.starts = 0
+        self.stops = 0
+        self._on_event = None
+
+    def start(self, on_event):
+        self._on_event = on_event
+        self.starts += 1
+        self.state = ReplicaState.HEALTHY
+        self.last_heartbeat = time.monotonic()
+        if self.stats_on_start is not None:
+            self.stats = dict(self.stats_on_start)
+
+    def stop(self, graceful=True, timeout=10.0):
+        self.stops += 1
+
+    def kill(self):
+        self.state = ReplicaState.STOPPED
+
+    def send(self, obj):
+        pass
+
+
+def make_router(n=6, **kw):
+    reps = [FakeReplica(f"r{i}") for i in range(n)]
+    router = FleetRouter(reps, **kw)
+    for rep in reps:
+        rep.state = ReplicaState.HEALTHY
+        rep.last_heartbeat = time.monotonic()
+    return router
+
+
+def firing(rule, key, severity="page"):
+    return {"event": "firing",
+            "alert": {"rule": rule, "key": key, "severity": severity,
+                      "state": "firing"}}
+
+
+def resolved(rule, key, severity="page"):
+    return {"event": "resolved",
+            "alert": {"rule": rule, "key": key, "severity": severity,
+                      "state": "resolved"}}
+
+
+# ---------------------------------------------------------------------------
+# Playbook grammar
+# ---------------------------------------------------------------------------
+
+class TestPlaybook:
+    def test_unknown_action_rejected(self):
+        with pytest.raises(ValueError, match="unknown action"):
+            Playbook("x-*", "reboot_the_universe")
+
+    def test_unknown_selector_rejected(self):
+        with pytest.raises(ValueError, match="target selector"):
+            Playbook("x-*", "restart_replica", target="vibes")
+
+    def test_fixed_selector_allowed(self):
+        pb = Playbook("x-*", "restart_replica", target="fixed:r3")
+        assert pb.target == "fixed:r3"
+
+    def test_parse_doc_roundtrip(self):
+        doc = {"match": "slo-*", "action": "drain_replica",
+               "target": "worst_slo", "severity": "page",
+               "cooldown_s": 5.0, "bake_s": 9.0}
+        assert Playbook.parse(doc).doc() == doc
+
+    def test_matches_severity_and_glob(self):
+        pb = Playbook("slo-*burn*", "restart_replica", severity="page")
+        assert pb.matches({"rule": "slo-ttft-burn", "severity": "page"})
+        assert not pb.matches({"rule": "slo-ttft-burn",
+                               "severity": "ticket"})
+        assert not pb.matches({"rule": "queue-depth", "severity": "page"})
+
+    def test_default_pack_is_valid(self):
+        for pb in default_playbooks():
+            assert pb.action in ACTIONS
+
+
+# ---------------------------------------------------------------------------
+# Interlocks, one at a time
+# ---------------------------------------------------------------------------
+
+def make_engine(router, clk, **kw):
+    kw.setdefault("playbooks", [Playbook("burn-*", "restart_replica",
+                                         target="alert_key")])
+    kw.setdefault("clock", clk)
+    kw.setdefault("lease_wait_s", 1.0)
+    return RemediationEngine(router, **kw)
+
+
+def acted(eng):
+    return [e for e in eng.audit_tail(10 ** 6) if e["kind"] == "acted"]
+
+
+def suppressed(eng, reason=None):
+    out = [e for e in eng.audit_tail(10 ** 6) if e["kind"] == "suppressed"]
+    return [e for e in out if reason is None or e["reason"] == reason]
+
+
+class TestInterlocks:
+    def test_acts_through_the_lease_with_attribution(self):
+        router = make_router()
+        clk = FakeClock()
+        eng = make_engine(router, clk)
+        eng.notify(firing("burn-ttft", "r0"))
+        assert len(acted(eng)) == 1
+        assert router.replicas["r0"].starts == 1
+        recent = router.actuation_stats()["recent"]
+        assert any(e["owner"] == "remediation" and e["target"] == "r0"
+                   for e in recent)
+
+    def test_cooldown_suppresses_immediate_repeat(self):
+        router = make_router()
+        clk = FakeClock()
+        eng = make_engine(router, clk, cooldown_s=10.0)
+        eng.notify(firing("burn-ttft", "r0"))
+        clk.tick(1.0)
+        eng.notify(firing("burn-ttft", "r0"))
+        assert len(acted(eng)) == 1
+        assert len(suppressed(eng, "cooldown")) == 1
+        clk.tick(10.0)
+        eng.notify(firing("burn-ttft", "r0"))
+        assert len(acted(eng)) == 2
+
+    def test_global_rate_limit(self):
+        router = make_router()
+        clk = FakeClock()
+        eng = make_engine(router, clk, cooldown_s=0.0,
+                          global_max_actions=1, global_window_s=60.0,
+                          blast_radius=1.0)
+        eng.notify(firing("burn-ttft", "r0"))
+        eng.notify(firing("burn-ttft", "r1"))
+        assert len(acted(eng)) == 1
+        assert len(suppressed(eng, "global_rate_limit")) == 1
+        clk.tick(61.0)
+        eng.notify(firing("burn-ttft", "r1"))
+        assert len(acted(eng)) == 2
+
+    def test_blast_radius_caps_distinct_replicas(self):
+        router = make_router(n=6)
+        clk = FakeClock()
+        # cap = max(1, int(0.2 * 6)) = 1 distinct replica per window
+        eng = make_engine(router, clk, cooldown_s=0.0,
+                          global_max_actions=100, blast_radius=0.2)
+        eng.notify(firing("burn-ttft", "r0"))
+        eng.notify(firing("burn-ttft", "r1"))
+        assert len(acted(eng)) == 1
+        assert len(suppressed(eng, "blast_radius")) == 1
+        # the already-touched replica is NOT blocked by the radius cap
+        clk.tick(1.0)
+        eng.notify(firing("burn-ttft", "r0"))
+        assert len(acted(eng)) == 2
+
+    def test_flap_quarantine_pages_instead_of_restart_loop(self, tmp_path):
+        router = make_router()
+        clk = FakeClock()
+        ledger = JobLedger(str(tmp_path / "job_state.json"))
+        eng = make_engine(router, clk, cooldown_s=0.0, flap_n=3,
+                          flap_window_s=100.0, ledger=ledger)
+        n0 = len(flight_recorder.flight().events("remediation.quarantined"))
+        for _ in range(4):
+            eng.notify(firing("burn-ttft", "r0"))
+            clk.tick(5.0)
+        assert len(acted(eng)) == 2            # never a third restart
+        assert "r0" in eng.quarantined
+        assert len(suppressed(eng, "flap_quarantine")) == 1
+        assert len(suppressed(eng, "quarantined")) == 1
+        # quarantine is a page + a durable record, not a shrug
+        assert len(flight_recorder.flight().events(
+            "remediation.quarantined")) == n0 + 1
+        assert any(e["event"] == "remediation_quarantine"
+                   for e in ledger.read()["events"])
+        # operator override re-arms the playbook
+        assert eng.unquarantine("r0")
+        eng.notify(firing("burn-ttft", "r0"))
+        assert len(acted(eng)) == 3
+
+    def test_escalate_on_failed_bake_never_retries(self, tmp_path):
+        router = make_router()
+        clk = FakeClock()
+        ledger = JobLedger(str(tmp_path / "job_state.json"))
+        eng = make_engine(router, clk, cooldown_s=0.0, bake_timeout_s=30.0,
+                          ledger=ledger)
+        eng.notify(firing("burn-ttft", "r0"))
+        assert len(acted(eng)) == 1
+        assert eng.stats()["pending_bakes"]
+        clk.tick(31.0)
+        assert eng.check_bakes() == 1
+        assert eng.stats()["escalated"] == [
+            {"rule": "burn-ttft", "key": "r0", "seq": 1}]
+        assert any(e["event"] == "remediation_escalation"
+                   for e in ledger.read()["events"])
+        # the alert re-fires: escalation hold, NOT a retry
+        eng.notify(firing("burn-ttft", "r0"))
+        assert len(acted(eng)) == 1
+        assert len(suppressed(eng, "escalation_hold")) == 1
+        # a resolve clears the hold; the playbook is live again
+        eng.notify(resolved("burn-ttft", "r0"))
+        eng.notify(firing("burn-ttft", "r0"))
+        assert len(acted(eng)) == 2
+
+    def test_bake_closes_ok_when_alert_resolves(self):
+        router = make_router()
+        clk = FakeClock()
+        eng = make_engine(router, clk, bake_timeout_s=30.0)
+        eng.notify(firing("burn-ttft", "r0"))
+        clk.tick(5.0)
+        eng.notify(resolved("burn-ttft", "r0"))
+        st = eng.stats()
+        assert st["bakes_ok"] == 1 and st["escalations"] == 0
+        assert not st["pending_bakes"]
+        clk.tick(60.0)
+        assert eng.check_bakes() == 0
+
+    def test_dry_run_records_but_does_not_touch_the_fleet(self, tmp_path):
+        router = make_router()
+        clk = FakeClock()
+        ledger = JobLedger(str(tmp_path / "job_state.json"))
+        eng = make_engine(router, clk, dry_run=True, ledger=ledger)
+        eng.notify(firing("burn-ttft", "r0"))
+        assert router.replicas["r0"].starts == 0
+        assert not acted(eng)
+        assert eng.stats()["dry_runs"] == 1
+        assert any(e["event"] == "remediation_dry_run"
+                   for e in ledger.read()["events"])
+
+    def test_no_target_suppressed(self):
+        router = make_router()
+        eng = make_engine(router, FakeClock())
+        eng.notify(firing("burn-ttft", "not-a-replica"))
+        assert not acted(eng)
+        assert len(suppressed(eng, "no_target")) == 1
+
+    def test_unmatched_rule_is_a_no_op(self):
+        router = make_router()
+        eng = make_engine(router, FakeClock())
+        eng.notify(firing("queue-depth", "r0"))
+        assert not acted(eng) and not suppressed(eng)
+        assert eng.stats()["events_seen"] == 1
+
+    def test_lease_busy_yields_to_the_holder(self):
+        router = make_router()
+        eng = make_engine(router, FakeClock())
+        eng.lease_wait_s = 0.05
+        hold = threading.Event()
+        release = threading.Event()
+
+        def holder():
+            with router.actuation("operator", "drain", "r5"):
+                hold.set()
+                release.wait(5.0)
+
+        t = threading.Thread(target=holder, name="test-lease-holder",
+                             daemon=True)
+        t.start()
+        assert hold.wait(5.0)
+        try:
+            eng.notify(firing("burn-ttft", "r0"))
+        finally:
+            release.set()
+            t.join(5.0)
+        sup = suppressed(eng, "lease_busy")
+        assert len(sup) == 1 and sup[0]["holder"]["owner"] == "operator"
+
+    def test_notifier_chain_sees_every_event(self):
+        router = make_router()
+        seen = []
+        eng = make_engine(router, FakeClock(), notifier=seen.append)
+        eng.notify(firing("burn-ttft", "r0"))
+        eng.notify(firing("queue-depth", "r0"))     # unmatched still chains
+        assert len(seen) == 2
+
+
+# ---------------------------------------------------------------------------
+# Target selectors + actions
+# ---------------------------------------------------------------------------
+
+class TestActions:
+    def test_worst_slo_selector_picks_highest_tpot(self):
+        router = make_router(n=3)
+        for rid, p95 in (("r0", 0.02), ("r1", 0.40), ("r2", 0.10)):
+            router.replicas[rid].stats = {
+                "slo": {"tpot": {"p95": p95}, "goodput_ratio": 1.0}}
+        eng = RemediationEngine(router, playbooks=[
+            Playbook("burn-*", "restart_replica", target="worst_slo")],
+            clock=FakeClock())
+        eng.notify(firing("burn-fleet", "fleet"))
+        assert [e["target"] for e in acted(eng)] == ["r1"]
+
+    def test_scale_up_revives_a_parked_replica_within_budget(self):
+        router = make_router(n=3)
+        router.replicas["r2"].state = ReplicaState.STOPPED
+        sup = SimpleNamespace(budget=RestartBudget(1), ledger=None)
+        clk = FakeClock()
+        eng = RemediationEngine(router, supervisor=sup, playbooks=[
+            Playbook("cap-*", "scale_up", target="fleet")],
+            cooldown_s=0.0, clock=clk)
+        eng.notify(firing("cap-queue", "fleet"))
+        assert router.replicas["r2"].state is ReplicaState.HEALTHY
+        assert acted(eng)[0]["detail"] == {"scaled": True, "replica": "r2"}
+        # budget exhausted: the action still audits, but does nothing
+        router.replicas["r2"].state = ReplicaState.STOPPED
+        clk.tick(1.0)
+        eng.notify(firing("cap-queue", "fleet"))
+        assert acted(eng)[1]["detail"]["reason"] == \
+            "restart_budget_exhausted"
+
+    def test_shed_tenant_drains_the_token_bucket(self):
+        router = make_router(n=1)
+        reg = TenantRegistry([Tenant(name="acme", rate_tokens_per_s=100.0,
+                                     burst_tokens=100.0)])
+        assert reg.admit("acme", 10.0) is None
+        eng = RemediationEngine(router, tenancy=reg, playbooks=[
+            Playbook("tenant-*", "shed_tenant", target="tenant")],
+            clock=FakeClock())
+        eng.notify(firing("tenant-hog", "acme"))
+        assert acted(eng)[0]["detail"] == {"shed": True, "tenant": "acme"}
+        assert reg.admit("acme", 50.0) is not None   # shedding now
+
+    def test_collect_postmortem_writes_a_dump(self, tmp_path):
+        router = make_router(n=1)
+        flight_recorder.record_event("test.heal", note="postmortem bait")
+        eng = RemediationEngine(
+            router, postmortem_dir=str(tmp_path / "pm"), playbooks=[
+                Playbook("*", "collect_postmortem", target="fleet",
+                         bake_s=0.0)],
+            clock=FakeClock())
+        eng.notify(firing("anything", "x", severity="ticket"))
+        path = acted(eng)[0]["detail"]["postmortem"]
+        assert path and str(tmp_path) in path
+        assert not eng.stats()["pending_bakes"]     # bake_s=0: no bake
+
+
+# ---------------------------------------------------------------------------
+# The property: a randomized alert storm never violates an interlock
+# ---------------------------------------------------------------------------
+
+STORM = dict(cooldown_s=10.0, global_window_s=60.0, global_max_actions=3,
+             blast_radius=0.34, flap_n=3, flap_window_s=120.0,
+             bake_timeout_s=30.0)
+
+
+def check_invariants(eng, n_replicas):
+    """Re-derive every interlock from the audit trail alone."""
+    log = eng.audit_tail(10 ** 6)
+    acts = [e for e in log if e["kind"] == "acted"]
+    w = STORM["global_window_s"]
+    last_by_key = {}
+    for e in acts:
+        key = (e["action"], e["target"])
+        if key in last_by_key:
+            assert e["t"] - last_by_key[key] >= STORM["cooldown_s"], \
+                f"cooldown violated for {key}"
+        last_by_key[key] = e["t"]
+        in_window = [x for x in acts if e["t"] - w < x["t"] <= e["t"]]
+        assert len(in_window) <= STORM["global_max_actions"], \
+            "global rate limit violated"
+        distinct = {x["target"] for x in in_window}
+        cap = max(1, int(STORM["blast_radius"] * n_replicas))
+        assert len(distinct) <= cap, \
+            f"blast radius violated: {distinct}"
+    # a quarantined target is never acted on after its quarantine
+    q_at = {}
+    for e in log:
+        if e["kind"] == "suppressed" and e["reason"] == "flap_quarantine":
+            q_at.setdefault(e["target"], e["t"])
+    for e in acts:
+        t0 = q_at.get(e["target"])
+        assert t0 is None or e["t"] <= t0, \
+            f"acted on quarantined {e['target']}"
+
+
+class TestAlertStormProperty:
+    @pytest.mark.parametrize("seed", [7, 2026, 40990])
+    def test_storm_never_violates_interlocks(self, seed):
+        n = 6
+        router = make_router(n=n)
+        clk = FakeClock()
+        eng = RemediationEngine(
+            router, playbooks=[Playbook("burn-*", "restart_replica",
+                                        target="alert_key")],
+            clock=clk, audit_len=10 ** 5, lease_wait_s=1.0, **STORM)
+        rng = random.Random(seed)
+        events = 0
+        for _ in range(250):
+            clk.tick(rng.choice([0.0, 1.0, 3.0, 7.0, 17.0]))
+            rule = rng.choice(["burn-ttft", "burn-tpot"])
+            key = f"r{rng.randrange(n)}"
+            if rng.random() < 0.25:
+                eng.notify(resolved(rule, key))
+            else:
+                eng.notify(firing(rule, key))
+            events += 1
+            check_invariants(eng, n)
+        st = eng.stats()
+        assert st["events_seen"] == events
+        assert st["actions"] == len(acted(eng))
+        # the storm must leave the fleet serving: every non-quarantined
+        # replica ends HEALTHY (remediation restarts complete)
+        for rid, rep in router.replicas.items():
+            if rid not in eng.quarantined:
+                assert rep.state is ReplicaState.HEALTHY
+
+
+# ---------------------------------------------------------------------------
+# Actuation lease: single-actuator arbitration with attribution
+# ---------------------------------------------------------------------------
+
+class TestActuationLease:
+    def test_owner_attribution_in_stats(self):
+        router = make_router()
+        with router.actuation("rollout", "upgrade", "r0"):
+            cur = router.stats()["actuation"]["owner"]
+            assert cur["owner"] == "rollout" and cur["target"] == "r0"
+        st = router.actuation_stats()
+        assert st["owner"] is None
+        assert st["recent"][-1]["owner"] == "rollout"
+        assert st["recent"][-1]["held_s"] >= 0.0
+
+    def test_reentrant_keeps_outermost_attribution(self):
+        router = make_router()
+        with router.actuation("remediation", "restart_replica", "r0"):
+            router.drain_and_restart("r0", budget_s=0.2,
+                                     owner="remediation")
+            assert router.actuation_stats()["owner"]["action"] == \
+                "restart_replica"
+        # inner drain/restart acquisitions did not log separate leases
+        owners = [e["owner"] for e in router.actuation_stats()["recent"]]
+        assert owners == ["remediation"]
+
+    def test_bounded_wait_raises_busy_with_holder(self):
+        router = make_router()
+        hold = threading.Event()
+        release = threading.Event()
+
+        def holder():
+            with router.actuation("autoscaler", "scale_down", "r3"):
+                hold.set()
+                release.wait(5.0)
+
+        t = threading.Thread(target=holder, name="test-act-holder",
+                             daemon=True)
+        t.start()
+        assert hold.wait(5.0)
+        try:
+            with pytest.raises(ActuationBusy) as ei:
+                with router.actuation("operator", "drain", "r0",
+                                      wait_s=0.05):
+                    pass
+            assert ei.value.holder["owner"] == "autoscaler"
+        finally:
+            release.set()
+            t.join(5.0)
+
+    def test_lifecycle_transitions_log_their_owner(self):
+        router = make_router()
+        router.drain("r1", budget_s=0.2, owner="operator")
+        router.restart("r1", owner="operator")
+        recent = router.actuation_stats()["recent"]
+        assert [(e["owner"], e["action"]) for e in recent[-2:]] == \
+            [("operator", "drain"), ("operator", "restart")]
+
+
+# ---------------------------------------------------------------------------
+# Pipe-protocol handshake
+# ---------------------------------------------------------------------------
+
+class TestProtoHandshake:
+    def test_current_version_is_compatible(self):
+        assert PROTO_VERSION in PROTO_COMPAT
+
+    def test_compatible_hello_admitted(self):
+        router = make_router(n=2)
+        rep = router.replicas["r0"]
+        router._on_event(rep, {"ev": "hello", "pid": 4242,
+                               "proto_version": PROTO_VERSION})
+        assert rep.state is ReplicaState.HEALTHY
+        assert rep.pid == 4242
+        assert router.stats()["replicas"]["r0"]["proto_version"] == \
+            PROTO_VERSION
+
+    def test_legacy_hello_without_version_admitted(self):
+        router = make_router(n=2)
+        rep = router.replicas["r0"]
+        router._on_event(rep, {"ev": "hello", "pid": 1})
+        assert rep.state is ReplicaState.HEALTHY
+        assert rep.proto_version == 0
+
+    def test_incompatible_hello_refused_and_parked(self):
+        router = make_router(n=2)
+        rep = router.replicas["r0"]
+        router._restart_at["r0"] = time.monotonic() + 60.0
+        router._on_event(rep, {"ev": "hello", "pid": 9,
+                               "proto_version": 99})
+        # parked STOPPED (not UNHEALTHY): no auto-restart loop on the
+        # same incompatible binary
+        assert rep.state is ReplicaState.STOPPED
+        assert rep.stops == 1
+        assert "r0" not in router._restart_at
+        assert router._c["proto_refused"] == 1
+        assert router.stats()["replicas"]["r0"]["proto_version"] == 99
+        # the rest of the fleet is untouched
+        assert router.replicas["r1"].state is ReplicaState.HEALTHY
+        assert router.stats()["proto_version"] == PROTO_VERSION
+
+
+# ---------------------------------------------------------------------------
+# Rolling upgrade + resume
+# ---------------------------------------------------------------------------
+
+GOOD_SLO = {"slo": {"tpot": {"p95": 0.05}, "goodput_ratio": 1.0,
+                    "window_requests": 10}}
+SLOW_SLO = {"slo": {"tpot": {"p95": 0.50}, "goodput_ratio": 1.0,
+                    "window_requests": 10}}
+
+
+def rollout_kwargs(**kw):
+    out = dict(canary_bake_s=0.05, bake_poll_s=0.01, drain_budget_s=1.0,
+               healthy_wait_s=2.0)
+    out.update(kw)
+    return out
+
+
+class TestRollingUpgrade:
+    def test_happy_path_upgrades_every_replica(self, tmp_path):
+        router = make_router(n=3)
+        ledger = JobLedger(str(tmp_path / "job_state.json"))
+        ru = RollingUpgrade(router, {"model": "v2"}, env={"ROLL": "1"},
+                            ledger=ledger, rollout_id="ro-happy",
+                            **rollout_kwargs())
+        doc = ru.run()
+        assert doc["state"] == "done"
+        assert doc["upgraded"] == ["r0", "r1", "r2"]
+        assert doc["canary_passed"]
+        for rep in router.replicas.values():
+            assert rep.spec == {"model": "v2"}
+            assert rep.extra_env == {"ROLL": "1"}
+            assert rep.state is ReplicaState.HEALTHY
+            assert rep.starts == 1
+        kinds = [e["event"] for e in ledger.read()["events"]
+                 if e["event"].startswith("rollout_")]
+        assert kinds == ["rollout_started", "rollout_replica_done",
+                        "rollout_canary_ok", "rollout_replica_done",
+                        "rollout_replica_done", "rollout_done"]
+
+    def test_canary_slo_regression_auto_rolls_back(self, tmp_path):
+        router = make_router(n=3)
+        for rep in router.replicas.values():
+            rep.stats = dict(GOOD_SLO)
+            # the NEW spec comes up slow: post-restart stats regress
+            rep.stats_on_start = dict(SLOW_SLO)
+        ledger = JobLedger(str(tmp_path / "job_state.json"))
+        ru = RollingUpgrade(router, {"model": "v2-slow"}, ledger=ledger,
+                            rollout_id="ro-slow",
+                            **rollout_kwargs(canary_bake_s=1.0,
+                                             regression_ratio=2.0,
+                                             min_samples=3))
+        doc = ru.run()
+        assert doc["state"] == "rolled_back"
+        assert "canary r0 regressed" in doc["reason"]
+        assert "tpot p95" in doc["reason"]
+        assert doc["upgraded"] == []
+        for rep in router.replicas.values():
+            assert rep.spec == {"model": "v1"}      # restored
+        kinds = [e["event"] for e in ledger.read()["events"]]
+        assert "rollout_rollback" in kinds
+        assert "rollout_rolled_back" in kinds
+        # only the canary was ever touched
+        assert router.replicas["r1"].starts == 0
+
+    def test_firing_page_alert_fails_the_canary(self, tmp_path):
+        router = make_router(n=2)
+        alerts = SimpleNamespace(active=lambda: [
+            {"rule": "slo-ttft-burn", "state": "firing",
+             "severity": "page"}])
+        ru = RollingUpgrade(router, {"model": "v2"}, alerts=alerts,
+                            ledger=JobLedger(str(tmp_path / "j.json")),
+                            rollout_id="ro-page",
+                            **rollout_kwargs(canary_bake_s=1.0))
+        doc = ru.run()
+        assert doc["state"] == "rolled_back"
+        assert "page alert firing" in doc["reason"]
+
+    def test_dry_run_touches_nothing(self, tmp_path):
+        router = make_router(n=2)
+        ledger = JobLedger(str(tmp_path / "j.json"))
+        ru = RollingUpgrade(router, {"model": "v2"}, ledger=ledger,
+                            rollout_id="ro-dry", dry_run=True,
+                            **rollout_kwargs())
+        doc = ru.run()
+        assert doc["state"] == "done" and doc["reason"] == "dry_run"
+        for rep in router.replicas.values():
+            assert rep.spec == {"model": "v1"} and rep.starts == 0
+
+    def test_sigkill_resume_is_bit_exact_and_completes(self, tmp_path):
+        ledger = JobLedger(str(tmp_path / "job_state.json"))
+        router1 = make_router(n=3)
+        ru1 = RollingUpgrade(router1, {"model": "v2"}, env={"ROLL": "1"},
+                             ledger=ledger, rollout_id="ro-kill",
+                             **rollout_kwargs())
+        ru1.start()
+        assert ru1._upgrade_one("r0")
+        doc_before = ru1.doc()
+        assert doc_before["state"] == "rolling"
+        assert doc_before["upgraded"] == ["r0"]
+        # SIGKILL: the supervisor process dies here. A new supervisor
+        # boots a fresh fleet on the OLD spec and resumes from the ledger.
+        router2 = make_router(n=3)
+        ru2 = RollingUpgrade.resume(router2, ledger,
+                                    **rollout_kwargs())
+        assert ru2 is not None
+        assert ru2.doc() == doc_before          # bit-exact
+        # the ledger's truth is re-applied to the already-upgraded
+        # replica the fresh supervisor booted on the old spec
+        assert router2.replicas["r0"].spec == {"model": "v2"}
+        assert router2.replicas["r0"].extra_env == {"ROLL": "1"}
+        doc = ru2.run()
+        assert doc["state"] == "done"
+        assert doc["upgraded"] == ["r0", "r1", "r2"]
+        for rep in router2.replicas.values():
+            assert rep.spec == {"model": "v2"}
+        # the resumed run did NOT redo r0's upgrade step
+        assert router2.replicas["r0"].starts == 0
+        assert router2.replicas["r1"].starts == 1
+
+    def test_resume_returns_none_when_nothing_in_flight(self, tmp_path):
+        ledger = JobLedger(str(tmp_path / "j.json"))
+        assert RollingUpgrade.resume(make_router(n=2), ledger) is None
+        router = make_router(n=2)
+        RollingUpgrade(router, {"model": "v2"}, ledger=ledger,
+                       rollout_id="ro-done", **rollout_kwargs()).run()
+        assert RollingUpgrade.resume(make_router(n=2), ledger) is None
+
+    def test_resume_after_rolled_back_is_none(self, tmp_path):
+        ledger = JobLedger(str(tmp_path / "j.json"))
+        router = make_router(n=2)
+        ru = RollingUpgrade(router, {"model": "v2"}, ledger=ledger,
+                            rollout_id="ro-rb", **rollout_kwargs())
+        ru.start()
+        assert ru._upgrade_one("r0")
+        ru.rollback(reason="operator test")
+        assert ru.doc()["state"] == "rolled_back"
+        assert RollingUpgrade.resume(make_router(n=2), ledger) is None
+
+    def test_operator_rollback_restores_newest_first(self, tmp_path):
+        router = make_router(n=3)
+        ledger = JobLedger(str(tmp_path / "j.json"))
+        ru = RollingUpgrade(router, {"model": "v2"}, ledger=ledger,
+                            rollout_id="ro-op", **rollout_kwargs())
+        ru.start()
+        assert ru._upgrade_one("r0") and ru._upgrade_one("r1")
+        doc = ru.rollback(reason="operator says no")
+        assert doc["state"] == "rolled_back"
+        assert doc["upgraded"] == []
+        for rid in ("r0", "r1"):
+            assert router.replicas[rid].spec == {"model": "v1"}
+        ev = [e for e in ledger.read()["events"]
+              if e["event"] == "rollout_rollback"][0]
+        assert ev["replicas"] == ["r1", "r0"]   # newest first
+
+
+# ---------------------------------------------------------------------------
+# fleet_ctl CLI
+# ---------------------------------------------------------------------------
+
+class TestFleetCtl:
+    def test_unreachable_gateway_counts_parse_errors(self, capsys):
+        import tools.fleet_ctl as fleet_ctl
+        rc = fleet_ctl.main(["status", "--gateway", "http://127.0.0.1:9",
+                             "--json"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "tool_parse_errors: 1" in out
+
+    def test_ledger_slice_filters_families(self, tmp_path):
+        import tools.fleet_ctl as fleet_ctl
+        ledger = JobLedger(str(tmp_path / "j.json"))
+        ledger.record("rollout_started", rollout_id="x")
+        ledger.record("restart", dead_ranks=[0])
+        ledger.record("remediation_action", action="restart_replica")
+        ledger.record("replica_drain", replica="r0")
+        evs, err = fleet_ctl._read_ledger(str(tmp_path / "j.json"))
+        assert err is None
+        assert [e["event"] for e in evs] == [
+            "rollout_started", "remediation_action", "replica_drain"]
+
+    def test_unparseable_ledger_is_counted_not_mistaken(self, tmp_path):
+        import tools.fleet_ctl as fleet_ctl
+        bad = tmp_path / "j.json"
+        bad.write_text("{not json")
+        evs, err = fleet_ctl._read_ledger(str(bad))
+        assert evs == [] and err is not None and "unparseable" in err

@@ -175,4 +175,4 @@ class TestScenarioCatalog:
         assert set(chaos_run.SUITE_SCENARIOS) == {
             "serving", "prefix", "spill", "perf", "serve-fleet",
             "durable", "kvfabric", "tenancy", "train", "straggler",
-            "locksan", "soak", "alerts"}
+            "locksan", "soak", "alerts", "heal"}

@@ -21,6 +21,7 @@ matters):
   loss re-decided from demand).
 """
 import collections
+import contextlib
 import json
 import random
 
@@ -486,11 +487,15 @@ class _StubRouter:
                 "est_wait_s": (self.est_wait_s if by_state["healthy"]
                                else float("inf"))}
 
-    def restart(self, rid):
+    @contextlib.contextmanager
+    def actuation(self, owner, action="", target=None, wait_s=None):
+        yield {"owner": owner, "action": action, "target": target}
+
+    def restart(self, rid, owner="operator"):
         self.restarts.append(rid)
         self.state[rid] = "starting"
 
-    def drain(self, rid, stop_replica=False):
+    def drain(self, rid, stop_replica=False, owner="operator"):
         self.drains.append(rid)
         self.state[rid] = "stopped"
         return {"drained": True, "failed_over": 0}

@@ -1,7 +1,7 @@
 """Chaos sweep: drive the runtime through batteries of deterministic fault
 plans and report survival / degradation stats per plan.
 
-Ten suites:
+The suites:
 
 ``--suite serving`` (default) — the continuous-batching engine under fault
 plans. For every plan the same request fleet runs on a fresh engine; the
@@ -130,6 +130,20 @@ trip the publisher-absence page (the watchdog for the watchers); (3) the
 history sampler's and profiler's own overhead is measured A/B
 (``serving_bench --obs-overhead``) and held to the 3% bar by perf_gate.
 
+``--suite heal`` — the self-healing control plane (docs/ROBUSTNESS.md
+"Self-healing & rollout"): the *act* half of detect→page→act on a real
+ProcReplica fleet. (1) a wedged replica blows the SLO → the burn page
+fires → the remediation engine drains+restarts it under the actuation
+lease → the fleet recovers, the alert resolves, and the post-condition
+bake closes ok — zero lost requests throughout; (2) a replica that is
+sick *every* incarnation re-triggers after each restart — flap detection
+must quarantine it (page + ledger) instead of a restart storm, with the
+rest of the fleet still serving; (3) a rolling upgrade onto a
+deliberately slow spec under live SSE traffic — the canary regresses
+against the pre-rollout baseline and the rollout auto-rolls back
+mid-traffic with token-for-token parity, driven end-to-end through the
+gateway admin API and verified with ``tools/fleet_ctl.py``.
+
 ``--suite straggler`` — the cluster observability plane
 (docs/OBSERVABILITY.md "Cluster observability"): a 4-rank job over a real
 TCPStore where one rank carries a ``collective:delay`` fault plan.
@@ -144,7 +158,7 @@ recorder + stack snapshot.
 Usage:
     python tools/chaos_run.py
         [--suite serving|prefix|spill|train|straggler|perf|serve-fleet|
-                 durable|kvfabric|locksan|soak|alerts]
+                 durable|kvfabric|tenancy|locksan|soak|alerts|heal]
         [--requests 6] [--prompt-len 24] [--max-new 16]
         [--slots 3] [--block-size 8] [--plan NAME:SPEC ...] [--json OUT.json]
         [--list] [--scenario NAME]
@@ -3245,6 +3259,473 @@ def run_alerts_suite(args, workdir=None, scenario=None):
     }
 
 
+# -- the heal battery ------------------------------------------------------
+# The self-healing control plane end to end (docs/ROBUSTNESS.md
+# "Self-healing & rollout") on a real ProcReplica fleet under live SSE
+# traffic: (1) a wedged replica blows the SLO -> burn page -> the
+# remediation engine drains+restarts it under the actuation lease -> the
+# alert resolves and the post-condition bake closes ok, zero lost; (2) a
+# replica sick EVERY incarnation re-triggers after each restart -> flap
+# detection quarantines it instead of a restart storm; (3) a rolling
+# upgrade onto a deliberately slow spec regresses the canary against the
+# pre-rollout baseline and auto-rolls back mid-traffic with token parity,
+# driven through the gateway admin API and read back by fleet_ctl.
+
+def _http_post(gw, path, body):
+    import http.client
+
+    conn = http.client.HTTPConnection(gw.host, gw.port, timeout=120)
+    conn.request("POST", path, json.dumps(body),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    out = resp.read()
+    conn.close()
+    return resp.status, out
+
+
+def _heal_fleet(workdir, spec, n, *, scenario, plans=None, supervisor=None):
+    """A gateway-less fleet start: heal scenarios wire their own Gateway
+    (alerts / remediation / rollout_factory) around the router."""
+    from paddle_tpu.serving import FleetRouter, ProcReplica
+
+    reps = []
+    for i in range(n):
+        env = {}
+        if plans and i in plans:
+            env["FLAGS_fault_plan"] = plans[i]
+        reps.append(ProcReplica(
+            f"p{i}", spec, env=env,
+            log_path=os.path.join(workdir, f"{scenario}-p{i}.log")))
+    router = FleetRouter(reps, probe_interval_s=0.1, probe_timeout_s=8.0,
+                         affinity_block_size=spec["engine"]["block_size"],
+                         supervisor=supervisor).start(wait_healthy_s=600)
+    unhealthy = [r.rid for r in reps if r.state.value != "healthy"]
+    if unhealthy:
+        router.close()
+        raise RuntimeError(f"fleet never became healthy: {unhealthy}")
+    return router, reps
+
+
+def _heal_goodput_source(router):
+    """ProcReplica SLO windows live in the child processes; re-export each
+    replica's goodput ratio into the parent's history store so the stock
+    burn-rate rule sees the fleet."""
+    def fn():
+        series = []
+        for rid, rep in (router.stats().get("replicas") or {}).items():
+            slo = rep.get("slo") or {}
+            g = slo.get("goodput_ratio")
+            if g is None:
+                if not slo.get("empty"):
+                    continue
+                g = 1.0          # empty window = nothing failing
+            series.append({"labels": {"replica": rid}, "value": float(g)})
+        if not series:
+            return {}
+        return {"slo_goodput_ratio": {"type": "gauge", "series": series}}
+    return fn
+
+
+def _scenario_wedged_replica_heal(args, workdir, spec, max_len):
+    """A wedged replica blows the TPOT SLO: the burn page fires, the
+    remediation engine drains+restarts it under the actuation lease, the
+    alert resolves, and the post-condition bake closes ok — with zero
+    lost requests end to end."""
+    from paddle_tpu.resilience import JobLedger
+    from paddle_tpu.serving import Gateway
+    from paddle_tpu.serving.remediation import Playbook, RemediationEngine
+    from paddle_tpu.telemetry import alerts as alerts_mod
+    from paddle_tpu.telemetry import history as history_mod
+
+    ts = 0.004                      # fast burn = 14.4s long / 1.2s short
+    spec = dict(spec, engine=dict(spec["engine"], slo_tpot_s=0.5,
+                                  slo_window_s=4.0))
+    sp = SamplingParams(max_new_tokens=args.max_new, temperature=0.0)
+    rng = np.random.RandomState(11)
+
+    def prompts(n):
+        return [[int(t) for t in rng.randint(0, args.vocab,
+                                             args.prompt_len)]
+                for _ in range(n)]
+
+    router, reps = _heal_fleet(
+        workdir, spec, 2, scenario="heal-wedge",
+        plans={1: "serving.decode:delay=1.2x1000000"})
+    # the wedge is this incarnation's disease, not the spec's: the
+    # remediation restart must come back clean
+    reps[1].extra_env.pop("FLAGS_fault_plan", None)
+    wedged_pid = reps[1].pid
+
+    ledger = JobLedger(os.path.join(workdir, "heal_wedge_state.json"))
+    hist = history_mod.TimeSeriesStore(interval_s=0.05)
+    hist.add_source("fleet", _heal_goodput_source(router))
+    hist.start()
+    rem = RemediationEngine(
+        router,
+        playbooks=[Playbook("slo-*burn*", "restart_replica",
+                            target="worst_slo", severity="page")],
+        ledger=ledger, cooldown_s=30.0, global_window_s=120.0,
+        global_max_actions=1, blast_radius=1.0, flap_n=10,
+        bake_timeout_s=90.0, lease_wait_s=30.0)
+    engine = alerts_mod.AlertEngine(
+        hist, alerts_mod.default_rules(objective=0.99, time_scale=ts),
+        interval_s=0.1, notifier=rem.notify)
+    engine.start()
+    gateway = Gateway(router, history=hist, alerts=engine,
+                      remediation=rem).start()
+    try:
+        # live traffic, part of it pinned to the wedged replica so its
+        # SLO window fills with violations
+        ps = prompts(4) + [_affinity_prompt(router, rng, args.prompt_len,
+                                            args.vocab, "p1")
+                           for _ in range(2)]
+        clients = [_SSEClient(gateway, p, sp) for p in ps]
+
+        acted = _alerts_wait(lambda: rem.stats()["actions"] >= 1, 240.0,
+                             poll_s=0.2)
+        for c in clients:
+            c.join(600)
+        if acted is None:
+            return {"scenario": "wedged_replica_heal", "survived": False,
+                    "failed": "remediation never acted on the burn page",
+                    "remediation": rem.stats()}
+
+        # the restart: a NEW healthy p1 process, fault plan gone
+        healed = _alerts_wait(
+            lambda: reps[1].state.value == "healthy"
+            and reps[1].pid != wedged_pid, 180.0, poll_s=0.2)
+
+        # recovery traffic until the alert resolves and the bake closes
+        baked = None
+        for _ in range(40):
+            for c2 in [_SSEClient(gateway, p, sp) for p in prompts(2)]:
+                c2.join(600)
+                clients.append(c2)
+            rem.check_bakes()
+            st = rem.stats()
+            if st["bakes_ok"] >= 1:
+                baked = st
+                break
+            if st["escalations"] >= 1:
+                break
+            time.sleep(0.5)
+
+        lost = [i for i, c in enumerate(clients)
+                if c.status != 200 or c.finish is None or c.error]
+        st = rem.stats()
+        gw_stats = json.loads(_http_get(gateway, "/stats"))
+        acts = [e for e in rem.audit_tail(64) if e["kind"] == "acted"]
+        ok = (baked is not None and healed is not None and not lost
+              and st["escalations"] == 0 and st["quarantines"] == 0
+              and acts and acts[0]["target"] == "p1"
+              and gw_stats.get("remediation") is not None)
+        return {
+            "scenario": "wedged_replica_heal",
+            "survived": bool(ok),
+            "paged_and_acted_s": round(acted, 2),
+            "healed": healed is not None,
+            "bake_ok": baked is not None,
+            "actions": st["actions"],
+            "suppressed": st["suppressed"],
+            "lost_requests": len(lost),
+            "acted_target": acts[0]["target"] if acts else None,
+            "ledger_events": sorted({e["event"] for e in
+                                     ledger.read().get("events", [])}),
+        }
+    finally:
+        engine.stop()
+        hist.stop()
+        gateway.stop()
+        router.close()
+
+
+def _scenario_flap_quarantine(args, workdir, spec, max_len):
+    """A replica that is sick EVERY incarnation re-triggers its playbook
+    after each restart: flap detection must quarantine it (page + ledger)
+    instead of a restart storm, with the rest of the fleet serving on."""
+    from paddle_tpu.resilience import JobLedger
+    from paddle_tpu.serving import Gateway
+    from paddle_tpu.serving.remediation import Playbook, RemediationEngine
+
+    sp = SamplingParams(max_new_tokens=args.max_new, temperature=0.0)
+    rng = np.random.RandomState(12)
+    # the fault plan STAYS in extra_env: every restarted incarnation of
+    # p1 comes back just as sick (slow, not dead)
+    router, reps = _heal_fleet(
+        workdir, spec, 2, scenario="heal-flap",
+        plans={1: "serving.decode:delay=0.4x1000000"})
+    ledger = JobLedger(os.path.join(workdir, "heal_flap_state.json"))
+    rem = RemediationEngine(
+        router,
+        playbooks=[Playbook("wedge-*", "restart_replica",
+                            target="alert_key", cooldown_s=0.0,
+                            bake_s=0.0)],
+        ledger=ledger, global_window_s=30.0, global_max_actions=10,
+        blast_radius=1.0, flap_n=3, flap_window_s=600.0,
+        lease_wait_s=30.0)
+    gateway = Gateway(router, remediation=rem).start()
+
+    def fire():
+        rem.notify({"event": "firing",
+                    "alert": {"rule": "wedge-tpot", "key": "p1",
+                              "severity": "page", "state": "firing"}})
+
+    def resolve():
+        rem.notify({"event": "resolved",
+                    "alert": {"rule": "wedge-tpot", "key": "p1",
+                              "severity": "page", "state": "resolved"}})
+
+    try:
+        restarts = 0
+        for round_ in range(3):
+            pid = reps[1].pid
+            fire()                  # synchronous: acts (or quarantines)
+            if rem.stats()["quarantined"]:
+                break
+            if _alerts_wait(lambda: reps[1].pid != pid
+                            and reps[1].state.value == "healthy",
+                            180.0, poll_s=0.2) is None:
+                return {"scenario": "flap_quarantine", "survived": False,
+                        "failed": f"restart {round_} never came healthy"}
+            restarts += 1
+            resolve()
+        # a further page against the quarantined target stays suppressed
+        pid = reps[1].pid
+        fire()
+        suppressed = [e for e in rem.audit_tail(8)
+                      if e["kind"] == "suppressed"]
+        # the sick-but-quarantined fleet still serves: p0 fast, p1 slow
+        clients = [_SSEClient(gateway,
+                              [int(t) for t in rng.randint(
+                                  0, args.vocab, args.prompt_len)], sp)
+                   for _ in range(4)]
+        for c in clients:
+            c.join(600)
+        lost = [i for i, c in enumerate(clients)
+                if c.status != 200 or c.finish is None or c.error]
+        gw_rem = (json.loads(_http_get(gateway, "/stats"))
+                  .get("remediation") or {})
+        led = {e["event"] for e in ledger.read().get("events", [])}
+        st = rem.stats()
+        ok = (restarts == 2 and st["quarantined"] == ["p1"]
+              and reps[1].pid == pid          # no 3rd/4th restart
+              and st["actions"] == 2 and st["quarantines"] == 1
+              and suppressed
+              and suppressed[-1]["reason"] == "quarantined"
+              and gw_rem.get("quarantined") == ["p1"]
+              and "remediation_quarantine" in led and not lost)
+        return {
+            "scenario": "flap_quarantine",
+            "survived": bool(ok),
+            "restarts_before_quarantine": restarts,
+            "quarantined": st["quarantined"],
+            "suppressed_reason": (suppressed[-1]["reason"]
+                                  if suppressed else None),
+            "actions": st["actions"],
+            "lost_requests": len(lost),
+            "ledger_has_quarantine": "remediation_quarantine" in led,
+        }
+    finally:
+        gateway.stop()
+        router.close()
+
+
+def _scenario_canary_rollback(args, workdir, spec, max_len):
+    """Rolling upgrade onto a deliberately slow spec under live SSE
+    traffic, driven through the gateway admin API: the canary regresses
+    against the pre-rollout baseline, the rollout auto-rolls back
+    mid-traffic, and every stream survives with token parity. The
+    fleet_ctl CLI then reads the whole aftermath."""
+    import subprocess
+
+    from paddle_tpu.resilience import JobLedger
+    from paddle_tpu.serving import Gateway
+    from paddle_tpu.serving.rollout import RollingUpgrade
+
+    # a lenient TPOT SLO (never violated — nothing sheds) whose window
+    # still yields the tpot p95 baseline the canary is judged against;
+    # the 12s window lets boot-warmup compile samples age out before the
+    # baseline is captured
+    spec = dict(spec, engine=dict(spec["engine"], slo_tpot_s=10.0,
+                                  slo_window_s=12.0))
+    sp = SamplingParams(max_new_tokens=args.max_new, temperature=0.0)
+    rng = np.random.RandomState(13)
+    ledger = JobLedger(os.path.join(workdir, "heal_rollout_state.json"))
+    router, reps = _heal_fleet(workdir, spec, 2, scenario="heal-canary")
+
+    def factory(new_spec, env, **kw):
+        kw.setdefault("canary_bake_s", 90.0)
+        return RollingUpgrade(router, new_spec, env=env, ledger=ledger,
+                              healthy_wait_s=240.0, **kw)
+
+    gateway = Gateway(router, rollout_factory=factory).start()
+    try:
+        # craft the full prompt schedule up front so one reference run
+        # yields the parity oracle; p2c load-based placement would route
+        # AROUND a slow canary, so half the rollout-phase prompts are
+        # pinned to p0 (the first replica the plan upgrades) and the warm
+        # phase pins one to each replica so both get an SLO baseline
+        warm = [_affinity_prompt(router, rng, args.prompt_len, args.vocab,
+                                 f"p{i % 2}") for i in range(4)]
+        wave = [(_affinity_prompt(router, rng, args.prompt_len, args.vocab,
+                                  "p0") if i % 2 == 0
+                 else [int(t) for t in rng.randint(0, args.vocab,
+                                                   args.prompt_len)])
+                for i in range(10)]
+        all_prompts = warm + wave
+        refs = _fleet_reference(spec, all_prompts, [sp] * len(all_prompts))
+
+        clients = []                       # (prompt index, client)
+        for i, p in enumerate(warm):
+            clients.append((i, _SSEClient(gateway, p, sp)))
+        for _, c in clients:
+            c.join(600)
+
+        # the first pass through each replica pays XLA compile for the
+        # serving shapes, and those multi-second inter-token gaps sit in
+        # the sliding SLO window as tpot p95 — a baseline captured then
+        # is so inflated the slow canary could never regress 2x past
+        # it. Trickle the warm prompts until every replica's window
+        # holds only steady-state samples (clean tpot p95 is ~5ms here;
+        # 0.2s leaves the 0.6s/step canary far beyond 2x any baseline
+        # that passes this gate)
+        def clean_baseline():
+            st = router.stats()["replicas"]
+            ps = [((r.get("slo") or {}).get("tpot") or {}).get("p95")
+                  for r in st.values()]
+            return all(p is not None and p < 0.2 for p in ps)
+
+        t_end = time.monotonic() + 90
+        while not clean_baseline() and time.monotonic() < t_end:
+            rnd = [(i, _SSEClient(gateway, warm[i], sp)) for i in (0, 1)]
+            clients.extend(rnd)
+            for _, c in rnd:
+                c.join(600)
+            time.sleep(1.0)
+        if not clean_baseline():
+            return {"scenario": "canary_rollback", "survived": False,
+                    "failed": "no clean SLO baseline after warm traffic"}
+
+        # -- the upgrade: the new spec ships a 0.6s/step decode delay --
+        status, raw = _http_post(gateway, "/v1/admin/rollout", {
+            "spec": spec,
+            "env": {"FLAGS_fault_plan": "serving.decode:delay=0.6x1000000"},
+            "canary_bake_s": 90.0, "drain_budget_s": 8.0,
+            "regression_ratio": 2.0})
+        if status != 202:
+            return {"scenario": "canary_rollback", "survived": False,
+                    "failed": f"rollout POST -> {status}: {raw[:200]}"}
+
+        # -- live traffic while the rollout drains / bakes / rolls back --
+        # the canary verdict needs >= min_samples COMPLETED requests
+        # inside the canary's sliding SLO window at once; a lone pinned
+        # stream every few seconds never gets there (the window drains
+        # between completions and the bake passes vacuously). Bursts of
+        # 3 concurrent pinned streams — exactly the engine's max_slots,
+        # and within the +2 affinity load slack so p2c does not route
+        # around the slow canary — finish batched together and land 3
+        # samples in the window in one shot; recycle the pinned prompts
+        # until the rollout reaches a terminal state
+        pinned_idx = [j for j in range(len(wave)) if j % 2 == 0]
+        doc, burst_n = None, 0
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            doc = json.loads(_http_get(gateway, "/v1/admin/rollout"))
+            if doc.get("state") in ("done", "rolled_back", "failed"):
+                break
+            batch = []
+            for m in range(3):
+                j = pinned_idx[(burst_n * 3 + m) % len(pinned_idx)]
+                batch.append((len(warm) + j,
+                              _SSEClient(gateway, wave[j], sp)))
+            burst_n += 1
+            clients.extend(batch)
+            for _, c in batch:
+                c.join(600)
+        # every wave prompt runs after the terminal state: post-rollback
+        # service plus full parity coverage (repeats are fine — greedy
+        # decode is deterministic, so the oracle is per prompt index)
+        for j in range(len(wave)):
+            clients.append((len(warm) + j,
+                            _SSEClient(gateway, wave[j], sp)))
+        for _, c in clients:
+            c.join(600)
+
+        rolled_back = (doc or {}).get("state") == "rolled_back"
+        reason = str((doc or {}).get("reason") or "")
+        healthy = _alerts_wait(
+            lambda: all(r.state.value == "healthy" for r in reps),
+            120.0, poll_s=0.2) is not None
+        clean_env = all("FLAGS_fault_plan" not in r.extra_env
+                        for r in reps)
+        lost = [i for i, c in clients
+                if c.status != 200 or c.finish is None or c.error]
+        parity = [i for i, c in clients if c.tokens != refs[i]]
+        led = {e["event"] for e in ledger.read().get("events", [])}
+        ledger_ok = {"rollout_started", "rollout_replica_done",
+                     "rollout_rollback", "rollout_rolled_back"} <= led
+
+        # the operator CLI reads the whole story end to end
+        ctl = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO_ROOT, "tools", "fleet_ctl.py"), "status",
+             "--gateway", f"http://{gateway.host}:{gateway.port}",
+             "--ledger", ledger.path],
+            capture_output=True, text=True, timeout=60, cwd=REPO_ROOT)
+        ctl_ok = (ctl.returncode == 0
+                  and "tool_parse_errors: 0" in ctl.stdout
+                  and "rolled_back" in ctl.stdout)
+
+        ok = (rolled_back and "canary" in reason and healthy
+              and clean_env and not lost and not parity and ledger_ok
+              and ctl_ok)
+        return {
+            "scenario": "canary_rollback",
+            "survived": bool(ok),
+            "state": (doc or {}).get("state"),
+            "reason": reason,
+            "fleet_healthy": healthy,
+            "env_restored": clean_env,
+            "lost_requests": len(lost),
+            "parity_failures": len(parity),
+            "ledger_ok": ledger_ok,
+            "fleet_ctl_ok": ctl_ok,
+        }
+    finally:
+        gateway.stop()
+        router.close()
+
+
+def run_heal_suite(args, workdir=None, scenario=None):
+    import tempfile
+
+    workdir = workdir or tempfile.mkdtemp(prefix="chaos-heal-")
+    max_len = args.prompt_len + args.max_new
+    spec = _fleet_spec(args, workdir, max_len)
+    rows = []
+    fns = _filter_scenarios(
+        (_scenario_wedged_replica_heal, _scenario_flap_quarantine,
+         _scenario_canary_rollback), "_scenario_", scenario)
+    for fn in fns:
+        try:
+            rows.append(fn(args, workdir, spec, max_len))
+        except Exception as e:  # lint: allow-silent(the crash is the row: survived=False fails the battery)
+            rows.append({"scenario": fn.__name__[len("_scenario_"):],
+                         "survived": False,
+                         "crashed": f"{type(e).__name__}: {e}"})
+    survived = sum(1 for r in rows if r["survived"])
+    dump_path = telemetry.dump(reason="heal chaos suite complete")
+    return {
+        "suite": "heal",
+        "workdir": workdir,
+        "plans_run": len(rows),
+        "plans_survived": survived,
+        "all_survived": survived == len(rows),
+        "flight_recorder_dump": dump_path,
+        "results": rows,
+    }
+
+
 SUITE_SCENARIOS = {
     "serving": lambda: [n for n, _ in DEFAULT_PLANS],
     "prefix": lambda: [n for n, _ in PREFIX_PLANS],
@@ -3264,6 +3745,8 @@ SUITE_SCENARIOS = {
     "soak": lambda: ["degrade", "rolling"],
     "alerts": lambda: ["slo_burn_page", "publisher_absence",
                        "overhead_gate"],
+    "heal": lambda: ["wedged_replica_heal", "flap_quarantine",
+                     "canary_rollback"],
 }
 
 
@@ -3292,7 +3775,7 @@ def run_sweep(argv=None):
                     choices=["serving", "prefix", "spill", "train",
                              "straggler", "perf", "serve-fleet", "durable",
                              "kvfabric", "tenancy", "locksan", "soak",
-                             "alerts"],
+                             "alerts", "heal"],
                     default="serving")
     ap.add_argument("--list", action="store_true",
                     help="print every suite's scenario names and exit")
@@ -3340,7 +3823,7 @@ def run_sweep(argv=None):
 
     if args.suite in ("train", "straggler", "prefix", "spill", "perf",
                       "serve-fleet", "durable", "kvfabric", "tenancy",
-                      "locksan", "soak", "alerts"):
+                      "locksan", "soak", "alerts", "heal"):
         report = (run_train_suite(scenario=args.scenario)
                   if args.suite == "train"
                   else run_straggler_suite(scenario=args.scenario)
@@ -3361,6 +3844,8 @@ def run_sweep(argv=None):
                   if args.suite == "soak"
                   else run_alerts_suite(args, scenario=args.scenario)
                   if args.suite == "alerts"
+                  else run_heal_suite(args, scenario=args.scenario)
+                  if args.suite == "heal"
                   else run_spill_suite(args, scenario=args.scenario)
                   if args.suite == "spill"
                   else run_prefix_suite(args, scenario=args.scenario))
@@ -3425,7 +3910,7 @@ def main(argv=None):
         if report.get("suite") in ("train", "straggler", "perf",
                                    "serve-fleet", "durable", "spill",
                                    "kvfabric", "tenancy", "locksan",
-                                   "soak", "alerts"):
+                                   "soak", "alerts", "heal"):
             detail = " ".join(f"{k}={v}" for k, v in r.items()
                               if k not in ("scenario", "survived"))
             print(f"[{status}] {r['scenario']:<26} {detail}",

@@ -1,0 +1,260 @@
+"""Operator CLI for the self-healing control plane.
+
+Talks to a running gateway's ``/stats`` + ``/v1/admin/*`` endpoints
+(serving/remediation.py + serving/rollout.py) and reads the supervisor's
+``job_state.json`` ledger directly, so the rollout/remediation story is
+inspectable even while the gateway is mid-chaos:
+
+    python tools/fleet_ctl.py status   --gateway http://127.0.0.1:8000
+        [--ledger job_state.json] [--audit 16] [--json]
+    python tools/fleet_ctl.py rollout  --gateway URL --spec spec.json
+        [--env env.json] [--canary-bake-s 10] [--dry-run]
+    python tools/fleet_ctl.py rollback --gateway URL [--reason text]
+    python tools/fleet_ctl.py remediate --gateway URL --dry-run
+        [--alert alert.json]
+
+``status`` prints: fleet health + actuation lease attribution, the
+active rollout state machine, the remediation engine's quarantine /
+pending-bake / escalation sets, and the tail of the audit trail (both
+the engine's ring and the ledger's ``remediation_*``/``rollout_*``
+events). Unparseable documents are *counted, never mistaken for
+absence*: the tool prints a ``tool_parse_errors`` line like the other
+operator CLIs.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, ".")
+
+
+def _fetch(url: str, payload: dict | None = None, timeout: float = 10.0):
+    """GET (payload None) or POST json; returns (doc, error_string)."""
+    try:
+        data = None if payload is None else json.dumps(payload).encode()
+        req = urllib.request.Request(
+            url, data=data, method="GET" if data is None else "POST",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            raw = resp.read()
+    except urllib.error.HTTPError as e:
+        raw = e.read()
+        try:
+            return json.loads(raw.decode() or "{}"), \
+                f"{url}: HTTP {e.code}"
+        except (ValueError, UnicodeDecodeError):
+            return None, f"{url}: HTTP {e.code} (unparseable body)"
+    except (urllib.error.URLError, OSError, TimeoutError) as e:
+        return None, f"{url}: {e}"
+    try:
+        return json.loads(raw.decode() or "{}"), None
+    except (ValueError, UnicodeDecodeError) as e:
+        return None, f"{url}: unparseable response ({e})"
+
+
+def _read_ledger(path: str | None):
+    """(events, error) — the rollout/remediation slice of the ledger."""
+    if not path:
+        return [], None
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except FileNotFoundError:
+        return [], None
+    except (ValueError, OSError) as e:
+        return [], f"{path}: unparseable ledger ({e})"
+    evs = doc.get("events")
+    if not isinstance(evs, list):
+        return [], f"{path}: ledger has no events list"
+    return [e for e in evs if isinstance(e, dict) and
+            str(e.get("event", "")).startswith(
+                ("rollout_", "remediation_", "replica_"))], None
+
+
+def _load_json_arg(path: str | None, what: str, errors: list) -> dict:
+    if not path:
+        return {}
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        errors.append(f"{what} {path}: {e}")
+        return {}
+    if not isinstance(doc, dict):
+        errors.append(f"{what} {path}: not a JSON object")
+        return {}
+    return doc
+
+
+def _print_parse_errors(errors: list):
+    if errors:
+        print(f"tool_parse_errors: {len(errors)} ({'; '.join(errors)})")
+    else:
+        print("tool_parse_errors: 0")
+
+
+def cmd_status(args) -> int:
+    errors = []
+    stats, err = _fetch(args.gateway.rstrip("/") + "/stats")
+    if err:
+        errors.append(err)
+    ledger_events, lerr = _read_ledger(args.ledger)
+    if lerr:
+        errors.append(lerr)
+    if args.json:
+        print(json.dumps({"stats": stats,
+                          "ledger_tail": ledger_events[-args.audit:]},
+                         indent=1, default=str))
+        _print_parse_errors(errors)
+        return 0 if stats is not None else 1
+    if stats is None:
+        print("gateway unreachable")
+        _print_parse_errors(errors)
+        return 1
+
+    print(f"# fleet  (proto v{stats.get('proto_version')})")
+    for rid, rep in sorted((stats.get("replicas") or {}).items()):
+        print(f"  {rid:12s} {rep.get('state', '?'):10s} "
+              f"proto={rep.get('proto_version')} "
+              f"inflight={rep.get('inflight', 0)}")
+    act = stats.get("actuation") or {}
+    cur = act.get("owner")
+    print(f"# actuation lease: "
+          f"{'idle' if not cur else cur.get('owner', '?') + ':' + str(cur.get('action'))}")
+    for ent in (act.get("recent") or [])[-args.audit:]:
+        print(f"  [{ent.get('seq')}] {ent.get('owner')}:"
+              f"{ent.get('action')} target={ent.get('target')} "
+              f"held={ent.get('held_s')}s")
+
+    ro = stats.get("rollout")
+    print(f"# rollout: "
+          f"{'none' if not ro else ro.get('state')}")
+    if ro:
+        print(f"  id={ro.get('rollout_id')} "
+              f"upgraded={ro.get('upgraded')} "
+              f"canary_passed={ro.get('canary_passed')} "
+              f"reason={ro.get('reason')}")
+
+    rem = stats.get("remediation")
+    print(f"# remediation: {'not wired' if not rem else ''}")
+    if rem:
+        print(f"  dry_run={rem.get('dry_run')} "
+              f"actions={rem.get('actions')} "
+              f"suppressed={rem.get('suppressed')} "
+              f"escalations={rem.get('escalations')}")
+        if rem.get("quarantined"):
+            print(f"  quarantined: {', '.join(rem['quarantined'])}")
+        for b in rem.get("pending_bakes") or []:
+            print(f"  baking: [{b.get('seq')}] {b.get('action')} "
+                  f"{b.get('target')} <- {b.get('rule')}")
+        for e in rem.get("escalated") or []:
+            print(f"  ESCALATED: {e.get('rule')}/{e.get('key')} "
+                  f"(seq {e.get('seq')}) — human needed")
+        for ent in (rem.get("audit_tail") or [])[-args.audit:]:
+            print(f"  audit t={ent.get('t')} {ent.get('kind')} "
+                  f"{ent.get('action', '')} {ent.get('target', '')} "
+                  f"{ent.get('reason', '')}".rstrip())
+    if ledger_events:
+        print(f"# ledger tail ({args.ledger})")
+        for ev in ledger_events[-args.audit:]:
+            extra = {k: v for k, v in ev.items()
+                     if k not in ("event", "t") and
+                     isinstance(v, (str, int, float, bool))}
+            print(f"  {ev.get('event'):24s} {extra}")
+    _print_parse_errors(errors)
+    return 0
+
+
+def cmd_rollout(args) -> int:
+    errors = []
+    spec = _load_json_arg(args.spec, "--spec", errors)
+    env = _load_json_arg(args.env, "--env", errors)
+    if not spec and args.spec:
+        _print_parse_errors(errors)
+        return 1
+    body = {"spec": spec, "env": env, "dry_run": bool(args.dry_run)}
+    if args.canary_bake_s is not None:
+        body["canary_bake_s"] = float(args.canary_bake_s)
+    doc, err = _fetch(args.gateway.rstrip("/") + "/v1/admin/rollout", body)
+    if err:
+        errors.append(err)
+    print(json.dumps(doc, indent=1, default=str) if doc is not None
+          else "rollout request failed")
+    _print_parse_errors(errors)
+    return 0 if doc is not None and not doc.get("error") else 1
+
+
+def cmd_rollback(args) -> int:
+    errors = []
+    doc, err = _fetch(args.gateway.rstrip("/") + "/v1/admin/rollback",
+                      {"reason": args.reason})
+    if err:
+        errors.append(err)
+    print(json.dumps(doc, indent=1, default=str) if doc is not None
+          else "rollback request failed")
+    _print_parse_errors(errors)
+    return 0 if doc is not None and not doc.get("error") else 1
+
+
+def cmd_remediate(args) -> int:
+    errors = []
+    body: dict = {"dry_run": bool(args.dry_run)}
+    alert = _load_json_arg(args.alert, "--alert", errors)
+    if alert:
+        body["alert"] = alert
+    doc, err = _fetch(args.gateway.rstrip("/") + "/v1/admin/remediate",
+                      body)
+    if err:
+        errors.append(err)
+    print(json.dumps(doc, indent=1, default=str) if doc is not None
+          else "remediate request failed")
+    _print_parse_errors(errors)
+    return 0 if doc is not None and not doc.get("error") else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="fleet self-healing / rollout control CLI")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    st = sub.add_parser("status", help="rollout + remediation state")
+    st.add_argument("--gateway", required=True)
+    st.add_argument("--ledger", default=None,
+                    help="job_state.json path for the audit tail")
+    st.add_argument("--audit", type=int, default=16)
+    st.add_argument("--json", action="store_true")
+    st.set_defaults(fn=cmd_status)
+
+    ro = sub.add_parser("rollout", help="start a rolling upgrade")
+    ro.add_argument("--gateway", required=True)
+    ro.add_argument("--spec", required=True,
+                    help="JSON file: the new replica spec")
+    ro.add_argument("--env", default=None,
+                    help="JSON file: extra env for upgraded replicas")
+    ro.add_argument("--canary-bake-s", type=float, default=None)
+    ro.add_argument("--dry-run", action="store_true")
+    ro.set_defaults(fn=cmd_rollout)
+
+    rb = sub.add_parser("rollback", help="roll the active rollout back")
+    rb.add_argument("--gateway", required=True)
+    rb.add_argument("--reason", default="operator")
+    rb.set_defaults(fn=cmd_rollback)
+
+    rm = sub.add_parser("remediate",
+                        help="poke / configure the remediation engine")
+    rm.add_argument("--gateway", required=True)
+    rm.add_argument("--dry-run", action="store_true")
+    rm.add_argument("--alert", default=None,
+                    help="JSON file: synthetic alert doc to consider")
+    rm.set_defaults(fn=cmd_remediate)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
